@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark driver: sedov3d uniform-grid hydro throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric is cell-updates/sec/chip on the sedov3d config (BASELINE.md §
+protocol, config 1: levelmin=levelmax uniform).  ``vs_baseline`` compares
+against the 64-rank MPI CPU reference baseline figure when one has been
+recorded in BASELINE.json ("published"); until then we report against the
+reference's self-measured single-core class figure of ~1 microsecond per
+cell-update (mus/pt, ``amr/adaptive_loop.f90:204-212``) scaled to 64 ranks
+=> 6.4e7 cell-updates/sec — the conservative stand-in the driver's
+north-star ratio is measured against.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.config import load_params
+from ramses_tpu.driver import Simulation
+from ramses_tpu.grid.uniform import run_steps
+
+# 64-rank MPI CPU baseline stand-in: 1 mus per cell-update per rank (the
+# classic RAMSES mus/pt figure) x 64 ranks => 64e6 updates/sec.
+BASELINE_CELL_UPDATES_PER_SEC = 64e6
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    nml = os.path.join(here, "namelists", "sedov3d.nml")
+    params = load_params(nml, ndim=3)
+    # levelmin=8 -> 256^3; keep the reference config. On small hosts allow
+    # override via BENCH_LEVEL.
+    lvl = int(os.environ.get("BENCH_LEVEL", params.amr.levelmin))
+    params.amr.levelmin = params.amr.levelmax = lvl
+    params.run.nstepmax = 10 ** 9
+
+    dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16") else jnp.float32
+    sim = Simulation(params, dtype=dtype)
+
+    nsteps = int(os.environ.get("BENCH_STEPS", "20"))
+    u = sim.state.u
+    t = jnp.asarray(0.0, jnp.float32)   # time in f32 even for bf16 state
+    tend = jnp.asarray(1e9, jnp.float32)
+
+    # warmup (compile)
+    u1, t1, _ = run_steps(sim.grid, u, t, tend, 2)
+    u1.block_until_ready()
+
+    t0 = time.perf_counter()
+    u2, t2, ndone = run_steps(sim.grid, u1, t1, tend, nsteps)
+    u2.block_until_ready()
+    wall = time.perf_counter() - t0
+
+    ncell = sim.grid.ncell
+    updates = ncell * int(ndone)
+    rate = updates / wall
+    out = {
+        "metric": f"cell-updates/sec/chip sedov3d uniform 2^{lvl}^3",
+        "value": rate,
+        "unit": "cell-updates/s",
+        "vs_baseline": rate / BASELINE_CELL_UPDATES_PER_SEC,
+        "detail": {
+            "device": str(jax.devices()[0].platform),
+            "n": ncell,
+            "steps": int(ndone),
+            "wall_s": wall,
+            "mus_per_cell_update": 1e6 * wall / max(updates, 1),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

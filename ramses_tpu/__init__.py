@@ -1,0 +1,18 @@
+"""ramses_tpu — a TPU-native astrophysics AMR framework.
+
+A ground-up JAX/XLA re-design of the capabilities of RAMSES
+(Fortran 90 + MPI reference surveyed in SURVEY.md): compressible
+(magneto-)hydrodynamics on adaptively refined meshes, self-gravity,
+particle-mesh N-body, radiative transfer, and the surrounding runtime
+(config, checkpointing, observability).
+
+Architecture (see README.md):
+  * host: octree topology, refinement decisions, I/O, orchestration
+  * device: dense per-level batch kernels under ``jax.jit`` — Godunov
+    sweeps, multigrid relaxation, CIC deposition — sharded over a
+    ``jax.sharding.Mesh`` with halo exchange via ``lax.ppermute``.
+"""
+
+__version__ = "0.1.0"
+
+from ramses_tpu.config import Params, load_params  # noqa: F401

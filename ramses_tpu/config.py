@@ -1,0 +1,320 @@
+"""Runtime configuration.
+
+Mirrors the reference's two-stage config system (SURVEY.md §5.6):
+compile-time constants become static fields of jitted programs here, and the
+runtime Fortran namelist (``amr/read_params.f90:51-70``,
+``hydro/read_hydro_params.f90:23-109``) is parsed by :mod:`ramses_tpu.nml`
+into the dataclasses below.  Defaults replicate the reference parameter
+modules (``amr/amr_parameters.f90``, ``hydro/hydro_parameters.f90``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ramses_tpu.nml import densify, load_nml, parse_nml
+
+MAXREGION = 100
+MAXBOUND = 100
+MAXLEVEL = 100
+MAXOUT = 1000
+HUGE = 1e30
+
+
+@dataclass
+class RunParams:
+    """&RUN_PARAMS (amr/amr_parameters.f90:58-103)."""
+    hydro: bool = False
+    poisson: bool = False
+    pic: bool = False
+    cosmo: bool = False
+    mhd: bool = False          # ours: solver selection is runtime, not VPATH
+    rt: bool = False
+    verbose: bool = False
+    static: bool = False
+    nrestart: int = 0
+    nstepmax: int = 1000000
+    ncontrol: int = 1
+    nremap: int = 0
+    nsubcycle: List[int] = field(default_factory=lambda: [2] * MAXLEVEL)
+    ordering: str = "hilbert"
+    cost_weighting: bool = True
+
+
+@dataclass
+class AmrParams:
+    """&AMR_PARAMS (amr/amr_parameters.f90:81-95)."""
+    levelmin: int = 1
+    levelmax: int = 1
+    ngridmax: int = 0
+    ngridtot: int = 0
+    npartmax: int = 0
+    nparttot: int = 0
+    nexpand: List[int] = field(default_factory=lambda: [1] * MAXLEVEL)
+    boxlen: float = 1.0
+    nx: int = 1
+    ny: int = 1
+    nz: int = 1
+
+
+@dataclass
+class OutputParams:
+    """&OUTPUT_PARAMS (amr/amr_parameters.f90:109-121)."""
+    noutput: int = 0
+    foutput: int = 1000000
+    tout: List[float] = field(default_factory=list)
+    aout: List[float] = field(default_factory=list)
+    delta_tout: float = HUGE
+    tend: float = 0.0
+    walltime_hrs: float = -1.0
+    minutes_dump: float = 1.0
+    output_dir: str = "."
+
+
+@dataclass
+class InitParams:
+    """&INIT_PARAMS regions (amr/amr_parameters.f90:301-311)."""
+    nregion: int = 0
+    region_type: List[str] = field(default_factory=list)
+    x_center: List[float] = field(default_factory=list)
+    y_center: List[float] = field(default_factory=list)
+    z_center: List[float] = field(default_factory=list)
+    length_x: List[float] = field(default_factory=list)
+    length_y: List[float] = field(default_factory=list)
+    length_z: List[float] = field(default_factory=list)
+    exp_region: List[float] = field(default_factory=list)
+    d_region: List[float] = field(default_factory=list)
+    u_region: List[float] = field(default_factory=list)
+    v_region: List[float] = field(default_factory=list)
+    w_region: List[float] = field(default_factory=list)
+    p_region: List[float] = field(default_factory=list)
+    filetype: str = "ascii"
+    initfile: List[str] = field(default_factory=list)
+    aexp_ini: float = 10.0
+    multiple: bool = False
+
+
+@dataclass
+class HydroParams:
+    """&HYDRO_PARAMS (hydro/hydro_parameters.f90:75-90)."""
+    gamma: float = 1.4
+    gamma_rad: List[float] = field(default_factory=list)
+    courant_factor: float = 0.5
+    smallr: float = 1e-10
+    smallc: float = 1e-10
+    niter_riemann: int = 10
+    slope_type: int = 1
+    slope_theta: float = 1.5
+    scheme: str = "muscl"
+    riemann: str = "llf"
+    riemann2d: str = "llf"     # MHD corner solver
+    difmag: float = 0.0
+    pressure_fix: bool = False
+    beta_fix: float = 0.0
+    eta_mag: float = 0.0
+
+
+@dataclass
+class RefineParams:
+    """&REFINE_PARAMS (hydro/hydro_parameters.f90:47-58 + amr flags)."""
+    err_grad_d: float = -1.0
+    err_grad_u: float = -1.0
+    err_grad_p: float = -1.0
+    err_grad_b: float = -1.0    # MHD (mhd/hydro_parameters variant)
+    floor_d: float = 1e-10
+    floor_u: float = 1e-10
+    floor_p: float = 1e-10
+    floor_b: float = 1e-10
+    interpol_var: int = 0
+    interpol_type: int = 1
+    jeans_refine: List[float] = field(default_factory=lambda: [-1.0] * MAXLEVEL)
+    m_refine: List[float] = field(default_factory=lambda: [-1.0] * MAXLEVEL)
+    mass_sph: float = 0.0
+    x_refine: List[float] = field(default_factory=lambda: [0.0] * MAXLEVEL)
+    y_refine: List[float] = field(default_factory=lambda: [0.0] * MAXLEVEL)
+    z_refine: List[float] = field(default_factory=lambda: [0.0] * MAXLEVEL)
+    r_refine: List[float] = field(default_factory=lambda: [-1.0] * MAXLEVEL)
+    a_refine: List[float] = field(default_factory=lambda: [1.0] * MAXLEVEL)
+    b_refine: List[float] = field(default_factory=lambda: [1.0] * MAXLEVEL)
+    exp_refine: List[float] = field(default_factory=lambda: [2.0] * MAXLEVEL)
+
+
+@dataclass
+class BoundaryParams:
+    """&BOUNDARY_PARAMS (amr/amr_parameters.f90:313-330).
+
+    boundary_type semantics follow the reference: per-region integer code,
+    1/2 = x-reflexive, 3/4 = y, 5/6 = z, 2x = outflow variants (20+ codes
+    collapse to: 0 periodic, 1 reflecting, 2 outflow, 3 inflow/imposed).
+    We keep the raw codes and region boxes.
+    """
+    nboundary: int = 0
+    bound_type: List[int] = field(default_factory=list)
+    ibound_min: List[int] = field(default_factory=list)
+    ibound_max: List[int] = field(default_factory=list)
+    jbound_min: List[int] = field(default_factory=list)
+    jbound_max: List[int] = field(default_factory=list)
+    kbound_min: List[int] = field(default_factory=list)
+    kbound_max: List[int] = field(default_factory=list)
+    d_bound: List[float] = field(default_factory=list)
+    u_bound: List[float] = field(default_factory=list)
+    v_bound: List[float] = field(default_factory=list)
+    w_bound: List[float] = field(default_factory=list)
+    p_bound: List[float] = field(default_factory=list)
+    no_inflow: bool = False
+
+
+@dataclass
+class PoissonParams:
+    """&POISSON_PARAMS (amr/amr_parameters.f90 + poisson commons)."""
+    epsilon: float = 1e-4
+    gravity_type: int = 0
+    gravity_params: List[float] = field(default_factory=lambda: [0.0] * 10)
+    cg_levelmin: int = 999
+    cic_levelmax: int = 0
+
+
+@dataclass
+class UnitsParams:
+    """&UNITS_PARAMS (amr/units.f90)."""
+    units_density: float = 1.0
+    units_time: float = 1.0
+    units_length: float = 1.0
+
+
+@dataclass
+class Params:
+    """Full runtime configuration (one object per simulation)."""
+    ndim: int = 3               # compile-time in the reference (bin/Makefile:7)
+    nvar: int = 0               # 0 → ndim+2+nener+npassive
+    nener: int = 0
+    npassive: int = 0
+    run: RunParams = field(default_factory=RunParams)
+    amr: AmrParams = field(default_factory=AmrParams)
+    output: OutputParams = field(default_factory=OutputParams)
+    init: InitParams = field(default_factory=InitParams)
+    hydro: HydroParams = field(default_factory=HydroParams)
+    refine: RefineParams = field(default_factory=RefineParams)
+    boundary: BoundaryParams = field(default_factory=BoundaryParams)
+    poisson: PoissonParams = field(default_factory=PoissonParams)
+    units: UnitsParams = field(default_factory=UnitsParams)
+    raw: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.nvar == 0:
+            self.nvar = self.ndim + 2 + self.nener + self.npassive
+        else:
+            self.npassive = self.nvar - self.ndim - 2 - self.nener
+
+
+_GROUP_MAP = {
+    "run_params": "run",
+    "amr_params": "amr",
+    "output_params": "output",
+    "init_params": "init",
+    "hydro_params": "hydro",
+    "refine_params": "refine",
+    "boundary_params": "boundary",
+    "poisson_params": "poisson",
+    "units_params": "units",
+}
+
+# fields that are per-region/bound/level lists: (field, count_attr, default)
+_LIST_FIELDS = {
+    "init": dict(count="nregion",
+                 fields=dict(region_type="square", x_center=0.0, y_center=0.0,
+                             z_center=0.0, length_x=1e10, length_y=1e10,
+                             length_z=1e10, exp_region=2.0, d_region=0.0,
+                             u_region=0.0, v_region=0.0, w_region=0.0,
+                             p_region=0.0)),
+    "boundary": dict(count="nboundary",
+                     fields=dict(bound_type=0, ibound_min=0, ibound_max=0,
+                                 jbound_min=0, jbound_max=0, kbound_min=0,
+                                 kbound_max=0, d_bound=0.0, u_bound=0.0,
+                                 v_bound=0.0, w_bound=0.0, p_bound=0.0)),
+}
+
+
+def params_from_dict(groups: Dict[str, Dict[str, Any]],
+                     ndim: int = 3, **overrides: Any) -> Params:
+    """Build :class:`Params` from parsed namelist groups."""
+    p = Params(ndim=ndim, **overrides)
+    p.raw = groups
+    for gname, attr in _GROUP_MAP.items():
+        gdict = groups.get(gname)
+        if not gdict:
+            continue
+        sub = getattr(p, attr)
+        valid = {f.name: f for f in dataclasses.fields(sub)}
+        for key, value in gdict.items():
+            if key == "boundary_type":
+                key = "bound_type"  # nml name differs from our field name
+            if key not in valid:
+                continue  # unknown keys ignored (subsystem not yet built)
+            ftype = valid[key].type
+            cur = getattr(sub, key)
+            if isinstance(cur, list) or str(ftype).startswith("List"):
+                setattr(sub, key, value if isinstance(value, (list, dict))
+                        else [value])
+            else:
+                if isinstance(value, list):
+                    value = value[0]
+                setattr(sub, key, value)
+    # densify per-region / per-boundary lists
+    for attr, spec in _LIST_FIELDS.items():
+        sub = getattr(p, attr)
+        n = getattr(sub, spec["count"])
+        for fname, default in spec["fields"].items():
+            setattr(sub, fname, densify(getattr(sub, fname) or None, n, default))
+    # densify per-level lists
+    p.run.nsubcycle = [int(v) for v in
+                       densify(p.run.nsubcycle, MAXLEVEL, 2)]
+    p.amr.nexpand = [int(v) for v in densify(p.amr.nexpand, MAXLEVEL, 1)]
+    for f in ("jeans_refine", "m_refine", "x_refine", "y_refine", "z_refine",
+              "r_refine", "a_refine", "b_refine", "exp_refine"):
+        cur = getattr(p.refine, f)
+        dflt = {"a_refine": 1.0, "b_refine": 1.0, "exp_refine": 2.0,
+                "x_refine": 0.0, "y_refine": 0.0, "z_refine": 0.0}.get(f, -1.0)
+        setattr(p.refine, f, [float(v) for v in densify(cur, MAXLEVEL, dflt)])
+    # output times (tout/aout accept scalars, lists and indexed assignment)
+    for f in ("tout", "aout"):
+        cur = getattr(p.output, f)
+        if isinstance(cur, dict) or any(isinstance(v, dict) for v in cur
+                                        if isinstance(cur, list)):
+            if isinstance(cur, list):  # list wrapping a {idx: vals} dict
+                cur = cur[0]
+            n = max(p.output.noutput, max(cur) + max(len(v) for v in
+                                                     cur.values()) - 1)
+            setattr(p.output, f, [float(v) for v in densify(cur, n, HUGE)])
+        elif not isinstance(cur, list):
+            setattr(p.output, f, [cur])
+    if p.output.noutput == 0 and p.output.tout:
+        p.output.noutput = len(p.output.tout)
+    # tend/delta_tout style (e.g. the reference's dice namelists): synthesise
+    # the tout ladder the driver iterates over.
+    if p.output.tend > 0.0 and not p.output.tout:
+        dt = p.output.delta_tout
+        if dt >= HUGE or dt <= 0.0:
+            p.output.tout = [p.output.tend]
+        else:
+            ts, t = [], dt
+            while t < p.output.tend * (1.0 - 1e-12):
+                ts.append(t)
+                t += dt
+            ts.append(p.output.tend)
+            p.output.tout = ts
+        p.output.noutput = len(p.output.tout)
+    if p.amr.ngridmax == 0 and p.amr.ngridtot:
+        p.amr.ngridmax = p.amr.ngridtot
+    return p
+
+
+def load_params(path: str, ndim: int = 3, **overrides: Any) -> Params:
+    """Load a RAMSES-style namelist file into a :class:`Params`."""
+    return params_from_dict(load_nml(path), ndim=ndim, **overrides)
+
+
+def params_from_string(text: str, ndim: int = 3, **overrides: Any) -> Params:
+    return params_from_dict(parse_nml(text), ndim=ndim, **overrides)

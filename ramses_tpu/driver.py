@@ -1,0 +1,113 @@
+"""Simulation driver: config → initial state → time loop → outputs.
+
+The equivalent of ``program ramses → adaptive_loop`` (``amr/ramses.f90:13``,
+``amr/adaptive_loop.f90:79-230``) for the single-level path: host keeps
+wall-clock/output bookkeeping; device advances in fused multi-step chunks.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.config import Params, load_params
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.grid.uniform import UniformGrid, cfl_dt, run_steps, step, totals
+from ramses_tpu.hydro.core import HydroStatic
+from ramses_tpu.init.regions import condinit
+
+
+@dataclass
+class SimState:
+    u: jax.Array
+    t: float = 0.0
+    nstep: int = 0
+    dt: float = 0.0
+    iout: int = 1  # next output slot (1-based, like the reference)
+
+
+class Simulation:
+    """Single-level simulation (SURVEY.md §7 stage 2).
+
+    Resolution is ``2**levelmin`` per dimension scaled by nx/ny/nz coarse
+    cells, cell size ``boxlen / 2**levelmin`` in user units — matching the
+    reference's fully-refined base mesh.
+    """
+
+    def __init__(self, params: Params, dtype=jnp.float32):
+        self.params = params
+        for flag in ("pressure_fix", "difmag"):
+            if getattr(params.hydro, flag):
+                import warnings
+                warnings.warn(f"HYDRO_PARAMS {flag} requested but not yet "
+                              "implemented in this solver; running without.")
+        self.cfg = HydroStatic.from_params(params)
+        lmin = params.amr.levelmin
+        n = 2 ** lmin
+        base = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
+        shape = tuple(b * n for b in base)
+        self.dx = params.amr.boxlen / n
+        self.bc = bmod.BoundarySpec.from_params(params)
+        self.grid = UniformGrid(cfg=self.cfg, shape=shape, dx=self.dx,
+                                bc=self.bc)
+        u0 = condinit(shape, self.dx, params, self.cfg)
+        self.state = SimState(u=jnp.asarray(u0, dtype=dtype))
+        self.output_times = list(params.output.tout[:params.output.noutput])
+        self.on_output: Optional[Callable] = None
+        # perf accounting (mus/pt of adaptive_loop.f90:204-212)
+        self.cell_updates = 0
+        self.wall_s = 0.0
+
+    @property
+    def tend(self) -> float:
+        if self.output_times:
+            return self.output_times[-1]
+        return float("inf")
+
+    def evolve(self, chunk: int = 16, verbose: bool = False):
+        """Run to the final output time, firing outputs on the way."""
+        st = self.state
+        nstepmax = self.params.run.nstepmax
+        # Time is integrated in f64 (f32 if x64 is disabled) regardless of
+        # the state dtype: with a bf16 state, t += dt would stall once
+        # dt < eps(t) and the run would spin to nstepmax.
+        tdtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        for tout in self.output_times[st.iout - 1:]:
+            while st.t < tout * (1.0 - 1e-12) and st.nstep < nstepmax:
+                n = min(chunk, nstepmax - st.nstep)
+                t0 = time.perf_counter()
+                u, t, ndone = run_steps(self.grid, st.u,
+                                        jnp.asarray(st.t, tdtype),
+                                        jnp.asarray(tout, tdtype), n)
+                u.block_until_ready()
+                self.wall_s += time.perf_counter() - t0
+                ndone = int(ndone)
+                st.u, st.t, st.nstep = u, float(t), st.nstep + ndone
+                self.cell_updates += ndone * self.grid.ncell
+                if verbose:
+                    mus_pt = (1e6 * self.wall_s / max(self.cell_updates, 1))
+                    print(f"step {st.nstep:6d}  t={st.t:.6e} "
+                          f"mus/pt={mus_pt:.4f}")
+                if ndone == 0:
+                    break
+            if st.t < tout * (1.0 - 1e-12):
+                break  # budget exhausted before this output time: no dump
+            if self.on_output is not None:
+                self.on_output(self, st.iout)
+            st.iout += 1
+        return st
+
+    def mus_per_cell_update(self) -> float:
+        return 1e6 * self.wall_s / max(self.cell_updates, 1)
+
+
+def run_namelist(path: str, ndim: int = 3, dtype=jnp.float32,
+                 verbose: bool = False) -> Simulation:
+    sim = Simulation(load_params(path, ndim=ndim), dtype=dtype)
+    sim.evolve(verbose=verbose)
+    return sim

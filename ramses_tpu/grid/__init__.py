@@ -1,0 +1,1 @@
+from ramses_tpu.grid.uniform import UniformGrid  # noqa: F401

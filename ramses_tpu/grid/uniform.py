@@ -1,0 +1,88 @@
+"""Single-level (uniform Cartesian) hydro solver.
+
+The degenerate one-level octree of SURVEY.md §7 stage 2: the whole grid is
+one dense device array, a full step is one fused XLA program
+(pad → ctoprim → slopes → trace → riemann → update), and N steps run as a
+``lax.scan`` with zero host round-trips — the design replaces the
+per-nvector-batch sweep of ``godunov_fine`` (``hydro/godunov_fine.f90:5-35``)
+with whole-grid fusion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.hydro import muscl
+from ramses_tpu.hydro.core import HydroStatic
+from ramses_tpu.hydro.timestep import compute_dt
+
+
+@dataclass(frozen=True)
+class UniformGrid:
+    """Static description of a uniform-grid problem (hashable, jit-static)."""
+    cfg: HydroStatic
+    shape: Tuple[int, ...]
+    dx: float
+    bc: bmod.BoundarySpec
+
+    @property
+    def ncell(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+@partial(jax.jit, static_argnames=("grid",))
+def step(grid: UniformGrid, u, dt):
+    """One conservative MUSCL-Hancock step on the active grid."""
+    cfg = grid.cfg
+    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
+    flux, _tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
+    un = muscl.apply_fluxes(up, flux, cfg)
+    return bmod.unpad(un, cfg.ndim, muscl.NGHOST)
+
+
+@partial(jax.jit, static_argnames=("grid",))
+def cfl_dt(grid: UniformGrid, u):
+    return compute_dt(u, None, grid.dx, grid.cfg)
+
+
+@partial(jax.jit, static_argnames=("grid", "nsteps"))
+def run_steps(grid: UniformGrid, u, t, tend, nsteps: int):
+    """Advance up to ``nsteps`` steps entirely on device.
+
+    dt is recomputed each step (``courant_fine``), clipped to land exactly
+    on ``tend``; steps past ``tend`` are no-ops.  Returns (u, t, n_done).
+    """
+    def body(carry, _):
+        u, t, ndone = carry
+        dt = cfl_dt(grid, u)
+        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+        active = t < tend
+        un = step(grid, u, jnp.where(active, dt, 0.0))
+        u = jnp.where(active, un, u)
+        t = jnp.where(active, t + dt, t)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, t, ndone), None
+
+    (u, t, ndone), _ = jax.lax.scan(body, (u, t, jnp.array(0)), None,
+                                    length=nsteps)
+    return u, t, ndone
+
+
+def totals(u, cfg: HydroStatic, dx: float):
+    """Conservation audit (mass, momentum, energy) — ``check_cons``
+    (``hydro/courant_fine.f90:161``)."""
+    vol = dx ** cfg.ndim
+    return {
+        "mass": jnp.sum(u[0]) * vol,
+        "momentum": [jnp.sum(u[1 + d]) * vol for d in range(cfg.ndim)],
+        "energy": jnp.sum(u[cfg.ndim + 1]) * vol,
+    }

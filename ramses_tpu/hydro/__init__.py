@@ -1,0 +1,1 @@
+from ramses_tpu.hydro.core import HydroStatic  # noqa: F401

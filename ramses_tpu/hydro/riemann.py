@@ -1,0 +1,325 @@
+"""Approximate Riemann solvers (vectorized, jit-safe).
+
+Re-implements the reference's five solvers (``hydro/godunov_utils.f90``:
+``riemann_approx:268``, ``riemann_acoustic:500``, ``riemann_llf:660``,
+``riemann_hll:825``, ``riemann_hllc:988``) as pure elementwise JAX ops.
+Where the Fortran compresses lanes and branches per cell, we compute all
+branches and select with ``jnp.where`` — the XLA-native formulation.
+
+Interface component layout (axis 0), for both inputs and the flux:
+    0: rho | 1: normal velocity | 2: pressure | 3..1+ndim: tangential
+    velocities | then nener non-thermal pressures | then passive scalars.
+Flux output has one extra trailing component: the internal-energy flux
+(used by the dual-energy ``pressure_fix``, ``hydro/godunov_fine.f90`` tmp).
+Flux layout: 0 mass, 1 normal momentum, 2 total energy, 3.. tangential
+momenta / non-thermal energy fluxes / passive fluxes, [-1] internal energy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.hydro.core import HydroStatic
+
+
+def _prims(q, cfg: HydroStatic):
+    """Floor density/pressure exactly as the reference does."""
+    r = jnp.maximum(q[0], cfg.smallr)
+    u = q[1]
+    p = jnp.maximum(q[2], r * cfg.smallp)
+    return r, u, p
+
+
+def _etot(q, r, u, p, cfg: HydroStatic):
+    """Total energy density from interface-layout primitives."""
+    entho = 1.0 / (cfg.gamma - 1.0)
+    e = p * entho + 0.5 * r * u * u
+    for t in range(cfg.ndim - 1):
+        e = e + 0.5 * r * q[3 + t] ** 2
+    for n in range(cfg.nener):
+        e = e + q[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0)
+    return e
+
+def _ptot(q, p, cfg: HydroStatic):
+    for n in range(cfg.nener):
+        p = p + q[2 + cfg.ndim + n]
+    return p
+
+
+def _cspeed2(q, r, p, cfg: HydroStatic):
+    """gamma*P (+ sum gamma_rad*Prad) / rho — squared signal speed."""
+    c2 = cfg.gamma * p
+    for n in range(cfg.nener):
+        c2 = c2 + cfg.gamma_rad[n] * q[2 + cfg.ndim + n]
+    return jnp.maximum(c2 / r, cfg.smallc ** 2)
+
+
+def _cons_and_flux(q, cfg: HydroStatic):
+    """Conservative state + physical flux in interface layout (+eint slot).
+
+    Mirrors riemann_llf's uleft/fleft construction
+    (``hydro/godunov_utils.f90:718-810``).
+    """
+    entho = 1.0 / (cfg.gamma - 1.0)
+    r, u, p = _prims(q, cfg)
+    et = _etot(q, r, u, p, cfg)
+    ucons = [r, r * u, et]
+    for t in range(cfg.ndim - 1):
+        ucons.append(r * q[3 + t])
+    for n in range(cfg.nener):
+        ucons.append(q[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0))
+    for s in range(cfg.npassive):
+        ucons.append(r * q[2 + cfg.ndim + cfg.nener + s])
+    ucons.append(p * entho)  # internal energy slot
+    ucons = jnp.stack(ucons)
+
+    ptot = _ptot(q, p, cfg)
+    fl = [r * u, r * u * u + ptot, u * (et + ptot)]
+    for t in range(cfg.ndim - 1):
+        fl.append(u * r * q[3 + t])
+    for n in range(cfg.nener):
+        fl.append(u * q[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0))
+    for s in range(cfg.npassive):
+        fl.append(u * r * q[2 + cfg.ndim + cfg.nener + s])
+    fl.append(u * p * entho)
+    return ucons, jnp.stack(fl)
+
+
+def riemann_llf(ql, qr, cfg: HydroStatic):
+    """Local Lax-Friedrichs (``riemann_llf``, godunov_utils.f90:660)."""
+    rl, ul, pl = _prims(ql, cfg)
+    rr, ur, pr = _prims(qr, cfg)
+    cl = jnp.sqrt(_cspeed2(ql, rl, pl, cfg))
+    cr = jnp.sqrt(_cspeed2(qr, rr, pr, cfg))
+    cmax = jnp.maximum(jnp.abs(ul) + cl, jnp.abs(ur) + cr)
+    uleft, fleft = _cons_and_flux(ql, cfg)
+    uright, fright = _cons_and_flux(qr, cfg)
+    return 0.5 * (fleft + fright - cmax[None] * (uright - uleft))
+
+
+def riemann_hll(ql, qr, cfg: HydroStatic):
+    """HLL (``riemann_hll``, godunov_utils.f90:825)."""
+    rl, ul, pl = _prims(ql, cfg)
+    rr, ur, pr = _prims(qr, cfg)
+    cl = jnp.sqrt(_cspeed2(ql, rl, pl, cfg))
+    cr = jnp.sqrt(_cspeed2(qr, rr, pr, cfg))
+    sl = jnp.minimum(jnp.minimum(ul, ur) - jnp.maximum(cl, cr), 0.0)
+    sr = jnp.maximum(jnp.maximum(ul, ur) + jnp.maximum(cl, cr), 0.0)
+    uleft, fleft = _cons_and_flux(ql, cfg)
+    uright, fright = _cons_and_flux(qr, cfg)
+    return (sr * fleft - sl * fright + sr * sl * (uright - uleft)) / (sr - sl)
+
+
+def riemann_hllc(ql, qr, cfg: HydroStatic):
+    """HLLC with Toro sampling (``riemann_hllc``, godunov_utils.f90:988)."""
+    entho = 1.0 / (cfg.gamma - 1.0)
+    rl, ul, pl = _prims(ql, cfg)
+    rr, ur, pr = _prims(qr, cfg)
+    el = pl * entho
+    er = pr * entho
+    etotl = _etot(ql, rl, ul, pl, cfg)
+    etotr = _etot(qr, rr, ur, pr, cfg)
+    ptotl = _ptot(ql, pl, cfg)
+    ptotr = _ptot(qr, pr, cfg)
+    cfastl = jnp.sqrt(_cspeed2(ql, rl, pl, cfg))
+    cfastr = jnp.sqrt(_cspeed2(qr, rr, pr, cfg))
+
+    SL = jnp.minimum(ul, ur) - jnp.maximum(cfastl, cfastr)
+    SR = jnp.maximum(ul, ur) + jnp.maximum(cfastl, cfastr)
+    rcl = rl * (ul - SL)
+    rcr = rr * (SR - ur)
+    ustar = (rcr * ur + rcl * ul + (ptotl - ptotr)) / (rcr + rcl)
+    ptotstar = (rcr * ptotl + rcl * ptotr + rcl * rcr * (ul - ur)) / (rcr + rcl)
+
+    rstarl = rl * (SL - ul) / (SL - ustar)
+    etotstarl = ((SL - ul) * etotl - ptotl * ul + ptotstar * ustar) / (SL - ustar)
+    estarl = el * (SL - ul) / (SL - ustar)
+    rstarr = rr * (SR - ur) / (SR - ustar)
+    etotstarr = ((SR - ur) * etotr - ptotr * ur + ptotstar * ustar) / (SR - ustar)
+    estarr = er * (SR - ur) / (SR - ustar)
+
+    # sample at x/t = 0: SL>0 → L | ustar>0 → *L | SR>0 → *R | else R
+    def sel(a_l, a_sl, a_sr, a_r):
+        return jnp.where(SL > 0.0, a_l,
+               jnp.where(ustar > 0.0, a_sl,
+               jnp.where(SR > 0.0, a_sr, a_r)))
+
+    ro = sel(rl, rstarl, rstarr, rr)
+    uo = sel(ul, ustar, ustar, ur)
+    ptoto = sel(ptotl, ptotstar, ptotstar, ptotr)
+    etoto = sel(etotl, etotstarl, etotstarr, etotr)
+    eo = sel(el, estarl, estarr, er)
+
+    upwind_left = ustar > 0.0
+    flux = [ro * uo, ro * uo * uo + ptoto, (etoto + ptoto) * uo]
+    for t in range(cfg.ndim - 1):
+        flux.append(ro * uo * jnp.where(upwind_left, ql[3 + t], qr[3 + t]))
+    for n in range(cfg.nener):
+        eradl = ql[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0)
+        eradr = qr[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0)
+        erado = sel(eradl, eradl * (SL - ul) / (SL - ustar),
+                    eradr * (SR - ur) / (SR - ustar), eradr)
+        flux.append(uo * erado)
+    for s in range(cfg.npassive):
+        i = 2 + cfg.ndim + cfg.nener + s
+        flux.append(ro * uo * jnp.where(upwind_left, ql[i], qr[i]))
+    flux.append(uo * eo)
+    return jnp.stack(flux)
+
+
+def riemann_approx(ql, qr, cfg: HydroStatic):
+    """Two-shock iterative solver (``riemann_approx``, godunov_utils.f90:268).
+
+    Newton-Raphson on p* for ``niter_riemann`` fixed iterations (the
+    reference compresses converged lanes out; iterating them further is a
+    no-op to machine precision and is branch-free here).
+    """
+    entho = 1.0 / (cfg.gamma - 1.0)
+    gamma6 = (cfg.gamma + 1.0) / (2.0 * cfg.gamma)
+    rl, ul, pl = _prims(ql, cfg)
+    rr, ur, pr = _prims(qr, cfg)
+    cl = cfg.gamma * pl * rl  # Lagrangian sound speed^2
+    cr = cfg.gamma * pr * rr
+    wl = jnp.sqrt(cl)
+    wr = jnp.sqrt(cr)
+    pstar0 = jnp.maximum(
+        ((wr * pl + wl * pr) + wl * wr * (ul - ur)) / (wl + wr), 0.0)
+
+    def body(_, pold):
+        wwl = jnp.sqrt(cl * (1.0 + gamma6 * (pold - pl) / pl))
+        wwr = jnp.sqrt(cr * (1.0 + gamma6 * (pold - pr) / pr))
+        qL = 2.0 * wwl ** 3 / (wwl ** 2 + cl)
+        qR = 2.0 * wwr ** 3 / (wwr ** 2 + cr)
+        usl = ul - (pold - pl) / wwl
+        usr = ur + (pold - pr) / wwr
+        delp = jnp.maximum(qR * qL / (qR + qL) * (usl - usr), -pold)
+        return pold + delp
+
+    pstar = jax.lax.fori_loop(0, cfg.niter_riemann, body, pstar0)
+
+    wl = jnp.sqrt(cl * (1.0 + gamma6 * (pstar - pl) / pl))
+    wr = jnp.sqrt(cr * (1.0 + gamma6 * (pstar - pr) / pr))
+    ustar = 0.5 * (ul + (pl - pstar) / wl + ur - (pr - pstar) / wr)
+
+    left = ustar >= 0.0   # sgnm == +1
+    ro = jnp.where(left, rl, rr)
+    uo = jnp.where(left, ul, ur)
+    po = jnp.where(left, pl, pr)
+    wo = jnp.where(left, wl, wr)
+    sgnm = jnp.where(left, 1.0, -1.0)
+    co = jnp.maximum(cfg.smallc, jnp.sqrt(jnp.abs(cfg.gamma * po / ro)))
+
+    shock = pstar >= po
+    rstar = jnp.where(
+        shock,
+        ro / (1.0 + ro * (po - pstar) / wo ** 2),
+        ro * jnp.abs(pstar / po) ** (1.0 / cfg.gamma))
+    rstar = jnp.maximum(rstar, cfg.smallr)
+    cstar = jnp.maximum(jnp.sqrt(jnp.abs(cfg.gamma * pstar / rstar)), cfg.smallc)
+    spout = jnp.where(shock, wo / ro - sgnm * uo, co - sgnm * uo)
+    spin = jnp.where(shock, wo / ro - sgnm * uo, cstar - sgnm * ustar)
+    # rarefaction fan interpolation
+    frac = spout / (spout - spin + 1e-300)
+    ufan = frac * ustar + (1.0 - frac) * uo
+    pfan = frac * pstar + (1.0 - frac) * po
+
+    qg_u = jnp.where(spout <= 0.0, uo, jnp.where(spin >= 0.0, ustar, ufan))
+    qg_p = jnp.where(spout <= 0.0, po, jnp.where(spin >= 0.0, pstar, pfan))
+    qg_r = jnp.where(spout <= 0.0, ro,
+           jnp.where(spin >= 0.0, rstar,
+                     ro * jnp.abs(qg_p / po) ** (1.0 / cfg.gamma)))
+
+    fmass = qg_r * qg_u
+    fmom = qg_p + qg_r * qg_u ** 2
+    etot = qg_p * entho + 0.5 * qg_r * qg_u ** 2
+    passive_vals = []
+    for t in range(cfg.ndim - 1):
+        v = jnp.where(left, ql[3 + t], qr[3 + t])
+        etot = etot + 0.5 * qg_r * v ** 2
+        passive_vals.append(v)
+    fener = qg_u * (etot + qg_p)
+    flux = [fmass, fmom, fener]
+    for v in passive_vals:
+        flux.append(fmass * v)
+    for n in range(cfg.nener):
+        i = 2 + cfg.ndim + n
+        flux.append(fmass * jnp.where(left, ql[i], qr[i]))
+    for s in range(cfg.npassive):
+        i = 2 + cfg.ndim + cfg.nener + s
+        flux.append(fmass * jnp.where(left, ql[i], qr[i]))
+    flux.append(fmass * (qg_p / qg_r * entho))
+    return jnp.stack(flux)
+
+
+def riemann_acoustic(ql, qr, cfg: HydroStatic):
+    """Linearized (acoustic) solver (``riemann_acoustic``,
+    godunov_utils.f90:500): one-shot Lagrangian p*/u* then sampling."""
+    entho = 1.0 / (cfg.gamma - 1.0)
+    rl, ul, pl = _prims(ql, cfg)
+    rr, ur, pr = _prims(qr, cfg)
+    cl = jnp.sqrt(_cspeed2(ql, rl, pl, cfg))
+    cr = jnp.sqrt(_cspeed2(qr, rr, pr, cfg))
+    wl = cl * rl
+    wr = cr * rr
+    pstar = ((wr * pl + wl * pr) + wl * wr * (ul - ur)) / (wl + wr)
+    ustar = ((wr * ur + wl * ul) + (pl - pr)) / (wl + wr)
+
+    left = ustar > 0.0
+    ro = jnp.where(left, rl, rr)
+    uo = jnp.where(left, ul, ur)
+    po = jnp.where(left, pl, pr)
+    co = jnp.maximum(cfg.smallc, jnp.sqrt(jnp.abs(cfg.gamma * po / ro)))
+    sgnm = jnp.where(left, 1.0, -1.0)
+    rstar = jnp.maximum(ro + (pstar - po) / co ** 2, cfg.smallr)
+    cstar = jnp.maximum(cfg.smallc,
+                        jnp.sqrt(jnp.abs(cfg.gamma * pstar / rstar)))
+    spout = co - sgnm * uo
+    spin = cstar - sgnm * ustar
+    ushock = 0.5 * (spin + spout)
+    spout_ = jnp.where(pstar >= po, ushock, spout)
+    spin_ = jnp.where(pstar >= po, ushock, spin)
+    frac = jnp.clip(0.5 * (1.0 + (spout_ + spin_) /
+                           jnp.maximum(spout_ - spin_, cfg.smallc)), 0.0, 1.0)
+    qg_r = jnp.where(spout_ < 0.0, ro,
+           jnp.where(spin_ > 0.0, rstar, frac * rstar + (1.0 - frac) * ro))
+    qg_u = jnp.where(spout_ < 0.0, uo,
+           jnp.where(spin_ > 0.0, ustar, frac * ustar + (1.0 - frac) * uo))
+    qg_p = jnp.where(spout_ < 0.0, po,
+           jnp.where(spin_ > 0.0, pstar, frac * pstar + (1.0 - frac) * po))
+
+    fmass = qg_r * qg_u
+    etot = qg_p * entho + 0.5 * qg_r * qg_u ** 2
+    tang = []
+    for t in range(cfg.ndim - 1):
+        v = jnp.where(left, ql[3 + t], qr[3 + t])
+        etot = etot + 0.5 * qg_r * v ** 2
+        tang.append(v)
+    flux = [fmass, qg_p + qg_r * qg_u ** 2, qg_u * (etot + qg_p)]
+    for v in tang:
+        flux.append(fmass * v)
+    for n in range(cfg.nener):
+        i = 2 + cfg.ndim + n
+        flux.append(fmass * jnp.where(left, ql[i], qr[i]))
+    for s in range(cfg.npassive):
+        i = 2 + cfg.ndim + cfg.nener + s
+        flux.append(fmass * jnp.where(left, ql[i], qr[i]))
+    flux.append(fmass * (qg_p / qg_r * entho))
+    return jnp.stack(flux)
+
+
+SOLVERS = {
+    "llf": riemann_llf,
+    "hll": riemann_hll,
+    "hllc": riemann_hllc,
+    "exact": riemann_approx,
+    "acoustic": riemann_acoustic,
+}
+
+
+def solve(ql, qr, cfg: HydroStatic):
+    """Dispatch by name (``hydro/umuscl.f90:791-804``)."""
+    try:
+        return SOLVERS[cfg.riemann](ql, qr, cfg)
+    except KeyError:
+        raise ValueError(f"unknown Riemann solver {cfg.riemann!r}") from None

@@ -1,0 +1,49 @@
+"""CFL timestep (``cmpdt``, hydro/godunov_utils.f90:5-125).
+
+Computes the per-cell Courant-limited dt including the reference's
+gravity-strength correction factor, reduced with ``jnp.min`` (the
+MPI_ALLREDUCE(MIN) of ``hydro/courant_fine.f90:140`` becomes a mesh
+``pmin`` in the sharded path).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ramses_tpu.hydro.core import HydroStatic
+
+
+def compute_dt(u, grav, dx: float, cfg: HydroStatic):
+    """Max allowed dt over a (sub)grid of conservative states.
+
+    ``u``: [nvar, *sp]; ``grav``: list of ndim accel arrays or None;
+    ``dx``: cell size (scalar — cubic cells, as the reference assumes).
+    """
+    r = jnp.maximum(u[0], cfg.smallr)
+    inv_r = 1.0 / r
+    vels = [u[1 + d] * inv_r for d in range(cfg.ndim)]
+    eint = u[cfg.ndim + 1] - 0.5 * r * sum(v * v for v in vels)
+    for n in range(cfg.nener):
+        eint = eint - u[2 + cfg.ndim + n]
+    p = jnp.maximum((cfg.gamma - 1.0) * eint, r * cfg.smallp)
+    c2 = cfg.gamma * p
+    for n in range(cfg.nener):
+        c2 = c2 + cfg.gamma_rad[n] * (cfg.gamma_rad[n] - 1.0) * u[2 + cfg.ndim + n]
+    c = jnp.sqrt(c2 * inv_r)
+
+    # wave speed: ndim*c + sum |v| (godunov_utils.f90:88-97)
+    ws = float(cfg.ndim) * c
+    for v in vels:
+        ws = ws + jnp.abs(v)
+
+    # gravity strength ratio (godunov_utils.f90:100-110)
+    if grav is not None:
+        gnorm = sum(jnp.abs(g) for g in grav)
+    else:
+        gnorm = jnp.zeros_like(ws)
+    ratio = jnp.maximum(gnorm * dx / ws ** 2, 1e-4)
+
+    cf = cfg.courant_factor
+    dtcell = dx / ws * (jnp.sqrt(1.0 + 2.0 * cf * ratio) - 1.0) / ratio
+    dtmax = cf * dx / cfg.smallc
+    return jnp.minimum(dtmax, jnp.min(dtcell))

@@ -1,0 +1,1 @@
+from ramses_tpu.init.regions import region_condinit, condinit  # noqa: F401

@@ -1,0 +1,1 @@
+from ramses_tpu.parallel.mesh import make_mesh, spatial_sharding  # noqa: F401

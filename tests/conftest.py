@@ -1,0 +1,26 @@
+"""Test configuration: CPU backend with 8 virtual devices.
+
+Tests run on a virtual 8-device CPU mesh (the 'mpirun -np N on one host'
+trick of the reference suite, ``tests/run_test_suite.sh:78-82``) with
+float64 enabled so correctness oracles are precision-limited by the
+algorithm, not the backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers a TPU-tunnel ("axon") PJRT plugin in
+# every interpreter and forces jax_platforms="axon,cpu" via jax.config —
+# overriding JAX_PLATFORMS from the environment.  Tests must run on the
+# virtual 8-device CPU mesh, so force the config back before any backend
+# is initialized (register() runs at interpreter start, long before us,
+# but backends are only instantiated on first use).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,90 @@
+"""Exact Riemann problem solution for test oracles (Toro ch. 4).
+
+Independent analytic reference — NOT the solver under test — used to
+validate shock-tube runs the same way the reference suite ships
+``sod-tube-ana.dat`` analytic curves.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.optimize import brentq
+
+
+def exact_riemann(rl, ul, pl, rr, ur, pr, gamma, x, t, x0=0.5):
+    """Sample the exact solution of a 1D Riemann problem at positions x."""
+    cl = np.sqrt(gamma * pl / rl)
+    cr = np.sqrt(gamma * pr / rr)
+    g1 = (gamma - 1.0) / (2.0 * gamma)
+    g2 = (gamma + 1.0) / (2.0 * gamma)
+    g3 = 2.0 * gamma / (gamma - 1.0)
+    g4 = 2.0 / (gamma - 1.0)
+    g5 = 2.0 / (gamma + 1.0)
+    g6 = (gamma - 1.0) / (gamma + 1.0)
+    g7 = (gamma - 1.0) / 2.0
+
+    def fK(p, rK, pK, cK):
+        if p > pK:  # shock
+            aK = g5 / rK
+            bK = g6 * pK
+            return (p - pK) * np.sqrt(aK / (p + bK))
+        return g4 * cK * ((p / pK) ** g1 - 1.0)  # rarefaction
+
+    def f(p):
+        return fK(p, rl, pl, cl) + fK(p, rr, pr, cr) + (ur - ul)
+
+    pstar = brentq(f, 1e-12, 10.0 * max(pl, pr))
+    ustar = 0.5 * (ul + ur) + 0.5 * (fK(pstar, rr, pr, cr)
+                                     - fK(pstar, rl, pl, cl))
+
+    rho = np.empty_like(x)
+    u = np.empty_like(x)
+    p = np.empty_like(x)
+    s = (x - x0) / max(t, 1e-300)
+
+    for i, si in enumerate(s):
+        if si <= ustar:  # left of contact
+            if pstar > pl:  # left shock
+                sL = ul - cl * np.sqrt(g2 * pstar / pl + g1)
+                if si < sL:
+                    rho[i], u[i], p[i] = rl, ul, pl
+                else:
+                    rho[i] = rl * ((pstar / pl + g6) / (g6 * pstar / pl + 1))
+                    u[i], p[i] = ustar, pstar
+            else:  # left rarefaction
+                shead = ul - cl
+                cstar = cl * (pstar / pl) ** g1
+                stail = ustar - cstar
+                if si < shead:
+                    rho[i], u[i], p[i] = rl, ul, pl
+                elif si > stail:
+                    rho[i] = rl * (pstar / pl) ** (1.0 / gamma)
+                    u[i], p[i] = ustar, pstar
+                else:
+                    u[i] = g5 * (cl + g7 * ul + si)
+                    c = g5 * (cl + g7 * (ul - si))
+                    rho[i] = rl * (c / cl) ** g4
+                    p[i] = pl * (c / cl) ** g3
+        else:  # right of contact
+            if pstar > pr:  # right shock
+                sR = ur + cr * np.sqrt(g2 * pstar / pr + g1)
+                if si > sR:
+                    rho[i], u[i], p[i] = rr, ur, pr
+                else:
+                    rho[i] = rr * ((pstar / pr + g6) / (g6 * pstar / pr + 1))
+                    u[i], p[i] = ustar, pstar
+            else:  # right rarefaction
+                shead = ur + cr
+                cstar = cr * (pstar / pr) ** g1
+                stail = ustar + cstar
+                if si > shead:
+                    rho[i], u[i], p[i] = rr, ur, pr
+                elif si < stail:
+                    rho[i] = rr * (pstar / pr) ** (1.0 / gamma)
+                    u[i], p[i] = ustar, pstar
+                else:
+                    u[i] = g5 * (-cr + g7 * ur + si)
+                    c = g5 * (cr - g7 * (ur - si))
+                    rho[i] = rr * (c / cr) ** g4
+                    p[i] = pr * (c / cr) ** g3
+    return rho, u, p

@@ -1,0 +1,107 @@
+"""1D shock-tube validation vs the exact Riemann solution.
+
+Mirrors the reference's sod-tube test (``tests/hydro/sod-tube``): same
+initial states, end time, and resolution class; the oracle here is the
+analytic solution (their ``sod-tube-ana.dat``) with an L1 gate.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ramses_tpu.config import params_from_string
+from ramses_tpu.driver import Simulation
+from ramses_tpu.grid.uniform import totals
+from tests.exact_riemann import exact_riemann
+
+SOD = """
+&RUN_PARAMS
+hydro=.true.
+/
+&AMR_PARAMS
+levelmin={lmin}
+levelmax={lmin}
+boxlen=1.0
+/
+&BOUNDARY_PARAMS
+nboundary=2
+ibound_min=-1,+1
+ibound_max=-1,+1
+bound_type= 2, 2
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='square'
+x_center=0.25,0.75
+length_x=0.5,0.5
+d_region=1.0,0.125
+u_region=0.0,0.0
+p_region=1.0,0.1
+/
+&OUTPUT_PARAMS
+noutput=1
+tout=0.245
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+slope_type={slope}
+riemann='{riemann}'
+/
+"""
+
+
+def run_sod(riemann: str, lmin: int = 7, slope: int = 2):
+    p = params_from_string(SOD.format(lmin=lmin, slope=slope,
+                                      riemann=riemann), ndim=1)
+    sim = Simulation(p, dtype=jnp.float64)
+    sim.evolve()
+    return sim
+
+
+@pytest.mark.parametrize("riemann", ["hllc", "llf", "hll", "exact",
+                                     "acoustic"])
+def test_sod_l1(riemann):
+    sim = run_sod(riemann)
+    n = sim.grid.shape[0]
+    x = (np.arange(n) + 0.5) / n
+    rho_a, u_a, p_a = exact_riemann(1.0, 0.0, 1.0, 0.125, 0.0, 0.1,
+                                    1.4, x, sim.state.t, x0=0.5)
+    rho = np.asarray(sim.state.u[0])
+    l1 = np.mean(np.abs(rho - rho_a))
+    # second-order scheme at 128 cells: L1(rho) ~ 5e-3; LLF is more
+    # diffusive.  Gates chosen ~2x above measured so regressions trip them.
+    gate = {"llf": 2.5e-2, "acoustic": 1.6e-2}.get(riemann, 1.6e-2)
+    assert l1 < gate, f"L1={l1:.3e} for {riemann}"
+    assert sim.state.t == pytest.approx(0.245, rel=1e-10)
+
+
+def test_sod_velocity_pressure():
+    sim = run_sod("hllc")
+    cfg = sim.cfg
+    u = np.asarray(sim.state.u)
+    n = sim.grid.shape[0]
+    x = (np.arange(n) + 0.5) / n
+    rho_a, u_a, p_a = exact_riemann(1.0, 0.0, 1.0, 0.125, 0.0, 0.1,
+                                    1.4, x, sim.state.t, x0=0.5)
+    vel = u[1] / u[0]
+    press = (cfg.gamma - 1.0) * (u[2] - 0.5 * u[1] ** 2 / u[0])
+    assert np.mean(np.abs(vel - u_a)) < 2e-2
+    assert np.mean(np.abs(press - p_a)) < 1e-2
+
+
+def test_conservation_periodic():
+    """Mass/momentum/energy exactly conserved on a periodic box."""
+    p = params_from_string(SOD.format(lmin=6, slope=2, riemann="hllc"),
+                           ndim=1)
+    p.boundary.nboundary = 0  # periodic
+    from ramses_tpu.grid import boundary as bmod
+    sim = Simulation(p, dtype=jnp.float64)
+    tot0 = totals(sim.state.u, sim.cfg, sim.grid.dx)
+    sim.evolve()
+    tot1 = totals(sim.state.u, sim.cfg, sim.grid.dx)
+    assert float(tot1["mass"]) == pytest.approx(float(tot0["mass"]),
+                                                rel=1e-13)
+    assert float(tot1["energy"]) == pytest.approx(float(tot0["energy"]),
+                                                  rel=1e-12)

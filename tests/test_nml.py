@@ -1,0 +1,106 @@
+"""Namelist parser + config tests."""
+
+from ramses_tpu.config import params_from_string
+from ramses_tpu.nml import parse_nml
+
+SOD = """
+This is the parameter file for Sod's shock tube test.
+
+&RUN_PARAMS
+hydro=.true.
+nsubcycle=3*1,2
+/
+
+&AMR_PARAMS
+levelmin=3
+levelmax=10
+ngridmax=2000
+boxlen=1.0
+/
+
+&BOUNDARY_PARAMS
+nboundary=2
+ibound_min=-1,+1
+ibound_max=-1,+1
+bound_type= 1, 1
+/
+
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='square'
+x_center=0.25,0.75
+length_x=0.5,0.5
+d_region=1.0,0.125
+u_region=0.0,0.0
+p_region=1.0,0.1
+/
+
+&OUTPUT_PARAMS
+noutput=1
+tout=0.245
+/
+
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+slope_type=2
+riemann='hllc'
+/
+"""
+
+
+def test_parse_groups():
+    g = parse_nml(SOD)
+    assert g["run_params"]["hydro"] is True
+    assert g["run_params"]["nsubcycle"] == [1, 1, 1, 2]
+    assert g["amr_params"]["levelmin"] == 3
+    assert g["hydro_params"]["riemann"] == "hllc"
+    assert g["boundary_params"]["ibound_min"] == [-1, 1]
+    assert g["init_params"]["region_type"] == {1: ["square"], 2: ["square"]}
+
+
+def test_params_object():
+    p = params_from_string(SOD, ndim=1)
+    assert p.run.hydro and p.amr.levelmin == 3
+    assert p.hydro.riemann == "hllc" and p.hydro.slope_type == 2
+    assert p.init.nregion == 2
+    assert p.init.region_type == ["square", "square"]
+    assert p.init.d_region == [1.0, 0.125]
+    assert p.init.length_y == [1e10, 1e10]  # densified default
+    assert p.boundary.bound_type == [1, 1]
+    assert p.output.tout == [0.245]
+    assert p.run.nsubcycle[:5] == [1, 1, 1, 2, 2]
+    assert p.nvar == 3  # 1D: rho, mom, E
+
+
+def test_fortran_literals():
+    g = parse_nml("&X\na=1d-3\nb=.false.\nc=2*0.5\nd='hi'\n/\n")
+    x = g["x"]
+    assert x["a"] == 1e-3 and x["b"] is False
+    assert x["c"] == [0.5, 0.5] and x["d"] == "hi"
+
+
+def test_continuation_after_scalar_first_line():
+    """A value list split across lines where the first line holds a single
+    value must append, not overwrite (regression: first value was lost)."""
+    g = parse_nml("&OUTPUT_PARAMS\ntout=0.1,\n0.2,0.3\n/")
+    assert g["output_params"]["tout"] == [0.1, 0.2, 0.3]
+
+
+def test_indexed_output_times_densified():
+    """tout(1)=... indexed assignment must produce a flat float list the
+    driver can iterate (regression: left as {index: values} dict)."""
+    p = params_from_string("&OUTPUT_PARAMS\nnoutput=2\ntout(1)=0.1\n"
+                           "tout(2)=0.245\n/", ndim=1)
+    assert p.output.tout == [0.1, 0.245]
+    assert p.output.noutput == 2
+
+
+def test_tend_delta_tout_ladder():
+    """tend/delta_tout style outputs synthesise the tout ladder."""
+    p = params_from_string("&OUTPUT_PARAMS\ntend=0.5\ndelta_tout=0.2\n/",
+                           ndim=1)
+    assert p.output.tout == [0.2, 0.4, 0.5]
+    p = params_from_string("&OUTPUT_PARAMS\ntend=0.5\n/", ndim=1)
+    assert p.output.tout == [0.5]
