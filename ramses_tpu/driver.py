@@ -20,6 +20,8 @@ from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.grid.uniform import UniformGrid, cfl_dt, run_steps, step, totals
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init.regions import condinit
+from ramses_tpu.poisson.coupling import (GravitySpec, gravity_field,
+                                         run_steps_grav)
 
 
 @dataclass
@@ -29,6 +31,7 @@ class SimState:
     nstep: int = 0
     dt: float = 0.0
     iout: int = 1  # next output slot (1-based, like the reference)
+    f: Optional[jax.Array] = None  # gravity field [ndim, *sp] (poisson)
 
 
 class Simulation:
@@ -57,6 +60,19 @@ class Simulation:
                                 bc=self.bc)
         u0 = condinit(shape, self.dx, params, self.cfg)
         self.state = SimState(u=jnp.asarray(u0, dtype=dtype))
+        self.gspec = GravitySpec.from_params(params)
+        if self.gspec.enabled:
+            if self.gspec.gravity_type == 0 and any(
+                    f.kind != bmod.PERIODIC
+                    for pair in self.bc.faces for f in pair):
+                import warnings
+                warnings.warn("self-gravity currently solves the periodic "
+                              "Poisson problem; non-periodic boundaries see "
+                              "periodic mass images (isolated-BC solve TBD).")
+            # initial force so the first -0.5dt "un-kick" cancels exactly
+            # (the reference's nstep==0 save_phi_old, amr/amr_step.f90:260)
+            self.state.f = gravity_field(self.gspec, self.state.u[0],
+                                         self.dx)
         self.output_times = list(params.output.tout[:params.output.noutput])
         self.on_output: Optional[Callable] = None
         # perf accounting (mus/pt of adaptive_loop.f90:204-212)
@@ -81,9 +97,15 @@ class Simulation:
             while st.t < tout * (1.0 - 1e-12) and st.nstep < nstepmax:
                 n = min(chunk, nstepmax - st.nstep)
                 t0 = time.perf_counter()
-                u, t, ndone = run_steps(self.grid, st.u,
-                                        jnp.asarray(st.t, tdtype),
-                                        jnp.asarray(tout, tdtype), n)
+                if self.gspec.enabled:
+                    u, st.f, t, ndone = run_steps_grav(
+                        self.grid, self.gspec, st.u, st.f,
+                        jnp.asarray(st.t, tdtype),
+                        jnp.asarray(tout, tdtype), n)
+                else:
+                    u, t, ndone = run_steps(self.grid, st.u,
+                                            jnp.asarray(st.t, tdtype),
+                                            jnp.asarray(tout, tdtype), n)
                 u.block_until_ready()
                 self.wall_s += time.perf_counter() - t0
                 ndone = int(ndone)

@@ -20,6 +20,7 @@ from ramses_tpu.config import Params
 from ramses_tpu.driver import Simulation
 from ramses_tpu.grid.uniform import run_steps
 from ramses_tpu.parallel.mesh import make_mesh, spatial_sharding
+from ramses_tpu.poisson.coupling import run_steps_grav
 
 
 class ShardedSim:
@@ -33,6 +34,9 @@ class ShardedSim:
         self.sharding = spatial_sharding(self.mesh, n_leading=1)
         self.u = jax.device_put(self.inner.state.u, self.sharding)
         self.inner.state.u = None  # drop the unsharded copy (memory)
+        self.gspec = self.inner.gspec
+        self.f = (jax.device_put(self.inner.state.f, self.sharding)
+                  if self.gspec.enabled else None)
         self.t = 0.0
         self.nstep = 0
 
@@ -42,9 +46,14 @@ class ShardedSim:
 
     def run(self, nsteps: int, tend: float = 1e30):
         tdtype = (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
-        u, t, ndone = run_steps(self.grid, self.u,
-                                jnp.asarray(self.t, tdtype),
-                                jnp.asarray(tend, tdtype), nsteps)
+        t0 = jnp.asarray(self.t, tdtype)
+        t1 = jnp.asarray(tend, tdtype)
+        if self.gspec.enabled:
+            u, f, t, ndone = run_steps_grav(self.grid, self.gspec,
+                                            self.u, self.f, t0, t1, nsteps)
+            self.f = f
+        else:
+            u, t, ndone = run_steps(self.grid, self.u, t0, t1, nsteps)
         u.block_until_ready()
         self.u, self.t = u, float(t)
         self.nstep += int(ndone)

@@ -1,0 +1,8 @@
+"""Gravity: Poisson solvers, force computation, analytic fields.
+
+TPU-native replacement of the reference ``poisson/`` layer (SURVEY.md §2.6):
+the per-AMR-level masked multigrid becomes dense whole-grid cycles under
+jit, the CG fallback keeps the reference's ``cg_levelmin`` escape hatch,
+and a periodic FFT solve (exact for the discrete 7-point operator) is the
+TPU-idiomatic fast path the Fortran never had.
+"""

@@ -1,0 +1,30 @@
+"""Gravitational acceleration from the potential.
+
+``force_fine → gradient_phi`` (``poisson/force_fine.f90:5,199``): the
+reference uses a 5-point, 4th-order finite-difference gradient with
+coefficients a=0.5*4/3/dx, b=0.25*1/3/dx — i.e.
+``dphi/dx = [8(phi_{+1}-phi_{-1}) - (phi_{+2}-phi_{-2})] / (12 dx)`` —
+and f = -grad(phi).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gradient_phi(phi, dx: float):
+    """4th-order central gradient, periodic wrap.  Returns [ndim, *sp]."""
+    a = 2.0 / (3.0 * dx)
+    b = 1.0 / (12.0 * dx)
+    comps = []
+    for ax in range(phi.ndim):
+        d1 = jnp.roll(phi, -1, axis=ax) - jnp.roll(phi, 1, axis=ax)
+        d2 = jnp.roll(phi, -2, axis=ax) - jnp.roll(phi, 2, axis=ax)
+        comps.append(a * d1 - b * d2)
+    return jnp.stack(comps)
+
+
+def force(phi, dx: float):
+    """f = -grad(phi), shape [ndim, *spatial]."""
+    return -gradient_phi(phi, dx)

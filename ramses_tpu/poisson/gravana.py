@@ -1,0 +1,50 @@
+"""Analytic (imposed) gravity fields — ``gravity_type > 0``.
+
+Mirrors ``poisson/gravana.f90:5-95``: when an analytic model is selected,
+the Poisson solve is bypassed entirely
+(``poisson/multigrid_fine_commons.f90:46-48``) and the acceleration is a
+fixed function of position:
+  type 1: constant vector  ``gravity_params(1:ndim)``
+  type 2: softened point mass — GM=params[0], softening=params[1],
+          center=params[2:5]
+  type 3: vertical galactic field (Kuijken & Gilmore 1989) —
+          a1, a2, z0 = params[0:3] (already in code units here; the
+          reference converts from kpc/Myr^2 internally)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def cell_centers(shape: Sequence[int], dx: float, dtype=jnp.float64):
+    """Cell-center coordinates [ndim, *spatial] in user units [0, boxlen]."""
+    coords = [(np.arange(n) + 0.5) * dx for n in shape]
+    mesh = np.meshgrid(*coords, indexing="ij")
+    return jnp.asarray(np.stack(mesh), dtype=dtype)
+
+
+def gravana(x, gravity_type: int, gravity_params: Sequence[float],
+            boxlen: float):
+    """Analytic acceleration at positions x [ndim, *spatial]."""
+    nd = x.shape[0]
+    gp = list(gravity_params) + [0.0] * 10
+    if gravity_type == 1:
+        g = [jnp.full(x.shape[1:], gp[d], x.dtype) for d in range(nd)]
+        return jnp.stack(g)
+    if gravity_type == 2:
+        gmass, emass = gp[0], gp[1]
+        center = gp[2:2 + nd]
+        rvec = [x[d] - center[d] for d in range(nd)]
+        rr = jnp.sqrt(sum(r * r for r in rvec) + emass * emass)
+        return jnp.stack([-gmass * r / rr ** 3 for r in rvec])
+    if gravity_type == 3:
+        a1, a2, z0 = gp[0], gp[1], gp[2]
+        rz = x[nd - 1] - 0.5 * boxlen
+        g = [jnp.zeros(x.shape[1:], x.dtype) for _ in range(nd)]
+        g[nd - 1] = -a1 * rz / jnp.sqrt(rz * rz + z0 * z0) - a2 * rz
+        return jnp.stack(g)
+    raise ValueError(f"gravity_type={gravity_type}")
