@@ -20,8 +20,10 @@ from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.grid.uniform import UniformGrid, cfl_dt, run_steps, step, totals
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init.regions import condinit
-from ramses_tpu.poisson.coupling import (GravitySpec, gravity_field,
-                                         run_steps_grav)
+from ramses_tpu.pm.coupling import PMSpec, run_steps_pm, total_density
+from ramses_tpu.pm.cosmology import Cosmology
+from ramses_tpu.pm.particles import ParticleSet
+from ramses_tpu.poisson.coupling import GravitySpec, gravity_field
 
 
 @dataclass
@@ -32,6 +34,8 @@ class SimState:
     dt: float = 0.0
     iout: int = 1  # next output slot (1-based, like the reference)
     f: Optional[jax.Array] = None  # gravity field [ndim, *sp] (poisson)
+    p: Optional[ParticleSet] = None
+    dt_old: float = 0.0            # previous step (split particle kick)
 
 
 class Simulation:
@@ -42,7 +46,8 @@ class Simulation:
     reference's fully-refined base mesh.
     """
 
-    def __init__(self, params: Params, dtype=jnp.float32):
+    def __init__(self, params: Params, dtype=jnp.float32,
+                 particles: Optional[ParticleSet] = None):
         self.params = params
         for flag in ("pressure_fix", "difmag"):
             if getattr(params.hydro, flag):
@@ -60,6 +65,14 @@ class Simulation:
                                 bc=self.bc)
         u0 = condinit(shape, self.dx, params, self.cfg)
         self.state = SimState(u=jnp.asarray(u0, dtype=dtype))
+        self.pspec = PMSpec.from_params(params)
+        self.cosmo = (Cosmology.from_params(params) if params.run.cosmo
+                      else None)
+        if self.pspec.enabled:
+            self.state.p = particles if particles is not None else \
+                ParticleSet.make(jnp.zeros((0, params.ndim)),
+                                 jnp.zeros((0, params.ndim)),
+                                 jnp.zeros((0,)), nmax=1)
         self.gspec = GravitySpec.from_params(params)
         if self.gspec.enabled:
             if self.gspec.gravity_type == 0 and any(
@@ -71,8 +84,20 @@ class Simulation:
                               "periodic mass images (isolated-BC solve TBD).")
             # initial force so the first -0.5dt "un-kick" cancels exactly
             # (the reference's nstep==0 save_phi_old, amr/amr_step.f90:260)
-            self.state.f = gravity_field(self.gspec, self.state.u[0],
-                                         self.dx)
+            rho0 = total_density(self.pspec, self.state.u, self.state.p,
+                                 shape, self.dx)
+            self.state.f = gravity_field(self.gspec, rho0, self.dx)
+        elif self.pspec.enabled or self.cosmo is not None:
+            self.state.f = jnp.zeros((params.ndim,) + shape, jnp.float64)
+        if self.cosmo is not None:
+            self.state.t = self.cosmo.tau_ini
+            # aexp-ladder outputs: convert aout -> conformal time
+            if params.output.aout:
+                taus = [float(self.cosmo.tau_of_aexp(a))
+                        for a in params.output.aout
+                        if a <= 1.0]
+                params.output.tout = sorted(set(params.output.tout + taus))
+                params.output.noutput = len(params.output.tout)
         self.output_times = list(params.output.tout[:params.output.noutput])
         self.on_output: Optional[Callable] = None
         # perf accounting (mus/pt of adaptive_loop.f90:204-212)
@@ -94,14 +119,21 @@ class Simulation:
         # dt < eps(t) and the run would spin to nstepmax.
         tdtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         for tout in self.output_times[st.iout - 1:]:
-            while st.t < tout * (1.0 - 1e-12) and st.nstep < nstepmax:
+            # sign-safe tolerance: cosmology runs in (negative) conformal
+            # time, so a relative factor on tout would flip direction
+            ttol = 1e-12 * (abs(tout) + 1.0)
+            while st.t < tout - ttol and st.nstep < nstepmax:
                 n = min(chunk, nstepmax - st.nstep)
                 t0 = time.perf_counter()
-                if self.gspec.enabled:
-                    u, st.f, t, ndone = run_steps_grav(
-                        self.grid, self.gspec, st.u, st.f,
-                        jnp.asarray(st.t, tdtype),
-                        jnp.asarray(tout, tdtype), n)
+                if (self.pspec.enabled or self.gspec.enabled
+                        or self.cosmo is not None):
+                    u, st.p, st.f, t, dt_old, ndone = run_steps_pm(
+                        self.grid, self.gspec, self.pspec, st.u, st.p,
+                        st.f, jnp.asarray(st.t, tdtype),
+                        jnp.asarray(tout, tdtype),
+                        jnp.asarray(st.dt_old, tdtype), n,
+                        cosmo=self.cosmo)
+                    st.dt_old = float(dt_old)
                 else:
                     u, t, ndone = run_steps(self.grid, st.u,
                                             jnp.asarray(st.t, tdtype),
@@ -117,7 +149,7 @@ class Simulation:
                           f"mus/pt={mus_pt:.4f}")
                 if ndone == 0:
                     break
-            if st.t < tout * (1.0 - 1e-12):
+            if st.t < tout - ttol:
                 break  # budget exhausted before this output time: no dump
             if self.on_output is not None:
                 self.on_output(self, st.iout)

@@ -20,7 +20,7 @@ from ramses_tpu.config import Params
 from ramses_tpu.driver import Simulation
 from ramses_tpu.grid.uniform import run_steps
 from ramses_tpu.parallel.mesh import make_mesh, spatial_sharding
-from ramses_tpu.poisson.coupling import run_steps_grav
+from ramses_tpu.pm.coupling import run_steps_pm
 
 
 class ShardedSim:
@@ -30,14 +30,21 @@ class ShardedSim:
                  devices: Optional[Sequence[jax.Device]] = None,
                  dtype=jnp.float32):
         self.inner = Simulation(params, dtype=dtype)
+        if self.inner.pspec.enabled:
+            raise NotImplementedError(
+                "sharded particle arrays are not wired up yet; run pic "
+                "simulations single-device or help build stage 6")
         self.mesh = make_mesh(params.ndim, devices)
         self.sharding = spatial_sharding(self.mesh, n_leading=1)
         self.u = jax.device_put(self.inner.state.u, self.sharding)
         self.inner.state.u = None  # drop the unsharded copy (memory)
         self.gspec = self.inner.gspec
+        self.pspec = self.inner.pspec
+        self.cosmo = self.inner.cosmo
         self.f = (jax.device_put(self.inner.state.f, self.sharding)
-                  if self.gspec.enabled else None)
-        self.t = 0.0
+                  if self.inner.state.f is not None else None)
+        self.t = float(self.inner.state.t)
+        self.dt_old = 0.0
         self.nstep = 0
 
     @property
@@ -48,10 +55,12 @@ class ShardedSim:
         tdtype = (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
         t0 = jnp.asarray(self.t, tdtype)
         t1 = jnp.asarray(tend, tdtype)
-        if self.gspec.enabled:
-            u, f, t, ndone = run_steps_grav(self.grid, self.gspec,
-                                            self.u, self.f, t0, t1, nsteps)
-            self.f = f
+        if self.gspec.enabled or self.cosmo is not None:
+            u, _p, f, t, dt_old, ndone = run_steps_pm(
+                self.grid, self.gspec, self.pspec, self.u, None, self.f,
+                t0, t1, jnp.asarray(self.dt_old, tdtype), nsteps,
+                cosmo=self.cosmo)
+            self.f, self.dt_old = f, float(dt_old)
         else:
             u, t, ndone = run_steps(self.grid, self.u, t0, t1, nsteps)
         u.block_until_ready()

@@ -1,0 +1,9 @@
+"""Particle-mesh layer: particles, deposition, dynamics, cosmology.
+
+TPU-native replacement of the reference ``pm/`` layer (SURVEY.md §2.7).
+The Fortran's per-grid linked lists (``pm/pm_commons.f90:46-96``) become
+fixed-size SoA device arrays with an active mask; the tree sort becomes
+index arithmetic; CIC/TSC deposition becomes scatter-add; the halo
+migration (``virtual_tree_fine``) becomes resharding of the particle
+arrays over the device mesh.
+"""

@@ -1,0 +1,131 @@
+"""Cosmology: Friedmann tables and supercomoving-unit scaffolding.
+
+Reference: ``amr/init_time.f90`` — ``init_cosmo`` (``:414``) and
+``friedman`` (``:756-855``).  The reference integrates the Friedmann
+equation backwards from a=1 with adaptive RK2 and stores look-up tables
+``axp_frw/hexp_frw/tau_frw/t_frw``; time stepping then advances the
+conformal time ``tau`` (code time) and interpolates ``aexp``.
+
+Conventions (comment block ``init_time.f90:764-773``):
+  - a = 1 today; tau (conformal, da/dtau convention below) and t
+    (proper look-back) are 0 today, both in units of 1/H0
+  - da/dtau = sqrt(a^3 (Om + Ol a^3 + Ok a))       (``dadtau:857-866``)
+  - da/dt   = sqrt((Om + Ol a^3 + Ok a) / a)
+  - hexp = (1/a) da/dtau
+
+Here the tables are built by direct quadrature on a fine log-spaced grid
+(vectorized, deterministic) instead of the sequential RK2 — same curves,
+no 1e6-step Python loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def dadtau(a, om, ov, ok):
+    return np.sqrt(a ** 3 * (om + ov * a ** 3 + ok * a))
+
+
+def dadt(a, om, ov, ok):
+    return np.sqrt((om + ov * a ** 3 + ok * a) / a)
+
+
+def friedman(om: float, ov: float, ok: float, aexp_min: float,
+             ntable: int = 1000):
+    """Look-up tables (a, hexp, tau, t) from a=aexp_min/1.2 to 1.
+
+    Quadrature replacement of ``friedman`` (``amr/init_time.f90:756``):
+    tau(a) = -int_a^1 da'/dadtau, t(a) = -int_a^1 da'/dadt.
+    """
+    if abs(om + ov + ok - 1.0) > 1e-9:
+        raise ValueError(f"Omegas must sum to 1: {om}+{ov}+{ok}")
+    nfine = max(20 * ntable, 20000)
+    a_fine = np.exp(np.linspace(np.log(aexp_min / 1.2), 0.0, nfine))
+    inv_dtau = 1.0 / dadtau(a_fine, om, ov, ok)
+    inv_dt = 1.0 / dadt(a_fine, om, ov, ok)
+    # cumulative trapezoid from a=1 downward (negative times in the past)
+    da = np.diff(a_fine)
+    tau_f = np.concatenate([[0.0], np.cumsum(0.5 * da * (inv_dtau[1:]
+                                                         + inv_dtau[:-1]))])
+    t_f = np.concatenate([[0.0], np.cumsum(0.5 * da * (inv_dt[1:]
+                                                       + inv_dt[:-1]))])
+    tau_f = tau_f - tau_f[-1]   # tau(1) = 0, negative in the past
+    t_f = t_f - t_f[-1]
+    # subsample to ntable+1 entries (reference keeps 0:ntable)
+    idx = np.linspace(0, nfine - 1, ntable + 1).round().astype(int)
+    a_t = a_fine[idx]
+    return (a_t, dadtau(a_t, om, ov, ok) / a_t, tau_f[idx], t_f[idx])
+
+
+@dataclass(frozen=True)
+class Cosmology:
+    """Flat(ish) FRW background + supercomoving unit scales.
+
+    Code units follow the reference (``amr/units.f90`` with cosmo):
+    scale_d = Om*rhocrit(h)*h^2/a^3, scale_t = a^2/H0,
+    scale_l = a * boxlen_ini Mpc / h.
+    """
+    omega_m: float = 1.0
+    omega_l: float = 0.0
+    omega_k: float = 0.0
+    omega_b: float = 0.045
+    h0: float = 70.0               # km/s/Mpc
+    aexp_ini: float = 1e-2
+    boxlen_ini: float = 1.0        # comoving Mpc/h
+    ntable: int = 1000
+    # tables (tuples for hashability; filled in __post_init__)
+    axp_frw: Tuple[float, ...] = ()
+    hexp_frw: Tuple[float, ...] = ()
+    tau_frw: Tuple[float, ...] = ()
+    t_frw: Tuple[float, ...] = ()
+
+    def __post_init__(self):
+        if not self.axp_frw:
+            a, h, tau, t = friedman(self.omega_m, self.omega_l, self.omega_k,
+                                    self.aexp_ini, self.ntable)
+            object.__setattr__(self, "axp_frw", tuple(a))
+            object.__setattr__(self, "hexp_frw", tuple(h))
+            object.__setattr__(self, "tau_frw", tuple(tau))
+            object.__setattr__(self, "t_frw", tuple(t))
+
+    @classmethod
+    def from_params(cls, p) -> "Cosmology":
+        raw = (p.raw or {}).get("cosmo_params", {})
+        return cls(omega_m=float(raw.get("omega_m", 1.0)),
+                   omega_l=float(raw.get("omega_l", 0.0)),
+                   omega_k=float(raw.get("omega_k", 0.0)),
+                   omega_b=float(raw.get("omega_b", 0.045)),
+                   h0=float(raw.get("h0", 70.0)),
+                   aexp_ini=float(raw.get("aexp", p.init.aexp_ini
+                                          if p.init.aexp_ini < 1.0 else 1e-2)),
+                   boxlen_ini=float(raw.get("boxlen_ini", p.amr.boxlen)))
+
+    # --- interpolators (host or device) ------------------------------
+    def aexp_of_tau(self, tau):
+        return jnp.interp(tau, jnp.asarray(self.tau_frw),
+                          jnp.asarray(self.axp_frw))
+
+    def hexp_of_tau(self, tau):
+        return jnp.interp(tau, jnp.asarray(self.tau_frw),
+                          jnp.asarray(self.hexp_frw))
+
+    def t_of_tau(self, tau):
+        return jnp.interp(tau, jnp.asarray(self.tau_frw),
+                          jnp.asarray(self.t_frw))
+
+    def tau_of_aexp(self, aexp):
+        return jnp.interp(aexp, jnp.asarray(self.axp_frw),
+                          jnp.asarray(self.tau_frw))
+
+    @property
+    def tau_ini(self) -> float:
+        return float(self.tau_of_aexp(self.aexp_ini))
+
+    def age_of_universe(self) -> float:
+        """In 1/H0 units (the reference's debug print, init_time.f90:811)."""
+        return -float(self.t_frw[0])

@@ -1,0 +1,185 @@
+"""Coupled particle + hydro + gravity stepper (uniform grid).
+
+This is THE single-level stepper: gravity-only runs use it with particles
+disabled, N-body-only runs with hydro disabled — one copy of the coupled
+sequence (the reference likewise has one ``amr_step`` for every physics
+combination).
+
+Replicates the per-step operation order of ``amr/amr_step.f90`` for the
+single-level case (SURVEY.md §3.2), with the reference's split-kick
+leapfrog:
+
+  1. ``rho_fine``: total density = gas + CIC(particles)   (:219-225)
+  2. hydro gravity un-kick (-0.5 dt, old force)           (:246)
+  3. Poisson solve -> phi -> f = -grad(phi)               (:250-266)
+  4. ``synchro_fine``: particle kick v += f(x) 0.5*dt_old (:268-273)
+     — completes the *previous* step's kick with the new force
+  5. hydro kick +0.5 dt new force; Godunov sweep with the gravity
+     predictor; final hydro kick +0.5 dt                  (:279,388,427)
+  6. ``move_fine``: v += f(x) 0.5*dt_new then x += v dt   (:479-486)
+  7. dt for the next step: min(hydro CFL, particle Courant,
+     free-fall, cosmological 0.1/hexp)                    (pm/newdt_fine.f90)
+
+Cosmology: integration runs in supercomoving conformal time; the Poisson
+rhs factor becomes ``1.5*omega_m*aexp`` and aexp/hexp are interpolated
+from the Friedmann tables each step (``amr/update_time.f90``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.grid.uniform import UniformGrid
+from ramses_tpu.hydro import muscl
+from ramses_tpu.hydro.timestep import compute_dt
+from ramses_tpu.pm import particles as pmod
+from ramses_tpu.pm.cosmology import Cosmology
+from ramses_tpu.poisson.coupling import (GravitySpec, _all_periodic,
+                                         _pad_force, gravity_field, kick)
+
+
+@dataclass(frozen=True)
+class PMSpec:
+    """Static particle-mesh configuration."""
+    enabled: bool = False
+    hydro: bool = True
+    deposit: str = "cic"          # cic | ngp | tsc
+    courant_factor: float = 0.5
+    boxlen: float = 1.0
+    cosmo: bool = False
+
+    @classmethod
+    def from_params(cls, p) -> "PMSpec":
+        return cls(enabled=bool(p.run.pic), hydro=bool(p.run.hydro),
+                   courant_factor=float(p.hydro.courant_factor),
+                   boxlen=float(p.amr.boxlen), cosmo=bool(p.run.cosmo))
+
+
+def deposit(spec: PMSpec, p: pmod.ParticleSet, shape, dx: float):
+    fn = {"cic": pmod.deposit_cic, "ngp": pmod.deposit_ngp,
+          "tsc": pmod.deposit_tsc}[spec.deposit]
+    return fn(p, shape, dx)
+
+
+def total_density(spec: PMSpec, u, p: Optional[pmod.ParticleSet],
+                  shape, dx: float):
+    """``rho_fine``: gas density + particle deposition."""
+    rho = u[0] if (spec.hydro and u is not None) else \
+        jnp.zeros(shape, jnp.float64)
+    if spec.enabled and p is not None:
+        rho = rho + deposit(spec, p, shape, dx)
+    return rho
+
+
+@partial(jax.jit, static_argnames=("grid", "gspec", "pspec"))
+def pm_hydro_step(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
+                  u, p: Optional[pmod.ParticleSet], f_old, dt, dt_old,
+                  fourpi=None, rho=None):
+    """One coupled step; returns (u, p, f_new).
+
+    ``rho`` may pass in the already-deposited total density at x^n (the
+    scan body computes it once for both dt and the step).
+    """
+    cfg = grid.cfg
+    particles = pspec.enabled and p is not None
+    # 1. total density at x^n
+    if rho is None:
+        rho = total_density(pspec, u, p, grid.shape, grid.dx)
+    # 2-3. gravity update
+    if pspec.hydro and gspec.enabled:
+        u = kick(u, f_old, -0.5 * dt, cfg)
+    f = (gravity_field(gspec, rho, grid.dx, fourpi) if gspec.enabled
+         else jnp.zeros_like(f_old))
+    # 4. complete previous particle kick with new force at x^n
+    if particles:
+        f_at_p = pmod.gather_cic(f, p.x, grid.dx)
+        p = pmod.kick(p, f_at_p, 0.5 * dt_old)
+    # 5. hydro with gravity predictor
+    if pspec.hydro:
+        if gspec.enabled:
+            u = kick(u, f, +0.5 * dt, cfg)
+        up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
+        mode = "wrap" if _all_periodic(grid.bc) else "edge"
+        fp = _pad_force(f, cfg.ndim, mode)
+        grav = [fp[d] for d in range(cfg.ndim)] if gspec.enabled else None
+        flux, _ = muscl.unsplit(up, grav, dt, (grid.dx,) * cfg.ndim, cfg)
+        un = muscl.apply_fluxes(up, flux, cfg)
+        u = bmod.unpad(un, cfg.ndim, muscl.NGHOST)
+        if gspec.enabled:
+            u = kick(u, f, +0.5 * dt, cfg)
+    # 6. particle half-kick + drift
+    if particles:
+        p = pmod.kick(p, f_at_p, 0.5 * dt)
+        p = pmod.drift(p, dt, pspec.boxlen)
+    return u, p, f
+
+
+def pm_compute_dt(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
+                  u, p, f, hexp=None, fourpi=None, rho=None):
+    """min(hydro CFL, particle Courant, free-fall, cosmo 0.1/hexp)."""
+    cfg = grid.cfg
+    dts = []
+    if pspec.hydro:
+        grav = [f[d] for d in range(cfg.ndim)] if gspec.enabled else None
+        dts.append(compute_dt(u, grav, grid.dx, cfg))
+    if pspec.enabled and p is not None:
+        dts.append(pmod.particle_dt(p, grid.dx, pspec.courant_factor))
+    if gspec.enabled:
+        if rho is None:
+            rho = total_density(pspec, u, p, grid.shape, grid.dx)
+        fp = gspec.fourpi if fourpi is None else fourpi
+        dts.append(pmod.freefall_dt(jnp.max(rho), pspec.courant_factor, fp))
+    dt = dts[0]
+    for d in dts[1:]:
+        dt = jnp.minimum(dt, d)
+    if hexp is not None:
+        dt = jnp.minimum(dt, 0.1 / jnp.abs(hexp))
+    return dt
+
+
+@partial(jax.jit, static_argnames=("grid", "gspec", "pspec", "nsteps",
+                                   "cosmo"))
+def run_steps_pm(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
+                 u, p, f, t, tend, dt_old, nsteps: int,
+                 cosmo: Optional[Cosmology] = None):
+    """Advance up to nsteps coupled steps on device.
+
+    With ``cosmo``, ``t`` is supercomoving conformal time tau and aexp /
+    hexp / the Poisson factor are table look-ups per step.
+    """
+    def body(carry, _):
+        u, p, f, t, dt_old, ndone = carry
+        if cosmo is not None:
+            aexp = cosmo.aexp_of_tau(t)
+            hexp = cosmo.hexp_of_tau(t)
+            fourpi = 1.5 * cosmo.omega_m * aexp
+        else:
+            hexp, fourpi = None, None
+        rho = total_density(pspec, u, p, grid.shape, grid.dx)
+        dt = pm_compute_dt(grid, gspec, pspec, u, p, f, hexp, fourpi,
+                           rho=rho)
+        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+        active = t < tend
+        dt = jnp.where(active, dt, 0.0)
+        un, pn, fn = pm_hydro_step(grid, gspec, pspec, u, p, f, dt, dt_old,
+                                   fourpi, rho=rho)
+        if u is not None:
+            u = jnp.where(active, un, u)
+        if p is not None:
+            p = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(active, b, a), p, pn)
+        f = jnp.where(active, fn, f)
+        t = t + dt
+        dt_old = jnp.where(active, dt, dt_old)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, p, f, t, dt_old, ndone), None
+
+    (u, p, f, t, dt_old, ndone), _ = jax.lax.scan(
+        body, (u, p, f, t, dt_old, jnp.array(0)), None, length=nsteps)
+    return u, p, f, t, dt_old, ndone

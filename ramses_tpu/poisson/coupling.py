@@ -1,38 +1,29 @@
-"""Self-gravity ↔ hydro coupling on the uniform grid.
+"""Gravity primitives shared by the coupled steppers.
 
-Replicates the reference's per-step gravity sequence
-(``amr/amr_step.f90:219-293,423-428``):
+The per-step sequence itself lives in :mod:`ramses_tpu.pm.coupling`
+(``pm_hydro_step`` — one stepper for every physics combination, like the
+reference's single ``amr_step``).  This module holds the pieces:
 
-  1. remove the half gravity kick applied with the *old* force
-     (``synchro_hydro_fine(ilevel, -0.5*dt, 1)``)
-  2. solve Poisson for the new potential, compute f = -grad(phi)
-  3. add the half kick with the *new* force (``+0.5*dt``)
-  4. hydro Godunov step with the gravity predictor in ctoprim
-  5. final half kick (``synchro_hydro_fine(+0.5*dt)``, amr_step.f90:427)
-
-The kick updates momentum at fixed internal energy
-(``hydro/synchro_hydro_fine.f90:56-141``: eint extracted, momentum kicked,
-total energy rebuilt).
-
-Poisson RHS: ``Lap(phi) = fourpi * (rho - mean(rho))`` with
-``fourpi = 4*pi`` in code units (G=1) or ``1.5*omega_m*aexp`` under
-supercomoving cosmology (``poisson/multigrid_fine_commons.f90:1082-1112``).
+- :class:`GravitySpec` — static config of the solve
+- :func:`solve_phi` / :func:`gravity_field` — Poisson RHS + solve + force
+  (``Lap(phi) = fourpi*(rho - mean)``, ``fourpi = 4*pi`` in code units or
+  ``1.5*omega_m*aexp`` under supercomoving cosmology,
+  ``poisson/multigrid_fine_commons.f90:1082-1112``)
+- :func:`kick` — momentum kick at fixed internal energy
+  (``hydro/synchro_hydro_fine.f90:56-141``)
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ramses_tpu.grid import boundary as bmod
-from ramses_tpu.grid.uniform import UniformGrid
 from ramses_tpu.hydro import muscl
 from ramses_tpu.hydro.core import HydroStatic
-from ramses_tpu.hydro.timestep import compute_dt
 from ramses_tpu.poisson import force as fmod
 from ramses_tpu.poisson import solver as smod
 from ramses_tpu.poisson.gravana import cell_centers, gravana
@@ -70,9 +61,14 @@ class GravitySpec:
                    boxlen=float(p.amr.boxlen))
 
 
-def solve_phi(spec: GravitySpec, rho, dx: float):
-    """Potential of the density contrast (zero-mean rhs, periodic)."""
-    rhs = spec.fourpi * (rho - jnp.mean(rho))
+def solve_phi(spec: GravitySpec, rho, dx: float, fourpi=None):
+    """Potential of the density contrast (zero-mean rhs, periodic).
+
+    ``fourpi`` may be a traced override of the static rhs factor — the
+    cosmological ``1.5*omega_m*aexp`` varies in time
+    (``poisson/multigrid_fine_commons.f90:1087-1088``)."""
+    factor = spec.fourpi if fourpi is None else fourpi
+    rhs = factor * (rho - jnp.mean(rho))
     if spec.solver == "fft":
         return smod.fft_solve(rhs, dx)
     if spec.solver == "mg":
@@ -82,13 +78,13 @@ def solve_phi(spec: GravitySpec, rho, dx: float):
     raise ValueError(spec.solver)
 
 
-def gravity_field(spec: GravitySpec, rho, dx: float):
+def gravity_field(spec: GravitySpec, rho, dx: float, fourpi=None):
     """Acceleration [ndim, *sp]: analytic model or self-gravity solve."""
     if spec.gravity_type > 0:
         x = cell_centers(rho.shape, dx, dtype=rho.dtype)
         return gravana(x, spec.gravity_type, spec.gravity_params,
                        spec.boxlen)
-    phi = solve_phi(spec, rho, dx)
+    phi = solve_phi(spec, rho, dx, fourpi)
     return fmod.force(phi, dx)
 
 
@@ -103,24 +99,6 @@ def kick(u, f, dteff, cfg: HydroStatic):
         [u[0:1], jnp.stack(mom), e[None], u[cfg.ndim + 2:]], axis=0)
 
 
-@partial(jax.jit, static_argnames=("grid", "spec"))
-def grav_hydro_step(grid: UniformGrid, spec: GravitySpec, u, f_old, dt):
-    """One coupled gravity+hydro step; returns (u_new, f_new)."""
-    cfg = grid.cfg
-    u = kick(u, f_old, -0.5 * dt, cfg)
-    f = gravity_field(spec, u[0], grid.dx)
-    u = kick(u, f, +0.5 * dt, cfg)
-    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
-    mode = "wrap" if _all_periodic(grid.bc) else "edge"
-    fp = _pad_force(f, cfg.ndim, mode)
-    grav = [fp[d] for d in range(cfg.ndim)]
-    flux, _tmp = muscl.unsplit(up, grav, dt, (grid.dx,) * cfg.ndim, cfg)
-    un = muscl.apply_fluxes(up, flux, cfg)
-    u = bmod.unpad(un, cfg.ndim, muscl.NGHOST)
-    u = kick(u, f, +0.5 * dt, cfg)
-    return u, f
-
-
 def _all_periodic(bc: bmod.BoundarySpec) -> bool:
     return all(f.kind == bmod.PERIODIC for pair in bc.faces for f in pair)
 
@@ -129,26 +107,3 @@ def _pad_force(f, ndim: int, mode: str, ng: int = muscl.NGHOST):
     """Ghost-pad the force field (wrap for periodic, edge otherwise)."""
     pads = [(0, 0)] * (f.ndim - ndim) + [(ng, ng)] * ndim
     return jnp.pad(f, pads, mode=mode)
-
-
-@partial(jax.jit, static_argnames=("grid", "spec", "nsteps"))
-def run_steps_grav(grid: UniformGrid, spec: GravitySpec, u, f, t, tend,
-                   nsteps: int):
-    """Advance up to nsteps coupled steps on device (cf. run_steps)."""
-    cfg = grid.cfg
-
-    def body(carry, _):
-        u, f, t, ndone = carry
-        dt = compute_dt(u, [f[d] for d in range(cfg.ndim)], grid.dx, cfg)
-        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
-        active = t < tend
-        un, fn = grav_hydro_step(grid, spec, u, f, jnp.where(active, dt, 0.0))
-        u = jnp.where(active, un, u)
-        f = jnp.where(active, fn, f)
-        t = jnp.where(active, t + dt, t)
-        ndone = ndone + jnp.where(active, 1, 0)
-        return (u, f, t, ndone), None
-
-    (u, f, t, ndone), _ = jax.lax.scan(body, (u, f, t, jnp.array(0)), None,
-                                       length=nsteps)
-    return u, f, t, ndone

@@ -14,7 +14,8 @@ import pytest
 from ramses_tpu.poisson import solver as ps
 from ramses_tpu.poisson import force as pf
 from ramses_tpu.poisson.gravana import cell_centers, gravana
-from ramses_tpu.poisson.coupling import GravitySpec, kick, grav_hydro_step
+from ramses_tpu.poisson.coupling import GravitySpec, kick
+from ramses_tpu.pm.coupling import PMSpec, pm_hydro_step
 from ramses_tpu.hydro.core import HydroStatic
 
 
@@ -129,7 +130,9 @@ def test_uniform_medium_stays_uniform_under_selfgravity():
     u = jnp.zeros((cfg.nvar, n, n, n), jnp.float64)
     u = u.at[0].set(1.0).at[4].set(1.0 / (1.4 - 1.0) / 1.0)
     f0 = jnp.zeros((3, n, n, n), jnp.float64)
-    u1, f1 = grav_hydro_step(grid, spec, u, f0, 0.01)
+    pspec = PMSpec(enabled=False, hydro=True)
+    u1, _p, f1 = pm_hydro_step(grid, spec, pspec, u, None, f0,
+                               jnp.asarray(0.01), jnp.asarray(0.0))
     assert float(jnp.max(jnp.abs(f1))) < 1e-10
     assert float(jnp.max(jnp.abs(u1 - u))) < 1e-10
 
