@@ -1,0 +1,13 @@
+"""Adaptive mesh refinement: host-resident octree + device level batches.
+
+TPU-native redesign of the reference's fully-threaded octree
+(``amr/amr_commons.f90``, ``amr/refine_utils.f90``, ``amr/flag_utils.f90``)
+per SURVEY.md §7: the tree topology (Morton-keyed oct coordinate sets, one
+sorted array per level) lives on the host; all field data lives on device as
+dense per-level batches ``[ncell, nvar]``; the ``build_comm``-shaped metadata
+passes (stencil gather maps, interpolation maps, flux-correction maps) are
+rebuilt on the host after each refinement and applied as XLA gathers and
+scatter-adds.
+"""
+
+from ramses_tpu.amr.hierarchy import AmrSim  # noqa: F401

@@ -1,0 +1,303 @@
+"""AMR simulation driver: recursive subcycled level stepping.
+
+The host-side recursion of ``amr_step`` (``amr/amr_step.f90:1-586``) with
+the hydro-only operation order preserved:
+
+    set_unew(l) → recurse(l+1) ×2 → godunov(l) [+ coarse corrections]
+    → set_uold(l) → upload_fine(l)
+
+Timestep policy: one CFL evaluation per coarse step,
+``dt = min_l courant(l) · 2^(l-levelmin)``, then exact factor-2 subcycling
+(the reference's per-level adaptive ``dtnew``/``dtold`` bookkeeping,
+``amr/update_time.f90``, is replaced by this stricter-but-simpler global
+choice — fine dts are exact halves, so the flux-correction weights of
+``godfine1`` are exact).  Refinement runs at coarse-step boundaries
+(the reference refines every level substep; coarse-step granularity is the
+standard regrid-interval relaxation).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.amr import flag as flagmod
+from ramses_tpu.amr import kernels as K
+from ramses_tpu.amr import maps as mapmod
+from ramses_tpu.amr.tree import Octree, cell_offsets
+from ramses_tpu.config import Params
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.hydro.core import HydroStatic
+from ramses_tpu.init import regions
+
+
+class AmrSim:
+    """Adaptive simulation: host octree + per-level device states."""
+
+    def __init__(self, params: Params, dtype=jnp.float32,
+                 init_tree: Optional[Octree] = None):
+        self.params = params
+        self.cfg = HydroStatic.from_params(params)
+        self.dtype = dtype
+        self.boxlen = float(params.amr.boxlen)
+        spec = bmod.BoundarySpec.from_params(params)
+        self.bc_kinds = [(f[0].kind, f[1].kind) for f in spec.faces]
+        self.lmin = params.amr.levelmin
+        self.lmax = params.amr.levelmax
+        self.t = 0.0
+        self.nstep = 0
+        self.regrid_interval = 1
+
+        if init_tree is not None:
+            self.tree = init_tree
+            self._rebuild_maps()
+            self._alloc_from_ics()
+        else:
+            self._init_refine()
+
+    # ------------------------------------------------------------------
+    # setup
+    # ------------------------------------------------------------------
+    def dx(self, lvl: int) -> float:
+        return self.boxlen / (1 << lvl)
+
+    def _rebuild_maps(self):
+        self.maps: Dict[int, mapmod.LevelMaps] = {}
+        self.dev: Dict[int, dict] = {}
+        for l in range(self.lmin, self.lmax + 1):
+            if not self.tree.has(l):
+                break
+            m = mapmod.build_level_maps(self.tree, l, self.bc_kinds)
+            self.maps[l] = m
+            valid_cell = np.repeat(m.valid_oct, 2 ** self.tree.ndim)
+            self.dev[l] = dict(
+                stencil_src=jnp.asarray(m.stencil_src),
+                vsgn=(jnp.asarray(m.vsgn) if m.vsgn is not None else None),
+                ok_ref=jnp.asarray(m.ok_ref),
+                interp_cell=jnp.asarray(m.interp_cell),
+                interp_nb=jnp.asarray(m.interp_nb),
+                interp_sgn=jnp.asarray(m.interp_sgn, dtype=self.dtype),
+                corr_idx=jnp.asarray(m.corr_idx),
+                ref_cell=jnp.asarray(m.ref_cell),
+                son_oct=jnp.asarray(m.son_oct),
+                valid_cell=jnp.asarray(valid_cell),
+            )
+
+    def _ic_state(self, lvl: int) -> jnp.ndarray:
+        """Analytic conservative ICs on this level's (padded) cells."""
+        m = self.maps[lvl]
+        centers = self.tree.cell_centers(lvl, self.boxlen)
+        x = [centers[:, d] for d in range(self.cfg.ndim)]
+        q = regions.region_condinit(x, self.dx(lvl), self.params, self.cfg)
+        u = regions.prim_to_cons(q, self.cfg)          # [nvar, ncell]
+        out = np.zeros((m.ncell_pad, self.cfg.nvar))
+        out[:u.shape[1]] = u.T
+        out[u.shape[1]:, 0] = self.cfg.smallr
+        out[u.shape[1]:, self.cfg.ndim + 1] = self.cfg.smalle * self.cfg.smallr
+        return jnp.asarray(out, dtype=self.dtype)
+
+    def _alloc_from_ics(self):
+        self.u: Dict[int, jnp.ndarray] = {}
+        for l in self.levels():
+            self.u[l] = self._ic_state(l)
+        self._restrict_all()
+
+    def _init_refine(self):
+        """Iterative initial mesh build (``amr/init_refine.f90:5-154``):
+        apply analytic ICs, flag, rebuild, repeat until stable."""
+        self.tree = Octree.base(self.tree_ndim, self.lmin, self.lmax)
+        self._rebuild_maps()
+        self._alloc_from_ics()
+        for _ in range(self.lmax - self.lmin + 2):
+            newtree = self._flag_and_tree()
+            same = True
+            for l in range(self.lmin, self.lmax + 1):
+                if newtree.has(l) != self.tree.has(l):
+                    same = False
+                elif newtree.has(l) and not np.array_equal(
+                        newtree.levels[l].keys, self.tree.levels[l].keys):
+                    same = False
+            if same:
+                break
+            self.tree = newtree
+            self._rebuild_maps()
+            self._alloc_from_ics()
+
+    @property
+    def tree_ndim(self) -> int:
+        return self.params.ndim
+
+    def levels(self):
+        return [l for l in range(self.lmin, self.lmax + 1)
+                if self.tree.has(l)]
+
+    # ------------------------------------------------------------------
+    # refinement
+    # ------------------------------------------------------------------
+    def _flag_and_tree(self) -> Octree:
+        r = self.params.refine
+        crit: Dict[int, np.ndarray] = {}
+        for l in self.levels():
+            d = self.dev[l]
+            m = self.maps[l]
+            interp = self._interp_for(l)
+            fl = K.refine_flags(
+                self.u[l], interp, d["stencil_src"], d["vsgn"],
+                (float(r.err_grad_d), float(r.err_grad_u),
+                 float(r.err_grad_p)),
+                (float(r.floor_d), float(r.floor_u), float(r.floor_p)),
+                self.cfg)
+            fl = np.asarray(fl)[:m.noct].reshape(-1)   # flat-cell order
+            geo = flagmod.geometry_flags(
+                self.tree.cell_centers(l, self.boxlen), l, self.params)
+            crit[l] = fl | geo
+        return flagmod.compute_new_tree(self.tree, crit, self.bc_kinds,
+                                        self.params)
+
+    def regrid(self):
+        """Flag, rebuild the tree, and migrate device state
+        (``flag_fine`` + ``refine_fine``/``kill_grid``,
+        ``amr/refine_utils.f90:332,953``)."""
+        if self.lmax == self.lmin:
+            return
+        newtree = self._flag_and_tree()
+        old_u = self.u
+        oldtree = self.tree
+        self.tree = newtree
+        self._rebuild_maps()
+        twotondim = 2 ** self.cfg.ndim
+        offs = cell_offsets(self.cfg.ndim)
+        new_u: Dict[int, jnp.ndarray] = {}
+        for l in self.levels():
+            m = self.maps[l]
+            if l == self.lmin:
+                # base level is identical (complete, same sorted order)
+                new_u[l] = old_u[l]
+                continue
+            cd, cs, new_octs, f_cell, nb = mapmod.build_prolong_maps(
+                self.tree, oldtree, l, self.bc_kinds)
+            buf = np.zeros((m.ncell_pad, self.cfg.nvar), dtype=np.float32)
+            u_new = jnp.asarray(buf, dtype=self.dtype)
+            if len(cd):
+                rows_d = (cd[:, None] * twotondim
+                          + np.arange(twotondim)[None, :]).reshape(-1)
+                rows_s = (cs[:, None] * twotondim
+                          + np.arange(twotondim)[None, :]).reshape(-1)
+                u_new = u_new.at[jnp.asarray(rows_d)].set(
+                    old_u[l][jnp.asarray(rows_s)])
+            if len(new_octs):
+                # one interpolation request per (new oct, child cell)
+                nn = len(new_octs)
+                sgn = (offs * 2 - 1).astype(np.float64)  # [2^d, ndim]
+                cell_rep = np.repeat(f_cell, twotondim)
+                nb_rep = np.repeat(nb, twotondim, axis=0)
+                sgn_rep = np.tile(sgn, (nn, 1))
+                vals = K.interp_cells(
+                    new_u[l - 1], jnp.asarray(cell_rep),
+                    jnp.asarray(nb_rep),
+                    jnp.asarray(sgn_rep, dtype=self.dtype), self.cfg,
+                    itype=int(self.params.refine.interpol_type))
+                rows = (new_octs[:, None] * twotondim
+                        + np.arange(twotondim)[None, :]).reshape(-1)
+                u_new = u_new.at[jnp.asarray(rows)].set(
+                    vals.astype(self.dtype))
+            new_u[l] = u_new
+        self.u = new_u
+        self._restrict_all()
+
+    def _restrict_all(self):
+        """Restriction sweep fine→coarse so non-leaf cells hold son means."""
+        for l in sorted(self.levels(), reverse=True):
+            if self.tree.has(l + 1):
+                d = self.dev[l]
+                self.u[l] = K.restrict_upload(self.u[l], self.u[l + 1],
+                                              d["ref_cell"], d["son_oct"],
+                                              self.cfg)
+
+    # ------------------------------------------------------------------
+    # time stepping
+    # ------------------------------------------------------------------
+    def _interp_for(self, l: int) -> jnp.ndarray:
+        d = self.dev[l]
+        if l == self.lmin:
+            return jnp.zeros((self.maps[l].ni_pad, self.cfg.nvar),
+                             self.dtype)
+        return K.interp_cells(self.u[l - 1], d["interp_cell"],
+                              d["interp_nb"], d["interp_sgn"], self.cfg,
+                              itype=int(self.params.refine.interpol_type))
+
+    def coarse_dt(self) -> float:
+        dts = []
+        for l in self.levels():
+            d = self.dev[l]
+            dt_l = K.level_courant(self.u[l], d["valid_cell"], self.dx(l),
+                                   self.cfg)
+            dts.append(float(dt_l) * (2 ** (l - self.lmin)))
+        return min(dts)
+
+    def step_coarse(self, dt: float):
+        self.unew: Dict[int, jnp.ndarray] = {}
+        self._advance(self.lmin, float(dt))
+        self.t += float(dt)
+        self.nstep += 1
+
+    def _advance(self, l: int, dt: float):
+        self.unew[l] = self.u[l]                       # set_unew
+        if self.tree.has(l + 1):
+            self._advance(l + 1, 0.5 * dt)             # subcycle ×2
+            self._advance(l + 1, 0.5 * dt)
+        d = self.dev[l]
+        interp = self._interp_for(l)
+        du, corr = K.level_sweep(
+            self.u[l], interp, d["stencil_src"], d["vsgn"], d["ok_ref"],
+            None, jnp.asarray(dt, self.dtype), self.dx(l), self.cfg)
+        self.unew[l] = self.unew[l] + du
+        if l > self.lmin:
+            self.unew[l - 1] = K.scatter_corrections(
+                self.unew[l - 1], corr, d["corr_idx"], self.cfg)
+        self.u[l] = self.unew[l]                       # set_uold
+        if self.tree.has(l + 1):
+            self.u[l] = K.restrict_upload(self.u[l], self.u[l + 1],
+                                          d["ref_cell"], d["son_oct"],
+                                          self.cfg)
+
+    def evolve(self, tend: float, nstepmax: int = 10 ** 9,
+               verbose: bool = False):
+        while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
+            if self.regrid_interval and \
+                    self.nstep % self.regrid_interval == 0:
+                self.regrid()
+            dt = min(self.coarse_dt(), tend - self.t)
+            self.step_coarse(dt)
+            if verbose:
+                print(f"step {self.nstep} t={self.t:.5e} dt={dt:.3e} "
+                      f"octs={[self.tree.noct(l) for l in self.levels()]}")
+
+    # ------------------------------------------------------------------
+    # diagnostics
+    # ------------------------------------------------------------------
+    def totals(self):
+        """Conservation audit over leaf cells (``check_cons``)."""
+        cfg = self.cfg
+        tot = np.zeros(cfg.nvar)
+        for l in self.levels():
+            m = self.maps[l]
+            vol = self.dx(l) ** cfg.ndim
+            u = np.asarray(self.u[l])[:m.noct * 2 ** cfg.ndim]
+            leaf = ~self.tree.refined_mask(l)
+            tot += u[leaf].sum(axis=0) * vol
+        return tot
+
+    def leaf_sample(self, lvl: int):
+        """(centers [n, ndim], u [n, nvar]) of leaf cells at one level."""
+        m = self.maps[lvl]
+        u = np.asarray(self.u[lvl])[:m.noct * 2 ** self.cfg.ndim]
+        leaf = ~self.tree.refined_mask(lvl)
+        return self.tree.cell_centers(lvl, self.boxlen)[leaf], u[leaf]
+
+    def ncell_leaf(self) -> int:
+        return sum(int((~self.tree.refined_mask(l)).sum())
+                   for l in self.levels())

@@ -1,0 +1,276 @@
+"""Per-level gather/scatter index maps (host, numpy).
+
+These are the TPU equivalents of the reference's per-step tree walks: the
+6^ndim stencil gather of ``godfine1`` (``hydro/godunov_fine.f90:553-676``),
+the buffer-cell interpolation requests (``:583-593``), the coarse-level
+flux-correction targets (``nbor(ind_grid, 2*idim-1/2)``, ``:795-910``), and
+the leaf→father restriction of ``upload_fine`` (``hydro/interpol_hydro.f90:5``).
+Where the reference re-walks the tree for every nvector batch every step,
+we materialize int32 index maps once per regrid (the ``build_comm``
+amortization pattern, ``amr/virtual_boundaries.f90:1286``) and the per-step
+work becomes pure XLA gathers/scatter-adds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ramses_tpu.amr import keys as kmod
+from ramses_tpu.amr.tree import Octree, cell_offsets, map_coords
+
+
+def bucket(n: int, lo: int = 16) -> int:
+    """Pad count to power-of-2 buckets to bound jit recompiles
+    (SURVEY.md §7 hard part 2)."""
+    if n <= lo:
+        return lo
+    return 1 << int(np.ceil(np.log2(n)))
+
+
+@dataclass
+class LevelMaps:
+    """All index maps of one level (numpy; hierarchy moves them to device)."""
+    lvl: int
+    noct: int
+    noct_pad: int
+    ni: int
+    ni_pad: int
+    # gather: src row for each stencil cell, into
+    # concat(cells [ncell_pad], interp [ni_pad], trash [1])
+    stencil_src: np.ndarray          # [noct_pad, 6^d] int32
+    vsgn: Optional[np.ndarray]       # [noct_pad, 6^d] uint8 bitmask, or None
+    ok_ref: np.ndarray               # [noct_pad, 6^d] bool: cell refined
+    # interpolation requests (absent at levelmin: ni=0)
+    interp_cell: np.ndarray          # [ni_pad] int32 flat cell idx at lvl-1
+    interp_nb: np.ndarray            # [ni_pad, ndim, 2] int32 (left,right)
+    interp_sgn: np.ndarray           # [ni_pad, ndim] int8 (±1 child offset)
+    # coarse flux-correction targets (absent at levelmin)
+    corr_idx: np.ndarray             # [noct_pad, ndim, 2] int32, -1 invalid
+    # restriction (upload_fine) from lvl+1 into this level
+    nref: int
+    nref_pad: int
+    ref_cell: np.ndarray             # [nref_pad] int32 flat cell idx, -1 pad
+    son_oct: np.ndarray              # [nref_pad] int32 oct idx at lvl+1
+    valid_oct: np.ndarray            # [noct_pad] bool
+
+    @property
+    def ndim(self) -> int:
+        return self.interp_sgn.shape[1]
+
+    @property
+    def ncell_pad(self) -> int:
+        return self.noct_pad * 2 ** self.ndim
+
+
+def stencil_offsets(ndim: int) -> np.ndarray:
+    """[6^ndim, ndim] stencil offsets in row-major order, range 0..5
+    (stencil cell coords = 2*og - 2 + offset)."""
+    return np.indices((6,) * ndim).reshape(ndim, -1).T.astype(np.int64)
+
+
+def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
+                     noct_pad: Optional[int] = None) -> LevelMaps:
+    ndim = tree.ndim
+    twotondim = 1 << ndim
+    lev = tree.levels[lvl]
+    noct = lev.noct
+    noct_pad = noct_pad or bucket(noct)
+    ncell_pad = noct_pad * twotondim
+    soff = stencil_offsets(ndim)                       # [6^d, ndim]
+    ns = len(soff)
+
+    # --- stencil cell coords, BC-mapped ---
+    fc = (2 * lev.og[:, None, :] - 2 + soff[None, :, :]).reshape(-1, ndim)
+    mapped, refl = map_coords(fc, lvl, bc_kinds, ndim)
+    oc = mapped >> 1
+    off = np.zeros(len(mapped), dtype=np.int64)
+    for d in range(ndim):
+        off = off * 2 + (mapped[:, d] & 1)
+    oct_idx = tree.lookup(lvl, oc)
+    exists = oct_idx >= 0
+
+    # refined flag (``ok`` of godfine1): does the stencil cell have a son?
+    if tree.has(lvl + 1):
+        ok = tree.lookup(lvl + 1, mapped) >= 0
+        ok &= exists
+    else:
+        ok = np.zeros(len(mapped), dtype=bool)
+
+    # --- interpolation requests for missing stencil cells ---
+    miss = ~exists
+    if lvl > tree.levelmin and miss.any():
+        miss_keys = kmod.encode(mapped[miss], ndim)
+        uniq_keys, inv = np.unique(miss_keys, return_inverse=True)
+        ucoords = kmod.decode(uniq_keys, ndim)         # fine cell coords
+        ni = len(uniq_keys)
+        ccoarse = ucoords >> 1                         # cell coords at lvl-1
+        f_oct = tree.lookup(lvl - 1, ccoarse >> 1)
+        if (f_oct < 0).any():
+            raise RuntimeError(
+                f"2:1 gradedness violated at level {lvl}: "
+                f"{int((f_oct < 0).sum())} missing father octs")
+        f_off = np.zeros(ni, dtype=np.int64)
+        for d in range(ndim):
+            f_off = f_off * 2 + (ccoarse[:, d] & 1)
+        interp_cell = (f_oct * twotondim + f_off).astype(np.int32)
+        interp_sgn = ((ucoords & 1) * 2 - 1).astype(np.int8)
+        interp_nb = np.empty((ni, ndim, 2), dtype=np.int32)
+        for d in range(ndim):
+            for side, s in ((0, -1), (1, +1)):
+                nc = ccoarse.copy()
+                nc[:, d] += s
+                ncm, nrefl = map_coords(nc, lvl - 1, bc_kinds, ndim)
+                n_oct = tree.lookup(lvl - 1, ncm >> 1)
+                n_off = np.zeros(ni, dtype=np.int64)
+                for d2 in range(ndim):
+                    n_off = n_off * 2 + (ncm[:, d2] & 1)
+                flat = n_oct * twotondim + n_off
+                # neighbour absent at lvl-1 (grade transition) or mirrored:
+                # fall back to the centre cell (zero slope contribution) —
+                # the reference walks up the tree instead
+                # (amr/nbors_utils.f90:404); this degrades to 1st order
+                # locally, which the minmod limiter tolerates.
+                bad = (n_oct < 0) | nrefl.any(axis=1)
+                interp_nb[:, d, side] = np.where(bad, interp_cell,
+                                                 flat).astype(np.int32)
+    else:
+        ni = 0
+        inv = None
+        interp_cell = np.zeros(0, dtype=np.int32)
+        interp_sgn = np.zeros((0, ndim), dtype=np.int8)
+        interp_nb = np.zeros((0, ndim, 2), dtype=np.int32)
+
+    ni_pad = bucket(ni, 8) if ni > 0 else 8
+    trash = ncell_pad + ni_pad
+
+    src = np.full(len(mapped), trash, dtype=np.int64)
+    src[exists] = oct_idx[exists] * twotondim + off[exists]
+    if ni > 0:
+        src[miss] = ncell_pad + inv
+
+    stencil_src = np.full((noct_pad, ns), trash, dtype=np.int32)
+    stencil_src[:noct] = src.reshape(noct, ns).astype(np.int32)
+    ok_ref = np.zeros((noct_pad, ns), dtype=bool)
+    ok_ref[:noct] = ok.reshape(noct, ns)
+
+    # velocity sign-flip bitmask for reflecting boundaries
+    if refl.any():
+        bits = np.zeros(len(mapped), dtype=np.uint8)
+        for d in range(ndim):
+            bits |= (refl[:, d].astype(np.uint8) << d)
+        vsgn = np.zeros((noct_pad, ns), dtype=np.uint8)
+        vsgn[:noct] = bits.reshape(noct, ns)
+    else:
+        vsgn = None
+
+    # pad interp arrays
+    def _pad(a, n, fill=0):
+        out = np.full((n,) + a.shape[1:], fill, dtype=a.dtype)
+        out[:len(a)] = a
+        return out
+    interp_cell = _pad(interp_cell, ni_pad)
+    interp_nb = _pad(interp_nb, ni_pad)
+    interp_sgn = _pad(interp_sgn, ni_pad, 1)
+
+    # --- coarse flux-correction targets ---
+    corr_idx = np.full((noct_pad, ndim, 2), -1, dtype=np.int32)
+    if lvl > tree.levelmin:
+        for d in range(ndim):
+            for side, s in ((0, -1), (1, +1)):
+                nc = lev.og.copy()                     # father cell coords
+                nc[:, d] += s
+                inb = nc[:, d]
+                in_domain = np.ones(noct, dtype=bool)
+                lo, hi = bc_kinds[d]
+                n_l1 = 1 << (lvl - 1)
+                if lo == 0 and hi == 0:
+                    nc[:, d] = np.mod(inb, n_l1)
+                else:
+                    # non-periodic: out-of-domain faces get no correction
+                    in_domain = (inb >= 0) & (inb < n_l1)
+                    nc[:, d] = np.clip(inb, 0, n_l1 - 1)
+                # target must be a coarse leaf: no oct at lvl covering it
+                covered = tree.lookup(lvl, nc) >= 0
+                f_oct = tree.lookup(lvl - 1, nc >> 1)
+                f_off = np.zeros(noct, dtype=np.int64)
+                for d2 in range(ndim):
+                    f_off = f_off * 2 + (nc[:, d2] & 1)
+                flat = f_oct * twotondim + f_off
+                valid = in_domain & ~covered & (f_oct >= 0)
+                corr_idx[:noct, d, side] = np.where(valid, flat,
+                                                    -1).astype(np.int32)
+
+    # --- restriction map (upload_fine at this level) ---
+    if tree.has(lvl + 1):
+        rmask = tree.refined_mask(lvl)
+        ref_idx = np.nonzero(rmask)[0]
+        son = tree.lookup(lvl + 1, tree.cell_coords(lvl)[ref_idx])
+        nref = len(ref_idx)
+        nref_pad = bucket(nref, 8)
+        ref_cell = _pad(ref_idx.astype(np.int32), nref_pad, -1)
+        son_oct = _pad(son.astype(np.int32), nref_pad)
+    else:
+        nref, nref_pad = 0, 8
+        ref_cell = np.full(nref_pad, -1, dtype=np.int32)
+        son_oct = np.zeros(nref_pad, dtype=np.int32)
+
+    valid_oct = np.zeros(noct_pad, dtype=bool)
+    valid_oct[:noct] = True
+
+    return LevelMaps(lvl=lvl, noct=noct, noct_pad=noct_pad, ni=ni,
+                     ni_pad=ni_pad, stencil_src=stencil_src, vsgn=vsgn,
+                     ok_ref=ok_ref, interp_cell=interp_cell,
+                     interp_nb=interp_nb, interp_sgn=interp_sgn,
+                     corr_idx=corr_idx, nref=nref, nref_pad=nref_pad,
+                     ref_cell=ref_cell, son_oct=son_oct,
+                     valid_oct=valid_oct)
+
+
+def build_prolong_maps(tree_new: Octree, tree_old: Octree, lvl: int,
+                       bc_kinds: List[tuple]
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray, np.ndarray]:
+    """Maps to fill level ``lvl`` of the new tree from old data.
+
+    Returns (copy_dst, copy_src, new_father_cell, new_nb, new_sgn):
+      * copy_dst/copy_src: oct indices new←old for octs that survived;
+      * for brand-new octs: father-cell interpolation request against the
+        NEW lvl-1 state (``make_grid_fine``, ``amr/refine_utils.f90:590``),
+        one request per (new oct, child cell) in flat-cell order.
+    """
+    ndim = tree_new.ndim
+    twotondim = 1 << ndim
+    newlev = tree_new.levels[lvl]
+    old_idx = tree_old.lookup_keys(lvl, newlev.keys) if tree_old.has(lvl) \
+        else np.full(newlev.noct, -1, dtype=np.int64)
+    kept = old_idx >= 0
+    copy_dst = np.nonzero(kept)[0].astype(np.int32)
+    copy_src = old_idx[kept].astype(np.int32)
+
+    new_octs = np.nonzero(~kept)[0]
+    nnew = len(new_octs)
+    father = newlev.og[new_octs]                       # cell coords at lvl-1
+    f_oct = tree_new.lookup(lvl - 1, father >> 1)
+    if nnew and (f_oct < 0).any():
+        raise RuntimeError("prolongation: father oct missing")
+    f_off = np.zeros(nnew, dtype=np.int64)
+    for d in range(ndim):
+        f_off = f_off * 2 + (father[:, d] & 1)
+    f_cell = (f_oct * twotondim + f_off).astype(np.int32)
+    nb = np.empty((nnew, ndim, 2), dtype=np.int32)
+    for d in range(ndim):
+        for side, s in ((0, -1), (1, +1)):
+            nc = father.copy()
+            nc[:, d] += s
+            ncm, nrefl = map_coords(nc, lvl - 1, bc_kinds, ndim)
+            n_oct = tree_new.lookup(lvl - 1, ncm >> 1)
+            n_off = np.zeros(nnew, dtype=np.int64)
+            for d2 in range(ndim):
+                n_off = n_off * 2 + (ncm[:, d2] & 1)
+            bad = (n_oct < 0) | nrefl.any(axis=1)
+            nb[:, d, side] = np.where(
+                bad, f_cell, n_oct * twotondim + n_off).astype(np.int32)
+    return copy_dst, copy_src, new_octs.astype(np.int32), f_cell, nb

@@ -83,10 +83,14 @@ class Simulation:
                               "Poisson problem; non-periodic boundaries see "
                               "periodic mass images (isolated-BC solve TBD).")
             # initial force so the first -0.5dt "un-kick" cancels exactly
-            # (the reference's nstep==0 save_phi_old, amr/amr_step.f90:260)
+            # (the reference's nstep==0 save_phi_old, amr/amr_step.f90:260);
+            # cosmology solves with the supercomoving source coefficient
+            # 1.5*omega_m*aexp, not 4pi
             rho0 = total_density(self.pspec, self.state.u, self.state.p,
                                  shape, self.dx)
-            self.state.f = gravity_field(self.gspec, rho0, self.dx)
+            fourpi0 = (1.5 * self.cosmo.omega_m * self.cosmo.aexp_ini
+                       if self.cosmo is not None else None)
+            self.state.f = gravity_field(self.gspec, rho0, self.dx, fourpi0)
         elif self.pspec.enabled or self.cosmo is not None:
             self.state.f = jnp.zeros((params.ndim,) + shape, jnp.float64)
         if self.cosmo is not None:

@@ -13,8 +13,8 @@ import jax.numpy as jnp
 from ramses_tpu.hydro.core import HydroStatic
 
 
-def compute_dt(u, grav, dx: float, cfg: HydroStatic):
-    """Max allowed dt over a (sub)grid of conservative states.
+def cell_dt(u, grav, dx: float, cfg: HydroStatic):
+    """Per-cell Courant-limited dt (shape = spatial shape of ``u``).
 
     ``u``: [nvar, *sp]; ``grav``: list of ndim accel arrays or None;
     ``dx``: cell size (scalar — cubic cells, as the reference assumes).
@@ -44,6 +44,11 @@ def compute_dt(u, grav, dx: float, cfg: HydroStatic):
     ratio = jnp.maximum(gnorm * dx / ws ** 2, 1e-4)
 
     cf = cfg.courant_factor
-    dtcell = dx / ws * (jnp.sqrt(1.0 + 2.0 * cf * ratio) - 1.0) / ratio
-    dtmax = cf * dx / cfg.smallc
-    return jnp.minimum(dtmax, jnp.min(dtcell))
+    return dx / ws * (jnp.sqrt(1.0 + 2.0 * cf * ratio) - 1.0) / ratio
+
+
+def compute_dt(u, grav, dx: float, cfg: HydroStatic):
+    """Max allowed dt over a (sub)grid: min of :func:`cell_dt`, capped by
+    the reference's ``dtmax`` guard."""
+    dtmax = cfg.courant_factor * dx / cfg.smallc
+    return jnp.minimum(dtmax, jnp.min(cell_dt(u, grav, dx, cfg)))

@@ -43,6 +43,7 @@ class ShardedSim:
         self.cosmo = self.inner.cosmo
         self.f = (jax.device_put(self.inner.state.f, self.sharding)
                   if self.inner.state.f is not None else None)
+        self.inner.state.f = None  # likewise
         self.t = float(self.inner.state.t)
         self.dt_old = 0.0
         self.nstep = 0

@@ -67,6 +67,15 @@ def deposit(spec: PMSpec, p: pmod.ParticleSet, shape, dx: float):
     return fn(p, shape, dx)
 
 
+def gather(spec: PMSpec, field, x, dx: float):
+    """Force interpolation with the SAME kernel as deposition — mismatched
+    pairs produce particle self-forces (the reference ties both to
+    ``interp_mode``, ``pm/move_fine.f90:255``)."""
+    fn = {"cic": pmod.gather_cic, "ngp": pmod.gather_ngp,
+          "tsc": pmod.gather_tsc}[spec.deposit]
+    return fn(field, x, dx)
+
+
 def total_density(spec: PMSpec, u, p: Optional[pmod.ParticleSet],
                   shape, dx: float):
     """``rho_fine``: gas density + particle deposition."""
@@ -98,7 +107,7 @@ def pm_hydro_step(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
          else jnp.zeros_like(f_old))
     # 4. complete previous particle kick with new force at x^n
     if particles:
-        f_at_p = pmod.gather_cic(f, p.x, grid.dx)
+        f_at_p = gather(pspec, f, p.x, grid.dx)
         p = pmod.kick(p, f_at_p, 0.5 * dt_old)
     # 5. hydro with gravity predictor
     if pspec.hydro:
@@ -135,6 +144,10 @@ def pm_compute_dt(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
             rho = total_density(pspec, u, p, grid.shape, grid.dx)
         fp = gspec.fourpi if fourpi is None else fourpi
         dts.append(pmod.freefall_dt(jnp.max(rho), pspec.courant_factor, fp))
+    if not dts:
+        # nothing constrains dt (e.g. cosmo-only run): expansion cap below,
+        # else a fixed fallback
+        dts.append(jnp.asarray(1e30))
     dt = dts[0]
     for d in dts[1:]:
         dt = jnp.minimum(dt, d)

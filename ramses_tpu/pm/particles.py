@@ -149,20 +149,38 @@ def gather_cic(field, x, dx: float):
     Returns [n, ncomp] (``move_fine`` force interpolation,
     ``pm/move_fine.f90:255-510``)."""
     shape = field.shape[1:]
-    ndim = x.shape[1]
     out = jnp.zeros((x.shape[0], field.shape[0]), field.dtype)
+    for idx, w in _cic_corners(x, shape, dx):
+        vals = field[(slice(None),) + idx]           # [ncomp, n]
+        out = out + (vals * w).T
+    return out
+
+
+def gather_ngp(field, x, dx: float):
+    """NGP field sampling, the pair of :func:`deposit_ngp`."""
+    shape = field.shape[1:]
+    ndim = x.shape[1]
+    i = jnp.floor(x / dx).astype(jnp.int32)
+    idx = tuple(i[:, d] % shape[d] for d in range(ndim))
+    return field[(slice(None),) + idx].T
+
+
+def gather_tsc(field, x, dx: float):
+    """TSC field sampling, the pair of :func:`deposit_tsc`."""
+    import itertools
+    shape = field.shape[1:]
+    ndim = x.shape[1]
     s = x / dx - 0.5
-    i0 = jnp.floor(s)
-    frac = s - i0
-    i0 = i0.astype(jnp.int32)
-    for bits in range(2 ** ndim):
+    ic = jnp.round(s).astype(jnp.int32)
+    t = s - ic
+    wd = [_tsc_w(t[:, d]) for d in range(ndim)]
+    out = jnp.zeros((x.shape[0], field.shape[0]), field.dtype)
+    for offs in itertools.product((-1, 0, 1), repeat=ndim):
         idx, w = [], None
-        for d in range(ndim):
-            b = (bits >> d) & 1
-            idx.append((i0[:, d] + b) % shape[d])
-            wd = frac[:, d] if b else (1.0 - frac[:, d])
-            w = wd if w is None else w * wd
-        vals = field[(slice(None),) + tuple(idx)]    # [ncomp, n]
+        for d, o in enumerate(offs):
+            idx.append((ic[:, d] + o) % shape[d])
+            w = wd[d][o + 1] if w is None else w * wd[d][o + 1]
+        vals = field[(slice(None),) + tuple(idx)]
         out = out + (vals * w).T
     return out
 
