@@ -1,0 +1,53 @@
+"""Command-line entry point: ``python -m ramses_tpu run.nml``.
+
+The ``program ramses`` equivalent (``amr/ramses.f90:1-15``): parse the
+namelist given as first argument, run the adaptive loop, write snapshots
+at the configured output times.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ramses_tpu",
+        description="TPU-native AMR astrophysics framework")
+    ap.add_argument("namelist", help="Fortran-namelist runtime config")
+    ap.add_argument("--ndim", type=int, default=3,
+                    help="spatial dimensions (compile-time in the reference)")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "float64", "bfloat16"])
+    ap.add_argument("--amr", action="store_true",
+                    help="force the multi-level AMR driver even when "
+                         "levelmin==levelmax")
+    ap.add_argument("--verbose", "-v", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from ramses_tpu.config import load_params
+
+    dtype = getattr(jnp, args.dtype)
+    params = load_params(args.namelist, ndim=args.ndim)
+
+    if args.amr or params.amr.levelmax > params.amr.levelmin:
+        from ramses_tpu.amr.hierarchy import AmrSim
+        sim = AmrSim(params, dtype=dtype)
+        tend = (params.output.tout[-1] if params.output.tout
+                else params.output.tend)
+        sim.evolve(tend, nstepmax=params.run.nstepmax, verbose=args.verbose)
+        sim.dump(1, params.output.output_dir, namelist_path=args.namelist)
+    else:
+        from ramses_tpu.driver import Simulation
+        sim = Simulation(params, dtype=dtype)
+        sim.on_output = lambda s, i: s.dump(
+            i, namelist_path=args.namelist)
+        sim.evolve(verbose=args.verbose)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

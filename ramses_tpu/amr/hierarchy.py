@@ -301,3 +301,42 @@ class AmrSim:
     def ncell_leaf(self) -> int:
         return sum(int((~self.tree.refined_mask(l)).sum())
                    for l in self.levels())
+
+    # ------------------------------------------------------------------
+    # snapshot / restart (SURVEY.md §3.4, §5.4)
+    # ------------------------------------------------------------------
+    def dump(self, iout: int = 1, base_dir: str = ".",
+             namelist_path: Optional[str] = None) -> str:
+        """Write a reference-format ``output_NNNNN/`` snapshot."""
+        from ramses_tpu.io import snapshot as snapmod
+        snap = snapmod.snapshot_from_amr(self, iout)
+        return snapmod.dump_all(snap, iout, base_dir,
+                                namelist_path=namelist_path)
+
+    @classmethod
+    def from_snapshot(cls, params: Params, outdir: str,
+                      dtype=jnp.float32) -> "AmrSim":
+        """Resume from a snapshot directory (``nrestart`` path)."""
+        from ramses_tpu.io.restart import restore_tree_state
+        cfg = HydroStatic.from_params(params)
+        tree_og, u_lv, meta, _parts = restore_tree_state(
+            outdir, cfg, params.amr.levelmin)
+        tree = Octree(params.ndim, params.amr.levelmin, params.amr.levelmax)
+        for l, og in tree_og.items():
+            tree.set_level(l, og)
+        sim = cls(params, dtype=dtype, init_tree=tree)
+        for l, u in u_lv.items():
+            # restored rows follow file order == our sorted-key order, but
+            # re-map defensively through the rebuilt tree's key order
+            og = tree_og[l]
+            pos = tree.lookup(l, og)
+            m = sim.maps[l]
+            ttd = 2 ** cfg.ndim
+            out = np.array(sim.u[l])
+            cells = u.reshape(len(og), ttd, cfg.nvar)
+            out[:m.noct * ttd] = cells[np.argsort(pos)].reshape(-1, cfg.nvar)
+            sim.u[l] = jnp.asarray(out, dtype=dtype)
+        sim._restrict_all()
+        sim.t = float(meta["t"])
+        sim.nstep = int(meta["nstep"])
+        return sim

@@ -163,6 +163,43 @@ class Simulation:
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
 
+    # ------------------------------------------------------------------
+    # snapshot / restart (SURVEY.md §3.4, §5.4)
+    # ------------------------------------------------------------------
+    def dump(self, iout: Optional[int] = None, base_dir: Optional[str] = None,
+             namelist_path: Optional[str] = None) -> str:
+        """Write a reference-format ``output_NNNNN/`` snapshot."""
+        from ramses_tpu.io import snapshot as snapmod
+        iout = iout if iout is not None else self.state.iout
+        snap = snapmod.snapshot_from_uniform(self, iout)
+        return snapmod.dump_all(snap, iout,
+                                base_dir or self.params.output.output_dir,
+                                namelist_path=namelist_path)
+
+    @classmethod
+    def from_snapshot(cls, params: Params, outdir: str,
+                      dtype=jnp.float32) -> "Simulation":
+        """Resume from a snapshot directory (``nrestart`` path)."""
+        from ramses_tpu.io.restart import restore_particles, restore_uniform
+        cfg = HydroStatic.from_params(params)
+        dense, meta, parts = restore_uniform(outdir, params, cfg)
+        p = restore_particles(parts, params.ndim) if parts else None
+        sim = cls(params, dtype=dtype, particles=p)
+        sim.state.u = jnp.asarray(dense, dtype=dtype)
+        sim.state.t = float(meta["t"])
+        sim.state.nstep = int(meta["nstep"])
+        sim.state.iout = max(int(meta["iout"]), 1) + 1
+        if sim.gspec.enabled:
+            rho = total_density(sim.pspec, sim.state.u, sim.state.p,
+                                sim.grid.shape, sim.dx)
+            # supercomoving source uses aexp AT the restored time, not
+            # aexp_ini — restart must continue the original trajectory
+            fourpi = (1.5 * sim.cosmo.omega_m
+                      * float(sim.cosmo.aexp_of_tau(sim.state.t))
+                      if sim.cosmo is not None else None)
+            sim.state.f = gravity_field(sim.gspec, rho, sim.dx, fourpi)
+        return sim
+
 
 def run_namelist(path: str, ndim: int = 3, dtype=jnp.float32,
                  verbose: bool = False) -> Simulation:

@@ -1,0 +1,2 @@
+"""Snapshot / restart I/O in the reference's on-disk format (SURVEY.md §3.4,
+§5.4): Fortran sequential-unformatted record files under ``output_NNNNN/``."""

@@ -1,0 +1,90 @@
+"""Checkpoint-resume: rebuild simulation state from a snapshot directory.
+
+The reference restarts by re-reading its own dump inside ``init_amr`` /
+``init_hydro`` / ``init_part`` (``nrestart>0``, SURVEY.md §5.4).  Here the
+same files restore the host octree, per-level conservative state, and the
+particle set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ramses_tpu.io import reader as rdr
+from ramses_tpu.io.snapshot import prim_out_to_cons, ref_cell_perm
+
+
+def restore_tree_state(outdir: str, cfg, levelmin: int):
+    """(tree_levels, u_levels, meta): per-level oct coords and conservative
+    cell arrays (our x-slowest flat order) for levels >= levelmin."""
+    snap = rdr.load_snapshot(outdir)
+    amr = snap["amr"][0]
+    hyd = snap["hydro"][0]
+    h = amr.header
+    ndim = h["ndim"]
+    perm = ref_cell_perm(ndim)
+    inv = np.argsort(perm)                  # our off → ref ind
+
+    tree_og: Dict[int, np.ndarray] = {}
+    u_lv: Dict[int, np.ndarray] = {}
+    for l, lev in amr.levels.items():
+        if l < levelmin:
+            continue
+        scale = 2.0 ** (l - 1)
+        og = np.rint(lev["xg"] * scale - 0.5).astype(np.int64)
+        tree_og[l] = og
+        vals = hyd["levels"][l]             # [n, 2^d, nvar] ref order
+        ours = vals[:, inv]                 # [n, 2^d] our order
+        q = ours.reshape(-1, vals.shape[2])
+        u_lv[l] = prim_out_to_cons(q, cfg)
+    meta = dict(t=h["t"], nstep=h["nstep"], iout=h["iout"],
+                aexp=h["aexp"], boxlen=h["boxlen"],
+                nlevelmax=h["nlevelmax"], dtold=h["dtold"],
+                dtnew=h["dtnew"], info=snap["info"])
+    parts = None
+    if "part" in snap:
+        parts = snap["part"][0]
+        parts["fields"] = snap["part_fields"]
+    return tree_og, u_lv, meta, parts
+
+
+def restore_particles(parts: dict, ndim: int, nmax: Optional[int] = None):
+    """Rebuild a :class:`ParticleSet` from a read particle file."""
+    from ramses_tpu.pm.particles import ParticleSet
+    if parts is None:
+        return None
+    dims = "xyz"[:ndim]
+    x = np.stack([parts[f"position_{d}"] for d in dims], axis=1)
+    v = np.stack([parts[f"velocity_{d}"] for d in dims], axis=1)
+    return ParticleSet.make(x, v, parts["mass"],
+                            idp=parts["identity"].astype(np.int64),
+                            family=parts["family"], nmax=nmax)
+
+
+def restore_uniform(outdir: str, params, cfg) -> Tuple[np.ndarray, dict,
+                                                       Optional[dict]]:
+    """Dense [nvar, *sp] conservative state for a single-level run."""
+    base = [params.amr.nx, params.amr.ny, params.amr.nz][:cfg.ndim]
+    if any(b != 1 for b in base):
+        raise NotImplementedError(
+            "snapshot restore requires nx=ny=nz=1 (single coarse cell); "
+            f"got {base}")
+    lmin = params.amr.levelmin
+    tree_og, u_lv, meta, parts = restore_tree_state(outdir, cfg, lmin)
+    if lmin not in u_lv:
+        raise ValueError(f"snapshot has no level {lmin} data")
+    from ramses_tpu.amr.tree import cell_offsets
+    og = tree_og[lmin]
+    ndim = cfg.ndim
+    n = 1 << lmin
+    offs = cell_offsets(ndim)
+    cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
+    dense = np.zeros((cfg.nvar,) + (n,) * ndim)
+    u = u_lv[lmin]                          # [ncell, nvar]
+    idx = tuple(cc[:, d] for d in range(ndim))
+    for iv in range(cfg.nvar):
+        dense[iv][idx] = u[:, iv]
+    return dense, meta, parts

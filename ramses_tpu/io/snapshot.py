@@ -1,0 +1,721 @@
+"""Snapshot writer in the reference's on-disk layout.
+
+One call to :func:`dump_all` produces ``output_NNNNN/`` with the same file
+set and record structure as the reference's ``dump_all``
+(``amr/output_amr.f90:5-206``): ``info_*.txt``, ``amr_*.outNNNNN``,
+``hydro_*.outNNNNN``, optional ``grav_*/part_*`` files, ``header_*.txt``
+and the ``*_file_descriptor.txt`` sidecars (``io/dump_utils.f90``).  The
+record sequences follow ``backup_amr`` (``amr/output_amr.f90:268-393``),
+``backup_hydro`` (``hydro/output_hydro.f90:54-160``), ``backup_part``
+(``pm/output_part.f90``) and ``output_info/output_header``
+(``amr/output_amr.f90:411-575``) byte for byte, so the reference's own
+test oracle (``tests/visu/visu_ramses.py:load_snapshot``) parses our
+snapshots unchanged.
+
+The cell-in-oct index convention differs between us (x slowest, numpy
+reshape order) and the reference (x fastest, ``ind=1+ix+2*iy+4*iz``); all
+per-cell records are permuted to reference order on the way out.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ramses_tpu.io import fortran as frt
+from ramses_tpu.units import Units
+
+
+# ----------------------------------------------------------------------
+# cell-order permutation
+# ----------------------------------------------------------------------
+
+def ref_cell_perm(ndim: int) -> np.ndarray:
+    """perm[ind_ref] = our flat cell offset, where ind_ref runs x-fastest
+    (the reference's ``ind_son``) and ours runs x-slowest."""
+    n = 1 << ndim
+    perm = np.zeros(n, dtype=np.int64)
+    for ind in range(n):
+        coords = [(ind >> d) & 1 for d in range(ndim)]   # cx, cy, cz
+        off = 0
+        for d in range(ndim):
+            off += coords[d] << (ndim - 1 - d)
+        perm[ind] = off
+    return perm
+
+
+# ----------------------------------------------------------------------
+# hydro output variables (primitive, hydro/output_hydro.f90:84-146)
+# ----------------------------------------------------------------------
+
+def hydro_var_names(cfg) -> List[str]:
+    dim_keys = ["x", "y", "z"]
+    names = ["density"]
+    names += [f"velocity_{dim_keys[d]}" for d in range(cfg.ndim)]
+    names += [f"non_thermal_energy_{i + cfg.ndim:02d}"
+              for i in range(cfg.nener)]
+    names += ["pressure"]
+    names += [f"scalar_{i:02d}" for i in range(cfg.npassive)]
+    return names
+
+
+def cons_to_prim_out(u: np.ndarray, cfg) -> np.ndarray:
+    """[ncell, nvar] conservative → reference output variables (primitive).
+
+    Mirrors the arithmetic of ``backup_hydro`` exactly: velocity =
+    momentum/max(rho,smallr); non-thermal pressures (gamma_rad-1)*e;
+    thermal pressure from total minus kinetic minus non-thermal; passive
+    scalars per unit mass.
+    """
+    u = np.asarray(u, dtype=np.float64)
+    ndim = cfg.ndim
+    rho = np.maximum(u[:, 0], cfg.smallr)
+    out = np.empty_like(u)
+    out[:, 0] = u[:, 0]
+    ekin = np.zeros_like(rho)
+    for d in range(ndim):
+        out[:, 1 + d] = u[:, 1 + d] / rho
+        ekin += 0.5 * u[:, 1 + d] ** 2 / rho
+    p = u[:, ndim + 1] - ekin
+    for i in range(cfg.nener):
+        e = u[:, ndim + 2 + i]
+        out[:, ndim + 2 + i] = (cfg.gamma_rad[i] - 1.0) * e
+        p = p - e
+    out[:, ndim + 1] = (cfg.gamma - 1.0) * p
+    for i in range(cfg.npassive):
+        j = ndim + 2 + cfg.nener + i
+        out[:, j] = u[:, j] / rho
+    return out
+
+
+def prim_out_to_cons(q: np.ndarray, cfg) -> np.ndarray:
+    """Inverse of :func:`cons_to_prim_out` (the restart read,
+    ``hydro/init_hydro.f90:137+``)."""
+    q = np.asarray(q, dtype=np.float64)
+    ndim = cfg.ndim
+    u = np.empty_like(q)
+    rho = q[:, 0]
+    u[:, 0] = rho
+    ekin = np.zeros_like(rho)
+    for d in range(ndim):
+        u[:, 1 + d] = rho * q[:, 1 + d]
+        ekin += 0.5 * rho * q[:, 1 + d] ** 2
+    etot = q[:, ndim + 1] / (cfg.gamma - 1.0) + ekin
+    for i in range(cfg.nener):
+        e = q[:, ndim + 2 + i] / (cfg.gamma_rad[i] - 1.0)
+        u[:, ndim + 2 + i] = e
+        etot = etot + e
+    u[:, ndim + 1] = etot
+    for i in range(cfg.npassive):
+        j = ndim + 2 + cfg.nener + i
+        u[:, j] = rho * q[:, j]
+    return u
+
+
+# ----------------------------------------------------------------------
+# snapshot tree model
+# ----------------------------------------------------------------------
+
+@dataclass
+class SnapLevel:
+    """One output level: octs in storage order (our sorted-key order)."""
+    og: np.ndarray                      # [noct, ndim] int oct coords
+    son: np.ndarray                     # [noct, 2^d] global son grid ids,
+    #                                     reference ind order, 0 = leaf
+    hydro: np.ndarray                   # [noct, 2^d, nvar_out] float64,
+    #                                     reference ind order
+    grav: Optional[np.ndarray] = None   # [noct, 2^d, ndim+1] phi + forces
+
+    @property
+    def noct(self) -> int:
+        return len(self.og)
+
+
+@dataclass
+class Snapshot:
+    """Everything :func:`dump_all` needs, solver-agnostic."""
+    ndim: int
+    nlevelmax: int                       # declared max (levelmax)
+    levels: Dict[int, SnapLevel]         # 1-based level → data
+    boxlen: float
+    t: float
+    gamma: float
+    var_names: List[str]
+    units: Units
+    levelmin: int = 1
+    nstep: int = 0
+    nstep_coarse: int = 0
+    aexp: float = 1.0
+    cosmo: Tuple[float, ...] = (1.0, 0.0, 0.0, 0.045, 1.0, 1.0, 1.0)
+    # (omega_m, omega_l, omega_k, omega_b, h0, aexp_ini, boxlen_ini)
+    dtold: Optional[np.ndarray] = None
+    dtnew: Optional[np.ndarray] = None
+    tout: Sequence[float] = (0.0,)
+    particles: Optional[dict] = None     # arrays: x,v,m,idp,level,family,tag
+    mstar_tot: float = 0.0
+    mstar_lost: float = 0.0
+
+    def grid_id_base(self) -> Dict[int, int]:
+        base, tot = {}, 0
+        for l in range(1, self.nlevelmax + 1):
+            base[l] = tot
+            tot += self.levels[l].noct if l in self.levels else 0
+        return base
+
+    @property
+    def ngrid_total(self) -> int:
+        return sum(lv.noct for lv in self.levels.values())
+
+
+# ----------------------------------------------------------------------
+# builders
+# ----------------------------------------------------------------------
+
+def _dense_to_level(dense: np.ndarray) -> np.ndarray:
+    """Restrict a dense [*sp, nvar] cell array one level down (2^d mean)."""
+    nd = dense.ndim - 1
+    sl = dense
+    for d in range(nd):
+        sh = sl.shape
+        ns = sh[d] // 2
+        sl = sl.reshape(sh[:d] + (ns, 2) + sh[d + 1:])
+        sl = sl.mean(axis=d + 1)
+    return sl
+
+
+def _full_level_og(lvl: int, ndim: int) -> np.ndarray:
+    """All oct coords of a complete level, Morton-key sorted order."""
+    from ramses_tpu.amr import keys as kmod
+    n = 1 << (lvl - 1)
+    ax = np.arange(n, dtype=np.int64)
+    grids = np.meshgrid(*([ax] * ndim), indexing="ij")
+    og = np.stack([g.ravel() for g in grids], axis=1)
+    ks = kmod.encode(og, ndim)
+    return og[np.argsort(ks, kind="stable")]
+
+
+def _gather_cells_dense(dense: np.ndarray, og: np.ndarray,
+                        perm: np.ndarray) -> np.ndarray:
+    """Per-oct cell values from a dense [*sp, nvar] array, ref ind order."""
+    from ramses_tpu.amr.tree import cell_offsets
+    ndim = og.shape[1]
+    offs = cell_offsets(ndim)                       # our flat order
+    cc = (2 * og[:, None, :] + offs[None, :, :])    # [noct, 2^d, ndim]
+    idx = tuple(cc[..., d] for d in range(ndim))
+    vals = dense[idx]                               # [noct, 2^d, nvar]
+    return vals[:, perm]
+
+
+def snapshot_from_uniform(sim, iout: int = 1) -> Snapshot:
+    """Build a snapshot from a single-level :class:`Simulation`.
+
+    Emits the full scaffold hierarchy 1..levelmin (coarser levels fully
+    refined, values by conservative restriction) so readers that walk the
+    octree see the same structure the reference writes.
+    """
+    from ramses_tpu.units import units as units_fn
+
+    cfg = sim.cfg
+    params = sim.params
+    lmin = params.amr.levelmin
+    ndim = cfg.ndim
+    perm = ref_cell_perm(ndim)
+    base = [params.amr.nx, params.amr.ny, params.amr.nz][:ndim]
+    if any(b != 1 for b in base):
+        raise NotImplementedError(
+            "snapshot output requires nx=ny=nz=1 (single coarse cell); "
+            f"got {base}")
+
+    u = np.asarray(sim.state.u, dtype=np.float64)   # [nvar, *sp]
+    dense = np.moveaxis(u, 0, -1)                   # [*sp, nvar]
+
+    levels: Dict[int, SnapLevel] = {}
+    denses = {lmin: dense}
+    for l in range(lmin - 1, 0, -1):
+        denses[l] = _dense_to_level(denses[l + 1])
+
+    id_base, tot = {}, 0
+    for l in range(1, lmin + 1):
+        id_base[l] = tot
+        tot += (1 << (l - 1)) ** ndim
+
+    grav_dense = None
+    if getattr(sim.state, "f", None) is not None:
+        f = np.asarray(sim.state.f, dtype=np.float64)    # [ndim, *sp]
+        phi = np.asarray(sim.phi, dtype=np.float64)[None] \
+            if hasattr(sim, "phi") and sim.phi is not None \
+            else np.zeros((1,) + f.shape[1:])
+        grav_dense = np.moveaxis(np.concatenate([phi, f], axis=0), 0, -1)
+
+    for l in range(1, lmin + 1):
+        og = _full_level_og(l, ndim)
+        hyd = _gather_cells_dense(cons_to_prim_out(
+            denses[l].reshape(-1, cfg.nvar), cfg).reshape(denses[l].shape),
+            og, perm)
+        if l < lmin:
+            # every cell refined: son id = global id of the oct at l+1
+            # whose coords equal the cell coords
+            from ramses_tpu.amr import keys as kmod
+            from ramses_tpu.amr.tree import cell_offsets
+            offs = cell_offsets(ndim)
+            cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
+            og1 = _full_level_og(l + 1, ndim)
+            ks1 = kmod.encode(og1, ndim)
+            pos = np.searchsorted(ks1, kmod.encode(cc, ndim))
+            son = (id_base[l + 1] + pos + 1).astype(np.int32)
+            son = son.reshape(len(og), -1)[:, perm]
+        else:
+            son = np.zeros((len(og), 1 << ndim), dtype=np.int32)
+        grav = None
+        if grav_dense is not None and l == lmin:
+            grav = _gather_cells_dense(grav_dense, og, perm)
+        elif grav_dense is not None:
+            grav = np.zeros((len(og), 1 << ndim, ndim + 1))
+        levels[l] = SnapLevel(og=og, son=son, hydro=hyd, grav=grav)
+
+    cosmo = getattr(sim, "cosmo", None)
+    aexp = (float(cosmo.aexp_of_tau(sim.state.t))
+            if cosmo is not None else 1.0)
+    un = units_fn(params, cosmo=cosmo, aexp=aexp)
+    snap = Snapshot(
+        ndim=ndim, nlevelmax=max(params.amr.levelmax, lmin), levels=levels,
+        boxlen=float(params.amr.boxlen), t=float(sim.state.t),
+        gamma=cfg.gamma, var_names=hydro_var_names(cfg), units=un,
+        levelmin=lmin, nstep=int(sim.state.nstep),
+        nstep_coarse=int(sim.state.nstep),
+        tout=[params.output.tend or 0.0],
+    )
+    if cosmo is not None:
+        snap.aexp = aexp
+        snap.cosmo = (cosmo.omega_m, cosmo.omega_l, cosmo.omega_k,
+                      cosmo.omega_b, cosmo.h0, cosmo.aexp_ini,
+                      cosmo.boxlen_ini)
+    if sim.state.p is not None:
+        snap.particles = particles_dict(sim.state.p)
+    return snap
+
+
+def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
+    """Build a snapshot from an :class:`AmrSim` (host octree + levels)."""
+    from ramses_tpu.amr import keys as kmod
+    from ramses_tpu.amr.tree import cell_offsets
+    from ramses_tpu.units import units as units_fn
+
+    cfg = sim.cfg
+    params = sim.params
+    ndim = cfg.ndim
+    lmin, lmax = sim.lmin, sim.lmax
+    perm = ref_cell_perm(ndim)
+    offs = cell_offsets(ndim)
+    tree = sim.tree
+
+    # per-level oct sets: scaffold 1..lmin-1 complete, lmin..finest real
+    og_of: Dict[int, np.ndarray] = {}
+    for l in range(1, lmin):
+        og_of[l] = _full_level_og(l, ndim)
+    for l in range(lmin, lmax + 1):
+        if tree.has(l):
+            og_of[l] = tree.levels[l].og
+
+    id_base, tot = {}, 0
+    for l in sorted(og_of):
+        id_base[l] = tot
+        tot += len(og_of[l])
+
+    # cell values: real levels from device state; scaffold by restriction
+    cellvals: Dict[int, np.ndarray] = {}
+    for l in range(lmin, lmax + 1):
+        if not tree.has(l):
+            continue
+        m = sim.maps[l]
+        nc = m.noct * (1 << ndim)
+        cellvals[l] = np.asarray(sim.u[l], dtype=np.float64)[:nc]
+    dense = None
+    for l in range(lmin - 1, 0, -1):
+        if dense is None:
+            # build dense array at lmin (complete base level)
+            n = 1 << lmin
+            nv = cfg.nvar
+            dense = np.zeros((n,) * ndim + (nv,))
+            cc = tree.cell_coords(lmin)
+            dense[tuple(cc[:, d] for d in range(ndim))] = cellvals[lmin]
+            dense = _dense_to_level(dense)
+        else:
+            dense = _dense_to_level(dense)
+        cc = (2 * og_of[l][:, None, :] + offs[None, :, :]).reshape(-1, ndim)
+        cellvals[l] = dense[tuple(cc[:, d] for d in range(ndim))]
+
+    levels: Dict[int, SnapLevel] = {}
+    for l, og in og_of.items():
+        noct = len(og)
+        cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
+        if (l + 1) in og_of:
+            ks1 = kmod.encode(og_of[l + 1], ndim)
+            pos = np.searchsorted(ks1, kmod.encode(cc, ndim))
+            pos = np.clip(pos, 0, len(ks1) - 1)
+            hit = ks1[pos] == kmod.encode(cc, ndim)
+            son = np.where(hit, id_base[l + 1] + pos + 1, 0).astype(np.int32)
+        else:
+            son = np.zeros(noct * (1 << ndim), dtype=np.int32)
+        hyd = cons_to_prim_out(cellvals[l], cfg)
+        levels[l] = SnapLevel(
+            og=og, son=son.reshape(noct, -1)[:, perm],
+            hydro=hyd.reshape(noct, 1 << ndim, -1)[:, perm])
+
+    un = units_fn(params)
+    return Snapshot(
+        ndim=ndim, nlevelmax=lmax, levels=levels,
+        boxlen=sim.boxlen, t=float(sim.t), gamma=cfg.gamma,
+        var_names=hydro_var_names(cfg), units=un, levelmin=lmin,
+        nstep=int(sim.nstep), nstep_coarse=int(sim.nstep),
+        tout=[params.output.tend or 0.0])
+
+
+def particles_dict(p) -> dict:
+    """Host copies of a :class:`ParticleSet`, active lanes only."""
+    act = np.asarray(p.active)
+    return dict(
+        x=np.asarray(p.x, dtype=np.float64)[act],
+        v=np.asarray(p.v, dtype=np.float64)[act],
+        m=np.asarray(p.m, dtype=np.float64)[act],
+        idp=np.asarray(p.idp)[act].astype(np.int32),
+        level=np.full(int(act.sum()), 1, dtype=np.int32),
+        family=np.asarray(p.family)[act].astype(np.int8),
+        tag=np.zeros(int(act.sum()), dtype=np.int8),
+        tp=np.asarray(p.tp, dtype=np.float64)[act],
+        zp=np.asarray(p.zp, dtype=np.float64)[act],
+    )
+
+
+# ----------------------------------------------------------------------
+# file writers
+# ----------------------------------------------------------------------
+
+def _fname(outdir: str, ftype: str, iout: int, icpu: int) -> str:
+    return os.path.join(outdir, f"{ftype}_{iout:05d}.out{icpu:05d}")
+
+
+def write_amr_file(path: str, snap: Snapshot, iout: int,
+                   ncpu: int = 1, icpu: int = 1) -> None:
+    """``backup_amr`` record sequence (``amr/output_amr.f90:268-393``)."""
+    ndim = snap.ndim
+    nlevelmax = snap.nlevelmax
+    twotondim = 1 << ndim
+    twondim = 2 * ndim
+    ncoarse = 1
+    ngrid = snap.ngrid_total
+    ngridmax = max(ngrid, 1)
+    id_base = snap.grid_id_base()
+    noutput = max(1, len(snap.tout))
+    tout = np.asarray(list(snap.tout) + [0.0] * noutput, dtype=np.float64)
+    tout = tout[:noutput]
+    dtold = (snap.dtold if snap.dtold is not None
+             else np.zeros(nlevelmax))[:nlevelmax]
+    dtnew = (snap.dtnew if snap.dtnew is not None
+             else np.zeros(nlevelmax))[:nlevelmax]
+
+    numbl = np.zeros((ncpu, nlevelmax), dtype=np.int32)
+    headl = np.zeros((ncpu, nlevelmax), dtype=np.int32)
+    taill = np.zeros((ncpu, nlevelmax), dtype=np.int32)
+    for l in range(1, nlevelmax + 1):
+        if l in snap.levels and snap.levels[l].noct > 0:
+            n = snap.levels[l].noct
+            numbl[icpu - 1, l - 1] = n
+            headl[icpu - 1, l - 1] = id_base[l] + 1
+            taill[icpu - 1, l - 1] = id_base[l] + n
+    numbtot = np.zeros((10, nlevelmax), dtype=np.int32)
+    numbtot[0] = numbl.sum(axis=0)
+    numbtot[1] = numbl.min(axis=0)
+    numbtot[2] = numbl.max(axis=0)
+
+    with open(path, "wb") as f:
+        frt.write_ints(f, ncpu)
+        frt.write_ints(f, ndim)
+        frt.write_ints(f, 1, 1, 1)                       # nx, ny, nz
+        frt.write_ints(f, nlevelmax)
+        frt.write_ints(f, ngridmax)
+        frt.write_ints(f, 0)                             # nboundary
+        frt.write_ints(f, ngrid)                         # ngrid_current
+        frt.write_reals(f, snap.boxlen)
+        frt.write_ints(f, noutput, iout, iout)           # noutput,iout,ifout
+        frt.write_record(f, tout)
+        frt.write_record(f, np.ones(noutput))            # aout
+        frt.write_reals(f, snap.t)
+        frt.write_record(f, np.asarray(dtold, dtype=np.float64))
+        frt.write_record(f, np.asarray(dtnew, dtype=np.float64))
+        frt.write_ints(f, snap.nstep, snap.nstep_coarse)
+        frt.write_reals(f, 0.0, 0.0, 0.0)   # einit, mass_tot_0, rho_tot
+        om, ol, ok, ob, h0, aexp_ini, boxlen_ini = snap.cosmo
+        frt.write_reals(f, om, ol, ok, ob, h0, aexp_ini, boxlen_ini)
+        frt.write_reals(f, snap.aexp, 0.0, snap.aexp, 0.0, 0.0)
+        # aexp, hexp, aexp_old, epot_tot_int, epot_tot_old
+        frt.write_reals(f, 0.0)                          # mass_sph
+        # level linked lists (Fortran column-major: cpu fastest)
+        frt.write_record(f, headl.T.ravel().astype(np.int32))
+        frt.write_record(f, taill.T.ravel().astype(np.int32))
+        frt.write_record(f, numbl.T.ravel().astype(np.int32))
+        frt.write_record(f, numbtot.T.ravel().astype(np.int32))
+        # free memory
+        frt.write_ints(f, 0, 0, 0, ngrid, ngrid)
+        frt.write_str(f, "hilbert", 128)
+        ndomain = ncpu
+        bk_max = float(2 ** min(ndim * nlevelmax, 62))
+        bound_key = np.linspace(0.0, bk_max, ndomain + 1)
+        frt.write_record(f, bound_key)
+        # coarse level
+        frt.write_record(f, np.asarray([1], dtype=np.int32))   # son
+        frt.write_record(f, np.zeros(ncoarse, dtype=np.int32))  # flag1
+        frt.write_record(f, np.full(ncoarse, icpu, dtype=np.int32))
+        # fine levels
+        for l in range(1, nlevelmax + 1):
+            lv = snap.levels.get(l)
+            if lv is None or lv.noct == 0:
+                continue
+            n = lv.noct
+            ids = np.arange(id_base[l] + 1, id_base[l] + n + 1,
+                            dtype=np.int32)
+            frt.write_record(f, ids)                     # ind_grid
+            nxt = np.where(ids < id_base[l] + n, ids + 1, 0).astype(np.int32)
+            frt.write_record(f, nxt)                     # next
+            prv = np.where(ids > id_base[l] + 1, ids - 1, 0).astype(np.int32)
+            frt.write_record(f, prv)                     # prev
+            scale = 0.5 ** (l - 1)
+            for d in range(ndim):
+                frt.write_record(f, (lv.og[:, d] + 0.5) * scale)
+            # father cell index
+            if l == 1:
+                father = np.ones(n, dtype=np.int32)
+            else:
+                pog = lv.og // 2
+                coff = lv.og - 2 * pog
+                ind_ref = np.zeros(n, dtype=np.int64)
+                for d in range(ndim):
+                    ind_ref += coff[:, d] << d           # x fastest
+                plv = snap.levels[l - 1]
+                pid = _lookup_ids(plv.og, pog, id_base[l - 1])
+                father = (ncoarse + ind_ref * ngridmax + pid).astype(np.int32)
+            frt.write_record(f, father)
+            # nbor: father's 2*ndim neighbour cells,
+            # reference order (-x,+x,-y,+y,-z,+z)
+            for idir in range(twondim):
+                d, sgn = idir // 2, (-1 if idir % 2 == 0 else 1)
+                if l == 1:
+                    frt.write_record(f, np.ones(n, dtype=np.int32))
+                    continue
+                cc = lv.og.copy()
+                cc[:, d] += sgn
+                ncell = 1 << (l - 1)
+                cc[:, d] = np.mod(cc[:, d], ncell)       # periodic wrap
+                pog = cc // 2
+                coff = cc - 2 * pog
+                ind_ref = np.zeros(n, dtype=np.int64)
+                for dd in range(ndim):
+                    ind_ref += coff[:, dd] << dd
+                plv = snap.levels[l - 1]
+                pid = _lookup_ids(plv.og, pog, id_base[l - 1])
+                frt.write_record(
+                    f, (ncoarse + ind_ref * ngridmax + pid).astype(np.int32))
+            # son / cpu_map / flag1 per cell slot (reference ind order)
+            for ind in range(twotondim):
+                frt.write_record(f, lv.son[:, ind].astype(np.int32))
+            for ind in range(twotondim):
+                frt.write_record(f, np.full(n, icpu, dtype=np.int32))
+            for ind in range(twotondim):
+                frt.write_record(f, np.zeros(n, dtype=np.int32))
+
+
+def _lookup_ids(og_sorted: np.ndarray, q: np.ndarray, base: int) -> np.ndarray:
+    """Global grid ids of oct coords ``q`` within a level's sorted oct set."""
+    from ramses_tpu.amr import keys as kmod
+    ndim = og_sorted.shape[1]
+    ks = kmod.encode(og_sorted, ndim)
+    kq = kmod.encode(q.astype(np.int64), ndim)
+    pos = np.searchsorted(ks, kq)
+    pos = np.clip(pos, 0, len(ks) - 1)
+    return base + pos + 1
+
+
+def write_hydro_file(path: str, snap: Snapshot, desc_path: Optional[str],
+                     ncpu: int = 1) -> None:
+    """``backup_hydro`` record sequence (``hydro/output_hydro.f90:54-160``)."""
+    ndim = snap.ndim
+    twotondim = 1 << ndim
+    nvar = len(snap.var_names)
+    with open(path, "wb") as f:
+        frt.write_ints(f, ncpu)
+        frt.write_ints(f, nvar)
+        frt.write_ints(f, ndim)
+        frt.write_ints(f, snap.nlevelmax)
+        frt.write_ints(f, 0)
+        frt.write_reals(f, snap.gamma)
+        for l in range(1, snap.nlevelmax + 1):
+            for ibound in range(ncpu):
+                lv = snap.levels.get(l)
+                ncache = lv.noct if lv is not None else 0
+                frt.write_ints(f, l)
+                frt.write_ints(f, ncache)
+                if ncache == 0:
+                    continue
+                for ind in range(twotondim):
+                    for ivar in range(nvar):
+                        frt.write_record(f, lv.hydro[:, ind, ivar])
+    if desc_path:
+        write_descriptor(desc_path, [(v, "d") for v in snap.var_names])
+
+
+def write_grav_file(path: str, snap: Snapshot, ncpu: int = 1) -> None:
+    """``backup_poisson`` record sequence (``poisson/output_poisson.f90``):
+    header ncpu/nvar/nlevelmax/nboundary then per (level, domain)
+    ilevel, ncache, and per cell slot phi + ndim force records."""
+    ndim = snap.ndim
+    twotondim = 1 << ndim
+    with open(path, "wb") as f:
+        frt.write_ints(f, ncpu)
+        frt.write_ints(f, ndim + 1)
+        frt.write_ints(f, snap.nlevelmax)
+        frt.write_ints(f, 0)
+        for l in range(1, snap.nlevelmax + 1):
+            for ibound in range(ncpu):
+                lv = snap.levels.get(l)
+                ncache = lv.noct if lv is not None else 0
+                frt.write_ints(f, l)
+                frt.write_ints(f, ncache)
+                if ncache == 0:
+                    continue
+                g = (lv.grav if lv.grav is not None
+                     else np.zeros((ncache, twotondim, ndim + 1)))
+                for ind in range(twotondim):
+                    for ivar in range(ndim + 1):
+                        frt.write_record(f, g[:, ind, ivar])
+
+
+def write_part_file(path: str, snap: Snapshot, desc_path: Optional[str],
+                    ncpu: int = 1) -> None:
+    """``backup_part`` record sequence (``pm/output_part.f90``)."""
+    p = snap.particles
+    ndim = snap.ndim
+    npart = len(p["m"])
+    fields: List[Tuple[str, np.ndarray, str]] = []
+    dim_keys = ["x", "y", "z"]
+    for d in range(ndim):
+        fields.append((f"position_{dim_keys[d]}",
+                       np.asarray(p["x"][:, d], dtype=np.float64), "d"))
+    for d in range(ndim):
+        fields.append((f"velocity_{dim_keys[d]}",
+                       np.asarray(p["v"][:, d], dtype=np.float64), "d"))
+    fields.append(("mass", np.asarray(p["m"], dtype=np.float64), "d"))
+    fields.append(("identity", np.asarray(p["idp"], dtype=np.int32), "i"))
+    fields.append(("levelp", np.asarray(p["level"], dtype=np.int32), "i"))
+    fields.append(("family", np.asarray(p["family"], dtype=np.int8), "b"))
+    fields.append(("tag", np.asarray(p["tag"], dtype=np.int8), "b"))
+    has_star = bool(np.any(p["family"] == 2)) or np.any(p.get("tp", 0))
+    if has_star:
+        fields.append(("birth_time",
+                       np.asarray(p["tp"], dtype=np.float64), "d"))
+        if "zp" in p:
+            fields.append(("metallicity",
+                           np.asarray(p["zp"], dtype=np.float64), "d"))
+
+    with open(path, "wb") as f:
+        frt.write_ints(f, ncpu)
+        frt.write_ints(f, ndim)
+        frt.write_ints(f, npart)
+        frt.write_record(f, np.zeros(4, dtype=np.int32))   # localseed
+        frt.write_ints(f, int(np.sum(p["family"] == 2)))   # nstar_tot
+        frt.write_reals(f, snap.mstar_tot)
+        frt.write_reals(f, snap.mstar_lost)
+        frt.write_ints(f, 0)                               # nsink
+        for _, arr, _k in fields:
+            frt.write_record(f, arr)
+    if desc_path:
+        write_descriptor(desc_path, [(n, k) for n, _, k in fields])
+
+
+def write_descriptor(path: str, fields: Sequence[Tuple[str, str]]) -> None:
+    """``*_file_descriptor.txt`` (``io/dump_utils.f90:127-139``)."""
+    with open(path, "w") as f:
+        f.write("# version:  1\n")
+        f.write("# ivar, variable_name, variable_type\n")
+        for i, (name, kind) in enumerate(fields, start=1):
+            f.write(f"{i:2d}, {name}, {kind}\n")
+
+
+def write_info_file(path: str, snap: Snapshot, ncpu: int = 1) -> None:
+    """``output_info`` (``amr/output_amr.f90:411-491``)."""
+    un = snap.units
+    om, ol, ok, ob, h0, _aexp_ini, _bli = snap.cosmo
+    with open(path, "w") as f:
+        f.write(f"ncpu        ={ncpu:11d}\n")
+        f.write(f"ndim        ={snap.ndim:11d}\n")
+        f.write(f"levelmin    ={snap.levelmin:11d}\n")
+        f.write(f"levelmax    ={snap.nlevelmax:11d}\n")
+        f.write(f"ngridmax    ={max(snap.ngrid_total, 1):11d}\n")
+        f.write(f"nstep_coarse={snap.nstep_coarse:11d}\n")
+        f.write("\n")
+        for k, v in [("boxlen", snap.boxlen), ("time", snap.t),
+                     ("aexp", snap.aexp), ("H0", h0), ("omega_m", om),
+                     ("omega_l", ol), ("omega_k", ok), ("omega_b", ob),
+                     ("unit_l", un.scale_l), ("unit_d", un.scale_d),
+                     ("unit_t", un.scale_t)]:
+            f.write(f"{k:<12s}={v:23.15E}\n")
+        f.write("\n")
+        f.write(f"ordering type={'hilbert':>80s}\n")
+        f.write("   DOMAIN   ind_min                 ind_max\n")
+        bk_max = float(2 ** min(snap.ndim * snap.nlevelmax, 62))
+        bounds = np.linspace(0.0, bk_max, ncpu + 1)
+        for idom in range(1, ncpu + 1):
+            f.write(f"{idom:8d} {bounds[idom - 1]:23.15E}"
+                    f" {bounds[idom]:23.15E}\n")
+
+
+# family keys, pm/pm_commons.f90:84-87 (index -5..5)
+FAMILY_KEYS = ["other_tracer", "debris_tracer", "cloud_tracer",
+               "star_tracer", "other_tracer", "gas_tracer",
+               "DM", "star", "cloud", "debris", "other"]
+
+
+def write_header_file(path: str, snap: Snapshot) -> None:
+    """``output_header`` (``amr/output_amr.f90:496-575``)."""
+    counts = np.zeros(11, dtype=np.int64)
+    total = 0
+    if snap.particles is not None:
+        fam = np.asarray(snap.particles["family"])
+        total = len(fam)
+        for i, f_code in enumerate(range(-5, 6)):
+            counts[i] = int(np.sum(fam == f_code))
+    with open(path, "w") as f:
+        f.write("#" + "Family".rjust(12) + "Count".rjust(10) + "\n")
+        for key, cnt in zip(FAMILY_KEYS, counts):
+            f.write(key.rjust(13) + f"{cnt:10d}" + "\n")
+        f.write("undefined".rjust(13) + f"{total - int(counts.sum()):10d}\n")
+        f.write(" Particle fields\n")
+        f.write("pos vel mass iord level family tag \n")
+
+
+def dump_all(snap: Snapshot, iout: int, base_dir: str = ".",
+             namelist_path: Optional[str] = None,
+             write_grav: bool = False) -> str:
+    """Write ``output_NNNNN/`` with the full reference file set; returns
+    the output directory path (``dump_all``, ``amr/output_amr.f90:5-206``)."""
+    outdir = os.path.join(base_dir, f"output_{iout:05d}")
+    os.makedirs(outdir, exist_ok=True)
+    suffix = f"{iout:05d}"
+    write_info_file(os.path.join(outdir, f"info_{suffix}.txt"), snap)
+    write_amr_file(_fname(outdir, "amr", iout, 1), snap, iout)
+    write_hydro_file(
+        _fname(outdir, "hydro", iout, 1), snap,
+        os.path.join(outdir, "hydro_file_descriptor.txt"))
+    if write_grav or any(lv.grav is not None for lv in snap.levels.values()):
+        write_grav_file(_fname(outdir, "grav", iout, 1), snap)
+    write_header_file(os.path.join(outdir, f"header_{suffix}.txt"), snap)
+    if snap.particles is not None and len(snap.particles["m"]) > 0:
+        write_part_file(
+            _fname(outdir, "part", iout, 1), snap,
+            os.path.join(outdir, "part_file_descriptor.txt"))
+    if namelist_path and os.path.exists(namelist_path):
+        shutil.copy(namelist_path, os.path.join(outdir, "namelist.txt"))
+    return outdir
