@@ -23,6 +23,11 @@ def main(argv=None) -> int:
     ap.add_argument("--amr", action="store_true",
                     help="force the multi-level AMR driver even when "
                          "levelmin==levelmax")
+    ap.add_argument("--solver", default=None,
+                    choices=["hydro", "mhd"],
+                    help="solver family (the reference's SOLVER= make "
+                         "variable); default: mhd when &INIT_PARAMS sets "
+                         "A/B/C_region, hydro otherwise")
     ap.add_argument("--verbose", "-v", action="store_true")
     args = ap.parse_args(argv)
 
@@ -33,7 +38,22 @@ def main(argv=None) -> int:
     dtype = getattr(jnp, args.dtype)
     params = load_params(args.namelist, ndim=args.ndim)
 
-    if args.amr or params.amr.levelmax > params.amr.levelmin:
+    solver = args.solver
+    if solver is None:
+        solver = ("mhd" if any(params.init.A_region) or
+                  any(params.init.B_region) or any(params.init.C_region)
+                  else "hydro")
+
+    if solver == "mhd":
+        if args.amr or params.amr.levelmax > params.amr.levelmin:
+            raise NotImplementedError(
+                "MHD runs are uniform-grid for now (levelmax must equal "
+                "levelmin); AMR MHD needs div-B-preserving prolongation")
+        from ramses_tpu.mhd.driver import MhdSimulation
+        sim = MhdSimulation(params, dtype=dtype)
+        sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
+        sim.dump(1, params.output.output_dir, namelist_path=args.namelist)
+    elif args.amr or params.amr.levelmax > params.amr.levelmin:
         from ramses_tpu.amr.hierarchy import AmrSim
         sim = AmrSim(params, dtype=dtype)
         tend = (params.output.tout[-1] if params.output.tout

@@ -90,6 +90,10 @@ class InitParams:
     v_region: List[float] = field(default_factory=list)
     w_region: List[float] = field(default_factory=list)
     p_region: List[float] = field(default_factory=list)
+    # MHD region fields (mhd/hydro_parameters.f90:80-82): uniform B per region
+    A_region: List[float] = field(default_factory=list)
+    B_region: List[float] = field(default_factory=list)
+    C_region: List[float] = field(default_factory=list)
     filetype: str = "ascii"
     initfile: List[str] = field(default_factory=list)
     aexp_ini: float = 10.0
@@ -228,7 +232,8 @@ _LIST_FIELDS = {
                              z_center=0.0, length_x=1e10, length_y=1e10,
                              length_z=1e10, exp_region=2.0, d_region=0.0,
                              u_region=0.0, v_region=0.0, w_region=0.0,
-                             p_region=0.0)),
+                             p_region=0.0, A_region=0.0, B_region=0.0,
+                             C_region=0.0)),
     "boundary": dict(count="nboundary",
                      fields=dict(bound_type=0, ibound_min=0, ibound_max=0,
                                  jbound_min=0, jbound_max=0, kbound_min=0,
@@ -251,6 +256,10 @@ def params_from_dict(groups: Dict[str, Dict[str, Any]],
         for key, value in gdict.items():
             if key == "boundary_type":
                 key = "bound_type"  # nml name differs from our field name
+            # the parser lowercases namelist keys; map back the reference's
+            # capitalized MHD region fields (mhd/hydro_parameters.f90:80-82)
+            key = {"a_region": "A_region", "b_region": "B_region",
+                   "c_region": "C_region"}.get(key, key)
             if key not in valid:
                 continue  # unknown keys ignored (subsystem not yet built)
             ftype = valid[key].type

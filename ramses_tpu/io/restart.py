@@ -21,6 +21,10 @@ def restore_tree_state(outdir: str, cfg, levelmin: int):
     """(tree_levels, u_levels, meta): per-level oct coords and conservative
     cell arrays (our x-slowest flat order) for levels >= levelmin."""
     snap = rdr.load_snapshot(outdir)
+    if len(snap["amr"]) != 1:
+        raise NotImplementedError(
+            f"restart from multi-cpu snapshots (ncpu={len(snap['amr'])}) "
+            "is not wired yet; domains would be silently dropped")
     amr = snap["amr"][0]
     hyd = snap["hydro"][0]
     h = amr.header

@@ -210,6 +210,40 @@ def _gather_cells_dense(dense: np.ndarray, og: np.ndarray,
     return vals[:, perm]
 
 
+def uniform_levels_from_dense(dense: np.ndarray, lmin: int,
+                              ndim: int) -> Dict[int, SnapLevel]:
+    """Scaffolded level set 1..lmin from a dense [*sp, nvar_out] array of
+    already-converted output variables (scaffold values by plain mean —
+    adequate for the never-leaf coarse levels)."""
+    from ramses_tpu.amr import keys as kmod
+    from ramses_tpu.amr.tree import cell_offsets
+
+    perm = ref_cell_perm(ndim)
+    offs = cell_offsets(ndim)
+    denses = {lmin: dense}
+    for l in range(lmin - 1, 0, -1):
+        denses[l] = _dense_to_level(denses[l + 1])
+    id_base, tot = {}, 0
+    for l in range(1, lmin + 1):
+        id_base[l] = tot
+        tot += (1 << (l - 1)) ** ndim
+    levels: Dict[int, SnapLevel] = {}
+    for l in range(1, lmin + 1):
+        og = _full_level_og(l, ndim)
+        hyd = _gather_cells_dense(denses[l], og, perm)
+        if l < lmin:
+            cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
+            og1 = _full_level_og(l + 1, ndim)
+            ks1 = kmod.encode(og1, ndim)
+            pos = np.searchsorted(ks1, kmod.encode(cc, ndim))
+            son = (id_base[l + 1] + pos + 1).astype(np.int32)
+            son = son.reshape(len(og), -1)[:, perm]
+        else:
+            son = np.zeros((len(og), 1 << ndim), dtype=np.int32)
+        levels[l] = SnapLevel(og=og, son=son, hydro=hyd)
+    return levels
+
+
 def snapshot_from_uniform(sim, iout: int = 1) -> Snapshot:
     """Build a snapshot from a single-level :class:`Simulation`.
 
@@ -232,50 +266,21 @@ def snapshot_from_uniform(sim, iout: int = 1) -> Snapshot:
 
     u = np.asarray(sim.state.u, dtype=np.float64)   # [nvar, *sp]
     dense = np.moveaxis(u, 0, -1)                   # [*sp, nvar]
+    dense_prim = cons_to_prim_out(
+        dense.reshape(-1, cfg.nvar), cfg).reshape(dense.shape)
+    levels = uniform_levels_from_dense(dense_prim, lmin, ndim)
 
-    levels: Dict[int, SnapLevel] = {}
-    denses = {lmin: dense}
-    for l in range(lmin - 1, 0, -1):
-        denses[l] = _dense_to_level(denses[l + 1])
-
-    id_base, tot = {}, 0
-    for l in range(1, lmin + 1):
-        id_base[l] = tot
-        tot += (1 << (l - 1)) ** ndim
-
-    grav_dense = None
     if getattr(sim.state, "f", None) is not None:
         f = np.asarray(sim.state.f, dtype=np.float64)    # [ndim, *sp]
         phi = np.asarray(sim.phi, dtype=np.float64)[None] \
             if hasattr(sim, "phi") and sim.phi is not None \
             else np.zeros((1,) + f.shape[1:])
         grav_dense = np.moveaxis(np.concatenate([phi, f], axis=0), 0, -1)
-
-    for l in range(1, lmin + 1):
-        og = _full_level_og(l, ndim)
-        hyd = _gather_cells_dense(cons_to_prim_out(
-            denses[l].reshape(-1, cfg.nvar), cfg).reshape(denses[l].shape),
-            og, perm)
-        if l < lmin:
-            # every cell refined: son id = global id of the oct at l+1
-            # whose coords equal the cell coords
-            from ramses_tpu.amr import keys as kmod
-            from ramses_tpu.amr.tree import cell_offsets
-            offs = cell_offsets(ndim)
-            cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
-            og1 = _full_level_og(l + 1, ndim)
-            ks1 = kmod.encode(og1, ndim)
-            pos = np.searchsorted(ks1, kmod.encode(cc, ndim))
-            son = (id_base[l + 1] + pos + 1).astype(np.int32)
-            son = son.reshape(len(og), -1)[:, perm]
-        else:
-            son = np.zeros((len(og), 1 << ndim), dtype=np.int32)
-        grav = None
-        if grav_dense is not None and l == lmin:
-            grav = _gather_cells_dense(grav_dense, og, perm)
-        elif grav_dense is not None:
-            grav = np.zeros((len(og), 1 << ndim, ndim + 1))
-        levels[l] = SnapLevel(og=og, son=son, hydro=hyd, grav=grav)
+        for l, lv in levels.items():
+            if l == lmin:
+                lv.grav = _gather_cells_dense(grav_dense, lv.og, perm)
+            else:
+                lv.grav = np.zeros((lv.noct, 1 << ndim, ndim + 1))
 
     cosmo = getattr(sim, "cosmo", None)
     aexp = (float(cosmo.aexp_of_tau(sim.state.t))

@@ -1,0 +1,7 @@
+"""Ideal MHD with constrained transport (SURVEY.md §2.3).
+
+TPU-native re-design of the reference ``mhd/`` solver: cell-centered
+conservative state plus staggered face-centered B, whole-grid fused
+kernels, Gardiner-Stone arithmetic EMF averaging for the corner problem,
+HLLD/HLL/LLF interface solvers.
+"""

@@ -1,0 +1,164 @@
+"""MHD state layout, conversions, wave speeds.
+
+Reference: ``mhd/`` solver (``mhd/init_hydro.f90:29``,
+``mhd/hydro_parameters.f90``).  The reference stores 8+ cell variables
+[ρ, ρv(3), E, B_left(3)] plus right-face B in slots nvar+1:nvar+3 — i.e.
+BOTH faces per cell per dim.  Here the staggered field is stored once:
+``bf[d]`` holds B_d on the LOW face of each cell along axis d (the high
+face is the neighbour's low face), which halves the memory and makes the
+divergence stencil exact by construction.  Velocity and B always carry 3
+components regardless of grid dimensionality, as in the reference.
+
+Cell state ``u``: [ρ, ρv_x, ρv_y, ρv_z, E, Bc_x, Bc_y, Bc_z, passives…]
+Primitive ``q``:  [ρ, v_x, v_y, v_z, P, Bc_x, Bc_y, Bc_z, passives…]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ramses_tpu.config import Params
+
+IRHO, IVX, IVY, IVZ, IP, IBX, IBY, IBZ = 0, 1, 2, 3, 4, 5, 6, 7
+NCOMP = 3  # velocity/field components (always 3, mhd convention)
+
+
+@dataclass(frozen=True)
+class MhdStatic:
+    """Static solver config (hashable; jit static arg)."""
+    ndim: int = 3               # grid dimensionality (1/2/3)
+    npassive: int = 0
+    gamma: float = 1.6666667
+    smallr: float = 1e-10
+    smallc: float = 1e-10
+    slope_type: int = 1
+    slope_theta: float = 1.5
+    riemann: str = "hlld"
+    riemann2d: str = "average"
+    courant_factor: float = 0.8
+
+    @property
+    def nvar(self) -> int:
+        return 8 + self.npassive
+
+    @classmethod
+    def from_params(cls, p: Params) -> "MhdStatic":
+        h = p.hydro
+        riemann = str(h.riemann)
+        # the reference's roe/upwind 1D solvers are not implemented;
+        # substitute hlld (less diffusive than their hll fallback)
+        if riemann in ("roe", "upwind", "hydro"):
+            import warnings
+            warnings.warn(f"mhd riemann='{riemann}' not implemented; "
+                          "using hlld")
+            riemann = "hlld"
+        return cls(ndim=p.ndim, npassive=p.npassive, gamma=float(h.gamma),
+                   smallr=float(h.smallr), smallc=float(h.smallc),
+                   slope_type=int(h.slope_type),
+                   slope_theta=float(h.slope_theta),
+                   riemann=riemann, riemann2d=str(h.riemann2d),
+                   courant_factor=float(h.courant_factor))
+
+
+def cell_center_b(bf: Sequence, ndim: int) -> list:
+    """Cell-centered B from staggered faces: mean of low/high faces for
+    staggered dims, identity for degenerate (cell-centered) components."""
+    out = []
+    for c in range(NCOMP):
+        b = bf[c]
+        if c < ndim:
+            ax = b.ndim - ndim + c
+            out.append(0.5 * (b + jnp.roll(b, -1, axis=ax)))
+        else:
+            out.append(b)
+    return out
+
+
+def ctoprim(u, cfg: MhdStatic):
+    """Conservative → primitive (``mhd/umuscl.f90`` ctoprim equivalent)."""
+    r = jnp.maximum(u[IRHO], cfg.smallr)
+    inv_r = 1.0 / r
+    v = [u[1 + c] * inv_r for c in range(NCOMP)]
+    b = [u[IBX + c] for c in range(NCOMP)]
+    eken = 0.5 * sum(vc * vc for vc in v)
+    emag = 0.5 * sum(bc * bc for bc in b) * inv_r
+    eint = jnp.maximum(u[IP] * inv_r - eken - emag,
+                       cfg.smallc ** 2 / cfg.gamma / (cfg.gamma - 1.0))
+    p = (cfg.gamma - 1.0) * r * eint
+    comps = [r] + v + [p] + b
+    for s in range(cfg.npassive):
+        comps.append(u[8 + s] * inv_r)
+    return jnp.stack(comps)
+
+
+def prim_to_cons(q, cfg: MhdStatic):
+    r = jnp.maximum(q[IRHO], cfg.smallr)
+    v = [q[1 + c] for c in range(NCOMP)]
+    b = [q[IBX + c] for c in range(NCOMP)]
+    e = (q[IP] / (cfg.gamma - 1.0)
+         + 0.5 * r * sum(vc * vc for vc in v)
+         + 0.5 * sum(bc * bc for bc in b))
+    comps = [r] + [r * vc for vc in v] + [e] + b
+    for s in range(cfg.npassive):
+        comps.append(r * q[8 + s])
+    return jnp.stack(comps)
+
+
+def fast_speed(q, d: int, cfg: MhdStatic):
+    """Fast magnetosonic speed along component d
+    (``mhd/courant_fine.f90`` / ``godunov_utils`` cmpdt)."""
+    r = jnp.maximum(q[IRHO], cfg.smallr)
+    c2 = cfg.gamma * jnp.maximum(q[IP], cfg.smallr * cfg.smallc ** 2) / r
+    b2 = sum(q[IBX + c] ** 2 for c in range(NCOMP)) / r
+    bd2 = q[IBX + d] ** 2 / r
+    s = c2 + b2
+    disc = jnp.sqrt(jnp.maximum(s * s - 4.0 * c2 * bd2, 0.0))
+    return jnp.sqrt(jnp.maximum(0.5 * (s + disc), cfg.smallc ** 2))
+
+
+def flux_along(q, d: int, cfg: MhdStatic):
+    """Ideal-MHD physical flux along component d from primitives.
+
+    F(ρ)    = ρ v_d
+    F(ρv_c) = ρ v_d v_c − B_d B_c + δ_cd (P + B²/2)
+    F(E)    = (E + P + B²/2) v_d − B_d (v·B)
+    F(B_c)  = v_d B_c − v_c B_d   (zero for c=d)
+    """
+    r = jnp.maximum(q[IRHO], cfg.smallr)
+    v = [q[1 + c] for c in range(NCOMP)]
+    b = [q[IBX + c] for c in range(NCOMP)]
+    p = q[IP]
+    b2 = sum(bc * bc for bc in b)
+    ptot = p + 0.5 * b2
+    vdotb = sum(vc * bc for vc, bc in zip(v, b))
+    e = (p / (cfg.gamma - 1.0) + 0.5 * r * sum(vc * vc for vc in v)
+         + 0.5 * b2)
+    vd = v[d]
+    comps = [r * vd]
+    for c in range(NCOMP):
+        f = r * vd * v[c] - b[d] * b[c]
+        if c == d:
+            f = f + ptot
+        comps.append(f)
+    comps.append((e + ptot) * vd - b[d] * vdotb)
+    for c in range(NCOMP):
+        if c == d:
+            comps.append(jnp.zeros_like(vd))
+        else:
+            comps.append(vd * b[c] - v[c] * b[d])
+    for s in range(cfg.npassive):
+        comps.append(comps[0] * q[8 + s])
+    return jnp.stack(comps)
+
+
+def div_b(bf: Sequence, dx: Sequence[float], ndim: int):
+    """Exact staggered divergence (machine-zero under CT)."""
+    out = None
+    for d in range(ndim):
+        ax = bf[d].ndim - ndim + d
+        t = (jnp.roll(bf[d], -1, axis=ax) - bf[d]) / dx[d]
+        out = t if out is None else out + t
+    return out

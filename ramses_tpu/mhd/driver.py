@@ -1,0 +1,202 @@
+"""MHD simulation driver: region ICs, time loop, snapshots.
+
+The ``SOLVER=mhd`` build of the reference selected at compile time via
+VPATH shadowing (SURVEY.md §1 L0); here it is a runtime solver choice.
+Region ICs follow ``mhd/init_flow_fine.f90:475-596``: square regions set
+[d, u, v, w, P] plus a uniform field [A_region, B_region, C_region]
+(both faces, ``:529-532``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.config import Params, load_params
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.mhd import core, uniform as mu
+from ramses_tpu.mhd.core import IBX, IP, MhdStatic, NCOMP
+
+
+def _region_mask(x, k, init, ndim):
+    centers = [init.x_center, init.y_center, init.z_center]
+    lengths = [init.length_x, init.length_y, init.length_z]
+    en = float(init.exp_region[k])
+    if en < 10.0:
+        r = sum((2.0 * np.abs(x[d] - centers[d][k]) / lengths[d][k]) ** en
+                for d in range(ndim)) ** (1.0 / en)
+    else:
+        r = np.maximum.reduce(
+            [2.0 * np.abs(x[d] - centers[d][k]) / lengths[d][k]
+             for d in range(ndim)])
+    return r < 1.0
+
+
+def mhd_condinit(shape, dx: float, p: Params, cfg: MhdStatic):
+    """(u [nvar, *sp], bf [3, *sp]): conservative cell state + staggered
+    faces from &INIT_PARAMS regions (uniform B per region)."""
+    init = p.init
+    ndim = cfg.ndim
+    axes_c = [(np.arange(n) + 0.5) * dx for n in shape]
+
+    q = np.zeros((cfg.nvar,) + tuple(shape))
+    q[0] = cfg.smallr
+    q[IP] = cfg.smallr * cfg.smallc ** 2 / cfg.gamma
+    vels = [init.u_region, init.v_region, init.w_region]
+    bvals = [init.A_region, init.B_region, init.C_region]
+
+    # staggered faces: each cell's LOW face takes the owning cell's region
+    # value — exactly how the reference seeds both face fields from the
+    # cell's region (``mhd/init_flow_fine.f90:529-532``); evaluating at
+    # face centres would leave faces that sit exactly on a region border
+    # (including the domain edge) unset
+    bf = np.zeros((NCOMP,) + tuple(shape))
+    xc = np.meshgrid(*axes_c, indexing="ij")
+    for k in range(init.nregion):
+        if str(init.region_type[k]).strip() != "square":
+            raise NotImplementedError("mhd ICs: square regions only")
+        m = _region_mask(xc, k, init, ndim)
+        q[0][m] = init.d_region[k]
+        for c in range(NCOMP):
+            q[1 + c][m] = vels[c][k]
+            bf[c][m] = bvals[c][k]
+        q[IP][m] = init.p_region[k]
+
+    for c in range(NCOMP):
+        if c < ndim:
+            q[IBX + c] = 0.5 * (bf[c] + np.roll(bf[c], -1, axis=c))
+        else:
+            q[IBX + c] = bf[c]
+    u = np.asarray(core.prim_to_cons(jnp.asarray(q), cfg))
+    return u, bf
+
+
+class MhdSimulation:
+    """Uniform-grid MHD run (CT solver, SURVEY.md §7 stage 7)."""
+
+    def __init__(self, params: Params, dtype=jnp.float64):
+        self.params = params
+        self.cfg = MhdStatic.from_params(params)
+        lmin = params.amr.levelmin
+        n = 2 ** lmin
+        shape = tuple([n] * params.ndim)
+        self.dx = params.amr.boxlen / n
+        spec = bmod.BoundarySpec.from_params(params)
+        bc_kinds = tuple((f[0].kind, f[1].kind) for f in spec.faces)
+        for lo, hi in bc_kinds:
+            for k in (lo, hi):
+                if k not in (bmod.PERIODIC, bmod.OUTFLOW):
+                    raise NotImplementedError(
+                        "mhd boundaries: periodic/outflow only")
+        self.grid = mu.MhdGrid(cfg=self.cfg, shape=shape, dx=self.dx,
+                               bc_kinds=bc_kinds)
+        u0, bf0 = mhd_condinit(shape, self.dx, params, self.cfg)
+        self.u = jnp.asarray(u0, dtype=dtype)
+        self.bf = jnp.asarray(bf0, dtype=dtype)
+        self.t = 0.0
+        self.nstep = 0
+        self.iout = 1
+        self.cell_updates = 0
+        self.wall_s = 0.0
+
+    def evolve(self, tend: Optional[float] = None, chunk: int = 16,
+               nstepmax: int = 10 ** 9, verbose: bool = False):
+        p = self.params
+        tend = tend if tend is not None else (
+            p.output.tout[-1] if p.output.tout else p.output.tend)
+        tdtype = (jnp.float64 if jax.config.jax_enable_x64
+                  else jnp.float32)
+        while self.t < tend * (1.0 - 1e-12) and self.nstep < nstepmax:
+            n = min(chunk, nstepmax - self.nstep)
+            t0 = time.perf_counter()
+            u, bf, t, ndone = mu.run_steps(
+                self.grid, self.u, self.bf,
+                jnp.asarray(self.t, tdtype), jnp.asarray(tend, tdtype), n)
+            u.block_until_ready()
+            self.wall_s += time.perf_counter() - t0
+            ndone = int(ndone)
+            self.u, self.bf, self.t = u, bf, float(t)
+            self.nstep += ndone
+            self.cell_updates += ndone * self.grid.ncell
+            if verbose:
+                print(f"mhd step {self.nstep} t={self.t:.5e} "
+                      f"divb={float(self.max_divb()):.2e}")
+            if ndone == 0:
+                break
+
+    def max_divb(self):
+        return jnp.max(jnp.abs(core.div_b(
+            [self.bf[c] for c in range(NCOMP)],
+            (self.dx,) * self.cfg.ndim, self.cfg.ndim)))
+
+    def totals(self):
+        return mu.totals(self.u, self.cfg, self.dx)
+
+    # ------------------------------------------------------------------
+    # snapshot output (reference MHD layout: B left/right columns,
+    # mhd/output_hydro.f90:88-149)
+    # ------------------------------------------------------------------
+    def var_names(self) -> List[str]:
+        dims = "xyz"
+        names = ["density"]
+        names += [f"velocity_{dims[d]}" for d in range(self.cfg.ndim)]
+        names += [f"B_{dims[c]}_left" for c in range(3)]
+        names += [f"B_{dims[c]}_right" for c in range(3)]
+        names += ["pressure"]
+        names += [f"scalar_{i:02d}" for i in range(self.cfg.npassive)]
+        return names
+
+    def output_vars(self) -> np.ndarray:
+        """[*sp, nvar_out] float64 in var_names() order."""
+        cfg = self.cfg
+        u = np.asarray(self.u, dtype=np.float64)
+        bf = np.asarray(self.bf, dtype=np.float64)
+        rho = np.maximum(u[0], cfg.smallr)
+        cols = [u[0]]
+        cols += [u[1 + d] / rho for d in range(cfg.ndim)]
+        b_left, b_right = [], []
+        for c in range(3):
+            if c < cfg.ndim:
+                b_left.append(bf[c])
+                br = np.roll(bf[c], -1, axis=c)
+                if self.grid.bc_kinds[c][1] != bmod.PERIODIC:
+                    # outflow: the wrap would import the opposite edge;
+                    # replicate the local edge face instead (zero-gradient)
+                    idx = [slice(None)] * cfg.ndim
+                    idx[c] = -1
+                    br[tuple(idx)] = bf[c][tuple(idx)]
+                b_right.append(br)
+            else:
+                b_left.append(u[IBX + c])
+                b_right.append(u[IBX + c])
+        cols += b_left + b_right
+        ek = 0.5 * sum(u[1 + c] ** 2 for c in range(NCOMP)) / rho
+        em = 0.5 * sum((0.5 * (bl + br)) ** 2
+                       for bl, br in zip(b_left, b_right))
+        cols.append((cfg.gamma - 1.0) * (u[IP] - ek - em))
+        for s in range(cfg.npassive):
+            cols.append(u[8 + s] / rho)
+        return np.stack(cols, axis=-1)
+
+    def dump(self, iout: int = 1, base_dir: str = ".",
+             namelist_path: Optional[str] = None) -> str:
+        from ramses_tpu.io import snapshot as sm
+        from ramses_tpu.units import units as units_fn
+        params = self.params
+        lmin = params.amr.levelmin
+        ndim = self.cfg.ndim
+        dense = self.output_vars()
+        levels = sm.uniform_levels_from_dense(dense, lmin, ndim)
+        snap = sm.Snapshot(
+            ndim=ndim, nlevelmax=max(params.amr.levelmax, lmin),
+            levels=levels, boxlen=float(params.amr.boxlen), t=float(self.t),
+            gamma=self.cfg.gamma, var_names=self.var_names(),
+            units=units_fn(params), levelmin=lmin, nstep=self.nstep,
+            nstep_coarse=self.nstep, tout=[params.output.tend or 0.0])
+        return sm.dump_all(snap, iout, base_dir,
+                           namelist_path=namelist_path)

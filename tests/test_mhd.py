@@ -1,0 +1,280 @@
+"""MHD solver tests.
+
+Correctness anchors (no frozen reference aggregates yet, SURVEY.md §4):
+constant-state preservation, exact div(B)=0 under CT, B→0 reduction to
+the hydro solver, cross-solver agreement (LLF vs HLLD converge to the
+same weak solution), rotation invariance, conservation on periodic
+domains, Brio-Wu and Orszag-Tang smoke physics.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.mhd import core, uniform as mu
+from ramses_tpu.mhd.core import IBX, IP, NCOMP
+from ramses_tpu.mhd.driver import MhdSimulation, mhd_condinit
+
+
+def _briowu_params(lmin=6, riemann="hlld", slope=1):
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": lmin, "levelmax": lmin, "boxlen": 1.0},
+        "boundary_params": {"nboundary": 2,
+                            "ibound_min": [-1, 1], "ibound_max": [-1, 1],
+                            "bound_type": [2, 2]},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75],
+                        "length_x": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [1.0, 0.1],
+                        "A_region": [0.75, 0.75],
+                        "B_region": [1.0, -1.0],
+                        "C_region": [0.0, 0.0]},
+        "hydro_params": {"gamma": 2.0, "courant_factor": 0.7,
+                         "riemann": riemann, "slope_type": slope},
+        "output_params": {"tend": 0.1},
+    }
+    return params_from_dict(groups, ndim=1)
+
+
+def _uniform_sim(ndim=2, lmin=4, riemann="hlld", bvals=(0.3, 0.4, 0.5),
+                 v=(0.5, -0.3, 0.2)):
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": lmin, "levelmax": lmin, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "y_center": [0.5],
+                        "z_center": [0.5],
+                        "length_x": [10.0], "length_y": [10.0],
+                        "length_z": [10.0], "exp_region": [10.0],
+                        "d_region": [1.0], "p_region": [1.0],
+                        "u_region": [v[0]], "v_region": [v[1]],
+                        "w_region": [v[2]],
+                        "A_region": [bvals[0]], "B_region": [bvals[1]],
+                        "C_region": [bvals[2]]},
+        "hydro_params": {"gamma": 5.0 / 3.0, "riemann": riemann,
+                         "courant_factor": 0.8},
+        "output_params": {"tend": 0.1},
+    }
+    return MhdSimulation(params_from_dict(groups, ndim=ndim),
+                         dtype=jnp.float64)
+
+
+@pytest.mark.parametrize("riemann", ["llf", "hll", "hlld"])
+def test_constant_state_preserved(riemann):
+    sim = _uniform_sim(ndim=2, lmin=4, riemann=riemann)
+    u0 = np.asarray(sim.u).copy()
+    sim.evolve(0.05)
+    assert sim.nstep > 0
+    assert np.allclose(np.asarray(sim.u), u0, atol=1e-12)
+    assert float(sim.max_divb()) < 1e-12
+
+
+def test_divb_zero_3d_random_field():
+    sim = _uniform_sim(ndim=3, lmin=3)
+    rng = np.random.default_rng(0)
+    n = 8
+    # faces from a staggered vector potential curl ⇒ div B = 0 exactly
+    ax, ay, az = rng.standard_normal((3, n, n, n))
+    dx = sim.dx
+    bfx = (np.roll(az, -1, 1) - az) / dx - (np.roll(ay, -1, 2) - ay) / dx
+    bfy = (np.roll(ax, -1, 2) - ax) / dx - (np.roll(az, -1, 0) - az) / dx
+    bfz = (np.roll(ay, -1, 0) - ay) / dx - (np.roll(ax, -1, 1) - ax) / dx
+    bf = np.stack([bfx, bfy, bfz]) * 0.05
+    u = np.asarray(sim.u).copy()
+    bc = core.cell_center_b(list(bf), 3)
+    for c in range(3):
+        u[IBX + c] = bc[c]
+    # refresh total energy with the new magnetic energy
+    u[IP] = 1.0 / (5.0 / 3.0 - 1.0) + 0.5 * (
+        u[1] ** 2 + u[2] ** 2 + u[3] ** 2) / u[0] + 0.5 * sum(
+        b ** 2 for b in bc)
+    sim.u = jnp.asarray(u)
+    sim.bf = jnp.asarray(bf)
+    assert float(sim.max_divb()) < 1e-10
+    sim.evolve(0.02)
+    assert sim.nstep > 0
+    assert float(sim.max_divb()) < 1e-10
+    assert np.all(np.isfinite(np.asarray(sim.u)))
+
+
+def test_briowu_tube_physics():
+    sim = MhdSimulation(_briowu_params(lmin=7), dtype=jnp.float64)
+    sim.evolve(0.1)
+    u = np.asarray(sim.u)
+    q = np.asarray(core.ctoprim(sim.u, sim.cfg))
+    rho = q[0]
+    # end states untouched (waves have not reached the boundaries)
+    assert np.isclose(rho[0], 1.0, atol=1e-8)
+    assert np.isclose(rho[-1], 0.125, atol=1e-8)
+    # compound/intermediate structure exists
+    assert rho.min() > 0.1 and rho.max() <= 1.0 + 1e-10
+    assert q[IBX + 1].min() < -0.9 and q[IBX + 1].max() > 0.9
+    # Bx exactly constant in 1D CT
+    assert np.allclose(np.asarray(sim.bf[0]), 0.75, atol=1e-13)
+    assert np.all(np.isfinite(u))
+
+
+def test_briowu_solver_cross_agreement():
+    """LLF and HLLD converge to the same weak solution."""
+    sol = {}
+    for riemann in ("llf", "hlld"):
+        sim = MhdSimulation(_briowu_params(lmin=8, riemann=riemann),
+                            dtype=jnp.float64)
+        sim.evolve(0.1)
+        sol[riemann] = np.asarray(core.ctoprim(sim.u, sim.cfg))
+    l1 = np.mean(np.abs(sol["llf"][0] - sol["hlld"][0]))
+    assert l1 < 0.015, f"LLF vs HLLD density L1 {l1}"
+
+
+def test_rotation_invariance_2d():
+    """The same tube along x and along y gives identical profiles when
+    stepped with an identical dt sequence (the drivers' CFL differs: the
+    2D run pays the transverse fast-speed in its rate sum)."""
+    simx = MhdSimulation(_briowu_params(lmin=6), dtype=jnp.float64)
+
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 6, "levelmax": 6, "boxlen": 1.0},
+        "boundary_params": {"nboundary": 2,
+                            "jbound_min": [-1, 1], "jbound_max": [-1, 1],
+                            "ibound_min": [0, 0], "ibound_max": [0, 0],
+                            "bound_type": [4, 4]},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.5, 0.5], "y_center": [0.25, 0.75],
+                        "length_x": [10.0, 10.0], "length_y": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [1.0, 0.1],
+                        # normal = y: A (x-comp) = tangential 1, B = 0.75
+                        "u_region": [0.0, 0.0], "v_region": [0.0, 0.0],
+                        "A_region": [1.0, -1.0],
+                        "B_region": [0.75, 0.75],
+                        "C_region": [0.0, 0.0]},
+        "hydro_params": {"gamma": 2.0, "courant_factor": 0.7,
+                         "riemann": "hlld", "slope_type": 1},
+        "output_params": {"tend": 0.1},
+    }
+    simy = MhdSimulation(params_from_dict(groups, ndim=2),
+                         dtype=jnp.float64)
+    dt = 0.25 / 64 / 3.0
+    for _ in range(40):
+        simx.u, simx.bf = mu.step(simx.grid, simx.u, simx.bf, dt)
+        simy.u, simy.bf = mu.step(simy.grid, simy.u, simy.bf, dt)
+    qx = np.asarray(core.ctoprim(simx.u, simx.cfg))        # [nvar, nx]
+    qy = np.asarray(core.ctoprim(simy.u, simy.cfg))        # [nvar, nx, ny]
+    # no symmetry breaking across the transverse dimension — exact
+    assert np.abs(qy[0] - qy[0][0:1, :]).max() < 1e-12
+    rho_y = qy[0][0, :]                                     # profile along y
+    # cross-orientation agreement is at truncation order only: the 2D path
+    # carries the corner-EMF (GS05) machinery that a 1D evolution has no
+    # analogue of, so the tangential-field updates differ at O(dt·Δ)
+    assert np.allclose(qx[0], rho_y, atol=1e-3)
+    # tangential field maps: x-tube B_y ↔ y-tube B_x
+    assert np.allclose(qx[IBX + 1], qy[IBX][0, :], atol=5e-3)
+
+
+def test_b_zero_matches_hydro():
+    """With B=0 the MHD solver must reproduce the hydro solver."""
+    from ramses_tpu.driver import Simulation
+
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 7, "levelmax": 7, "boxlen": 1.0},
+        "boundary_params": {"nboundary": 2,
+                            "ibound_min": [-1, 1], "ibound_max": [-1, 1],
+                            "bound_type": [2, 2]},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75], "length_x": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [1.0, 0.1]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc", "slope_type": 1},
+        "output_params": {"noutput": 1, "tout": [0.1], "tend": 0.1},
+    }
+    ph = params_from_dict(groups, ndim=1)
+    hsim = Simulation(ph, dtype=jnp.float64)
+    hsim.evolve()
+
+    groups["hydro_params"]["riemann"] = "hlld"
+    pm = params_from_dict(dict(groups), ndim=1)
+    msim = MhdSimulation(pm, dtype=jnp.float64)
+    msim.evolve(0.1)
+
+    rho_h = np.asarray(hsim.state.u)[0]
+    rho_m = np.asarray(msim.u)[0]
+    l1 = np.mean(np.abs(rho_h - rho_m))
+    assert l1 < 5e-3, f"hydro vs B=0 MHD L1 {l1}"
+
+
+def _orszag_tang(lmin=5):
+    sim = _uniform_sim(ndim=2, lmin=lmin, bvals=(0.0, 0.0, 0.0),
+                       v=(0.0, 0.0, 0.0))
+    n = 2 ** lmin
+    dx = sim.dx
+    gamma = 5.0 / 3.0
+    # standard OT: rho=gamma*p0... use the Fromang+2006 normalization
+    d0 = 25.0 / (36.0 * np.pi)
+    p0 = 5.0 / (12.0 * np.pi)
+    b0 = 1.0 / np.sqrt(4.0 * np.pi)
+    xc = (np.arange(n) + 0.5) * dx
+    X, Y = np.meshgrid(xc, xc, indexing="ij")
+    vx = -np.sin(2 * np.pi * Y)
+    vy = np.sin(2 * np.pi * X)
+    # vector potential Az on corners → exactly solenoidal staggered field
+    xf = np.arange(n) * dx
+    Xf, Yf = np.meshgrid(xf, xf, indexing="ij")
+    Az = (b0 / (4 * np.pi) * np.cos(4 * np.pi * Xf)
+          + b0 / (2 * np.pi) * np.cos(2 * np.pi * Yf))
+    bfx = (np.roll(Az, -1, 1) - Az) / dx          # Bx = dAz/dy at x-faces
+    bfy = -(np.roll(Az, -1, 0) - Az) / dx         # By = -dAz/dx at y-faces
+    bf = np.stack([bfx, bfy, np.zeros((n, n))])
+    bc = core.cell_center_b(list(bf), 2)
+    u = np.zeros((8,) + (n, n))
+    u[0] = d0
+    u[1] = d0 * vx
+    u[2] = d0 * vy
+    u[IBX] = bc[0]
+    u[IBX + 1] = bc[1]
+    u[IP] = (p0 / (gamma - 1.0) + 0.5 * d0 * (vx ** 2 + vy ** 2)
+             + 0.5 * (bc[0] ** 2 + bc[1] ** 2))
+    sim.u = jnp.asarray(u)
+    sim.bf = jnp.asarray(bf)
+    return sim
+
+
+def test_orszag_tang_conservation_and_divb():
+    sim = _orszag_tang(lmin=5)
+    m0 = float(sim.totals()["mass"])
+    e0 = float(sim.totals()["energy"])
+    sim.evolve(0.1)
+    assert sim.nstep > 5
+    assert float(sim.max_divb()) < 1e-11
+    assert np.isclose(float(sim.totals()["mass"]), m0, rtol=1e-12)
+    assert np.isclose(float(sim.totals()["energy"]), e0, rtol=1e-11)
+    q = np.asarray(core.ctoprim(sim.u, sim.cfg))
+    assert q[0].min() > 0.0 and np.all(np.isfinite(q))
+
+
+def test_mhd_snapshot(tmp_path):
+    from ramses_tpu.io import reader as rdr
+    sim = _uniform_sim(ndim=2, lmin=3)
+    sim.evolve(0.02)
+    out = sim.dump(iout=1, base_dir=str(tmp_path))
+    s = rdr.load_snapshot(out)
+    names = s["var_names"]
+    assert "B_x_left" in names and "B_z_right" in names
+    cells = rdr.leaf_cells(s)
+    assert len(cells["density"]) == 64
+    assert np.allclose(cells["B_x_left"], 0.3, atol=1e-12)
+    assert np.allclose(cells["B_y_right"], 0.4, atol=1e-12)
+    assert np.allclose(cells["pressure"], 1.0, atol=1e-10)
