@@ -181,6 +181,29 @@ class PoissonParams:
 
 
 @dataclass
+class CoolingParams:
+    """&COOLING_PARAMS (hydro/read_hydro_params.f90:92-95)."""
+    cooling: bool = False
+    metal: bool = False
+    isothermal: bool = False
+    haardt_madau: bool = False
+    J21: float = 0.0
+    a_spec: float = 1.0
+    self_shielding: bool = False
+    z_ave: float = 0.0
+    z_reion: float = 8.5
+    T2max: float = 1e50
+    neq_chem: bool = False
+    cooling_ism: bool = False
+    barotropic_eos: bool = False
+    barotropic_eos_form: str = "isothermal"
+    polytrope_rho: float = 0.0
+    polytrope_index: float = 1.0
+    T_eos: float = 10.0
+    mu_gas: float = 1.0
+
+
+@dataclass
 class UnitsParams:
     """&UNITS_PARAMS (amr/units.f90)."""
     units_density: float = 1.0
@@ -203,6 +226,7 @@ class Params:
     refine: RefineParams = field(default_factory=RefineParams)
     boundary: BoundaryParams = field(default_factory=BoundaryParams)
     poisson: PoissonParams = field(default_factory=PoissonParams)
+    cooling: CoolingParams = field(default_factory=CoolingParams)
     units: UnitsParams = field(default_factory=UnitsParams)
     raw: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
@@ -222,6 +246,7 @@ _GROUP_MAP = {
     "refine_params": "refine",
     "boundary_params": "boundary",
     "poisson_params": "poisson",
+    "cooling_params": "cooling",
     "units_params": "units",
 }
 
@@ -259,7 +284,8 @@ def params_from_dict(groups: Dict[str, Dict[str, Any]],
             # the parser lowercases namelist keys; map back the reference's
             # capitalized MHD region fields (mhd/hydro_parameters.f90:80-82)
             key = {"a_region": "A_region", "b_region": "B_region",
-                   "c_region": "C_region"}.get(key, key)
+                   "c_region": "C_region", "j21": "J21", "t2max": "T2max",
+                   "t_eos": "T_eos"}.get(key, key)
             if key not in valid:
                 continue  # unknown keys ignored (subsystem not yet built)
             ftype = valid[key].type

@@ -102,6 +102,26 @@ class Simulation:
                         if a <= 1.0]
                 params.output.tout = sorted(set(params.output.tout + taus))
                 params.output.noutput = len(params.output.tout)
+        # cooling microphysics (&COOLING_PARAMS → tables at this epoch)
+        self.cool_tables = None
+        self.cool_spec = None
+        if params.cooling.cooling:
+            from ramses_tpu.hydro.cooling import CoolingSpec, build_tables
+            from ramses_tpu.units import units as units_fn
+            un = units_fn(params, cosmo=self.cosmo,
+                          aexp=(self.cosmo.aexp_ini if self.cosmo else 1.0))
+            self.cool_spec = CoolingSpec.from_params(params, un)
+            c = params.cooling
+            self.cool_tables = build_tables(
+                aexp=(self.cosmo.aexp_ini if self.cosmo else 1.0),
+                J21=float(c.J21), a_spec=float(c.a_spec),
+                z_reion=float(c.z_reion),
+                haardt_madau=bool(c.haardt_madau))
+            if (self.pspec.enabled or self.gspec.enabled
+                    or self.cosmo is not None):
+                import warnings
+                warnings.warn("cooling is wired into the pure-hydro path "
+                              "only for now; gravity/PM runs ignore it")
         self.output_times = list(params.output.tout[:params.output.noutput])
         self.on_output: Optional[Callable] = None
         # perf accounting (mus/pt of adaptive_loop.f90:204-212)
@@ -138,6 +158,12 @@ class Simulation:
                         jnp.asarray(st.dt_old, tdtype), n,
                         cosmo=self.cosmo)
                     st.dt_old = float(dt_old)
+                elif self.cool_tables is not None:
+                    from ramses_tpu.grid.uniform import run_steps_cool
+                    u, t, ndone = run_steps_cool(
+                        self.grid, st.u, jnp.asarray(st.t, tdtype),
+                        jnp.asarray(tout, tdtype), n,
+                        self.cool_tables, self.cool_spec)
                 else:
                     u, t, ndone = run_steps(self.grid, st.u,
                                             jnp.asarray(st.t, tdtype),

@@ -77,6 +77,32 @@ def run_steps(grid: UniformGrid, u, t, tend, nsteps: int):
     return u, t, ndone
 
 
+@partial(jax.jit, static_argnames=("grid", "cspec", "nsteps"))
+def run_steps_cool(grid: UniformGrid, u, t, tend, nsteps: int,
+                   tables, cspec):
+    """:func:`run_steps` with the cooling source applied after each hydro
+    step (the ``cooling_fine`` call that follows ``godunov_fine`` in
+    ``amr/amr_step.f90:448-474``)."""
+    from ramses_tpu.hydro.cooling import cooling_step
+
+    def body(carry, _):
+        u, t, ndone = carry
+        dt = cfl_dt(grid, u)
+        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+        active = t < tend
+        dt_eff = jnp.where(active, dt, 0.0)
+        un = step(grid, u, dt_eff)
+        un = cooling_step(un, tables, cspec, dt_eff, grid.cfg)
+        u = jnp.where(active, un, u)
+        t = jnp.where(active, t + dt, t)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, t, ndone), None
+
+    (u, t, ndone), _ = jax.lax.scan(body, (u, t, jnp.array(0)), None,
+                                    length=nsteps)
+    return u, t, ndone
+
+
 def totals(u, cfg: HydroStatic, dx: float):
     """Conservation audit (mass, momentum, energy) — ``check_cons``
     (``hydro/courant_fine.f90:161``)."""

@@ -1,0 +1,30 @@
+"""Barotropic equations of state (``hydro/eos.f90``).
+
+``barotropic_eos_temperature``: T2 = T/mu [K] as a function of density
+[H/cc], selected by ``barotropic_eos_form`` (&COOLING_PARAMS,
+``amr/amr_parameters.f90:219-230``).  Used as the polytrope temperature
+floor in the cooling pass and as the full EOS when ``barotropic_eos`` is
+set (cooling then disabled, ``hydro/cooling_fine.f90:397``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def barotropic_eos_temperature(nH, form: str, T2_eos: float,
+                               polytrope_rho_cu: float,
+                               polytrope_index: float):
+    """T2(nH); ``polytrope_rho_cu`` is the break density in code units
+    already divided by scale_nH upstream (``cooling_fine.f90:139``)."""
+    x = nH / polytrope_rho_cu
+    if form == "isothermal":
+        return jnp.full_like(nH, T2_eos)
+    if form == "polytrope":
+        return T2_eos * x ** (polytrope_index - 1.0)
+    if form == "double_polytrope":
+        return T2_eos * (1.0 + x ** (polytrope_index - 1.0))
+    if form == "custom":
+        return jnp.where(x < 1.0, T2_eos,
+                         T2_eos * x ** (polytrope_index - 1.0))
+    raise ValueError(f"unknown barotropic eos form {form!r}")

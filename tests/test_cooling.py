@@ -1,0 +1,186 @@
+"""Cooling/microphysics tests.
+
+Anchors: the implicit solver against a brute-force explicit ODE
+integration of the same tabulated rate, physical shape of the cooling
+function, equilibrium behavior, unconditional stability for huge dt,
+polytrope floor, EOS forms, and the driver wiring.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.hydro import cooling as cm
+from ramses_tpu.hydro.eos import barotropic_eos_temperature
+from ramses_tpu.units import X_frac, kB
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return cm.build_tables(aexp=1.0, J21=0.0)
+
+
+@pytest.fixture(scope="module")
+def tables_uv():
+    return cm.build_tables(aexp=0.25, J21=1.0)  # z=3, UV on
+
+
+def test_cooling_function_shape(tables):
+    """Primordial Lambda(T): negligible below 1e4 K, peaks near 1e5 K,
+    Bremsstrahlung ~sqrt(T) tail at high T."""
+    cool = np.asarray(tables.cool)
+    log_T2 = np.asarray(tables.log_T2)
+    i_n0 = 80  # nH ~ 1 /cc column
+    lam = 10.0 ** cool[i_n0]
+    T2 = 10.0 ** log_T2
+    assert lam[np.searchsorted(log_T2, 3.0)] < 1e-25   # cold: no cooling
+    ipeak = np.argmax(lam)
+    # CIE primordial curve: H excitation peak at T≈2e4 K (logT2≈4.3-4.6)
+    assert 4.2 < log_T2[ipeak] < 5.7
+    # free-free tail slope ~ 0.5 between 1e8 and 1e9
+    i1 = np.searchsorted(log_T2, 8.0)
+    i2 = np.searchsorted(log_T2, 8.8)
+    slope = (np.log10(lam[i2]) - np.log10(lam[i1])) / (log_T2[i2]
+                                                       - log_T2[i1])
+    assert 0.3 < slope < 0.7
+
+
+def test_solve_cooling_matches_explicit_ode(tables):
+    """The implicit integrator must track a high-resolution explicit
+    integration of the same interpolated rate."""
+    nH = jnp.asarray([0.1, 1.0, 10.0])
+    T2 = jnp.asarray([1e6, 1e6, 1e6])
+    one = jnp.ones(3)
+    dt_s = 3.15e13  # ~1 Myr
+    out = np.asarray(cm.solve_cooling(tables, nH, T2, 0.0 * one, one,
+                                      dt_s))
+
+    # explicit reference: many tiny implicit steps through the same entry
+    nsub = 4000
+    T = np.array([1e6, 1e6, 1e6])
+    for _ in range(nsub):
+        cur = np.asarray(cm.solve_cooling(tables, nH, jnp.asarray(T),
+                                          0.0 * one, one, dt_s / nsub))
+        T = cur
+    # compare in dex: near the 1e4 K cutoff the rate is extremely steep,
+    # so pointwise agreement between time-discretizations is log-scale
+    assert np.allclose(np.log10(out), np.log10(T), atol=0.05)
+
+
+def test_solve_cooling_stability_huge_dt(tables):
+    """Stiff limit: dt of a Hubble time must return finite positive T2
+    near the thermal equilibrium/floor, never negative."""
+    nH = jnp.asarray([1e-4, 1.0, 1e4])
+    T2 = jnp.asarray([1e7, 1e7, 1e7])
+    one = jnp.ones(3)
+    out = np.asarray(cm.solve_cooling(tables, nH, T2, one, one, 4e17))
+    assert np.all(np.isfinite(out))
+    assert np.all(out > 0.0)
+    assert np.all(out < 1e7)   # it cooled
+
+
+def test_heating_equilibrium_with_uv(tables_uv):
+    """With a UV background, low-density gas warms toward ~1e4 K
+    photoheating equilibrium instead of cooling to the floor."""
+    nH = jnp.asarray([1e-5])
+    cold = np.asarray(cm.solve_cooling(tables_uv, nH,
+                                       jnp.asarray([100.0]),
+                                       jnp.zeros(1), jnp.ones(1), 1e18))
+    assert cold[0] > 1e3   # heated by orders of magnitude
+
+
+def test_metal_cooling_scales(tables):
+    nH = jnp.asarray([1.0])
+    T2 = jnp.asarray([10 ** 5.3])
+    dt = 1e13
+    t_prim = np.asarray(cm.solve_cooling(tables, nH, T2, jnp.zeros(1),
+                                         jnp.ones(1), dt))[0]
+    t_meta = np.asarray(cm.solve_cooling(tables, nH, T2, jnp.ones(1),
+                                         jnp.ones(1), dt))[0]
+    assert t_meta < t_prim  # metals cool faster
+
+
+def test_eos_forms():
+    nH = jnp.asarray([0.1, 1.0, 10.0, 1000.0])
+    iso = np.asarray(barotropic_eos_temperature(nH, "isothermal", 10.0,
+                                                1.0, 1.4))
+    assert np.allclose(iso, 10.0)
+    poly = np.asarray(barotropic_eos_temperature(nH, "polytrope", 10.0,
+                                                 1.0, 1.4))
+    assert np.allclose(poly, 10.0 * np.asarray(nH) ** 0.4)
+    cust = np.asarray(barotropic_eos_temperature(nH, "custom", 10.0,
+                                                 1.0, 1.4))
+    assert np.allclose(cust[:2], 10.0)
+    assert cust[3] > 10.0
+
+
+def test_cooling_step_energy_decrease(tables):
+    """Hot dense box: cooling_step removes thermal energy, leaves kinetic
+    energy and mass untouched."""
+    from ramses_tpu.hydro.core import HydroStatic
+    cfg = HydroStatic(ndim=2, gamma=5.0 / 3.0)
+    spec = cm.CoolingSpec(enabled=True, scale_T2=1e7, scale_nH=1.0,
+                          scale_t=1e15)
+    n = 8
+    rho = jnp.ones((n, n))
+    vx = 0.3 * jnp.ones((n, n))
+    p = jnp.ones((n, n)) * 0.1      # T2 = 1e6/mu-ish after scaling
+    u = jnp.stack([rho, rho * vx, jnp.zeros((n, n)),
+                   p / (cfg.gamma - 1) + 0.5 * rho * vx ** 2])
+    un = cm.cooling_step(u, tables, spec, 1.0, cfg)
+    assert float(jnp.max(jnp.abs(un[0] - u[0]))) == 0.0
+    assert float(jnp.max(jnp.abs(un[1] - u[1]))) == 0.0
+    assert float(un[3].sum()) < float(u[3].sum())
+    # kinetic part preserved exactly: E_new - E_old is thermal only
+    eint_old = u[3] - 0.5 * rho * vx ** 2
+    eint_new = un[3] - 0.5 * rho * vx ** 2
+    assert float(jnp.min(eint_new)) > 0.0
+    assert float(jnp.max(eint_new / eint_old)) < 1.0
+
+
+def test_polytrope_floor(tables):
+    """With a barotropic floor the gas cannot cool below it."""
+    from ramses_tpu.hydro.core import HydroStatic
+    cfg = HydroStatic(ndim=1, gamma=5.0 / 3.0)
+    spec = cm.CoolingSpec(enabled=True, scale_T2=1e7, scale_nH=10.0,
+                          scale_t=1e18, floor_form="isothermal",
+                          T2_eos=3e4)
+    rho = jnp.ones((16,))
+    p = jnp.ones((16,)) * 0.1
+    u = jnp.stack([rho, jnp.zeros(16), p / (cfg.gamma - 1)])
+    un = cm.cooling_step(u, tables, spec, 10.0, cfg)
+    T2 = np.asarray((cfg.gamma - 1) * un[2] / un[0] * spec.scale_T2)
+    assert np.all(T2 > 0.9 * 3e4)
+
+
+def test_driver_wiring(tmp_path):
+    """A sedov-like hot blast with cooling on runs and loses energy."""
+    from ramses_tpu.driver import Simulation
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "point"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "length_x": [10.0, 1.0], "length_y": [10.0, 1.0],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.0],
+                        "p_region": [1e-3, 20.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc"},
+        "cooling_params": {"cooling": True},
+        "units_params": {"units_density": 1.66e-24, "units_time": 3.15e13,
+                         "units_length": 3.086e18},
+        "output_params": {"noutput": 1, "tout": [0.02], "tend": 0.02},
+    }
+    p = params_from_dict(groups, ndim=2)
+    sim = Simulation(p, dtype=jnp.float64)
+    from ramses_tpu.grid.uniform import totals
+    e0 = float(totals(sim.state.u, sim.cfg, sim.dx)["energy"])
+    sim.evolve()
+    e1 = float(totals(sim.state.u, sim.cfg, sim.dx)["energy"])
+    assert sim.state.nstep > 0
+    assert e1 < e0
+    assert np.all(np.isfinite(np.asarray(sim.state.u)))
