@@ -181,6 +181,17 @@ class PoissonParams:
 
 
 @dataclass
+class RtParams:
+    """&RT_PARAMS (rt/rt_init.f90:151-152), reduced to the implemented
+    single-group M1 surface."""
+    rt_c_fraction: float = 0.01
+    rt_courant_factor: float = 0.8
+    rt_otsa: bool = True
+    rt_nsubcycle: int = 1
+    rt_is_outflow_bound: bool = False
+
+
+@dataclass
 class CoolingParams:
     """&COOLING_PARAMS (hydro/read_hydro_params.f90:92-95)."""
     cooling: bool = False
@@ -227,6 +238,7 @@ class Params:
     boundary: BoundaryParams = field(default_factory=BoundaryParams)
     poisson: PoissonParams = field(default_factory=PoissonParams)
     cooling: CoolingParams = field(default_factory=CoolingParams)
+    rt: RtParams = field(default_factory=RtParams)
     units: UnitsParams = field(default_factory=UnitsParams)
     raw: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
@@ -247,6 +259,7 @@ _GROUP_MAP = {
     "boundary_params": "boundary",
     "poisson_params": "poisson",
     "cooling_params": "cooling",
+    "rt_params": "rt",
     "units_params": "units",
 }
 

@@ -1,0 +1,10 @@
+"""M1-closure radiative transfer (SURVEY.md §2.5, §2.9).
+
+The ``rt/`` module equivalent — photon density + flux per group advected
+with the M1 Eddington closure at a reduced speed of light, coupled to
+non-equilibrium hydrogen photochemistry and photoheating — and at the
+same time the ATON replacement: the whole solve is one dense fused device
+program on the uniform grid (the reference's GPU offload pattern,
+gather → device step × N → scatter, §2.9, is simply our normal execution
+model).
+"""
