@@ -122,6 +122,28 @@ class Simulation:
                 import warnings
                 warnings.warn("cooling is wired into the pure-hydro path "
                               "only for now; gravity/PM runs ignore it")
+        # star formation / feedback / sinks (coarse-step cadence passes)
+        from ramses_tpu.pm.sinks import SinkSet, SinkSpec
+        from ramses_tpu.pm.star_formation import SfSpec
+        from ramses_tpu.units import units as units_fn
+        self.units = units_fn(params, cosmo=self.cosmo,
+                              aexp=(self.cosmo.aexp_ini if self.cosmo
+                                    else 1.0))
+        self.sf_spec = SfSpec.from_params(params)
+        self.sink_spec = SinkSpec.from_params(params)
+        self.sinks = (SinkSet.empty(params.ndim)
+                      if self.sink_spec.enabled else None)
+        self._sf_rng = np.random.default_rng(1234)
+        self._next_star_id = 1
+        if self.sf_spec.enabled and not self.pspec.enabled:
+            import dataclasses as _dc
+            self.pspec = _dc.replace(self.pspec, enabled=True)
+            if self.state.p is None:
+                npmax = params.amr.npartmax or 100000
+                self.state.p = ParticleSet.make(
+                    jnp.zeros((0, params.ndim)),
+                    jnp.zeros((0, params.ndim)), jnp.zeros((0,)),
+                    nmax=npmax)
         self.output_times = list(params.output.tout[:params.output.noutput])
         self.on_output: Optional[Callable] = None
         # perf accounting (mus/pt of adaptive_loop.f90:204-212)
@@ -148,6 +170,7 @@ class Simulation:
             ttol = 1e-12 * (abs(tout) + 1.0)
             while st.t < tout - ttol and st.nstep < nstepmax:
                 n = min(chunk, nstepmax - st.nstep)
+                t_before = st.t
                 t0 = time.perf_counter()
                 if (self.pspec.enabled or self.gspec.enabled
                         or self.cosmo is not None):
@@ -173,6 +196,7 @@ class Simulation:
                 ndone = int(ndone)
                 st.u, st.t, st.nstep = u, float(t), st.nstep + ndone
                 self.cell_updates += ndone * self.grid.ncell
+                self._source_passes(st.t - t_before)
                 if verbose:
                     mus_pt = (1e6 * self.wall_s / max(self.cell_updates, 1))
                     print(f"step {st.nstep:6d}  t={st.t:.6e} "
@@ -185,6 +209,39 @@ class Simulation:
                 self.on_output(self, st.iout)
             st.iout += 1
         return st
+
+    def _source_passes(self, dt_chunk: float):
+        """Coarse-step-cadence source terms: star formation, SN feedback,
+        sink creation/accretion/merging/motion (``amr_step`` order
+        ``:369-380,493,549-567``)."""
+        if dt_chunk <= 0.0:
+            return
+        st = self.state
+        if self.sf_spec.enabled:
+            from ramses_tpu.pm.star_formation import (star_formation,
+                                                      thermal_feedback)
+            u_np = np.asarray(st.u, dtype=np.float64)
+            u_np, p2, self._next_star_id = star_formation(
+                u_np, st.p, self._sf_rng, self.sf_spec, self.units,
+                self.dx, st.t, dt_chunk, self._next_star_id)
+            u_np, p2 = thermal_feedback(u_np, p2, self.sf_spec,
+                                        self.units, self.dx, st.t)
+            st.u = jnp.asarray(u_np, st.u.dtype)
+            st.p = p2
+        if self.sinks is not None:
+            from ramses_tpu.pm.sinks import (accrete, create_sinks,
+                                             drift_kick, merge_sinks)
+            u_np = np.asarray(st.u, dtype=np.float64)
+            u_np, self.sinks = create_sinks(
+                u_np, self.sinks, self.sink_spec, self.units, self.dx,
+                st.t, self.cfg.gamma)
+            u_np, self.sinks = accrete(
+                u_np, self.sinks, self.sink_spec, self.units, self.dx,
+                dt_chunk, self.cfg.gamma)
+            self.sinks = merge_sinks(self.sinks, self.sink_spec, self.dx)
+            self.sinks = drift_kick(self.sinks, st.f, self.dx, dt_chunk,
+                                    self.params.amr.boxlen)
+            st.u = jnp.asarray(u_np, st.u.dtype)
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)

@@ -44,6 +44,7 @@ class ParticleSet:
     family: jax.Array     # [n] int8 family codes
     tp: jax.Array         # [n] birth time (stars)
     zp: jax.Array         # [n] metallicity (stars)
+    flags: jax.Array      # [n] int8 event bookkeeping (e.g. SN done)
 
     @property
     def n(self) -> int:
@@ -74,7 +75,8 @@ class ParticleSet:
                   else jnp.full((nmax,), FAM_DM, jnp.int8))
         zero = jnp.zeros((nmax,), dtype)
         return cls(x=x, v=v, m=m, active=active, idp=idp, family=family,
-                   tp=zero, zp=zero)
+                   tp=zero, zp=zero,
+                   flags=jnp.zeros((nmax,), jnp.int8))
 
 
 def _cic_corners(x, shape: Tuple[int, ...], dx: float):

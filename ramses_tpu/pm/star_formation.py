@@ -1,0 +1,202 @@
+"""Star formation (Schmidt law) and supernova feedback.
+
+Reference: ``pm/star_formation.f90`` (threshold + Poisson sampling,
+``:536-574``) and ``pm/feedback.f90`` (``thermal_feedback:6``, SN specific
+energy 1e51 erg / 10 Msun, ``:231``).
+
+These passes run at coarse-step cadence on the host (numpy): particle
+creation is a data-dependent append, the one operation that fights XLA's
+static shapes — exactly the part the reference also treats as scalar
+bookkeeping between vectorized sweeps.  Gas state transfers back as a
+device array; everything else stays fused on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace as dreplace
+
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
+from ramses_tpu.units import Units, factG_in_cgs, yr2sec
+
+M_SUN = 1.9891e33
+FLAG_SN_DONE = 1
+
+
+@dataclass(frozen=True)
+class SfSpec:
+    """&SF_PARAMS + &FEEDBACK_PARAMS subset (amr/amr_parameters.f90:141-164)."""
+    enabled: bool = False
+    n_star: float = 0.1          # SF density threshold [H/cc]
+    t_star: float = 0.0          # SF timescale at threshold [Gyr]
+    eps_star: float = 0.0        # efficiency per free-fall when t_star=0
+    m_star: float = -1.0         # particle mass in units of the quantum
+    T2_star: float = 0.0         # ISM polytrope normalization [K]
+    g_star: float = 1.0          # ISM polytrope index
+    # feedback
+    eta_sn: float = 0.0          # ejecta mass fraction
+    yield_metal: float = 0.1
+    t_sne: float = 10.0          # delay [Myr]
+
+    @classmethod
+    def from_params(cls, p) -> "SfSpec":
+        raw_sf = p.raw.get("sf_params", {}) if p.raw else {}
+        raw_fb = p.raw.get("feedback_params", {}) if p.raw else {}
+
+        def g(d, k, dflt):
+            v = d.get(k, dflt)
+            return v[0] if isinstance(v, list) else v
+
+        return cls(
+            enabled=bool(raw_sf),
+            n_star=float(g(raw_sf, "n_star", 0.1)),
+            t_star=float(g(raw_sf, "t_star", 0.0)),
+            eps_star=float(g(raw_sf, "eps_star", 0.0)),
+            m_star=float(g(raw_sf, "m_star", -1.0)),
+            T2_star=float(g(raw_sf, "t2_star", 0.0)),
+            g_star=float(g(raw_sf, "g_star", 1.0)),
+            eta_sn=float(g(raw_fb, "eta_sn", 0.0)),
+            yield_metal=float(g(raw_fb, "yield", 0.1)),
+            t_sne=float(g(raw_fb, "t_sne", 10.0)))
+
+
+def mstar_quantum(spec: SfSpec, units: Units, dx_min: float,
+                  ndim: int) -> float:
+    """Star particle mass [code]: n_star·vol_min by default, or
+    m_star·mass_sph (``star_formation.f90:154-158``)."""
+    vol_min = dx_min ** ndim
+    base = spec.n_star / units.scale_nH * vol_min
+    return base if spec.m_star <= 0 else spec.m_star * base
+
+
+def star_formation(u, p: ParticleSet, rng: np.random.Generator,
+                   spec: SfSpec, units: Units, dx: float, t: float,
+                   dt: float, next_id: int):
+    """One SF pass over a dense state ``u [nvar, *sp]`` (host numpy).
+
+    Returns (u', particles', next_id').  Poisson-samples
+    N ~ P(mgas/mstar · dt/t_star(ρ)) per eligible cell
+    (``star_formation.f90:561-574``), caps at 90% of the cell gas, removes
+    the mass at the cell's velocity, appends FAM_STAR particles.
+    """
+    u = np.array(u)
+    ndim = u.ndim - 1
+    vol = dx ** ndim
+    rho = u[0]
+    nH = rho * units.scale_nH
+    eligible = nH > spec.n_star
+    if not eligible.any():
+        return u, p, next_id
+
+    mstar = mstar_quantum(spec, units, dx, ndim)
+    # SF timescale: t_star·(nH/n_star)^-1/2, or t_ff/eps_star
+    if spec.t_star > 0:
+        tstar_s = (spec.t_star * 1e9 * yr2sec
+                   * np.sqrt(spec.n_star / np.maximum(nH, 1e-30)))
+    else:
+        rho_cgs = rho * units.scale_d
+        t_ff = np.sqrt(3 * np.pi / (32 * factG_in_cgs
+                                    * np.maximum(rho_cgs, 1e-300)))
+        tstar_s = t_ff / max(spec.eps_star, 1e-10)
+    tstar_code = tstar_s / units.scale_t
+
+    lam = np.where(eligible, rho * vol / mstar * dt / tstar_code, 0.0)
+    nnew = rng.poisson(lam)
+    # cap: at most 90% of the cell's gas (``:569``)
+    cap = (0.9 * rho * vol / mstar).astype(np.int64)
+    nnew = np.minimum(nnew, np.maximum(cap, 0))
+    idx = np.argwhere(nnew > 0)
+    if len(idx) == 0:
+        return u, p, next_id
+
+    counts = nnew[tuple(idx.T)]
+    ntot = int(counts.sum())
+    # free capacity in the particle arrays
+    active = np.asarray(p.active)
+    free = np.where(~active)[0]
+    if len(free) < ntot:     # truncate: keep the earliest cells
+        keep = np.cumsum(counts) <= len(free)
+        idx, counts = idx[keep], counts[keep]
+        ntot = int(counts.sum())
+        if ntot == 0:
+            return u, p, next_id
+    slots = free[:ntot]
+
+    # remove gas at the cell velocity (momentum/energy proportionally)
+    dm = counts * mstar / vol                        # density removed
+    cells = tuple(idx.T)
+    frac = 1.0 - dm / rho[cells]
+    for iv in range(u.shape[0]):
+        u[iv][cells] = u[iv][cells] * frac
+
+    # new particles at cell centres, gas velocity
+    xnew = (idx + 0.5) * dx
+    vel = np.stack([u[1 + d][cells] / np.maximum(u[0][cells], 1e-300)
+                    for d in range(ndim)], axis=1)
+    rep = np.repeat(np.arange(len(idx)), counts)
+
+    x_arr = np.array(p.x)
+    v_arr = np.array(p.v)
+    m_arr = np.array(p.m)
+    act = active.copy()
+    fam = np.array(p.family)
+    tp = np.array(p.tp)
+    idp = np.array(p.idp)
+    flg = np.array(p.flags)
+    x_arr[slots] = xnew[rep]
+    v_arr[slots] = vel[rep]
+    m_arr[slots] = mstar
+    act[slots] = True
+    fam[slots] = FAM_STAR
+    tp[slots] = t
+    idp[slots] = next_id + np.arange(ntot)
+    flg[slots] = 0
+    p2 = dreplace(p, x=jnp.asarray(x_arr), v=jnp.asarray(v_arr),
+                  m=jnp.asarray(m_arr), active=jnp.asarray(act),
+                  family=jnp.asarray(fam), tp=jnp.asarray(tp),
+                  idp=jnp.asarray(idp), flags=jnp.asarray(flg))
+    return u, p2, next_id + ntot
+
+
+def thermal_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,
+                     dx: float, t: float):
+    """Delayed thermal SN dumps (``pm/feedback.f90:6-231,351``): stars
+    older than t_sne return eta_sn of their mass and inject
+    1e51 erg / 10 Msun of specific ejecta energy into their cell, once."""
+    if spec.eta_sn <= 0:
+        return u, p
+    u = np.array(u)
+    ndim = u.ndim - 1
+    vol = dx ** ndim
+    age_code = t - np.asarray(p.tp)
+    t_sne_code = spec.t_sne * 1e6 * yr2sec / units.scale_t
+    due = (np.asarray(p.active)
+           & (np.asarray(p.family) == FAM_STAR)
+           & (np.asarray(p.flags) & FLAG_SN_DONE == 0)
+           & (age_code > t_sne_code))
+    if not due.any():
+        return u, p
+
+    # specific SN energy in code units (feedback.f90:231)
+    esn_code = (1e51 / (10.0 * M_SUN)) / units.scale_v ** 2
+    xdue = np.asarray(p.x)[due]
+    mdue = np.asarray(p.m)[due]
+    mej = spec.eta_sn * mdue
+    cells = tuple(np.clip((xdue[:, d] / dx).astype(np.int64), 0,
+                          u.shape[1 + d] - 1) for d in range(ndim))
+    np.add.at(u[0], cells, mej / vol)
+    vstar = np.asarray(p.v)[due]
+    for d in range(ndim):
+        np.add.at(u[1 + d], cells, mej * vstar[:, d] / vol)
+    # kinetic energy of the returned mass + SN thermal energy
+    ek = 0.5 * mej * (vstar ** 2).sum(axis=1)
+    np.add.at(u[1 + ndim], cells, (ek + mej * esn_code) / vol)
+
+    m_arr = np.array(p.m)
+    m_arr[due] = m_arr[due] - mej
+    flg = np.array(p.flags)
+    flg[due] |= FLAG_SN_DONE
+    p2 = dreplace(p, m=jnp.asarray(m_arr), flags=jnp.asarray(flg))
+    return u, p2

@@ -1,0 +1,43 @@
+"""End-to-end driver test: dense box forms stars during an evolve run."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.driver import Simulation
+
+
+def test_driver_forms_stars():
+    groups = {
+        "run_params": {"hydro": True, "pic": True},
+        "amr_params": {"levelmin": 3, "levelmax": 3, "boxlen": 1.0,
+                       "npartmax": 50000},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "y_center": [0.5],
+                        "z_center": [0.5],
+                        "length_x": [10.0], "length_y": [10.0],
+                        "length_z": [10.0], "exp_region": [10.0],
+                        "d_region": [100.0], "p_region": [10.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc"},
+        "sf_params": {"n_star": 1.0, "t_star": 0.05},
+        "feedback_params": {"eta_sn": 0.1, "t_sne": 1e-6},
+        "units_params": {"units_density": 1.66e-24,
+                         "units_time": 3.156e13,
+                         "units_length": 3.086e18},
+        "output_params": {"noutput": 1, "tout": [0.02], "tend": 0.02},
+    }
+    p = params_from_dict(groups, ndim=3)
+    sim = Simulation(p, dtype=jnp.float64)
+    m0 = float(np.asarray(sim.state.u)[0].sum()) * sim.dx ** 3
+    sim.evolve(chunk=4)
+    act = np.asarray(sim.state.p.active)
+    nstars = int(act.sum())
+    assert nstars > 0, "no stars formed in a 100x-threshold box"
+    m_star = float(np.asarray(sim.state.p.m)[act].sum())
+    m_gas = float(np.asarray(sim.state.u)[0].sum()) * sim.dx ** 3
+    # mass budget closes (SN mass returns included)
+    assert np.isclose(m_gas + m_star, m0, rtol=1e-10)
+    assert np.all(np.isfinite(np.asarray(sim.state.u)))

@@ -1,0 +1,175 @@
+"""Star formation / SN feedback / sink particle tests."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
+from ramses_tpu.pm.sinks import (SinkSet, SinkSpec, accrete, create_sinks,
+                                 drift_kick, merge_sinks)
+from ramses_tpu.pm.star_formation import (FLAG_SN_DONE, SfSpec,
+                                          mstar_quantum, star_formation,
+                                          thermal_feedback)
+from ramses_tpu.units import Units, yr2sec
+
+
+def _units():
+    # 1 cc at mH, Myr timescale, pc lengths
+    return Units(scale_l=3.086e18, scale_t=3.156e13, scale_d=1.66e-24)
+
+
+def _empty_particles(ndim=3, nmax=4096):
+    return ParticleSet.make(np.zeros((0, ndim)), np.zeros((0, ndim)),
+                            np.zeros(0), nmax=nmax)
+
+
+def _box(n=8, rho=100.0, ndim=3, p=1.0):
+    u = np.zeros((ndim + 2,) + (n,) * ndim)
+    u[0] = rho
+    u[ndim + 1] = p / 0.4
+    return u
+
+
+def test_sf_threshold():
+    """No stars below the density threshold."""
+    un = _units()
+    spec = SfSpec(enabled=True, n_star=1e4, t_star=1.0)
+    u = _box(rho=1.0)          # nH ~ 0.76 << 1e4
+    p = _empty_particles()
+    rng = np.random.default_rng(0)
+    u2, p2, nid = star_formation(u, p, rng, spec, un, 1.0 / 8, 0.0, 0.1, 1)
+    assert int(np.asarray(p2.active).sum()) == 0
+
+
+def test_sf_expected_mass_and_conservation():
+    """Poisson-sampled stellar mass ≈ mgas·dt/t_star; total conserved."""
+    un = _units()
+    spec = SfSpec(enabled=True, n_star=1.0, t_star=0.1)
+    n = 8
+    dx = 1.0 / n
+    u = _box(n=n, rho=100.0)
+    p = _empty_particles(nmax=200000)
+    rng = np.random.default_rng(1)
+    m_gas0 = u[0].sum() * dx ** 3
+    dt = 0.01
+    # expected: lam_cell = mcell/mstar * dt/tstar(rho)
+    u2, p2, nid = star_formation(u, p, rng, spec, un, dx, 0.0, dt, 1)
+    m_star = float(np.asarray(p2.m)[np.asarray(p2.active)].sum())
+    m_gas1 = u2[0].sum() * dx ** 3
+    assert np.isclose(m_gas0, m_gas1 + m_star, rtol=1e-12)
+    nH = 100.0 * un.scale_nH
+    tstar_code = (0.1 * 1e9 * yr2sec * np.sqrt(1.0 / nH)) / un.scale_t
+    expected = m_gas0 * dt / tstar_code
+    assert abs(m_star - expected) < 0.2 * expected
+    fam = np.asarray(p2.family)[np.asarray(p2.active)]
+    assert np.all(fam == FAM_STAR)
+
+
+def test_sn_feedback_once():
+    """SN fires once after t_sne, returns mass and energy."""
+    un = _units()
+    spec = SfSpec(enabled=True, eta_sn=0.2, t_sne=10.0)
+    n = 4
+    dx = 1.0 / n
+    u = _box(n=n, rho=1.0, ndim=3)
+    p = ParticleSet.make(np.array([[0.4, 0.4, 0.4]]),
+                         np.array([[0.5, 0.0, 0.0]]), np.array([2.0]),
+                         family=np.array([FAM_STAR], dtype=np.int8),
+                         nmax=4)
+    t_sne_code = 10.0 * 1e6 * yr2sec / un.scale_t
+    e0 = u[4].sum() * dx ** 3
+    m0 = u[0].sum() * dx ** 3 + 2.0
+    # before the delay: nothing
+    u1, p1 = thermal_feedback(u.copy(), p, spec, un, dx, 0.5 * t_sne_code)
+    assert np.allclose(u1, u)
+    # after the delay: explosion
+    u2, p2 = thermal_feedback(u.copy(), p, spec, un, dx, 2.0 * t_sne_code)
+    mej = 0.2 * 2.0
+    assert np.isclose(float(np.asarray(p2.m)[0]), 2.0 - mej)
+    assert np.isclose(u2[0].sum() * dx ** 3 + float(np.asarray(p2.m)[0]),
+                      m0, rtol=1e-12)
+    de = u2[4].sum() * dx ** 3 - e0
+    esn_code = (1e51 / (10 * 1.9891e33)) / un.scale_v ** 2
+    ek_ej = 0.5 * mej * 0.25
+    assert np.isclose(de, mej * esn_code + ek_ej, rtol=1e-10)
+    assert int(np.asarray(p2.flags)[0]) & FLAG_SN_DONE
+    # and not twice
+    u3, p3 = thermal_feedback(u2.copy(), p2, spec, un, dx,
+                              3.0 * t_sne_code)
+    assert np.allclose(u3, u2)
+
+
+def test_sink_creation_and_threshold_accretion():
+    un = _units()
+    spec = SinkSpec(enabled=True, n_sink=1e3 / un.scale_nH * un.scale_nH,
+                    accretion_scheme="threshold", c_acc=0.5)
+    spec = SinkSpec(enabled=True, n_sink=1e3,
+                    accretion_scheme="threshold", c_acc=0.5)
+    n = 8
+    dx = 1.0 / n
+    u = _box(n=n, rho=1.0)
+    peak_rho = 5e3 / un.scale_nH
+    u[0][4, 4, 4] = peak_rho
+    m0 = u[0].sum() * dx ** 3
+    sinks = SinkSet.empty(3)
+    u, sinks = create_sinks(u, sinks, spec, un, dx, 0.0, 1.4)
+    assert sinks.n == 1
+    d_thr = 1e3 / un.scale_nH
+    assert np.isclose(sinks.m[0], (peak_rho - d_thr) * dx ** 3)
+    assert np.isclose(u[0].sum() * dx ** 3 + sinks.m.sum(), m0, rtol=1e-12)
+    # refill the cell above threshold and accrete
+    u[0][4, 4, 4] = 2e3 / un.scale_nH
+    m1 = u[0].sum() * dx ** 3 + sinks.m.sum()
+    u, sinks = accrete(u, sinks, spec, un, dx, 1.0, 1.4)
+    assert np.isclose(u[0].sum() * dx ** 3 + sinks.m.sum(), m1, rtol=1e-12)
+    assert u[0][4, 4, 4] * un.scale_nH > 1e3 * 0.49  # half the excess left
+
+
+def test_sink_bondi_rate():
+    """Bondi accretion matches the analytic rate on a uniform medium."""
+    un = _units()
+    spec = SinkSpec(enabled=True, accretion_scheme="bondi")
+    n = 8
+    dx = 10.0 / n
+    u = _box(n=n, rho=2.0, p=0.5)
+    sinks = SinkSet.empty(3)
+    sinks.x = np.array([[5.0, 5.0, 5.0]])
+    sinks.v = np.zeros((1, 3))
+    sinks.m = np.array([3.0])
+    sinks.tform = np.zeros(1)
+    sinks.idp = np.array([1], dtype=np.int64)
+    from ramses_tpu.units import factG_in_cgs
+    g_code = factG_in_cgs * un.scale_d * un.scale_t ** 2
+    cs2 = 1.4 * 0.5 / 2.0
+    expected = 4 * np.pi * g_code ** 2 * 9.0 * 2.0 / cs2 ** 1.5
+    dt = 1e-3
+    m0 = sinks.m[0]
+    u, sinks = accrete(u, sinks, spec, un, dx, dt, 1.4)
+    assert np.isclose(sinks.m[0] - m0, expected * dt, rtol=1e-6)
+
+
+def test_sink_merging():
+    spec = SinkSpec(enabled=True, merging_cells=2.0)
+    s = SinkSet.empty(2)
+    s.x = np.array([[0.5, 0.5], [0.52, 0.5], [0.9, 0.9]])
+    s.v = np.array([[1.0, 0.0], [0.0, 1.0], [0.0, 0.0]])
+    s.m = np.array([2.0, 1.0, 5.0])
+    s.tform = np.zeros(3)
+    s.idp = np.arange(3, dtype=np.int64)
+    s2 = merge_sinks(s, spec, dx=0.05)
+    assert s2.n == 2
+    i = np.argmin(s2.m)  # merged pair has mass 3
+    assert np.isclose(s2.m[i], 3.0)
+    assert np.allclose(s2.v[i], [2.0 / 3.0, 1.0 / 3.0])
+
+
+def test_sink_drift():
+    s = SinkSet.empty(2)
+    s.x = np.array([[0.9, 0.5]])
+    s.v = np.array([[0.3, 0.0]])
+    s.m = np.array([1.0])
+    s.tform = np.zeros(1)
+    s.idp = np.array([1], dtype=np.int64)
+    s = drift_kick(s, None, 0.1, 0.5, boxlen=1.0)
+    assert np.isclose(s.x[0, 0], 0.05)  # periodic wrap
