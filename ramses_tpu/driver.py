@@ -135,6 +135,11 @@ class Simulation:
                       if self.sink_spec.enabled else None)
         self._sf_rng = np.random.default_rng(1234)
         self._next_star_id = 1
+        # turbulence forcing (&TURB_PARAMS)
+        from ramses_tpu.turb.forcing import TurbForcing, TurbSpec
+        self.turb_spec = TurbSpec.from_params(params)
+        self.turb = (TurbForcing(shape, self.turb_spec)
+                     if self.turb_spec.enabled else None)
         if self.sf_spec.enabled and not self.pspec.enabled:
             import dataclasses as _dc
             self.pspec = _dc.replace(self.pspec, enabled=True)
@@ -217,6 +222,12 @@ class Simulation:
         if dt_chunk <= 0.0:
             return
         st = self.state
+        if self.turb is not None:
+            from ramses_tpu.turb.forcing import apply_forcing
+            self.turb.update(dt_chunk)
+            acc = self.turb.acceleration()
+            st.u = apply_forcing(st.u, acc, dt_chunk,
+                                 self.turb_spec.turb_min_rho)
         if self.sf_spec.enabled:
             from ramses_tpu.pm.star_formation import (star_formation,
                                                       thermal_feedback)

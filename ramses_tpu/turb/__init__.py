@@ -1,0 +1,5 @@
+"""Turbulence forcing (SURVEY.md §2.8): Ornstein-Uhlenbeck process in
+k-space with solenoidal/compressive Helmholtz projection, applied as a
+body acceleration.  The reference's FFTW-on-rank-1-then-broadcast design
+(``turb/``) becomes a device-resident ``jnp.fft`` field — no broadcast,
+no dedicated rank."""

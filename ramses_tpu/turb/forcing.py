@@ -1,0 +1,170 @@
+"""OU forcing field in spectral space.
+
+Reference: ``turb/turb_next_field.f90`` (OU update), the Helmholtz
+projection of ``turb/turb_force_utils.f90`` (``proj_op``: solenoidal
+(I - kk/k²) vs compressive kk/k² mixed by ``comp_frac``) and the power
+spectra of ``calc_power_spectrum:65-102`` ('parabolic' 1-(|k|-2)²,
+'power_law' |k|⁻², 'konstandin' 2-|k|).  State is the complex spectral
+field [ndim, *kshape]; each update is
+
+    f ← f·exp(-dt/T) + σ·sqrt(1-exp(-2dt/T))·N(0,1)
+
+followed by projection and rms normalization — all fused on device.
+Checkpointing mirrors ``write_turb_fields.f90``: the spectral state +
+RNG key round-trips through ``.npz``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TurbSpec:
+    """&TURB_PARAMS (turb/turb_parameters.f90:36-51)."""
+    enabled: bool = False
+    turb_type: int = 1            # 1 driven evolving, 3 decaying
+    seed: int = 0
+    comp_frac: float = 1.0 / 3.0  # compressive fraction
+    turb_T: float = 1.0           # autocorrelation time [code]
+    turb_Ndt: int = 100           # OU updates per autocorrelation time
+    turb_rms: float = 1.0         # target rms acceleration
+    turb_min_rho: float = 1e-50
+    spectrum: str = "parabolic"
+    kmax: float = 3.0             # driving modes |k| <= kmax (box units)
+
+    @classmethod
+    def from_params(cls, p) -> "TurbSpec":
+        raw = p.raw.get("turb_params", {}) if p.raw else {}
+
+        def g(k, dflt):
+            v = raw.get(k, dflt)
+            return v[0] if isinstance(v, list) else v
+
+        return cls(enabled=bool(g("turb", False)),
+                   turb_type=int(g("turb_type", 1)),
+                   seed=int(g("turb_seed", 0)),
+                   comp_frac=float(g("comp_frac", 1.0 / 3.0)),
+                   turb_T=float(g("turb_t", 1.0)),
+                   turb_Ndt=int(g("turb_ndt", 100)),
+                   turb_rms=float(g("turb_rms", 1.0)),
+                   turb_min_rho=float(g("turb_min_rho", 1e-50)),
+                   spectrum=str(g("forcing_power_spectrum", "parabolic")))
+
+
+def _kgrid(shape: Sequence[int]):
+    """Integer wavenumber arrays for an rfftn layout (last axis halved)."""
+    ndim = len(shape)
+    ks = []
+    for d in range(ndim - 1):
+        ks.append(np.fft.fftfreq(shape[d]) * shape[d])
+    ks.append(np.fft.rfftfreq(shape[-1]) * shape[-1])
+    return np.meshgrid(*ks, indexing="ij")
+
+
+def _power(kmag, spec: TurbSpec):
+    if spec.spectrum == "parabolic":
+        p = 1.0 - (kmag - 2.0) ** 2
+    elif spec.spectrum == "power_law":
+        p = np.where(kmag > 0, kmag ** -2.0, 0.0)
+    elif spec.spectrum == "konstandin":
+        p = 2.0 - kmag
+    else:
+        raise ValueError(f"unknown forcing spectrum {spec.spectrum!r}")
+    p = np.where((kmag >= 1.0 - 1e-9) & (kmag <= spec.kmax), p, 0.0)
+    return np.maximum(p, 0.0)
+
+
+class TurbForcing:
+    """Driven-turbulence forcing field on an [n]*ndim grid."""
+
+    def __init__(self, shape: Sequence[int], spec: TurbSpec,
+                 key: Optional[jax.Array] = None):
+        self.shape = tuple(shape)
+        self.ndim = len(self.shape)
+        self.spec = spec
+        kk = _kgrid(self.shape)
+        kmag = np.sqrt(sum(k ** 2 for k in kk))
+        self.amp = jnp.asarray(np.sqrt(_power(kmag, spec)))
+        kmag_safe = np.where(kmag > 0, kmag, 1.0)
+        self.khat = [jnp.asarray(k / kmag_safe) for k in kk]
+        self.key = (key if key is not None
+                    else jax.random.PRNGKey(spec.seed))
+        self.fhat = jnp.zeros((self.ndim,) + self.amp.shape,
+                              dtype=jnp.complex128)
+        # spin up to the stationary OU distribution (instant_turb)
+        self.key, sub = jax.random.split(self.key)
+        self.fhat = self._noise(sub)
+
+    def _noise(self, key):
+        """Projected, normalized random spectral field."""
+        kr, ki = jax.random.split(key)
+        shape = (self.ndim,) + self.amp.shape
+        re = jax.random.normal(kr, shape)
+        im = jax.random.normal(ki, shape)
+        f = (re + 1j * im) * self.amp
+        return self._project(f)
+
+    def _project(self, f):
+        """Helmholtz mix: (1-cf)·solenoidal + cf·compressive
+        (``proj_op``, comp_frac weighting)."""
+        cf = self.spec.comp_frac
+        kdotf = sum(self.khat[d] * f[d] for d in range(self.ndim))
+        comp = jnp.stack([self.khat[d] * kdotf for d in range(self.ndim)])
+        sol = f - comp
+        return (1.0 - cf) * sol + cf * comp
+
+    def update(self, dt: float):
+        """OU step over dt (type 3 'decaying': no noise refresh)."""
+        T = self.spec.turb_T
+        decay = jnp.exp(-dt / T)
+        if self.spec.turb_type == 3:
+            self.fhat = self.fhat * decay
+            return
+        self.key, sub = jax.random.split(self.key)
+        noise = self._noise(sub)
+        self.fhat = self.fhat * decay + noise * jnp.sqrt(
+            jnp.maximum(1.0 - decay ** 2, 0.0))
+
+    def acceleration(self):
+        """Real-space acceleration [ndim, *shape], rms-normalized to
+        turb_rms (``add_turb_forcing.f90`` afac scaling)."""
+        acc = jnp.stack([jnp.fft.irfftn(self.fhat[d], s=self.shape)
+                         for d in range(self.ndim)])
+        rms = jnp.sqrt(jnp.mean(jnp.sum(acc ** 2, axis=0)))
+        return acc * (self.spec.turb_rms / jnp.maximum(rms, 1e-300))
+
+    # checkpoint (write_turb_fields.f90 / read_turb_fields.f90) ---------
+    def save(self, path: str):
+        np.savez(path, fhat=np.asarray(self.fhat),
+                 key=np.asarray(self.key), shape=np.asarray(self.shape))
+
+    @classmethod
+    def load(cls, path: str, spec: TurbSpec) -> "TurbForcing":
+        data = np.load(path)
+        obj = cls(tuple(int(s) for s in data["shape"]), spec)
+        obj.fhat = jnp.asarray(data["fhat"])
+        obj.key = jnp.asarray(data["key"])
+        return obj
+
+
+def apply_forcing(u, acc, dt, min_rho: float = 1e-50):
+    """Momentum/energy kick from the acceleration field
+    (``add_turb_forcing.f90``): Δ(ρv) = ρ a dt,
+    ΔE = (v·a ρ + ½ρa²dt) dt evaluated conservatively."""
+    ndim = acc.shape[0]
+    rho = jnp.maximum(u[0], min_rho)
+    unew = u
+    de = jnp.zeros_like(rho)
+    for d in range(ndim):
+        mom_old = u[1 + d]
+        mom_new = mom_old + rho * acc[d] * dt
+        de = de + 0.5 * (mom_new ** 2 - mom_old ** 2) / rho
+        unew = unew.at[1 + d].set(mom_new)
+    return unew.at[1 + ndim].add(de)

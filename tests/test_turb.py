@@ -1,0 +1,146 @@
+"""Turbulence forcing tests."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.turb.forcing import (TurbForcing, TurbSpec, apply_forcing)
+
+
+def _div_curl(acc, ndim):
+    """Spectral divergence and curl magnitude of a real field."""
+    div = sum(np.gradient(np.asarray(acc[d]), axis=d) for d in range(ndim))
+    if ndim == 3:
+        a = np.asarray(acc)
+        curl = [np.gradient(a[2], axis=1) - np.gradient(a[1], axis=2),
+                np.gradient(a[0], axis=2) - np.gradient(a[2], axis=0),
+                np.gradient(a[1], axis=0) - np.gradient(a[0], axis=1)]
+        curl_mag = np.sqrt(sum(c ** 2 for c in curl))
+    else:
+        a = np.asarray(acc)
+        curl_mag = np.abs(np.gradient(a[1], axis=0)
+                          - np.gradient(a[0], axis=1))
+    return div, curl_mag
+
+
+def test_solenoidal_projection():
+    """comp_frac=0: k·f̂ = 0 exactly (spectral divergence)."""
+    spec = TurbSpec(enabled=True, comp_frac=0.0, turb_rms=1.0, seed=3)
+    f = TurbForcing((32, 32, 32), spec)
+    kdotf = sum(np.asarray(f.khat[d]) * np.asarray(f.fhat[d])
+                for d in range(3))
+    scale = np.abs(np.asarray(f.fhat)).max()
+    assert np.abs(kdotf).max() < 1e-12 * scale
+
+
+def test_compressive_projection():
+    """comp_frac=1: f̂ ∥ k (zero solenoidal part) exactly."""
+    spec = TurbSpec(enabled=True, comp_frac=1.0, turb_rms=1.0, seed=3)
+    f = TurbForcing((32, 32, 32), spec)
+    kdotf = sum(np.asarray(f.khat[d]) * np.asarray(f.fhat[d])
+                for d in range(3))
+    sol = [np.asarray(f.fhat[d]) - np.asarray(f.khat[d]) * kdotf
+           for d in range(3)]
+    scale = np.abs(np.asarray(f.fhat)).max()
+    assert max(np.abs(s).max() for s in sol) < 1e-12 * scale
+
+
+def test_rms_normalization():
+    spec = TurbSpec(enabled=True, turb_rms=2.5, seed=1)
+    f = TurbForcing((16, 16), spec)
+    acc = np.asarray(f.acceleration())
+    rms = np.sqrt((acc ** 2).sum(axis=0).mean())
+    assert np.isclose(rms, 2.5, rtol=1e-10)
+
+
+def test_ou_decorrelation():
+    """Spectral correlation decays as exp(-t/T) (sampled over many
+    modes: kmax=8 on 32³ so the estimator noise is small)."""
+    spec = TurbSpec(enabled=True, turb_T=1.0, seed=5, comp_frac=0.3,
+                    kmax=8.0)
+    f = TurbForcing((32, 32, 32), spec)
+    f0 = np.asarray(f.fhat).ravel()
+
+    def corr():
+        f1 = np.asarray(f.fhat).ravel()
+        return (np.real(np.vdot(f0, f1))
+                / np.sqrt(np.vdot(f0, f0).real * np.vdot(f1, f1).real))
+
+    f.update(0.25)
+    assert abs(corr() - np.exp(-0.25)) < 0.12
+    for _ in range(11):
+        f.update(0.25)
+    assert abs(corr()) < 0.2     # 3 autocorrelation times: ~e^-3
+
+
+def test_decaying_mode():
+    spec = TurbSpec(enabled=True, turb_type=3, turb_T=1.0, seed=2)
+    f = TurbForcing((16, 16), spec)
+    e0 = float(jnp.sum(jnp.abs(f.fhat) ** 2))
+    f.update(1.0)
+    e1 = float(jnp.sum(jnp.abs(f.fhat) ** 2))
+    assert np.isclose(e1 / e0, np.exp(-2.0), rtol=1e-6)
+
+
+def test_apply_forcing_conservation():
+    rng = np.random.default_rng(0)
+    n = 8
+    u = jnp.asarray(np.abs(rng.standard_normal((4, n, n))) + 1.0)
+    spec = TurbSpec(enabled=True, seed=1)
+    f = TurbForcing((n, n), spec)
+    acc = f.acceleration()
+    dt = 0.01
+    un = apply_forcing(u, acc, dt)
+    # mass unchanged
+    assert np.allclose(np.asarray(un[0]), np.asarray(u[0]))
+    # momentum kick = rho a dt
+    assert np.allclose(np.asarray(un[1] - u[1]),
+                       np.asarray(u[0] * acc[0] * dt))
+    # internal energy unchanged: E change equals kinetic change
+    ek0 = np.asarray((u[1] ** 2 + u[2] ** 2) / (2 * u[0]))
+    ek1 = np.asarray((un[1] ** 2 + un[2] ** 2) / (2 * un[0]))
+    assert np.allclose(np.asarray(un[3] - u[3]), ek1 - ek0, atol=1e-14)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    spec = TurbSpec(enabled=True, seed=9)
+    f = TurbForcing((8, 8, 8), spec)
+    f.update(0.3)
+    p = str(tmp_path / "turb.npz")
+    f.save(p)
+    g = TurbForcing.load(p, spec)
+    assert np.allclose(np.asarray(f.fhat), np.asarray(g.fhat))
+    f.update(0.1)
+    g.update(0.1)
+    assert np.allclose(np.asarray(f.acceleration()),
+                       np.asarray(g.acceleration()))
+
+
+def test_driver_turb_stirring():
+    """Quiescent box gains kinetic energy under driving."""
+    from ramses_tpu.driver import Simulation
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "y_center": [0.5],
+                        "length_x": [10.0], "length_y": [10.0],
+                        "exp_region": [10.0],
+                        "d_region": [1.0], "p_region": [1.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc"},
+        "turb_params": {"turb": True, "turb_rms": 2.0, "turb_t": 0.5,
+                        "turb_seed": 11},
+        "output_params": {"noutput": 1, "tout": [0.1], "tend": 0.1},
+    }
+    p = params_from_dict(groups, ndim=2)
+    sim = Simulation(p, dtype=jnp.float64)
+    sim.evolve(chunk=4)
+    u = np.asarray(sim.state.u)
+    ke = ((u[1] ** 2 + u[2] ** 2) / (2 * u[0])).sum()
+    assert ke > 1e-4
+    assert np.all(np.isfinite(u))
+    # mass conserved
+    assert np.isclose(u[0].mean(), 1.0, rtol=1e-12)
