@@ -50,6 +50,20 @@ def step(grid: UniformGrid, u, dt):
 
 
 @partial(jax.jit, static_argnames=("grid",))
+def step_with_flux(grid: UniformGrid, u, dt):
+    """Like :func:`step` but also returns the mass flux·dt/dx at the LOW
+    face of every active cell, ``[ndim, *sp]`` — the quantity the
+    Monte-Carlo tracers sample (``hydro/godunov_fine.f90:685-715``)."""
+    cfg = grid.cfg
+    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
+    flux, _tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
+    un = muscl.apply_fluxes(up, flux, cfg)
+    mass_flux = jnp.stack([bmod.unpad(flux[d][0], cfg.ndim, muscl.NGHOST)
+                           for d in range(cfg.ndim)])
+    return bmod.unpad(un, cfg.ndim, muscl.NGHOST), mass_flux
+
+
+@partial(jax.jit, static_argnames=("grid",))
 def cfl_dt(grid: UniformGrid, u):
     return compute_dt(u, None, grid.dx, grid.cfg)
 

@@ -1,0 +1,175 @@
+"""Clump finder: watershed peak-patch segmentation (PHEW).
+
+Reference: ``pm/clump_finder.f90`` (``count_peaks:428``,
+``propagate_flag:499``, ``saddlepoint_search:524``; doc
+``doc/wiki/PHEW.md``).  The reference's serial flag-propagation over
+linked cells becomes: steepest-ascent parent assignment (one gather over
+the 3^ndim neighbourhood) + pointer-jumping label propagation
+(O(log L) device gathers), then host-side saddle merging — peaks are few,
+cells are many, so the device does the O(N) work and the host the O(npeaks²).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _neighbor_offsets(ndim: int):
+    return [off for off in itertools.product((-1, 0, 1), repeat=ndim)
+            if any(off)]
+
+
+def steepest_parent(rho, ndim: int):
+    """Flat index of the densest 3^ndim neighbour (self if local max)."""
+    shape = rho.shape
+    flat_idx = jnp.arange(rho.size).reshape(shape)
+    best_rho = rho
+    best_idx = flat_idx
+    for off in _neighbor_offsets(ndim):
+        r = rho
+        i = flat_idx
+        for d, o in enumerate(off):
+            if o:
+                r = jnp.roll(r, -o, axis=d)
+                i = jnp.roll(i, -o, axis=d)
+        # strict ascent, with an index tie-break so equal-density plateaus
+        # (e.g. a peak centred exactly on a cell face) drain to one cell
+        take = (r > best_rho) | ((r == best_rho) & (i > best_idx))
+        best_rho = jnp.where(take, r, best_rho)
+        best_idx = jnp.where(take, i, best_idx)
+    return best_idx
+
+
+@jax.jit
+def _pointer_jump(parent):
+    """Iterate parent ← parent[parent] to the fixed point (peak labels)."""
+    def body(carry):
+        p, _ = carry
+        p2 = p.reshape(-1)[p]
+        return p2, jnp.any(p2 != p)
+
+    def cond(carry):
+        return carry[1]
+
+    p, _ = jax.lax.while_loop(cond, body, (parent, jnp.array(True)))
+    return p
+
+
+def watershed(rho, threshold: float, ndim: int):
+    """Label array: flat peak index per cell above threshold, -1 outside."""
+    rho = jnp.asarray(rho)
+    parent = steepest_parent(rho, ndim)
+    labels = _pointer_jump(parent)
+    return jnp.where(rho > threshold, labels, -1)
+
+
+@dataclass
+class Clump:
+    """One clump's properties (``pm/clump_merger.f90`` table columns)."""
+    index: int
+    peak_cell: Tuple[int, ...]
+    peak_rho: float
+    ncell: int
+    mass: float
+    pos: np.ndarray          # mass-weighted centre [ndim]
+    relevance: float         # peak / max saddle
+
+
+def _saddles(rho, labels, ndim: int) -> Dict[Tuple[int, int], float]:
+    """Max over faces of min(rho_a, rho_b) for neighbouring labels."""
+    rho = np.asarray(rho)
+    lab = np.asarray(labels)
+    out: Dict[Tuple[int, int], float] = {}
+    for d in range(ndim):
+        la, lb = lab, np.roll(lab, -1, axis=d)
+        ra, rb = rho, np.roll(rho, -1, axis=d)
+        m = (la != lb) & (la >= 0) & (lb >= 0)
+        if not m.any():
+            continue
+        key_a, key_b = la[m], lb[m]
+        val = np.minimum(ra[m], rb[m])
+        for a, b, v in zip(key_a, key_b, val):
+            k = (min(a, b), max(a, b))
+            if v > out.get(k, -np.inf):
+                out[k] = v
+    return out
+
+
+def find_clumps(rho, threshold: float, relevance: float = 2.0,
+                dx: float = 1.0, merge: bool = True):
+    """Full PHEW pass: watershed → saddle merge → properties.
+
+    Peaks with peak/saddle < ``relevance`` are merged into the neighbour
+    across their highest saddle (``clump_merger`` relevance criterion).
+    Returns (labels [same shape, -1 outside], [Clump]).
+    """
+    rho_j = jnp.asarray(rho)
+    ndim = rho_j.ndim
+    labels = np.array(watershed(rho_j, threshold, ndim))
+    rho = np.asarray(rho_j)
+
+    if merge:
+        changed = True
+        while changed:
+            changed = False
+            saddles = _saddles(rho, labels, ndim)
+            # per peak: highest saddle + partner
+            best: Dict[int, Tuple[float, int]] = {}
+            for (a, b), v in saddles.items():
+                if v > best.get(a, (-np.inf, -1))[0]:
+                    best[a] = (v, b)
+                if v > best.get(b, (-np.inf, -1))[0]:
+                    best[b] = (v, a)
+            peaks = np.unique(labels[labels >= 0])
+            peak_rho = {p: rho.reshape(-1)[p] for p in peaks}
+            # merge the least relevant peak first (deterministic order)
+            for p in sorted(peaks, key=lambda q: peak_rho[q]):
+                if p not in best:
+                    continue
+                s, partner = best[p]
+                if peak_rho[p] / max(s, 1e-300) < relevance:
+                    # absorb into the partner across the highest saddle
+                    tgt = partner
+                    labels[labels == p] = tgt
+                    changed = True
+                    break
+
+    clumps: List[Clump] = []
+    vol = dx ** ndim
+    peaks = np.unique(labels[labels >= 0])
+    saddles = _saddles(rho, labels, ndim)
+    for p in peaks:
+        m = labels == p
+        cells = np.argwhere(m)
+        rr = rho[m]
+        mass = rr.sum() * vol
+        pos = (cells * rr[:, None]).sum(0) / rr.sum()
+        smax = max([v for (a, b), v in saddles.items()
+                    if p in (a, b)] or [0.0])
+        pk = np.unravel_index(p, rho.shape)
+        clumps.append(Clump(
+            index=int(p), peak_cell=tuple(int(c) for c in pk),
+            peak_rho=float(rho.reshape(-1)[p]), ncell=int(m.sum()),
+            mass=float(mass), pos=(pos + 0.5) * dx,
+            relevance=float(rho.reshape(-1)[p] / max(smax, 1e-300))))
+    clumps.sort(key=lambda c: -c.mass)
+    return labels, clumps
+
+
+def write_clump_table(clumps: List[Clump], path: str):
+    """``output_clump``-style ascii table."""
+    with open(path, "w") as f:
+        f.write("# index ncell peak_x peak_y peak_z rho_peak mass "
+                "relevance\n")
+        for c in clumps:
+            pk = list(c.peak_cell) + [0] * (3 - len(c.peak_cell))
+            f.write(f"{c.index:8d} {c.ncell:8d} "
+                    f"{pk[0]:6d} {pk[1]:6d} {pk[2]:6d} "
+                    f"{c.peak_rho:14.6e} {c.mass:14.6e} "
+                    f"{c.relevance:10.3f}\n")

@@ -1,0 +1,115 @@
+"""Clump finder + Monte-Carlo tracer tests."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+from ramses_tpu.pm.clumps import find_clumps, watershed, write_clump_table
+from ramses_tpu.pm.tracers import mc_tracer_step
+
+
+def _two_blobs(n=48, sep=0.45, amp2=0.6, sigma=0.05):
+    x = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(x, x, indexing="ij")
+    blob = lambda cx, cy, a: a * np.exp(
+        -((X - cx) ** 2 + (Y - cy) ** 2) / (2 * sigma ** 2))
+    return 0.01 + blob(0.3, 0.5, 1.0) + blob(0.3 + sep, 0.5, amp2)
+
+
+def test_watershed_two_peaks():
+    rho = _two_blobs()
+    labels, clumps = find_clumps(rho, threshold=0.05, relevance=1.5,
+                                 dx=1.0 / 48, merge=False)
+    assert len(clumps) == 2
+    # every above-threshold cell is labeled
+    assert ((np.asarray(labels) >= 0) == (rho > 0.05)).all()
+    # peak positions at the blob centres
+    pks = sorted(c.peak_cell for c in clumps)
+    assert pks[0][0] == int(0.3 * 48) and pks[0][1] == 24
+    assert pks[1][0] == int(0.75 * 48)
+    # masses ~ 2π σ² amp ratio
+    m = sorted(c.mass for c in clumps)
+    assert 0.4 < m[0] / m[1] < 0.8
+
+
+def test_clump_merging_by_relevance():
+    """Overlapping blobs (peak/saddle ≈ 1.7-1.9) merge when the relevance
+    threshold is above that, survive when below."""
+    rho = _two_blobs(sep=0.16, amp2=0.9, sigma=0.05)
+    _l1, c1 = find_clumps(rho, threshold=0.05, relevance=1.2, merge=True)
+    _l2, c2 = find_clumps(rho, threshold=0.05, relevance=3.0, merge=True)
+    assert len(c1) == 2
+    assert len(c2) == 1
+    # merged mass equals the sum
+    assert np.isclose(c2[0].mass, sum(c.mass for c in c1), rtol=1e-12)
+
+
+def test_clump_table(tmp_path):
+    rho = _two_blobs()
+    _, clumps = find_clumps(rho, threshold=0.05, merge=False)
+    p = str(tmp_path / "clumps.txt")
+    write_clump_table(clumps, p)
+    rows = [l for l in open(p) if not l.startswith("#")]
+    assert len(rows) == len(clumps)
+
+
+def test_watershed_3d_single_peak():
+    n = 16
+    x = (np.arange(n) + 0.5) / n
+    X, Y, Z = np.meshgrid(x, x, x, indexing="ij")
+    rho = np.exp(-((X - 0.5) ** 2 + (Y - 0.5) ** 2 + (Z - 0.5) ** 2)
+                 / 0.02)
+    labels, clumps = find_clumps(rho, threshold=0.1)
+    assert len(clumps) == 1
+    assert clumps[0].peak_cell == (8, 8, 8)
+
+
+def test_tracers_follow_uniform_advection():
+    """Uniform flow: ensemble tracer drift ≈ gas velocity."""
+    from ramses_tpu.grid.uniform import UniformGrid, step_with_flux
+    from ramses_tpu.grid import boundary as bmod
+    from ramses_tpu.hydro.core import HydroStatic
+
+    cfg = HydroStatic(ndim=2, gamma=1.4, riemann="hllc")
+    n = 32
+    dx = 1.0 / n
+    grid = UniformGrid(cfg=cfg, shape=(n, n), dx=dx,
+                       bc=bmod.BoundarySpec.periodic(2))
+    rho0, vx = 1.0, 0.5
+    u = jnp.stack([jnp.full((n, n), rho0),
+                   jnp.full((n, n), rho0 * vx),
+                   jnp.zeros((n, n)),
+                   jnp.full((n, n), 1.0 / 0.4 + 0.5 * rho0 * vx ** 2)])
+    ntr = 4000
+    key = jax.random.PRNGKey(0)
+    key, k1, k2 = jax.random.split(key, 3)
+    x = jax.random.uniform(k1, (ntr, 2))
+    x0 = np.array(x)
+    dt = 0.4 * dx / (vx + np.sqrt(1.4 / rho0))
+    nsteps = 40
+    for i in range(nsteps):
+        rho_before = u[0]
+        u, mf = step_with_flux(grid, u, dt)
+        key, sub = jax.random.split(key)
+        x = mc_tracer_step(x, sub, rho_before, mf, (n, n), dx)
+    # mean displacement along x (mod box): expected vx * t
+    disp = np.asarray(x) - x0
+    disp = (disp + 0.5) % 1.0 - 0.5
+    expect = vx * dt * nsteps
+    assert abs(disp[:, 0].mean() - expect) < 0.15 * expect
+    assert abs(disp[:, 1].mean()) < 0.02
+    # distribution stays uniform: chi^2 over a coarse binning
+    h, _ = np.histogram(np.asarray(x)[:, 0], bins=8, range=(0, 1))
+    assert h.min() > ntr / 8 * 0.8
+
+
+def test_tracer_no_flux_no_motion():
+    from ramses_tpu.pm.tracers import mc_tracer_step
+    x = jnp.asarray([[0.51, 0.52], [0.11, 0.93]])
+    key = jax.random.PRNGKey(1)
+    rho = jnp.ones((8, 8))
+    mf = jnp.zeros((2, 8, 8))
+    x2 = mc_tracer_step(x, key, rho, mf, (8, 8), 1.0 / 8)
+    assert np.allclose(np.asarray(x2), np.asarray(x))
