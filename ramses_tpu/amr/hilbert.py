@@ -1,0 +1,70 @@
+"""Hilbert space-filling-curve keys for domain decomposition.
+
+The role of ``amr/hilbert.f90:5-196`` (P1 of SURVEY.md §2.12): order octs
+along a locality-preserving curve so contiguous key ranges become compact
+spatial domains (the shard boundaries of the multi-chip mesh).  Uses
+Skilling's transpose formulation (AIP Conf. Proc. 707, 381, 2004) —
+int64-clean, no ``real*16 QUADHILBERT`` workaround, supporting 21
+bits/dim in 3D vs the reference's float-key cap of 19 levels.
+
+Native C++ fast path (``ramses_tpu.native``), vectorized numpy fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramses_tpu import native
+
+
+def hilbert_key(og: np.ndarray, ndim: int, nbits: int) -> np.ndarray:
+    """uint64 Hilbert indices of integer coords ``og [n, ndim]``,
+    coordinates in [0, 2^nbits)."""
+    og = np.asarray(og, dtype=np.int64).reshape(-1, ndim)
+    if ndim == 1:
+        return og[:, 0].astype(np.uint64)
+    nat = native.hilbert_encode(og, ndim, nbits)
+    if nat is not None:
+        return nat
+    return _hilbert_numpy(og, ndim, nbits)
+
+
+def _hilbert_numpy(og: np.ndarray, ndim: int, nbits: int) -> np.ndarray:
+    """Vectorized Skilling AxesToTranspose + bit interleave."""
+    X = [og[:, d].astype(np.uint64).copy() for d in range(ndim)]
+    M = np.uint64(1 << (nbits - 1))
+    Q = int(M)
+    while Q > 1:
+        P = np.uint64(Q - 1)
+        Qu = np.uint64(Q)
+        for i in range(ndim):
+            hi = (X[i] & Qu) != 0
+            # branch 1: X[0] ^= P where bit set
+            X[0] = np.where(hi, X[0] ^ P, X[0])
+            # branch 2: swap low bits of X[0], X[i]
+            t = np.where(hi, np.uint64(0), (X[0] ^ X[i]) & P)
+            X[0] ^= t
+            X[i] ^= t
+        Q >>= 1
+    for i in range(1, ndim):
+        X[i] ^= X[i - 1]
+    t = np.zeros_like(X[0])
+    Q = int(M)
+    while Q > 1:
+        Qu = np.uint64(Q)
+        t = np.where((X[ndim - 1] & Qu) != 0, t ^ np.uint64(Q - 1), t)
+        Q >>= 1
+    for i in range(ndim):
+        X[i] ^= t
+    # interleave transpose bits
+    key = np.zeros(len(og), dtype=np.uint64)
+    for j in range(nbits - 1, -1, -1):
+        for i in range(ndim):
+            key = (key << np.uint64(1)) | ((X[i] >> np.uint64(j))
+                                           & np.uint64(1))
+    return key
+
+
+def hilbert_order(og: np.ndarray, ndim: int, nbits: int) -> np.ndarray:
+    """argsort of the Hilbert keys — the domain-decomposition order."""
+    return np.argsort(hilbert_key(og, ndim, nbits), kind="stable")

@@ -42,6 +42,11 @@ def encode(ig: np.ndarray, ndim: int) -> np.ndarray:
     ig = np.asarray(ig)
     if ndim == 1:
         return ig[:, 0].astype(np.int64)
+    if len(ig) >= 4096:      # amortize the ctypes call
+        from ramses_tpu import native
+        nat = native.morton_encode(ig, ndim)
+        if nat is not None:
+            return nat
     if ndim == 2:
         return (_spread2(ig[:, 0]) | (_spread2(ig[:, 1]) << np.uint64(1))
                 ).astype(np.int64)

@@ -101,6 +101,11 @@ class Octree:
         if not self.has(lvl):
             return np.full(len(ks), -1, dtype=np.int64)
         lev = self.levels[lvl]
+        if len(ks) >= 4096:
+            from ramses_tpu import native
+            nat = native.lookup_sorted(lev.keys, ks)
+            if nat is not None:
+                return nat
         pos = np.searchsorted(lev.keys, ks)
         pos = np.clip(pos, 0, lev.noct - 1)
         hit = lev.keys[pos] == ks

@@ -1,0 +1,161 @@
+// Native host-side kernels for the tree/metadata passes.
+//
+// The reference keeps its hot host-side machinery in compiled code
+// (Fortran tree walks amr/nbors_utils.f90, C++/CUDA atonlib, pario
+// transfer.c); these are the equivalents for our host core: space-filling
+// curve keys, batched ordered lookups, and neighbour index-map
+// construction — the build_comm-shaped passes that run after each
+// refinement (SURVEY.md §7).
+//
+// Hilbert indices use John Skilling's public-domain transpose algorithm
+// ("Programming the Hilbert curve", AIP Conf. Proc. 707, 381 (2004)) —
+// an independent, cleaner formulation of what amr/hilbert.f90 implements
+// with per-dimension state machines.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------- Morton
+static inline uint64_t spread2(uint64_t x) {
+    x &= 0xFFFFFFFFull;
+    x = (x | (x << 16)) & 0x0000FFFF0000FFFFull;
+    x = (x | (x << 8))  & 0x00FF00FF00FF00FFull;
+    x = (x | (x << 4))  & 0x0F0F0F0F0F0F0F0Full;
+    x = (x | (x << 2))  & 0x3333333333333333ull;
+    x = (x | (x << 1))  & 0x5555555555555555ull;
+    return x;
+}
+
+static inline uint64_t spread3(uint64_t x) {
+    x &= 0x1FFFFFull;
+    x = (x | (x << 32)) & 0x1F00000000FFFFull;
+    x = (x | (x << 16)) & 0x1F0000FF0000FFull;
+    x = (x | (x << 8))  & 0x100F00F00F00F00Full;
+    x = (x | (x << 4))  & 0x10C30C30C30C30C3ull;
+    x = (x | (x << 2))  & 0x1249249249249249ull;
+    return x;
+}
+
+void morton_encode(const int64_t* og, int64_t n, int ndim, int64_t* out) {
+    if (ndim == 1) {
+        memcpy(out, og, sizeof(int64_t) * (size_t)n);
+    } else if (ndim == 2) {
+        for (int64_t i = 0; i < n; i++)
+            out[i] = (int64_t)(spread2((uint64_t)og[2 * i])
+                               | (spread2((uint64_t)og[2 * i + 1]) << 1));
+    } else {
+        for (int64_t i = 0; i < n; i++)
+            out[i] = (int64_t)(spread3((uint64_t)og[3 * i])
+                               | (spread3((uint64_t)og[3 * i + 1]) << 1)
+                               | (spread3((uint64_t)og[3 * i + 2]) << 2));
+    }
+}
+
+// ---------------------------------------------------------------- Hilbert
+// Skilling (2004): AxesToTranspose + bit interleave of the transpose.
+static inline uint64_t hilbert_key_one(uint64_t* X, int b, int n) {
+    uint64_t M = 1ull << (b - 1), P, Q, t;
+    // Inverse undo
+    for (Q = M; Q > 1; Q >>= 1) {
+        P = Q - 1;
+        for (int i = 0; i < n; i++) {
+            if (X[i] & Q) X[0] ^= P;
+            else { t = (X[0] ^ X[i]) & P; X[0] ^= t; X[i] ^= t; }
+        }
+    }
+    // Gray encode
+    for (int i = 1; i < n; i++) X[i] ^= X[i - 1];
+    t = 0;
+    for (Q = M; Q > 1; Q >>= 1)
+        if (X[n - 1] & Q) t ^= Q - 1;
+    for (int i = 0; i < n; i++) X[i] ^= t;
+    // interleave transpose bits, x-bit most significant per group
+    uint64_t key = 0;
+    for (int j = b - 1; j >= 0; j--)
+        for (int i = 0; i < n; i++)
+            key = (key << 1) | ((X[i] >> j) & 1ull);
+    return key;
+}
+
+void hilbert_encode(const int64_t* og, int64_t n, int ndim, int nbits,
+                    uint64_t* out) {
+    uint64_t X[3];
+    for (int64_t i = 0; i < n; i++) {
+        for (int d = 0; d < ndim; d++)
+            X[d] = (uint64_t)og[i * ndim + d];
+        out[i] = hilbert_key_one(X, nbits, ndim);
+    }
+}
+
+// ------------------------------------------------------------- searching
+void searchsorted_i64(const int64_t* sorted, int64_t m, const int64_t* q,
+                      int64_t n, int64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t lo = 0, hi = m;
+        int64_t v = q[i];
+        while (lo < hi) {
+            int64_t mid = (lo + hi) >> 1;
+            if (sorted[mid] < v) lo = mid + 1;
+            else hi = mid;
+        }
+        out[i] = lo;
+    }
+}
+
+// lookup: position where sorted[pos]==q, else -1
+void lookup_i64(const int64_t* sorted, int64_t m, const int64_t* q,
+                int64_t n, int64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t lo = 0, hi = m;
+        int64_t v = q[i];
+        while (lo < hi) {
+            int64_t mid = (lo + hi) >> 1;
+            if (sorted[mid] < v) lo = mid + 1;
+            else hi = mid;
+        }
+        out[i] = (lo < m && sorted[lo] == v) ? lo : -1;
+    }
+}
+
+// ------------------------------------------------- neighbour index maps
+// For each oct (og[i]) and each offset (offs[k]), find the index of the
+// oct at og[i]+offs[k] (periodic wrap at level_size) in the sorted key
+// array; -1 if absent.  This is the kernel of build_level_maps — the
+// get3cubefather equivalent (amr/nbors_utils.f90:5).
+void neighbor_lookup(const int64_t* keys_sorted, const int64_t* og,
+                     int64_t noct, int ndim, int64_t level_size,
+                     const int64_t* offs, int64_t nofs, int64_t* out) {
+    uint64_t tmp[3];
+    for (int64_t i = 0; i < noct; i++) {
+        for (int64_t k = 0; k < nofs; k++) {
+            // wrapped neighbour coordinates → Morton key
+            for (int d = 0; d < ndim; d++) {
+                int64_t c = og[i * ndim + d] + offs[k * ndim + d];
+                c %= level_size;
+                if (c < 0) c += level_size;
+                tmp[d] = (uint64_t)c;
+            }
+            uint64_t key;
+            if (ndim == 1) key = tmp[0];
+            else if (ndim == 2)
+                key = spread2(tmp[0]) | (spread2(tmp[1]) << 1);
+            else
+                key = spread3(tmp[0]) | (spread3(tmp[1]) << 1)
+                    | (spread3(tmp[2]) << 2);
+            // binary search
+            int64_t lo = 0, hi = noct;
+            int64_t v = (int64_t)key;
+            while (lo < hi) {
+                int64_t mid = (lo + hi) >> 1;
+                if (keys_sorted[mid] < v) lo = mid + 1;
+                else hi = mid;
+            }
+            out[i * nofs + k] =
+                (lo < noct && keys_sorted[lo] == v) ? lo : -1;
+        }
+    }
+}
+
+}  // extern "C"
