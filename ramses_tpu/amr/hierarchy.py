@@ -64,26 +64,40 @@ class AmrSim:
     def dx(self, lvl: int) -> float:
         return self.boxlen / (1 << lvl)
 
+    def _noct_pad(self, noct: int) -> Optional[int]:
+        """Padded oct count; subclasses align it to the device mesh."""
+        return None
+
+    def _place(self, arr, kind: str):
+        """Placement hook: ``kind`` ∈ {octs, cells, rep} row semantics.
+        Single-device base class keeps arrays as-is; the sharded subclass
+        device_puts octs/cells-row arrays across the mesh."""
+        return arr
+
     def _rebuild_maps(self):
         self.maps: Dict[int, mapmod.LevelMaps] = {}
         self.dev: Dict[int, dict] = {}
         for l in range(self.lmin, self.lmax + 1):
             if not self.tree.has(l):
                 break
-            m = mapmod.build_level_maps(self.tree, l, self.bc_kinds)
+            m = mapmod.build_level_maps(
+                self.tree, l, self.bc_kinds,
+                noct_pad=self._noct_pad(self.tree.noct(l)))
             self.maps[l] = m
             valid_cell = np.repeat(m.valid_oct, 2 ** self.tree.ndim)
             self.dev[l] = dict(
-                stencil_src=jnp.asarray(m.stencil_src),
-                vsgn=(jnp.asarray(m.vsgn) if m.vsgn is not None else None),
-                ok_ref=jnp.asarray(m.ok_ref),
-                interp_cell=jnp.asarray(m.interp_cell),
-                interp_nb=jnp.asarray(m.interp_nb),
-                interp_sgn=jnp.asarray(m.interp_sgn, dtype=self.dtype),
-                corr_idx=jnp.asarray(m.corr_idx),
-                ref_cell=jnp.asarray(m.ref_cell),
-                son_oct=jnp.asarray(m.son_oct),
-                valid_cell=jnp.asarray(valid_cell),
+                stencil_src=self._place(jnp.asarray(m.stencil_src), "octs"),
+                vsgn=(self._place(jnp.asarray(m.vsgn), "octs")
+                      if m.vsgn is not None else None),
+                ok_ref=self._place(jnp.asarray(m.ok_ref), "octs"),
+                interp_cell=self._place(jnp.asarray(m.interp_cell), "rep"),
+                interp_nb=self._place(jnp.asarray(m.interp_nb), "rep"),
+                interp_sgn=self._place(
+                    jnp.asarray(m.interp_sgn, dtype=self.dtype), "rep"),
+                corr_idx=self._place(jnp.asarray(m.corr_idx), "rep"),
+                ref_cell=self._place(jnp.asarray(m.ref_cell), "rep"),
+                son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
+                valid_cell=self._place(jnp.asarray(valid_cell), "cells"),
             )
 
     def _ic_state(self, lvl: int) -> jnp.ndarray:
@@ -97,7 +111,7 @@ class AmrSim:
         out[:u.shape[1]] = u.T
         out[u.shape[1]:, 0] = self.cfg.smallr
         out[u.shape[1]:, self.cfg.ndim + 1] = self.cfg.smalle * self.cfg.smallr
-        return jnp.asarray(out, dtype=self.dtype)
+        return self._place(jnp.asarray(out, dtype=self.dtype), "cells")
 
     def _alloc_from_ics(self):
         self.u: Dict[int, jnp.ndarray] = {}
@@ -180,7 +194,7 @@ class AmrSim:
             cd, cs, new_octs, f_cell, nb = mapmod.build_prolong_maps(
                 self.tree, oldtree, l, self.bc_kinds)
             buf = np.zeros((m.ncell_pad, self.cfg.nvar), dtype=np.float32)
-            u_new = jnp.asarray(buf, dtype=self.dtype)
+            u_new = self._place(jnp.asarray(buf, dtype=self.dtype), "cells")
             if len(cd):
                 rows_d = (cd[:, None] * twotondim
                           + np.arange(twotondim)[None, :]).reshape(-1)

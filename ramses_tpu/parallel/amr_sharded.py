@@ -1,0 +1,65 @@
+"""Multi-device AMR: level batches sharded over the device mesh.
+
+Design (SURVEY.md §2.12 P1-P4): each level's dense cell batch
+``[ncell_pad, nvar]`` is a global-view jax.Array sharded by rows over a
+1D "oct" mesh axis.  Rows follow the Morton/Hilbert key order, so equal
+row-splits are compact spatial domains (P1) that are balanced by
+construction — the reference's cost-weighted ``cmp_new_cpu_map``
+re-partition (P4) degenerates to "re-sort after refinement", which the
+regrid pass already does.  Stencil gathers that cross shard boundaries
+become compiler-inserted collectives (P2/P3); CFL min-reduction is a
+``jnp.min`` → ``AllReduce`` (P7).
+
+This is the correctness-first global-view formulation; the shard_map +
+``ppermute`` halo pipeline with precomputed per-shard halo maps is the
+known next optimization when profiles show the gather collectives
+dominating.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.amr.maps import bucket
+from ramses_tpu.config import Params
+
+
+class ShardedAmrSim(AmrSim):
+    """AmrSim with per-level state sharded over an ``oct`` mesh axis."""
+
+    def __init__(self, params: Params,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 dtype=jnp.float32):
+        devices = list(devices if devices is not None else jax.devices())
+        self.ndev = len(devices)
+        self.mesh = Mesh(np.array(devices), ("oct",))
+        self._row_sharding = NamedSharding(self.mesh, P("oct"))
+        self._row2_sharding = NamedSharding(self.mesh, P("oct", None))
+        self._rep_sharding = NamedSharding(self.mesh, P())
+        super().__init__(params, dtype=dtype)
+
+    def _noct_pad(self, noct: int) -> int:
+        """Bucketed oct count rounded to a multiple of the device count
+        (shardable rows; cells stay 2^d-aligned automatically)."""
+        b = bucket(noct)
+        if b % self.ndev:
+            b += self.ndev - (b % self.ndev)
+        return b
+
+    def _place(self, arr, kind: str):
+        if kind == "rep":
+            return jax.device_put(arr, self._rep_sharding)
+        if arr.ndim == 1:
+            # cells/octs rows must be divisible; replicate otherwise
+            if arr.shape[0] % self.ndev:
+                return jax.device_put(arr, self._rep_sharding)
+            return jax.device_put(arr, self._row_sharding)
+        if arr.shape[0] % self.ndev:
+            return jax.device_put(arr, self._rep_sharding)
+        return jax.device_put(arr, self._row2_sharding)

@@ -15,6 +15,8 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
 
 from ramses_tpu.config import Params
 from ramses_tpu.driver import Simulation
@@ -30,15 +32,43 @@ class ShardedSim:
                  devices: Optional[Sequence[jax.Device]] = None,
                  dtype=jnp.float32):
         self.inner = Simulation(params, dtype=dtype)
-        if self.inner.pspec.enabled:
-            raise NotImplementedError(
-                "sharded particle arrays are not wired up yet; run pic "
-                "simulations single-device or help build stage 6")
         self.mesh = make_mesh(params.ndim, devices)
         self.sharding = spatial_sharding(self.mesh, n_leading=1)
         self.u = jax.device_put(self.inner.state.u, self.sharding)
         self.inner.state.u = None  # drop the unsharded copy (memory)
+        # particles: data-parallel over lanes (flattened mesh); deposits
+        # into the spatially-sharded grid become partitioned scatters
+        self.p = None
+        if self.inner.pspec.enabled and self.inner.state.p is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            flat = Mesh(np.asarray(self.mesh.devices).reshape(-1),
+                        ("lane",))
+            lane = NamedSharding(flat, PartitionSpec("lane"))
+            lane2 = NamedSharding(flat, PartitionSpec("lane", None))
+            rep = NamedSharding(flat, PartitionSpec())
+            import dataclasses as _dc
+            p0 = self.inner.state.p
+            ndev = flat.devices.size
+
+            def put(a):
+                if a is None:
+                    return None
+                if a.ndim >= 1 and a.shape[0] % ndev == 0:
+                    return jax.device_put(
+                        a, lane2 if a.ndim > 1 else lane)
+                return jax.device_put(a, rep)
+
+            self.p = _dc.replace(
+                p0, **{f.name: put(getattr(p0, f.name))
+                       for f in _dc.fields(p0)})
+            self.inner.state.p = None
         self.gspec = self.inner.gspec
+        if self.gspec.enabled and self.gspec.solver == "fft":
+            # the spectral solve is global (all-to-all) and XLA's CPU FFT
+            # thunk rejects partitioned layouts; the CG stencil solver
+            # partitions cleanly over the mesh (halo permutes only)
+            import dataclasses as _dc
+            self.gspec = _dc.replace(self.gspec, solver="cg")
         self.pspec = self.inner.pspec
         self.cosmo = self.inner.cosmo
         self.f = (jax.device_put(self.inner.state.f, self.sharding)
@@ -56,12 +86,13 @@ class ShardedSim:
         tdtype = (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
         t0 = jnp.asarray(self.t, tdtype)
         t1 = jnp.asarray(tend, tdtype)
-        if self.gspec.enabled or self.cosmo is not None:
-            u, _p, f, t, dt_old, ndone = run_steps_pm(
-                self.grid, self.gspec, self.pspec, self.u, None, self.f,
+        if (self.gspec.enabled or self.cosmo is not None
+                or self.pspec.enabled):
+            u, p, f, t, dt_old, ndone = run_steps_pm(
+                self.grid, self.gspec, self.pspec, self.u, self.p, self.f,
                 t0, t1, jnp.asarray(self.dt_old, tdtype), nsteps,
                 cosmo=self.cosmo)
-            self.f, self.dt_old = f, float(dt_old)
+            self.f, self.p, self.dt_old = f, p, float(dt_old)
         else:
             u, t, ndone = run_steps(self.grid, self.u, t0, t1, nsteps)
         u.block_until_ready()

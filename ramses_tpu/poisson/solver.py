@@ -143,6 +143,15 @@ def fft_solve(rhs, dx: float):
     """
     nd = rhs.ndim
     shape = rhs.shape
+    # The spectral solve is inherently global (all-to-all); under a
+    # sharded jit, force a replicated layout around the FFT — XLA's CPU
+    # FFT thunk cannot run on partitioned operands, and on TPU a
+    # partitioned FFT would all-to-all anyway.
+    try:
+        from jax.sharding import PartitionSpec
+        rhs = jax.lax.with_sharding_constraint(rhs, PartitionSpec())
+    except (ValueError, RuntimeError, TypeError):
+        pass  # no mesh in scope: single-device path
     rhat = jnp.fft.rfftn(rhs)
     lam = jnp.zeros(rhat.shape, rhs.dtype)
     for ax in range(nd):

@@ -48,3 +48,96 @@ def test_sharded_matches_single_device(ndim):
 def test_mesh_shape():
     mesh = make_mesh(3)
     assert mesh.devices.size == len(jax.devices())
+
+
+def test_sharded_amr_matches_single_device():
+    """Decomposition invariance for the AMR path: identical aggregates
+    from the 8-device sharded run and the single-device run."""
+    from ramses_tpu.config import params_from_dict
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 3, "levelmax": 5, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75], "y_center": [0.5, 0.5],
+                        "length_x": [0.5, 0.5], "length_y": [10.0, 10.0],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [1.0, 0.1]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.8,
+                         "riemann": "hllc", "slope_type": 1},
+        "refine_params": {"err_grad_d": 0.05, "err_grad_p": 0.05},
+        "output_params": {"tend": 0.05},
+    }
+    p1 = params_from_dict({k: dict(v) for k, v in groups.items()}, ndim=2)
+    p2 = params_from_dict({k: dict(v) for k, v in groups.items()}, ndim=2)
+    sim1 = AmrSim(p1, dtype=jnp.float64)
+    sim8 = ShardedAmrSim(p2, dtype=jnp.float64)
+    sim1.evolve(0.03)
+    sim8.evolve(0.03)
+    assert sim1.nstep == sim8.nstep
+    for l in sim1.levels():
+        assert sim1.tree.noct(l) == sim8.tree.noct(l)
+    t1 = sim1.totals()
+    t8 = sim8.totals()
+    np.testing.assert_allclose(t1, t8, rtol=1e-13)
+    # leaf state bitwise-comparable on the base level
+    nc = sim1.maps[sim1.lmin].noct * 4
+    np.testing.assert_allclose(
+        np.asarray(sim1.u[sim1.lmin])[:nc],
+        np.asarray(sim8.u[sim8.lmin])[:nc], rtol=1e-13, atol=1e-14)
+
+
+def test_sharded_pm_matches_single_device():
+    """Decomposition invariance with particles + self-gravity."""
+    from ramses_tpu.config import params_from_string
+    from ramses_tpu.pm.particles import ParticleSet
+
+    nml = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "poisson=.true.", "pic=.true.", "/",
+        "&AMR_PARAMS", "levelmin=3", "levelmax=3", "boxlen=1.0", "/",
+        "&POISSON_PARAMS", "solver='cg'", "/",
+        "&OUTPUT_PARAMS", "noutput=1", "tout=1.0", "/",
+        "&INIT_PARAMS", "nregion=1", "region_type(1)='square'",
+        "d_region=1.0", "p_region=1.0", "/",
+    ])
+    rng = np.random.default_rng(0)
+    x0 = rng.uniform(0, 1, (64, 3))
+    v0 = rng.standard_normal((64, 3)) * 0.01
+    m0 = np.full(64, 0.01)
+
+    p1 = params_from_string(nml)
+    sim = Simulation(p1, dtype=jnp.float64,
+                     particles=ParticleSet.make(x0, v0, m0))
+    from ramses_tpu.pm.coupling import run_steps_pm
+    u1, pp1, f1, t1, _d, n1 = run_steps_pm(
+        sim.grid, sim.gspec, sim.pspec, sim.state.u, sim.state.p,
+        sim.state.f, jnp.asarray(0.0, jnp.float64),
+        jnp.asarray(1e9, jnp.float64), jnp.asarray(0.0, jnp.float64), 4)
+
+    p2 = params_from_string(nml)
+    ssim = ShardedSim(p2, dtype=jnp.float64)
+    # note: ShardedSim builds its own empty particle set only if driver
+    # created one; inject the same particles sharded
+    from ramses_tpu.parallel.sharded import ShardedSim as _SS
+    sim2 = Simulation(p2, dtype=jnp.float64,
+                      particles=ParticleSet.make(x0, v0, m0))
+    ss = _SS.__new__(_SS)
+    ss.inner = sim2
+    ss.mesh = make_mesh(3)
+    from ramses_tpu.parallel.mesh import spatial_sharding
+    ss.sharding = spatial_sharding(ss.mesh, n_leading=1)
+    ss.u = jax.device_put(sim2.state.u, ss.sharding)
+    ss.gspec, ss.pspec, ss.cosmo = sim2.gspec, sim2.pspec, sim2.cosmo
+    ss.f = jax.device_put(sim2.state.f, ss.sharding)
+    ss.p = sim2.state.p
+    ss.t, ss.dt_old, ss.nstep = 0.0, 0.0, 0
+    ss.run(4)
+    assert int(n1) == ss.nstep
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(ss.u),
+                               rtol=1e-12, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(pp1.x), np.asarray(ss.p.x),
+                               rtol=1e-12)
