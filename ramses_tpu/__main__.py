@@ -24,7 +24,7 @@ def main(argv=None) -> int:
                     help="force the multi-level AMR driver even when "
                          "levelmin==levelmax")
     ap.add_argument("--solver", default=None,
-                    choices=["hydro", "mhd"],
+                    choices=["hydro", "mhd", "rhd"],
                     help="solver family (the reference's SOLVER= make "
                          "variable); default: mhd when &INIT_PARAMS sets "
                          "A/B/C_region, hydro otherwise")
@@ -44,7 +44,13 @@ def main(argv=None) -> int:
                   any(params.init.B_region) or any(params.init.C_region)
                   else "hydro")
 
-    if solver == "mhd":
+    if solver == "rhd":
+        if args.amr or params.amr.levelmax > params.amr.levelmin:
+            raise NotImplementedError("rhd runs are uniform-grid for now")
+        from ramses_tpu.rhd.driver import RhdSimulation
+        sim = RhdSimulation(params, dtype=dtype)
+        sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
+    elif solver == "mhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
             raise NotImplementedError(
                 "MHD runs are uniform-grid for now (levelmax must equal "

@@ -1,0 +1,6 @@
+"""Special-relativistic hydrodynamics (SURVEY.md §2.4).
+
+The ``SOLVER=rhd`` build (Lamberts+2013): conservative (D, S, τ) state,
+Newton conservative→primitive recovery, ideal and Taub-Mathews equations
+of state, relativistic HLL fluxes, Lorentz-factor refinement criterion.
+"""

@@ -1,0 +1,188 @@
+"""SRHD state conversions, EOS, wave speeds.
+
+Reference: ``rhd/`` (own ``umuscl.f90``/``godunov_utils.f90`` with
+con→prim recovery and the TM equation of state, SURVEY.md §2.4).
+
+State (units c=1):
+  conservative u = [D, S_x, S_y, S_z, τ]        (+ passive D·X)
+    D = ρΓ,  S_i = ρ h Γ² v_i,  τ = ρ h Γ² − P − D
+  primitive  q = [ρ, v_x, v_y, v_z, P]
+
+EOS through the specific enthalpy h(ρ, P):
+  ideal:  h = 1 + γ/(γ−1)·Θ
+  tm:     h = 2.5Θ + sqrt(2.25Θ² + 1)   (Taub-Mathews; γ_eff 5/3→4/3)
+with Θ = P/ρ.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.config import Params
+
+NCOMP = 3
+
+
+@dataclass(frozen=True)
+class RhdStatic:
+    ndim: int = 1
+    npassive: int = 0
+    gamma: float = 5.0 / 3.0
+    eos: str = "ideal"          # ideal | tm
+    smallr: float = 1e-10
+    smallp: float = 1e-14
+    slope_type: int = 1
+    slope_theta: float = 1.5
+    courant_factor: float = 0.8
+    niter: int = 30             # con→prim Newton iterations
+
+    @property
+    def nvar(self) -> int:
+        return 5 + self.npassive
+
+    @classmethod
+    def from_params(cls, p: Params) -> "RhdStatic":
+        h = p.hydro
+        raw = p.raw.get("hydro_params", {}) if p.raw else {}
+        eos = str(raw.get("eos", "ideal")).strip("'\" ").lower()
+        return cls(ndim=p.ndim, npassive=p.npassive, gamma=float(h.gamma),
+                   eos=eos, smallr=float(h.smallr),
+                   slope_type=int(h.slope_type),
+                   slope_theta=float(h.slope_theta),
+                   courant_factor=float(h.courant_factor))
+
+
+def enthalpy(rho, p, cfg: RhdStatic):
+    theta = p / jnp.maximum(rho, cfg.smallr)
+    if cfg.eos == "tm":
+        return 2.5 * theta + jnp.sqrt(2.25 * theta ** 2 + 1.0)
+    return 1.0 + cfg.gamma / (cfg.gamma - 1.0) * theta
+
+
+def sound_speed2(rho, p, cfg: RhdStatic):
+    """Relativistic cs² = (∂p/∂e)|_s / h-weighted; ideal: γp/(ρh).
+    TM: cs² = Θ(5h−8Θ)/(3h(h−Θ)) (Mignone+2005 eq. for TM)."""
+    theta = p / jnp.maximum(rho, cfg.smallr)
+    h = enthalpy(rho, p, cfg)
+    if cfg.eos == "tm":
+        return theta * (5.0 * h - 8.0 * theta) / (
+            3.0 * h * jnp.maximum(h - theta, 1e-30))
+    return cfg.gamma * theta / jnp.maximum(h, 1e-30)
+
+
+def prim_to_cons(q, cfg: RhdStatic):
+    rho = jnp.maximum(q[0], cfg.smallr)
+    v = [q[1 + c] for c in range(NCOMP)]
+    p = jnp.maximum(q[4], cfg.smallp)
+    v2 = sum(vc * vc for vc in v)
+    lor = 1.0 / jnp.sqrt(jnp.maximum(1.0 - v2, 1e-14))
+    h = enthalpy(rho, p, cfg)
+    D = rho * lor
+    w = rho * h * lor ** 2
+    comps = [D] + [w * vc for vc in v] + [w - p - D]
+    for s in range(cfg.npassive):
+        comps.append(D * q[5 + s])
+    return jnp.stack(comps)
+
+
+def cons_to_prim(u, cfg: RhdStatic):
+    """Newton recovery of (ρ, v, P) from (D, S, τ).
+
+    Root of f(P) = ρ(P)·h(ρ,P)·Γ(P)² − P − (τ+D) with
+    v² = S²/(τ+D+P)², Γ = 1/√(1−v²), ρ = D/Γ — the standard SRHD
+    pressure iteration (the rhd godunov_utils recovery), fixed-iteration
+    for jit with a bisection-safe clamp.
+    """
+    D = jnp.maximum(u[0], cfg.smallr)
+    S = [u[1 + c] for c in range(NCOMP)]
+    tau = u[4]
+    S2 = sum(s * s for s in S)
+    E = tau + D                              # ρhΓ² − P
+
+    # initial guess: nonrelativistic-ish
+    p = jnp.maximum((cfg.gamma - 1.0) * (tau - 0.5 * S2
+                                         / jnp.maximum(E, 1e-30)),
+                    cfg.smallp)
+
+    def body(i, p):
+        """Classic pressure Newton: f(p) = p_eos(ρ, ε) − p with
+        f' ≈ v²cs² − 1, where ε = (E+p)(1−v²) − ρ − p per unit ρ.
+        Ideal gas: p_eos = (γ−1)ρε.  TM: the exact closure
+        p = ρ·ε(ε+2)/(3(1+ε)) (from h = 1+ε+θ in 4θ²−5hθ+h²−1=0)."""
+        wtot = E + p
+        v2 = jnp.clip(S2 / jnp.maximum(wtot ** 2, 1e-30), 0.0,
+                      1.0 - 1e-12)
+        lor = 1.0 / jnp.sqrt(1.0 - v2)
+        rho = jnp.maximum(D / lor, cfg.smallr)
+        eps = jnp.maximum((wtot * (1.0 - v2) - rho - p) / rho, 1e-14)
+        if cfg.eos == "tm":
+            p_eos = rho * eps * (eps + 2.0) / (3.0 * (1.0 + eps))
+        else:
+            p_eos = (cfg.gamma - 1.0) * rho * eps
+        f = p_eos - p
+        cs2 = jnp.clip(sound_speed2(rho, jnp.maximum(p, cfg.smallp), cfg),
+                       0.0, 1.0 - 1e-12)
+        dfdp = v2 * cs2 - 1.0
+        return jnp.maximum(p - f / dfdp, cfg.smallp)
+
+    p = jax.lax.fori_loop(0, cfg.niter, body, p)
+    wtot = E + p
+    v2 = jnp.clip(S2 / jnp.maximum(wtot ** 2, 1e-30), 0.0, 1.0 - 1e-12)
+    lor = 1.0 / jnp.sqrt(1.0 - v2)
+    rho = jnp.maximum(D / lor, cfg.smallr)
+    v = [s / jnp.maximum(wtot, 1e-30) for s in S]
+    comps = [rho] + v + [jnp.maximum(p, cfg.smallp)]
+    for sidx in range(cfg.npassive):
+        comps.append(u[5 + sidx] / D)
+    return jnp.stack(comps)
+
+
+def theta_of_h(h):
+    """Exact θ(h) for the TM EOS: h = 2.5θ + √(2.25θ²+1) ⇒
+    4θ² − 5hθ + (h²−1) = 0 ⇒ θ = (5h − √(9h² + 16))/8… check:
+    (h−2.5θ)² = 2.25θ²+1 ⇒ h² −5hθ +6.25θ² = 2.25θ²+1 ⇒
+    4θ² − 5hθ + (h²−1) = 0, physical (smaller) root."""
+    disc = jnp.sqrt(jnp.maximum(25.0 * h * h - 16.0 * (h * h - 1.0), 0.0))
+    return (5.0 * h - disc) / 8.0
+
+
+def lorentz(q):
+    v2 = sum(q[1 + c] ** 2 for c in range(NCOMP))
+    return 1.0 / jnp.sqrt(jnp.maximum(1.0 - v2, 1e-14))
+
+
+def flux_along(q, d: int, cfg: RhdStatic):
+    """Physical SRHD flux along component d from primitives."""
+    u = prim_to_cons(q, cfg)
+    vd = q[1 + d]
+    p = q[4]
+    comps = [u[0] * vd]
+    for c in range(NCOMP):
+        f = u[1 + c] * vd
+        if c == d:
+            f = f + p
+        comps.append(f)
+    comps.append(u[1 + d] - u[0] * vd)       # F(τ) = S_n − D v_n
+    for s in range(cfg.npassive):
+        comps.append(u[5 + s] * vd)
+    return jnp.stack(comps)
+
+
+def wave_speeds(q, d: int, cfg: RhdStatic):
+    """Relativistic characteristic speeds λ± along d (Mignone & Bodo)."""
+    rho = jnp.maximum(q[0], cfg.smallr)
+    p = jnp.maximum(q[4], cfg.smallp)
+    cs2 = jnp.clip(sound_speed2(rho, p, cfg), 1e-16, 1.0 - 1e-12)
+    v2 = jnp.clip(sum(q[1 + c] ** 2 for c in range(NCOMP)), 0.0,
+                  1.0 - 1e-12)
+    vn = q[1 + d]
+    den = 1.0 - v2 * cs2
+    disc = cs2 * (1.0 - v2) * (1.0 - v2 * cs2
+                               - vn * vn * (1.0 - cs2))
+    root = jnp.sqrt(jnp.maximum(disc, 0.0))
+    lam_p = (vn * (1.0 - cs2) + root) / den
+    lam_m = (vn * (1.0 - cs2) - root) / den
+    return lam_m, lam_p

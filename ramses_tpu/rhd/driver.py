@@ -1,0 +1,96 @@
+"""SRHD simulation driver with region ICs (the rhd test-suite shapes:
+shock tubes and blast waves, ``rhd/test_suite/``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.config import Params
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.rhd import core, uniform as ru
+from ramses_tpu.rhd.core import NCOMP, RhdStatic
+
+
+def rhd_condinit(shape, dx: float, p: Params, cfg: RhdStatic):
+    """Conservative ICs from &INIT_PARAMS regions (d, u/v/w = velocities
+    in units of c, P)."""
+    init = p.init
+    ndim = cfg.ndim
+    axes = [(np.arange(n) + 0.5) * dx for n in shape]
+    xc = np.meshgrid(*axes, indexing="ij")
+    q = np.zeros((cfg.nvar,) + tuple(shape))
+    q[0] = cfg.smallr
+    q[4] = cfg.smallp
+    vels = [init.u_region, init.v_region, init.w_region]
+    centers = [init.x_center, init.y_center, init.z_center]
+    lengths = [init.length_x, init.length_y, init.length_z]
+    for k in range(init.nregion):
+        en = float(init.exp_region[k])
+        if en < 10.0:
+            r = sum((2.0 * np.abs(xc[d] - centers[d][k]) / lengths[d][k])
+                    ** en for d in range(ndim)) ** (1.0 / en)
+        else:
+            r = np.maximum.reduce(
+                [2.0 * np.abs(xc[d] - centers[d][k]) / lengths[d][k]
+                 for d in range(ndim)])
+        m = r < 1.0
+        q[0][m] = init.d_region[k]
+        for c in range(NCOMP):
+            q[1 + c][m] = vels[c][k]
+        q[4][m] = init.p_region[k]
+    return np.asarray(core.prim_to_cons(jnp.asarray(q), cfg))
+
+
+class RhdSimulation:
+    """Uniform-grid special-relativistic run."""
+
+    def __init__(self, params: Params, dtype=jnp.float64):
+        self.params = params
+        self.cfg = RhdStatic.from_params(params)
+        n = 2 ** params.amr.levelmin
+        shape = tuple([n] * params.ndim)
+        self.dx = params.amr.boxlen / n
+        spec = bmod.BoundarySpec.from_params(params)
+        bc_kinds = tuple((f[0].kind, f[1].kind) for f in spec.faces)
+        for lo, hi in bc_kinds:
+            for k in (lo, hi):
+                if k not in (bmod.PERIODIC, bmod.OUTFLOW):
+                    raise NotImplementedError(
+                        "rhd boundaries: periodic/outflow only")
+        self.grid = ru.RhdGrid(cfg=self.cfg, shape=shape, dx=self.dx,
+                               bc_kinds=bc_kinds)
+        self.u = jnp.asarray(rhd_condinit(shape, self.dx, params,
+                                          self.cfg), dtype=dtype)
+        self.t = 0.0
+        self.nstep = 0
+
+    def evolve(self, tend: Optional[float] = None, chunk: int = 16,
+               nstepmax: int = 10 ** 9, verbose: bool = False):
+        p = self.params
+        tend = tend if tend is not None else (
+            p.output.tout[-1] if p.output.tout else p.output.tend)
+        tdtype = (jnp.float64 if jax.config.jax_enable_x64
+                  else jnp.float32)
+        while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
+            n = min(chunk, nstepmax - self.nstep)
+            u, t, ndone = ru.run_steps(
+                self.grid, self.u, jnp.asarray(self.t, tdtype),
+                jnp.asarray(tend, tdtype), n)
+            u.block_until_ready()
+            ndone = int(ndone)
+            self.u, self.t = u, float(t)
+            self.nstep += ndone
+            if verbose:
+                q = core.cons_to_prim(self.u, self.cfg)
+                print(f"rhd step {self.nstep} t={self.t:.4e} "
+                      f"lor_max={float(jnp.max(core.lorentz(q))):.3f}")
+            if ndone == 0:
+                break
+
+    def prims(self):
+        return np.asarray(core.cons_to_prim(self.u, self.cfg))

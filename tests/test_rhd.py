@@ -1,0 +1,164 @@
+"""Special-relativistic hydro tests."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.rhd import core
+from ramses_tpu.rhd.core import RhdStatic
+from ramses_tpu.rhd.driver import RhdSimulation
+from ramses_tpu.rhd.uniform import lorentz_refine_flags
+
+
+@pytest.mark.parametrize("eos", ["ideal", "tm"])
+def test_cons_prim_roundtrip(eos):
+    cfg = RhdStatic(ndim=3, eos=eos, niter=60)
+    rng = np.random.default_rng(0)
+    n = 500
+    rho = 10.0 ** rng.uniform(-3, 2, n)
+    p = 10.0 ** rng.uniform(-4, 2, n)
+    # velocities up to Γ ~ 7
+    vmag = rng.uniform(0, 0.99, n)
+    phi = rng.uniform(0, 2 * np.pi, n)
+    mu = rng.uniform(-1, 1, n)
+    st = np.sqrt(1 - mu ** 2)
+    v = np.stack([vmag * st * np.cos(phi), vmag * st * np.sin(phi),
+                  vmag * mu])
+    q = jnp.asarray(np.concatenate([rho[None], v, p[None]]))
+    u = core.prim_to_cons(q, cfg)
+    q2 = core.cons_to_prim(u, cfg)
+    assert np.allclose(np.asarray(q2[0]), rho, rtol=1e-8)
+    assert np.allclose(np.asarray(q2[4]), p, rtol=1e-7)
+    assert np.allclose(np.asarray(q2[1:4]), v, atol=1e-8)
+
+
+def test_tm_eos_limits():
+    """TM enthalpy: γ_eff→5/3 cold, →4/3 hot."""
+    cfg = RhdStatic(eos="tm")
+    cold = float(core.enthalpy(jnp.asarray(1.0), jnp.asarray(1e-6), cfg))
+    assert np.isclose(cold, 1.0 + 2.5e-6, rtol=1e-3)
+    hot = float(core.enthalpy(jnp.asarray(1.0), jnp.asarray(1e4), cfg))
+    assert np.isclose(hot, 4e4, rtol=1e-3)
+    # θ(h) inversion is exact
+    th = 0.37
+    h = 2.5 * th + np.sqrt(2.25 * th ** 2 + 1)
+    assert np.isclose(float(core.theta_of_h(jnp.asarray(h))), th,
+                      rtol=1e-12)
+
+
+def test_wave_speeds_subluminal():
+    cfg = RhdStatic(ndim=1)
+    q = jnp.asarray([[1.0], [0.9], [0.0], [0.0], [10.0]])
+    lm, lp = core.wave_speeds(q, 0, cfg)
+    assert -1.0 < float(lm[0]) < float(lp[0]) < 1.0
+
+
+def _tube_params(lmin=7, d=(10.0, 1.0), p=(13.33, 1e-2), gamma=5.0 / 3.0):
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": lmin, "levelmax": lmin, "boxlen": 1.0},
+        "boundary_params": {"nboundary": 2,
+                            "ibound_min": [-1, 1], "ibound_max": [-1, 1],
+                            "bound_type": [2, 2]},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75], "length_x": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [d[0], d[1]],
+                        "p_region": [p[0], p[1]]},
+        "hydro_params": {"gamma": gamma, "courant_factor": 0.5,
+                         "slope_type": 1},
+        "output_params": {"tend": 0.4},
+    }
+    return params_from_dict(groups, ndim=1)
+
+
+def test_relativistic_blast_tube():
+    """Mildly relativistic blast wave (Marti-Mueller problem 1 style):
+    bounded velocities, intact end states, positive density/pressure,
+    relativistic shell forms."""
+    sim = RhdSimulation(_tube_params(), dtype=jnp.float64)
+    sim.evolve(0.35)
+    q = sim.prims()
+    assert np.isclose(q[0][0], 10.0, atol=1e-6)
+    assert np.isclose(q[0][-1], 1.0, atol=1e-6)
+    assert q[0].min() > 0 and q[4].min() > 0
+    v = q[1]
+    assert np.abs(v).max() < 1.0
+    # the shocked shell is relativistic: v_max ~ 0.7c for this setup
+    assert 0.5 < v.max() < 0.95
+    assert np.all(np.isfinite(q))
+
+
+def test_nonrelativistic_limit_matches_hydro():
+    """v << c: SRHD sod profile matches the Newtonian solver."""
+    from ramses_tpu.driver import Simulation
+
+    eps = 1e-4   # pressures scaled so v ~ sqrt(eps)
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 7, "levelmax": 7, "boxlen": 1.0},
+        "boundary_params": {"nboundary": 2,
+                            "ibound_min": [-1, 1], "ibound_max": [-1, 1],
+                            "bound_type": [2, 2]},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75], "length_x": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [eps, 0.1 * eps]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc", "slope_type": 1},
+        "output_params": {"noutput": 1, "tout": [0.1 / np.sqrt(eps)],
+                          "tend": 0.1 / np.sqrt(eps)},
+    }
+    ph = params_from_dict({k: dict(v) for k, v in groups.items()}, ndim=1)
+    hsim = Simulation(ph, dtype=jnp.float64)
+    hsim.evolve()
+    rho_h = np.asarray(hsim.state.u)[0]
+
+    pr = params_from_dict({k: dict(v) for k, v in groups.items()}, ndim=1)
+    rsim = RhdSimulation(pr, dtype=jnp.float64)
+    rsim.evolve(0.1 / np.sqrt(eps))
+    rho_r = rsim.prims()[0]
+    l1 = np.mean(np.abs(rho_h - rho_r))
+    assert l1 < 5e-3, f"nonrel limit L1 {l1}"
+
+
+def test_conservation_periodic_2d():
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "point"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "length_x": [10.0, 1.0], "length_y": [10.0, 1.0],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.0],
+                        "p_region": [0.1, 1.0]},
+        "hydro_params": {"gamma": 5.0 / 3.0, "courant_factor": 0.5},
+        "output_params": {"tend": 0.1},
+    }
+    p = params_from_dict(groups, ndim=2)
+    sim = RhdSimulation(p, dtype=jnp.float64)
+    u0 = np.asarray(sim.u).copy()
+    sim.evolve(0.1)
+    u1 = np.asarray(sim.u)
+    for row in (0, 1, 2, 4):      # D, S, τ conserved
+        assert np.isclose(u1[row].sum(), u0[row].sum(), rtol=1e-11,
+                          atol=1e-12)
+    assert sim.nstep > 3
+
+
+def test_lorentz_refine_flags():
+    cfg = RhdStatic(ndim=1)
+    q = np.zeros((5, 32))
+    q[0] = 1.0
+    q[4] = 1.0
+    q[1, 16:] = 0.9           # jump in velocity → Γ jump
+    u = core.prim_to_cons(jnp.asarray(q), cfg)
+    fl = np.asarray(lorentz_refine_flags(u, cfg, err=0.1))
+    assert fl[15] and fl[16]
+    assert not fl[5] and not fl[28]
