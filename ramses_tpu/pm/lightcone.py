@@ -1,0 +1,78 @@
+"""Cosmological lightcone particle selection.
+
+The geometry core of ``amr/light_cone.f90`` (``perform_my_selection:424``):
+between two coarse steps the lightcone shell [r1, r2] (comoving distance
+travelled by light) sweeps through periodic replicas of the box; particles
+inside the shell are emitted once with their replica-shifted coordinates.
+Comoving distances come from the Friedmann conformal-time table the
+cosmology module already integrates (r = c·Δτ in supercomoving units).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def shell_radii(cosmo, aexp1: float, aexp2: float) -> Tuple[float, float]:
+    """Comoving radii [code units, boxlen=1] of the lightcone shell
+    between two expansion factors (observer at aexp=1)."""
+    tau1 = float(cosmo.tau_of_aexp(aexp1))
+    tau2 = float(cosmo.tau_of_aexp(aexp2))
+    tau0 = float(cosmo.tau_of_aexp(1.0 - 1e-12))
+    # conformal lookback distance; supercomoving c=... relative scale
+    return abs(tau0 - tau2), abs(tau0 - tau1)
+
+
+def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
+                   r2: float, boxlen: float = 1.0,
+                   opening: Optional[float] = None,
+                   axis: Sequence[float] = (0, 0, 1.0)):
+    """Select particles in the shell r1 <= |x_rep − obs| < r2 over all
+    periodic replicas intersecting the shell.
+
+    Returns (positions [m, ndim] in observer coordinates, radii [m],
+    source indices [m]) — a particle can appear in several replicas
+    (``light_cone.f90`` replica loops).
+    """
+    x = np.asarray(x)
+    ndim = x.shape[1]
+    obs = np.asarray(obs, dtype=np.float64)
+    nrep = int(np.ceil(r2 / boxlen)) + 1
+    reps = np.arange(-nrep, nrep + 1) * boxlen
+    grids = np.meshgrid(*([reps] * ndim), indexing="ij")
+    shifts = np.stack([g.ravel() for g in grids], axis=1)
+    # prune replicas whose box cannot intersect the shell
+    lo = np.maximum(np.abs(shifts - obs[None, :]) - boxlen, 0.0)
+    hi = np.abs(shifts - obs[None, :]) + boxlen
+    dmin = np.sqrt((lo ** 2).sum(1))
+    dmax = np.sqrt((hi ** 2).sum(1))
+    shifts = shifts[(dmax >= r1) & (dmin < r2)]
+
+    out_x, out_r, out_i = [], [], []
+    ax = np.asarray(axis, dtype=np.float64)[:ndim]
+    ax = ax / np.linalg.norm(ax)
+    cos_open = np.cos(opening) if opening is not None else None
+    for s in shifts:
+        pos = x + s[None, :] - obs[None, :]
+        r = np.sqrt((pos ** 2).sum(1))
+        m = (r >= r1) & (r < r2)
+        if cos_open is not None:
+            mu = (pos @ ax) / np.maximum(r, 1e-300)
+            m &= mu >= cos_open
+        if m.any():
+            out_x.append(pos[m])
+            out_r.append(r[m])
+            out_i.append(np.where(m)[0])
+    if not out_x:
+        return (np.zeros((0, ndim)), np.zeros(0),
+                np.zeros(0, dtype=np.int64))
+    return (np.concatenate(out_x), np.concatenate(out_r),
+            np.concatenate(out_i))
+
+
+def write_cone(path: str, pos: np.ndarray, r: np.ndarray,
+               idx: np.ndarray, aexp: float) -> None:
+    """Cone dump (``output_cone`` reduced to an npz payload)."""
+    np.savez_compressed(path, pos=pos, r=r, idx=idx, aexp=aexp)

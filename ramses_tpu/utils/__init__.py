@@ -1,0 +1,1 @@
+"""Utilities: profiling timers, map-making post-processing tools."""

@@ -1,0 +1,95 @@
+"""Snapshot post-processing: amr2map / part2map equivalents.
+
+The reference ships 56 standalone f90 analysis programs (``utils/f90``,
+SURVEY.md §2.11); the two workhorses project AMR snapshots
+(``amr2map``) and particle snapshots (``part2map``) to 2D maps.  These
+read our ``output_NNNNN`` directories through :mod:`ramses_tpu.io.reader`
+and write the movie frame format.
+
+CLI:  ``python -m ramses_tpu.utils.maps amr2map output_00001 out.map
+      --var density --dir z --nx 256``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+import numpy as np
+
+from ramses_tpu.io import reader as rdr
+from ramses_tpu.io.movie import write_frame
+
+
+def amr2map(outdir: str, var: str = "density", axis: int = 2,
+            nx: int = 256, kind: str = "mean") -> np.ndarray:
+    """Project leaf cells onto a 2D grid (mass/volume-weighted)."""
+    snap = rdr.load_snapshot(outdir)
+    cells = rdr.leaf_cells(snap)
+    ndim = snap["info"]["ndim"]
+    boxlen = snap["amr"][0].header["boxlen"]
+    axes2d = [d for d in range(ndim) if d != axis][:2]
+    if ndim == 1:
+        axes2d = [0]
+    vals = cells[var]
+    dx = cells["dx"]
+    w = dx ** ndim                     # volume weight
+    if kind == "max":
+        grid = np.full((nx,) * min(len(axes2d), 2), -np.inf)
+    else:
+        grid = np.zeros((nx,) * min(len(axes2d), 2))
+        wsum = np.zeros_like(grid)
+    coords = [np.clip((cells["xyz"[d]] / boxlen * nx).astype(int),
+                      0, nx - 1) for d in axes2d]
+    idx = tuple(coords)
+    if kind == "max":
+        np.maximum.at(grid, idx, vals)
+        grid[np.isneginf(grid)] = 0.0
+        return grid
+    np.add.at(grid, idx, vals * w)
+    np.add.at(wsum, idx, w)
+    return grid / np.maximum(wsum, 1e-300)
+
+
+def part2map(outdir: str, axis: int = 2, nx: int = 256) -> np.ndarray:
+    """Mass-weighted particle surface density map."""
+    snap = rdr.load_snapshot(outdir)
+    if "part" not in snap:
+        raise FileNotFoundError(f"no particle files in {outdir}")
+    part = snap["part"][0]
+    ndim = snap["info"]["ndim"]
+    boxlen = snap["amr"][0].header["boxlen"]
+    axes2d = [d for d in range(ndim) if d != axis][:2]
+    grid = np.zeros((nx,) * min(len(axes2d), 2))
+    coords = [np.clip((part[f"position_{'xyz'[d]}"] / boxlen * nx)
+                      .astype(int), 0, nx - 1) for d in axes2d]
+    np.add.at(grid, tuple(coords), part["mass"])
+    return grid * (nx / boxlen) ** len(axes2d)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ramses_tpu.utils.maps")
+    ap.add_argument("tool", choices=["amr2map", "part2map"])
+    ap.add_argument("outdir")
+    ap.add_argument("mapfile")
+    ap.add_argument("--var", default="density")
+    ap.add_argument("--dir", default="z", choices=["x", "y", "z"])
+    ap.add_argument("--nx", type=int, default=256)
+    ap.add_argument("--kind", default="mean",
+                    choices=["mean", "max"])
+    args = ap.parse_args(argv)
+    axis = "xyz".index(args.dir)
+    if args.tool == "amr2map":
+        m = amr2map(args.outdir, var=args.var, axis=axis, nx=args.nx,
+                    kind=args.kind)
+    else:
+        m = part2map(args.outdir, axis=axis, nx=args.nx)
+    write_frame(args.mapfile, m)
+    print(f"{args.tool}: {m.shape} map -> {args.mapfile} "
+          f"(min {m.min():.4e} max {m.max():.4e})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Label-based wallclock timers (``timer_m``, ``amr/update_time.f90:38-56``).
+
+Same zero-overhead design as the reference: exactly one label is active;
+switching to a new label accumulates the elapsed time on the previous
+one.  ``output_timer`` prints the per-label breakdown and the fraction of
+total — the reference's per-dump report (``:77-180``).  For deep kernel
+profiles use ``jax.profiler`` (wired in ``profile_trace``); these timers
+give the host-side phase accounting.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+
+class Timers:
+    def __init__(self):
+        self.acc: Dict[str, float] = {}
+        self.count: Dict[str, int] = {}
+        self._label: Optional[str] = None
+        self._t0 = 0.0
+
+    def timer(self, label: str):
+        """Switch the active label (accumulates the previous one)."""
+        now = time.perf_counter()
+        if self._label is not None:
+            self.acc[self._label] = self.acc.get(self._label, 0.0) \
+                + (now - self._t0)
+            self.count[self._label] = self.count.get(self._label, 0) + 1
+        self._label = label if label != "stop" else None
+        self._t0 = now
+
+    def stop(self):
+        self.timer("stop")
+
+    @contextlib.contextmanager
+    def section(self, label: str):
+        prev = self._label
+        self.timer(label)
+        try:
+            yield
+        finally:
+            self.timer(prev if prev is not None else "stop")
+
+    def output_timer(self, file=None) -> str:
+        """Per-label breakdown (``output_timer``, min/avg/max collapse to
+        one host here; the sharded runs are single-controller)."""
+        self.stop()
+        total = sum(self.acc.values()) or 1.0
+        lines = ["   --------------------------------------------------",
+                 "   TIMER      %        time     calls   label",
+                 "   --------------------------------------------------"]
+        for lbl, t in sorted(self.acc.items(), key=lambda kv: -kv[1]):
+            lines.append(f"   {100 * t / total:6.1f}   {t:10.3f}  "
+                         f"{self.count.get(lbl, 0):8d}   {lbl}")
+        lines.append(f"   total: {total:.3f} s")
+        out = "\n".join(lines)
+        if file is not None:
+            print(out, file=file)
+        return out
+
+
+GLOBAL = Timers()
+timer = GLOBAL.timer
+section = GLOBAL.section
+output_timer = GLOBAL.output_timer
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """jax.profiler wrapper: structured device traces (the observability
+    the reference lacks, SURVEY.md §5.1)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,135 @@
+"""Auxiliary subsystem tests: timers, movie frames, map tools, lightcone."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.io.movie import MovieWriter, project, read_frame, write_frame
+from ramses_tpu.pm.lightcone import cone_selection
+from ramses_tpu.utils.maps import amr2map, main as maps_main, part2map
+from ramses_tpu.utils.timers import Timers
+
+
+def test_timers_accumulate():
+    tm = Timers()
+    tm.timer("a")
+    time.sleep(0.02)
+    tm.timer("b")
+    time.sleep(0.01)
+    tm.stop()
+    assert tm.acc["a"] >= 0.015
+    assert tm.acc["b"] >= 0.005
+    rep = tm.output_timer()
+    assert "a" in rep and "total" in rep
+
+
+def test_timer_section():
+    tm = Timers()
+    tm.timer("outer")
+    with tm.section("inner"):
+        time.sleep(0.01)
+    time.sleep(0.005)
+    tm.stop()
+    assert tm.acc["inner"] >= 0.008
+    assert tm.acc["outer"] >= 0.003
+
+
+def test_frame_roundtrip(tmp_path):
+    data = np.arange(12.0).reshape(3, 4)
+    p = str(tmp_path / "f.map")
+    write_frame(p, data, t=1.5, bounds=(0, 1, 0, 2))
+    fr = read_frame(p)
+    assert fr["t"] == 1.5
+    assert fr["bounds"] == (0, 1, 0, 2)
+    assert np.allclose(fr["data"], data)
+
+
+def test_project_kinds():
+    f = jnp.asarray(np.arange(27.0).reshape(3, 3, 3))
+    assert np.allclose(np.asarray(project(f, 0, "sum")),
+                       np.asarray(f).sum(0))
+    assert np.allclose(np.asarray(project(f, 2, "max")),
+                       np.asarray(f).max(2))
+    assert np.allclose(np.asarray(project(f, 1, "slice")),
+                       np.asarray(f)[:, 1, :])
+
+
+def _sod_sim(tmp_path):
+    from ramses_tpu.config import params_from_dict
+    from ramses_tpu.driver import Simulation
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75], "y_center": [0.5, 0.5],
+                        "length_x": [0.5, 0.5], "length_y": [10.0, 10.0],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [1.0, 0.1]},
+        "hydro_params": {"riemann": "hllc"},
+        "output_params": {"noutput": 1, "tout": [0.05], "tend": 0.05},
+    }
+    return Simulation(params_from_dict(groups, ndim=2), dtype=jnp.float64)
+
+
+def test_movie_writer(tmp_path):
+    sim = _sod_sim(tmp_path)
+    mw = MovieWriter(str(tmp_path / "movie"), fields=("density",
+                                                      "pressure"))
+    paths = mw.emit(sim)
+    assert len(paths) == 2
+    fr = read_frame(paths[0])
+    assert fr["data"].shape == (16, 16)
+    assert np.isclose(fr["data"].max(), 1.0, atol=1e-5)
+
+
+def test_amr2map_and_cli(tmp_path):
+    sim = _sod_sim(tmp_path)
+    out = sim.dump(iout=1, base_dir=str(tmp_path))
+    m = amr2map(out, var="density", axis=2, nx=16)
+    assert m.shape == (16, 16)
+    # left half dense, right half light
+    assert np.isclose(m[2, 8], 1.0, atol=1e-6)
+    assert np.isclose(m[13, 8], 0.125, atol=1e-6)
+    # CLI end-to-end
+    mapfile = str(tmp_path / "d.map")
+    assert maps_main(["amr2map", out, mapfile, "--nx", "16"]) == 0
+    fr = read_frame(mapfile)
+    assert fr["data"].shape == (16, 16)
+
+
+def test_part2map(tmp_path):
+    from ramses_tpu.pm.particles import ParticleSet
+    sim = _sod_sim(tmp_path)
+    rng = np.random.default_rng(0)
+    n = 50
+    sim.state.p = ParticleSet.make(
+        np.column_stack([np.full(n, 0.3), rng.uniform(0, 1, n)]),
+        np.zeros((n, 2)), np.full(n, 2.0))
+    out = sim.dump(iout=2, base_dir=str(tmp_path))
+    m = part2map(out, axis=2, nx=8)
+    # all mass lands in column x≈0.3 → bin 2
+    assert np.isclose(m[2].sum(), 100.0 * 8 ** 2, rtol=1e-12)
+    assert m[5].sum() == 0.0
+
+
+def test_cone_selection_shell():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, (5000, 3))
+    pos, r, idx = cone_selection(x, obs=(0.5, 0.5, 0.5), r1=0.6, r2=1.1,
+                                 boxlen=1.0)
+    assert (r >= 0.6).all() and (r < 1.1).all()
+    # shell volume fraction sanity: V = 4π/3 (r2³−r1³)
+    vol = 4 * np.pi / 3 * (1.1 ** 3 - 0.6 ** 3)
+    assert abs(len(r) / 5000 / vol - 1.0) < 0.1
+    # opening angle restricts the count
+    pos2, r2_, _ = cone_selection(x, obs=(0.5, 0.5, 0.5), r1=0.6, r2=1.1,
+                                  opening=np.pi / 8)
+    assert 0 < len(r2_) < len(r)
+    mu = pos2[:, 2] / r2_
+    assert (mu >= np.cos(np.pi / 8) - 1e-12).all()
