@@ -34,6 +34,13 @@ from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init import regions
 
 
+class _Cfg1:
+    """Minimal cfg shim for interp_cells on a single-column array."""
+
+    def __init__(self, ndim: int):
+        self.ndim = ndim
+
+
 class AmrSim:
     """Adaptive simulation: host octree + per-level device states."""
 
@@ -50,6 +57,15 @@ class AmrSim:
         self.t = 0.0
         self.nstep = 0
         self.regrid_interval = 1
+        # self-gravity (per-level Poisson, SURVEY.md §3.3)
+        self.gravity = bool(params.run.poisson)
+        if self.gravity:
+            if any(k != 0 for pair in self.bc_kinds for k in pair):
+                raise NotImplementedError(
+                    "AMR self-gravity requires periodic boundaries")
+            self.fourpi = 4.0 * np.pi
+        self.phi: Dict[int, jnp.ndarray] = {}
+        self.fg: Dict[int, jnp.ndarray] = {}
 
         if init_tree is not None:
             self.tree = init_tree
@@ -99,6 +115,26 @@ class AmrSim:
                 son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
                 valid_cell=self._place(jnp.asarray(valid_cell), "cells"),
             )
+            if self.gravity:
+                g = mapmod.build_gravity_maps(self.tree, l, self.bc_kinds,
+                                              noct_pad=m.noct_pad)
+                self.dev[l].update(
+                    g_nb=self._place(jnp.asarray(g.nb), "cells"),
+                    g_cell=self._place(jnp.asarray(g.g_cell), "rep"),
+                    g_gnb=self._place(jnp.asarray(g.g_nb), "rep"),
+                    g_sgn=self._place(jnp.asarray(g.g_sgn), "rep"),
+                    g_valid=self._place(jnp.asarray(g.valid_cell),
+                                        "cells"))
+                if l == self.lmin:
+                    # flat cell i ↔ dense raveled position map for the
+                    # exact FFT solve on the complete base level
+                    ccb = self.tree.cell_coords(l)
+                    nb_ = 1 << l
+                    self._base_scatter = jnp.asarray(
+                        np.ravel_multi_index(
+                            tuple(ccb[:, d] for d in
+                                  range(self.tree.ndim)),
+                            (nb_,) * self.tree.ndim))
 
     def _ic_state(self, lvl: int) -> jnp.ndarray:
         """Analytic conservative ICs on this level's (padded) cells."""
@@ -252,13 +288,59 @@ class AmrSim:
             dts.append(float(dt_l) * (2 ** (l - self.lmin)))
         return min(dts)
 
+    def solve_gravity(self):
+        """Per-level Poisson solve, coarse→fine one-way interface
+        (``multigrid_fine``): exact FFT on the complete base level,
+        Dirichlet-ghost CG above it; then the gradient force."""
+        from ramses_tpu.poisson import amr_solve as gs
+        from ramses_tpu.poisson.solver import fft_solve
+
+        nd = self.cfg.ndim
+        # mean density over leaves (periodic solvability)
+        rho_mean = float(self.totals()[0]) / self.boxlen ** nd
+        for l in self.levels():
+            m = self.maps[l]
+            d = self.dev[l]
+            dx = self.dx(l)
+            rho = self.u[l][:, 0]
+            rhs = self.fourpi * (rho - rho_mean)
+            if l == self.lmin:
+                nb_ = 1 << l
+                dense = jnp.zeros((nb_ ** nd,), rhs.dtype)
+                dense = dense.at[self._base_scatter].set(
+                    rhs[:m.noct * (1 << nd)])
+                phi_dense = fft_solve(dense.reshape((nb_,) * nd), dx)
+                phi = jnp.zeros((m.ncell_pad,), rhs.dtype)
+                phi = phi.at[:m.noct * (1 << nd)].set(
+                    phi_dense.reshape(-1)[self._base_scatter])
+                ghosts = jnp.zeros((8,), rhs.dtype)
+            else:
+                ghosts = K.interp_cells(
+                    self.phi[l - 1][:, None], d["g_cell"], d["g_gnb"],
+                    d["g_sgn"].astype(self.phi[l - 1].dtype),
+                    _Cfg1(nd), itype=1)[:, 0]
+                phi = gs.cg_level(rhs, ghosts, d["g_nb"],
+                                  jnp.asarray(dx, rhs.dtype),
+                                  d["g_valid"], nd, iters=150)
+            self.phi[l] = phi
+            self.fg[l] = gs.grad_phi(phi, ghosts, d["g_nb"],
+                                     jnp.asarray(dx, phi.dtype),
+                                     d["g_valid"], nd).astype(self.dtype)
+
     def step_coarse(self, dt: float):
         self.unew: Dict[int, jnp.ndarray] = {}
+        if self.gravity:
+            self.solve_gravity()
         self._advance(self.lmin, float(dt))
         self.t += float(dt)
         self.nstep += 1
 
     def _advance(self, l: int, dt: float):
+        if self.gravity:                               # synchro −½dt
+            from ramses_tpu.poisson.amr_solve import kick_flat
+            self.u[l] = kick_flat(self.u[l], self.fg[l],
+                                  jnp.asarray(0.5 * dt, self.dtype),
+                                  self.cfg.ndim, self.cfg.smallr)
         self.unew[l] = self.u[l]                       # set_unew
         if self.tree.has(l + 1):
             self._advance(l + 1, 0.5 * dt)             # subcycle ×2
@@ -273,6 +355,11 @@ class AmrSim:
             self.unew[l - 1] = K.scatter_corrections(
                 self.unew[l - 1], corr, d["corr_idx"], self.cfg)
         self.u[l] = self.unew[l]                       # set_uold
+        if self.gravity:                               # synchro +½dt
+            from ramses_tpu.poisson.amr_solve import kick_flat
+            self.u[l] = kick_flat(self.u[l], self.fg[l],
+                                  jnp.asarray(0.5 * dt, self.dtype),
+                                  self.cfg.ndim, self.cfg.smallr)
         if self.tree.has(l + 1):
             self.u[l] = K.restrict_upload(self.u[l], self.u[l + 1],
                                           d["ref_cell"], d["son_oct"],

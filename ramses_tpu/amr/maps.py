@@ -274,3 +274,120 @@ def build_prolong_maps(tree_new: Octree, tree_old: Octree, lvl: int,
             nb[:, d, side] = np.where(
                 bad, f_cell, n_oct * twotondim + n_off).astype(np.int32)
     return copy_dst, copy_src, new_octs.astype(np.int32), f_cell, nb
+
+
+@dataclass
+class GravityMaps:
+    """Face-neighbour maps for the per-level Poisson solve
+    (``poisson/multigrid_fine_*`` machinery reduced to index maps).
+
+    ``nb[:, d, side]`` rows index concat(φ_cells [ncell_pad],
+    ghosts [ng_pad], zero [1]); ghosts are fine cells whose neighbour
+    lives on the coarser level (the Dirichlet BC ring of
+    ``make_fine_bc_rhs``), filled by interpolating coarse φ.
+    """
+    lvl: int
+    ncell: int
+    ncell_pad: int
+    ng: int
+    ng_pad: int
+    nb: np.ndarray           # [ncell_pad, ndim, 2] int32
+    g_cell: np.ndarray       # [ng_pad] int32 coarse flat cell
+    g_nb: np.ndarray         # [ng_pad, ndim, 2] int32 coarse neighbours
+    g_sgn: np.ndarray        # [ng_pad, ndim] int8 child offset signs
+    valid_cell: np.ndarray   # [ncell_pad] bool
+
+
+def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
+                       noct_pad: Optional[int] = None) -> GravityMaps:
+    """Build the 2·ndim face-neighbour map of a level's cells with
+    coarse-ghost requests where the neighbour is unrefined."""
+    ndim = tree.ndim
+    twotondim = 1 << ndim
+    lev = tree.levels[lvl]
+    noct = lev.noct
+    noct_pad = noct_pad or bucket(noct)
+    ncell = noct * twotondim
+    ncell_pad = noct_pad * twotondim
+
+    cc = tree.cell_coords(lvl)                    # [ncell, ndim]
+    nb_rows = np.zeros((ncell, ndim, 2), dtype=np.int64)
+    miss_coords = []
+    miss_where = []
+    for d in range(ndim):
+        for side, s in ((0, -1), (1, +1)):
+            nc = cc.copy()
+            nc[:, d] += s
+            ncm, _refl = map_coords(nc, lvl, bc_kinds, ndim)
+            oct_idx = tree.lookup(lvl, ncm >> 1)
+            off = np.zeros(len(ncm), dtype=np.int64)
+            for d2 in range(ndim):
+                off = off * 2 + (ncm[:, d2] & 1)
+            flat = oct_idx * twotondim + off
+            ok = oct_idx >= 0
+            nb_rows[:, d, side] = np.where(ok, flat, -1)
+            if (~ok).any():
+                miss_coords.append(ncm[~ok])
+                miss_where.append((d, side, np.where(~ok)[0]))
+
+    # unique ghost cells
+    if miss_coords:
+        allmiss = np.concatenate(miss_coords)
+        keys = kmod.encode(allmiss, ndim)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        ucoords = kmod.decode(uniq, ndim)
+        ng = len(uniq)
+        # interp requests from lvl-1 (same construction as hydro ghosts)
+        ccoarse = ucoords >> 1
+        f_oct = tree.lookup(lvl - 1, ccoarse >> 1)
+        if (f_oct < 0).any():
+            raise RuntimeError(f"gradedness violated at level {lvl}")
+        f_off = np.zeros(ng, dtype=np.int64)
+        for d in range(ndim):
+            f_off = f_off * 2 + (ccoarse[:, d] & 1)
+        g_cell = (f_oct * twotondim + f_off).astype(np.int32)
+        g_sgn = ((ucoords & 1) * 2 - 1).astype(np.int8)
+        g_nb = np.empty((ng, ndim, 2), dtype=np.int32)
+        for d in range(ndim):
+            for side, s in ((0, -1), (1, +1)):
+                nc2 = ccoarse.copy()
+                nc2[:, d] += s
+                ncm2, nrefl = map_coords(nc2, lvl - 1, bc_kinds, ndim)
+                n_oct = tree.lookup(lvl - 1, ncm2 >> 1)
+                n_off = np.zeros(ng, dtype=np.int64)
+                for d2 in range(ndim):
+                    n_off = n_off * 2 + (ncm2[:, d2] & 1)
+                flat2 = n_oct * twotondim + n_off
+                bad = (n_oct < 0) | nrefl.any(axis=1)
+                g_nb[:, d, side] = np.where(bad, g_cell,
+                                            flat2).astype(np.int32)
+        # patch nb_rows with ghost slots
+        pos = 0
+        for chunk, (d, side, rows) in zip(miss_coords, miss_where):
+            n = len(chunk)
+            nb_rows[rows, d, side] = ncell_pad + inv[pos:pos + n]
+            pos += n
+    else:
+        ng = 0
+        g_cell = np.zeros(0, dtype=np.int32)
+        g_sgn = np.zeros((0, ndim), dtype=np.int8)
+        g_nb = np.zeros((0, ndim, 2), dtype=np.int32)
+
+    ng_pad = bucket(ng, 8) if ng > 0 else 8
+    zero_row = ncell_pad + ng_pad
+    nb_rows[nb_rows < 0] = zero_row
+
+    def _padg(a, n, fill=0):
+        out = np.full((n,) + a.shape[1:], fill, dtype=a.dtype)
+        out[:len(a)] = a
+        return out
+
+    nb = np.full((ncell_pad, ndim, 2), zero_row, dtype=np.int64)
+    nb[:ncell] = nb_rows
+    valid = np.zeros(ncell_pad, dtype=bool)
+    valid[:ncell] = True
+    return GravityMaps(
+        lvl=lvl, ncell=ncell, ncell_pad=ncell_pad, ng=ng, ng_pad=ng_pad,
+        nb=nb.astype(np.int32),
+        g_cell=_padg(g_cell, ng_pad), g_nb=_padg(g_nb, ng_pad),
+        g_sgn=_padg(g_sgn, ng_pad), valid_cell=valid)

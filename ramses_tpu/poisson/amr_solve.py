@@ -1,0 +1,98 @@
+"""Per-level Poisson solve on the AMR hierarchy.
+
+The ``multigrid_fine``/``phi_fine_cg`` capability (SURVEY.md §3.3):
+levels are solved coarse→fine with a one-way interface — each level's
+solve sees Dirichlet boundary values interpolated from the coarser φ
+(``make_fine_bc_rhs``), exactly the reference's masked level solve.  The
+base level is complete, so its solve is the exact FFT inversion; finer
+levels run preconditioned-free CG (the reference's own fallback,
+``amr/amr_step.f90:250-258``) with matvec = one gather over the
+face-neighbour index map.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _ext(phi, ghosts):
+    zero = jnp.zeros((1,), phi.dtype)
+    return jnp.concatenate([phi, ghosts, zero])
+
+
+def laplacian(phi, ghosts, nb, dx, valid, ndim: int):
+    """7-point Laplacian over the face-neighbour map; zero on pad rows."""
+    ext = _ext(phi, ghosts)
+    s = jnp.zeros_like(phi)
+    for d in range(ndim):
+        s = s + ext[nb[:, d, 0]] + ext[nb[:, d, 1]]
+    lap = (s - 2.0 * ndim * phi) / dx ** 2
+    return jnp.where(valid, lap, 0.0)
+
+
+@partial(jax.jit, static_argnames=("ndim", "iters"))
+def cg_level(rhs, ghosts, nb, dx, valid, ndim: int, iters: int = 200,
+             phi0=None):
+    """CG solve of Δφ = rhs with fixed Dirichlet ghosts.
+
+    The affine split: A(φ) ≡ lap(φ, 0); b ≡ rhs − lap(0, ghosts).  A is
+    symmetric negative definite on the masked cells; CG runs on −A.
+    """
+    zero_g = jnp.zeros_like(ghosts)
+    b = jnp.where(valid,
+                  rhs - laplacian(jnp.zeros_like(rhs), ghosts, nb, dx,
+                                  valid, ndim), 0.0)
+
+    def A(x):
+        return -laplacian(x, zero_g, nb, dx, valid, ndim)
+
+    x = (phi0 if phi0 is not None else jnp.zeros_like(rhs))
+    r = jnp.where(valid, -b - A(x), 0.0)
+    p = r
+    rs = jnp.sum(r * r)
+
+    def body(i, state):
+        x, r, p, rs = state
+        Ap = A(p)
+        denom = jnp.sum(p * Ap)
+        alpha = jnp.where(denom != 0.0, rs / denom, 0.0)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rs_new = jnp.sum(r * r)
+        beta = jnp.where(rs != 0.0, rs_new / rs, 0.0)
+        p = r + beta * p
+        return x, r, p, rs_new
+
+    x, r, p, rs = jax.lax.fori_loop(0, iters, body, (x, r, p, rs))
+    return jnp.where(valid, x, 0.0)
+
+
+@partial(jax.jit, static_argnames=("ndim",))
+def grad_phi(phi, ghosts, nb, dx, valid, ndim: int):
+    """Central-difference force f = −∇φ, [ncell_pad, ndim]
+    (``force_fine``'s 5-point gradient)."""
+    ext = _ext(phi, ghosts)
+    comps = []
+    for d in range(ndim):
+        g = -(ext[nb[:, d, 1]] - ext[nb[:, d, 0]]) / (2.0 * dx)
+        comps.append(jnp.where(valid, g, 0.0))
+    return jnp.stack(comps, axis=1)
+
+
+@partial(jax.jit, static_argnames=("ndim",))
+def kick_flat(u, f, dteff, ndim: int, smallr: float):
+    """Gravity momentum kick on flat cells [ncell, nvar] at fixed
+    internal energy (``synchro_hydro_fine``)."""
+    r = jnp.maximum(u[:, 0], smallr)
+    ek_old = sum(0.5 * u[:, 1 + d] ** 2 for d in range(ndim)) / r
+    mom = [u[:, 1 + d] + r * f[:, d] * dteff for d in range(ndim)]
+    ek_new = sum(0.5 * m * m for m in mom) / r
+    e = u[:, 1 + ndim] - ek_old + ek_new
+    cols = [u[:, 0:1]] + [m[:, None] for m in mom] + [e[:, None]]
+    if u.shape[1] > ndim + 2:
+        cols.append(u[:, ndim + 2:])
+    return jnp.concatenate(cols, axis=1)

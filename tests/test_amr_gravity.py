@@ -1,0 +1,163 @@
+"""AMR self-gravity tests: map/operator sanity, refined-patch accuracy
+against the dense fine solve, point-mass force law, coupled dynamics."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.amr.maps import build_gravity_maps
+from ramses_tpu.amr.tree import Octree
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.poisson import amr_solve as gs
+from ramses_tpu.poisson.solver import fft_solve
+
+
+def test_gravity_maps_complete_level():
+    """Complete periodic base level: no ghosts, Laplacian exact on
+    linear and quadratic fields."""
+    t = Octree.base(2, 4, 4)
+    g = build_gravity_maps(t, 4, [(0, 0), (0, 0)])
+    assert g.ng == 0
+    n = 16
+    dx = 1.0 / n
+    cc = t.cell_centers(4)
+    # linear field has zero Laplacian away from the periodic wrap
+    phi_lin = jnp.asarray(cc[:, 0])
+    pad = g.ncell_pad - g.ncell
+    phi_lin = jnp.concatenate([phi_lin, jnp.zeros(pad)])
+    ghosts = jnp.zeros((g.ng_pad,))
+    lap = np.asarray(gs.laplacian(phi_lin, ghosts, jnp.asarray(g.nb),
+                                  dx, jnp.asarray(g.valid_cell), 2))
+    interior = (cc[:, 0] > 2 * dx) & (cc[:, 0] < 1 - 2 * dx)
+    assert np.abs(lap[:g.ncell][interior]).max() < 1e-9
+    # sin field: Δ sin(2πx) = −(2π)² sin(2πx) to O(h²)
+    phi_sin = jnp.concatenate([jnp.asarray(np.sin(2 * np.pi * cc[:, 0])),
+                               jnp.zeros(pad)])
+    lap = np.asarray(gs.laplacian(phi_sin, ghosts, jnp.asarray(g.nb),
+                                  dx, jnp.asarray(g.valid_cell), 2))
+    expect = -(2 * np.pi) ** 2 * np.sin(2 * np.pi * cc[:, 0])
+    assert np.allclose(lap[:g.ncell], expect, atol=0.5)
+
+
+def test_cg_matches_fft_on_complete_level():
+    """CG on the base level reproduces the exact FFT solution."""
+    t = Octree.base(2, 4, 4)
+    g = build_gravity_maps(t, 4, [(0, 0), (0, 0)])
+    n = 16
+    dx = 1.0 / n
+    cc = t.cell_coords(4)
+    rng = np.random.default_rng(0)
+    rho_d = rng.standard_normal((n, n))
+    rho_d -= rho_d.mean()
+    phi_d = np.asarray(fft_solve(jnp.asarray(rho_d), dx))
+    rhs = jnp.zeros((g.ncell_pad,))
+    rhs = rhs.at[jnp.arange(g.ncell)].set(
+        jnp.asarray(rho_d[cc[:, 0], cc[:, 1]]))
+    ghosts = jnp.zeros((g.ng_pad,))
+    phi = np.asarray(gs.cg_level(rhs, ghosts, jnp.asarray(g.nb), dx,
+                                 jnp.asarray(g.valid_cell), 2, iters=400))
+    got = phi[:g.ncell] - phi[:g.ncell].mean()
+    want = phi_d[cc[:, 0], cc[:, 1]]
+    want = want - want.mean()
+    assert np.abs(got - want).max() < 2e-5 * np.abs(want).max()
+
+
+def _blob_params(lmin=4, lmax=5, ndim=2, d0=50.0):
+    groups = {
+        "run_params": {"hydro": True, "poisson": True},
+        "amr_params": {"levelmin": lmin, "levelmax": lmax, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "z_center": [0.5, 0.5],
+                        "length_x": [10.0, 0.25], "length_y": [10.0, 0.25],
+                        "length_z": [10.0, 0.25],
+                        "exp_region": [10.0, 2.0],
+                        "d_region": [1.0, d0],
+                        "p_region": [10.0, 10.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc"},
+        "refine_params": {"err_grad_d": 0.2},
+        "output_params": {"tend": 0.01},
+    }
+    return params_from_dict(groups, ndim=ndim)
+
+
+def test_refined_patch_force_matches_dense():
+    """Force on the refined patch ≈ the dense fine-grid solve."""
+    p = _blob_params(lmin=4, lmax=5, ndim=2)
+    sim = AmrSim(p, dtype=jnp.float64)
+    assert sim.tree.has(5), "blob did not trigger refinement"
+    sim.solve_gravity()
+
+    # dense reference at the fine resolution
+    n = 32
+    dx = 1.0 / n
+    dense = np.full((n, n), 1.0)
+    xc = (np.arange(n) + 0.5) * dx
+    X, Y = np.meshgrid(xc, xc, indexing="ij")
+    r = np.sqrt(((X - 0.5) / 0.125) ** 2 + ((Y - 0.5) / 0.125) ** 2)
+    dense[r < 1.0] = 50.0
+    rhs = 4 * np.pi * (dense - dense.mean())
+    phi_d = np.asarray(fft_solve(jnp.asarray(rhs), dx))
+    fx_d = -(np.roll(phi_d, -1, 0) - np.roll(phi_d, 1, 0)) / (2 * dx)
+
+    m = sim.maps[5]
+    cc = sim.tree.cell_coords(5)
+    f_amr = np.asarray(sim.fg[5])[:m.noct * 4]
+    # compare where the patch is interior (2 fine cells from its edge)
+    lab = np.zeros((n, n), dtype=bool)
+    lab[tuple(cc.T)] = True
+    interior = lab.copy()
+    for d in range(2):
+        for s in (-1, 1):
+            for _ in range(1):
+                interior &= np.roll(lab, s * 2, axis=d)
+    sel = interior[tuple(cc.T)]
+    got = f_amr[sel, 0]
+    want = fx_d[tuple(cc[sel].T)]
+    scale = np.abs(fx_d).max()
+    assert np.abs(got - want).max() < 0.05 * scale, \
+        f"max err {np.abs(got - want).max():.3e} vs scale {scale:.3e}"
+
+
+def test_point_mass_force_law_3d():
+    """Central concentration: radial force ~ GM/r² outside it."""
+    p = _blob_params(lmin=4, lmax=4, ndim=3, d0=1000.0)
+    sim = AmrSim(p, dtype=jnp.float64)
+    sim.solve_gravity()
+    m = sim.maps[4]
+    cc = sim.tree.cell_centers(4)
+    f = np.asarray(sim.fg[4])[:m.noct * 8]
+    rvec = cc - 0.5
+    r = np.sqrt((rvec ** 2).sum(1))
+    fr = -(f * rvec).sum(1) / np.maximum(r, 1e-12)   # inward positive
+    u0 = np.asarray(sim.u[4])[:m.noct * 8, 0]
+    mass_c = ((u0 - 1.0) * sim.dx(4) ** 3).sum()     # excess blob mass
+    shell = (r > 0.2) & (r < 0.3)
+    want = mass_c / r[shell] ** 2                    # G=1 user units
+    got = fr[shell]
+    # periodic images + finite blob: ~15% band
+    assert np.median(np.abs(got / want - 1.0)) < 0.2
+
+
+def test_amr_gravity_dynamics_smoke():
+    """Coupled run: dense blob starts infalling; everything finite."""
+    p = _blob_params(lmin=3, lmax=4, ndim=2, d0=100.0)
+    sim = AmrSim(p, dtype=jnp.float64)
+    sim.evolve(0.02)
+    assert sim.nstep > 0
+    for l in sim.levels():
+        assert np.all(np.isfinite(np.asarray(sim.u[l])))
+    # inward momentum near the blob edge: radial velocity < 0 on average
+    l = sim.lmin
+    m = sim.maps[l]
+    cc = sim.tree.cell_centers(l)
+    u = np.asarray(sim.u[l])[:m.noct * 4]
+    rvec = cc - 0.5
+    r = np.sqrt((rvec ** 2).sum(1))
+    vr = ((u[:, 1:3] / u[:, 0:1]) * rvec).sum(1) / np.maximum(r, 1e-12)
+    ring = (r > 0.15) & (r < 0.35)
+    assert vr[ring].mean() < 0.0
