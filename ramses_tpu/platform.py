@@ -1,0 +1,53 @@
+"""Backend selection helpers.
+
+The deployment image's ``sitecustomize`` registers a TPU-tunnel ("axon")
+PJRT plugin in every interpreter and forces ``jax_platforms="axon,cpu"``
+through ``jax.config`` — overriding the ``JAX_PLATFORMS`` environment
+variable.  Anything that must run on a virtual multi-device CPU mesh
+(the reference suite's same-host multi-rank trick,
+``tests/run_test_suite.sh:78-82``) has to force the CPU platform back
+*before the first backend is instantiated*.  This module is the single
+home for that workaround; ``tests/conftest.py`` and
+``__graft_entry__.dryrun_multichip`` both use it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_mesh(n_devices: int):
+    """Force the CPU backend with ``n_devices`` virtual devices.
+
+    Safe to call more than once with the same count.  Raises if a JAX
+    backend was already initialized on a different platform or with
+    fewer devices — a loud failure instead of a silently-smaller mesh.
+    Returns the first ``n_devices`` devices.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"{_COUNT_FLAG}={n_devices}"
+    if _COUNT_FLAG in flags:
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    if devices[0].platform != "cpu":
+        raise RuntimeError(
+            f"CPU platform could not be forced: backend already "
+            f"initialized on {devices[0].platform!r}. Call force_cpu_mesh "
+            f"before any other jax use in the process.")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"requested {n_devices} virtual CPU devices but the backend "
+            f"has {len(devices)}; it was initialized before XLA_FLAGS "
+            f"could be updated.")
+    return devices[:n_devices]

@@ -7,20 +7,14 @@ algorithm, not the backend.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ramses_tpu.platform import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)
 
 import jax  # noqa: E402
 
-# The image's sitecustomize registers a TPU-tunnel ("axon") PJRT plugin in
-# every interpreter and forces jax_platforms="axon,cpu" via jax.config —
-# overriding JAX_PLATFORMS from the environment.  Tests must run on the
-# virtual 8-device CPU mesh, so force the config back before any backend
-# is initialized (register() runs at interpreter start, long before us,
-# but backends are only instantiated on first use).
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
