@@ -1,0 +1,215 @@
+// CPU baseline proxy: 3D MUSCL-Hancock unsplit hydro, HLLC Riemann.
+//
+// The reference (tatary/ramses) cannot be compiled in this image (no
+// Fortran compiler), so this file re-creates the algorithmic cost of its
+// hot kernel — hydro/umuscl.f90: ctoprim (:861) -> uslope minmod (:970,
+// slope_type=1) -> trace3d predictor (:483) -> cmpflxm/riemann per
+// direction (:714) — as plain optimized C++ on a uniform grid, the same
+// sedov3d levelmin=levelmax configuration that is BASELINE.md config 1.
+// Measured mus-per-cell-update from this program stands in for the
+// reference's self-instrumented `mus/pt` (amr/adaptive_loop.f90:204-212).
+//
+// Build: g++ -O3 -march=native -funroll-loops -o muscl3d muscl3d.cc
+// Run:   ./muscl3d [N] [nsteps]   -> one JSON line on stdout
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <chrono>
+#include <vector>
+
+static const double GAMMA = 1.4;
+static const double SMALLR = 1e-10, SMALLC = 1e-10;
+
+struct Grid {
+  int n;          // cells per side (interior)
+  int s;          // stride with 2-ghost pad
+  std::vector<double> u;  // [5][s^3] conservative: rho, mom xyz, E
+  Grid(int n_) : n(n_), s(n_ + 4), u(5 * (size_t)(n_ + 4) * (n_ + 4) * (n_ + 4)) {}
+  inline size_t idx(int v, int i, int j, int k) const {
+    return ((size_t)v * s + i) * s * s + (size_t)j * s + k;
+  }
+};
+
+static inline double minmod(double a, double b) {
+  // slope_type=1 minmod limiter (hydro/umuscl.f90:970 dsgn/dlim branch)
+  if (a * b <= 0.0) return 0.0;
+  double sa = std::fabs(a), sb = std::fabs(b);
+  return (a > 0 ? 1.0 : -1.0) * (sa < sb ? sa : sb);
+}
+
+// One unsplit MUSCL-Hancock step over the interior; periodic ghosts.
+// prim layout per cell: rho, vx, vy, vz, p.
+static void step(Grid &g, double dt) {
+  const int n = g.n, s = g.s;
+  const size_t nc = (size_t)s * s * s;
+  static std::vector<double> q, dqx, dqy, dqz, flux;
+  q.resize(5 * nc); dqx.resize(5 * nc); dqy.resize(5 * nc); dqz.resize(5 * nc);
+  flux.resize(5 * nc * 3);
+  const double dx = 1.0 / n, dtdx = dt / dx;
+
+  // periodic ghost fill (2 wide) on conservative state
+  for (int v = 0; v < 5; v++)
+    for (int i = 0; i < s; i++)
+      for (int j = 0; j < s; j++)
+        for (int k = 0; k < s; k++) {
+          int ii = (i - 2 + n) % n + 2, jj = (j - 2 + n) % n + 2,
+              kk = (k - 2 + n) % n + 2;
+          if (ii != i || jj != j || kk != k)
+            g.u[g.idx(v, i, j, k)] = g.u[g.idx(v, ii, jj, kk)];
+        }
+
+  // ctoprim
+  for (size_t c = 0; c < nc; c++) {
+    double rho = g.u[0 * nc + c]; rho = rho > SMALLR ? rho : SMALLR;
+    double inv = 1.0 / rho;
+    double vx = g.u[1 * nc + c] * inv, vy = g.u[2 * nc + c] * inv,
+           vz = g.u[3 * nc + c] * inv;
+    double ek = 0.5 * rho * (vx * vx + vy * vy + vz * vz);
+    double p = (GAMMA - 1.0) * (g.u[4 * nc + c] - ek);
+    p = p > SMALLR * SMALLC ? p : SMALLR * SMALLC;
+    q[0 * nc + c] = rho; q[1 * nc + c] = vx; q[2 * nc + c] = vy;
+    q[3 * nc + c] = vz; q[4 * nc + c] = p;
+  }
+
+  // uslope: minmod limited central differences in each direction
+  const size_t di = (size_t)s * s, dj = s, dk = 1;
+  for (int v = 0; v < 5; v++)
+    for (int i = 1; i < s - 1; i++)
+      for (int j = 1; j < s - 1; j++)
+        for (int k = 1; k < s - 1; k++) {
+          size_t c = ((size_t)i) * di + (size_t)j * dj + k, b = (size_t)v * nc + c;
+          dqx[b] = minmod(q[b + di] - q[b], q[b] - q[b - di]);
+          dqy[b] = minmod(q[b + dj] - q[b], q[b] - q[b - dj]);
+          dqz[b] = minmod(q[b + dk] - q[b], q[b] - q[b - dk]);
+        }
+
+  // trace3d: half-dt predictor in primitive variables, then per-face
+  // HLLC flux (cmpflxm).  For each direction, reconstruct L/R states at
+  // the face from the predicted cell states.
+  auto hllc = [&](const double qL[5], const double qR[5], int d, double F[5]) {
+    // rotate so velocity component d is the normal one
+    int iv = 1 + d;
+    double rl = qL[0], ul = qL[iv], pl = qL[4];
+    double rr = qR[0], ur = qR[iv], pr = qR[4];
+    double cl = std::sqrt(GAMMA * pl / rl), cr = std::sqrt(GAMMA * pr / rr);
+    double sl = (ul - cl < ur - cr) ? ul - cl : ur - cr;
+    double sr = (ul + cl > ur + cr) ? ul + cl : ur + cr;
+    double sm = (pr - pl + rl * ul * (sl - ul) - rr * ur * (sr - ur)) /
+                (rl * (sl - ul) - rr * (sr - ur) + 1e-300);
+    const double *qs; double rs, us, ps, ss;
+    if (sm >= 0) { qs = qL; rs = rl; us = ul; ps = pl; ss = sl; }
+    else         { qs = qR; rs = rr; us = ur; ps = pr; ss = sr; }
+    double pstar = ps + rs * (ss - us) * (sm - us);
+    double rstar = rs * (ss - us) / (ss - sm + 1e-300);
+    double e = ps / (GAMMA - 1.0) +
+               0.5 * rs * (qs[1] * qs[1] + qs[2] * qs[2] + qs[3] * qs[3]);
+    double estar = ((ss - us) * e - ps * us + pstar * sm) / (ss - sm + 1e-300);
+    double ro, uo, po, eo, vo[3] = {qs[1], qs[2], qs[3]};
+    if ((sm >= 0 && sl >= 0) || (sm < 0 && sr <= 0)) {
+      ro = rs; uo = us; po = ps; eo = e;
+    } else {
+      ro = rstar; uo = sm; po = pstar; eo = estar;
+    }
+    vo[d] = uo;
+    F[0] = ro * uo;
+    F[1] = ro * uo * vo[0] + (d == 0 ? po : 0);
+    F[2] = ro * uo * vo[1] + (d == 1 ? po : 0);
+    F[3] = ro * uo * vo[2] + (d == 2 ? po : 0);
+    F[4] = (eo + po) * uo;
+  };
+
+  const size_t dstep[3] = {di, dj, dk};
+  for (int i = 1; i < s - 1; i++)
+    for (int j = 1; j < s - 1; j++)
+      for (int k = 1; k < s - 1; k++) {
+        size_t c = ((size_t)i) * di + (size_t)j * dj + k;
+        // predictor: q^{n+1/2} = q - dt/2 (A dq) summed over directions
+        for (int d = 0; d < 3; d++) {
+          size_t dd = dstep[d];
+          // left state: cell c predicted, +half slope in d
+          double qL[5], qR[5];
+          for (int side = 0; side < 2; side++) {
+            size_t cc = side == 0 ? c - dd : c;
+            double *dst = side == 0 ? qL : qR;
+            double r = q[0 * nc + cc], vx = q[1 * nc + cc],
+                   vy = q[2 * nc + cc], vz = q[3 * nc + cc], p = q[4 * nc + cc];
+            double drx = dqx[0 * nc + cc], dux = dqx[1 * nc + cc],
+                   dvx = dqx[2 * nc + cc], dwx = dqx[3 * nc + cc],
+                   dpx = dqx[4 * nc + cc];
+            double dry = dqy[0 * nc + cc], duy = dqy[1 * nc + cc],
+                   dvy = dqy[2 * nc + cc], dwy = dqy[3 * nc + cc],
+                   dpy = dqy[4 * nc + cc];
+            double drz = dqz[0 * nc + cc], duz = dqz[1 * nc + cc],
+                   dvz = dqz[2 * nc + cc], dwz = dqz[3 * nc + cc],
+                   dpz = dqz[4 * nc + cc];
+            // source terms (trace3d, hydro/umuscl.f90:483): primitive
+            // evolution r' = -(u r_x + r u_x) - ... etc., half dt
+            double sr0 = -(vx * drx + vy * dry + vz * drz)
+                         - (dux + dvy + dwz) * r;
+            double su0 = -(vx * dux + vy * duy + vz * duz) - dpx / r;
+            double sv0 = -(vx * dvx + vy * dvy + vz * dvz) - dpy / r;
+            double sw0 = -(vx * dwx + vy * dwy + vz * dwz) - dpz / r;
+            double sp0 = -(vx * dpx + vy * dpy + vz * dpz)
+                         - (dux + dvy + dwz) * GAMMA * p;
+            double half = 0.5 * dtdx;
+            double rp = r + half * sr0, up = vx + half * su0,
+                   vp = vy + half * sv0, wp = vz + half * sw0,
+                   pp = p + half * sp0;
+            // interpolate to the face: +/- half slope along d
+            double sgn = side == 0 ? 0.5 : -0.5;
+            const double *dq = d == 0 ? &dqx[0] : d == 1 ? &dqy[0] : &dqz[0];
+            dst[0] = rp + sgn * dq[0 * nc + cc];
+            dst[1] = up + sgn * dq[1 * nc + cc];
+            dst[2] = vp + sgn * dq[2 * nc + cc];
+            dst[3] = wp + sgn * dq[3 * nc + cc];
+            dst[4] = pp + sgn * dq[4 * nc + cc];
+            if (dst[0] < SMALLR) dst[0] = SMALLR;
+            if (dst[4] < SMALLR * SMALLC) dst[4] = SMALLR * SMALLC;
+          }
+          hllc(qL, qR, d, &flux[(d * 5) * nc + c]);
+        }
+      }
+
+  // conservative update: u -= dtdx * (F_{i+1} - F_i) per direction
+  for (int v = 0; v < 5; v++)
+    for (int i = 2; i < 2 + n; i++)
+      for (int j = 2; j < 2 + n; j++)
+        for (int k = 2; k < 2 + n; k++) {
+          size_t c = ((size_t)i) * di + (size_t)j * dj + k;
+          double d0 = flux[(0 * 5 + v) * nc + c + di] - flux[(0 * 5 + v) * nc + c];
+          double d1 = flux[(1 * 5 + v) * nc + c + dj] - flux[(1 * 5 + v) * nc + c];
+          double d2 = flux[(2 * 5 + v) * nc + c + dk] - flux[(2 * 5 + v) * nc + c];
+          g.u[(size_t)v * nc + c] -= dtdx * (d0 + d1 + d2);
+        }
+}
+
+int main(int argc, char **argv) {
+  int n = argc > 1 ? atoi(argv[1]) : 128;
+  int nsteps = argc > 2 ? atoi(argv[2]) : 5;
+  Grid g(n);
+  const size_t nc = (size_t)g.s * g.s * g.s;
+  // sedov-like ICs: cold uniform medium + central energy point
+  for (int i = 2; i < 2 + n; i++)
+    for (int j = 2; j < 2 + n; j++)
+      for (int k = 2; k < 2 + n; k++) {
+        size_t c = g.idx(0, i, j, k);
+        g.u[c] = 1.0;
+        g.u[4 * nc + (c - 0)] = 1e-5 / (GAMMA - 1.0);
+      }
+  int m = 2 + n / 2;
+  g.u[g.idx(4, m, m, m)] = 0.4 * n * n * n / (GAMMA - 1.0) * 1e-5 + 1.0;
+
+  // warm-up step (first touch, page faults)
+  step(g, 1e-6);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int it = 0; it < nsteps; it++) step(g, 1e-6);
+  auto t1 = std::chrono::steady_clock::now();
+  double wall = std::chrono::duration<double>(t1 - t0).count();
+  double updates = (double)n * n * n * nsteps;
+  printf("{\"proxy\": \"muscl3d-hllc\", \"n\": %d, \"steps\": %d, "
+         "\"wall_s\": %.4f, \"mus_per_cell_update\": %.4f, "
+         "\"cell_updates_per_sec\": %.3e}\n",
+         n, nsteps, wall, 1e6 * wall / updates, updates / wall);
+  return 0;
+}

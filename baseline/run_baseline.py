@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Build and run the CPU baseline proxies; record results in BASELINE.json.
+
+The driver's north star compares TPU cell-updates/sec against a "64-rank
+MPI CPU baseline" of the reference (BASELINE.md).  The reference is
+Fortran 90 and this image ships no Fortran compiler (verified: no
+gfortran/flang/ifx anywhere on the filesystem), so the baseline cannot be
+produced by running the reference itself.  This script produces the
+nearest honest substitute: C++ re-creations of the reference's two hot
+kernels (muscl3d.cc — the hydro/umuscl.f90 MUSCL-Hancock+HLLC update;
+mg3d.cc — the poisson/multigrid_fine_fine.f90 red-black V-cycle),
+compiled -O3 -march=native and measured on this host's CPU, extrapolated
+to 64 ranks assuming *perfect* linear scaling.  Both choices (kernel-only
+cost without AMR/MPI overhead; perfect scaling) make the baseline FASTER
+than a real 64-rank reference run would be, i.e. they are conservative
+for the TPU framework's vs_baseline ratio.
+
+Usage: python baseline/run_baseline.py   (writes ../BASELINE.json in place)
+"""
+
+import json
+import os
+import platform
+import subprocess
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+PROXIES = {
+    "muscl3d": ("muscl3d.cc", ["128", "5"]),
+    "mg3d": ("mg3d.cc", ["128", "10"]),
+}
+
+
+def build_and_run(name, src, args):
+    exe = os.path.join(HERE, name)
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-funroll-loops", "-o", exe,
+         os.path.join(HERE, src)], check=True)
+    out = subprocess.run([exe] + args, check=True, capture_output=True,
+                         text=True).stdout.strip()
+    return json.loads(out.splitlines()[-1])
+
+
+def cpu_model():
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    return line.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    return platform.processor()
+
+
+def main():
+    hydro = build_and_run("muscl3d", *PROXIES["muscl3d"])
+    mg = build_and_run("mg3d", *PROXIES["mg3d"])
+    nranks = 64
+
+    published = {
+        "method": (
+            "measured C++ proxy kernels (baseline/muscl3d.cc, baseline/"
+            "mg3d.cc) recreating the reference's hot loops; the reference "
+            "itself cannot be compiled in this image (no Fortran "
+            "compiler). Kernel-only cost + perfect 64-rank scaling both "
+            "overestimate the baseline, so vs_baseline is conservative."),
+        "host_cpu": cpu_model(),
+        "hydro": {
+            "proxy": hydro,
+            "mus_per_cell_update_1core": hydro["mus_per_cell_update"],
+            "cell_updates_per_sec_1core": hydro["cell_updates_per_sec"],
+            "cell_updates_per_sec_64rank":
+                hydro["cell_updates_per_sec"] * nranks,
+        },
+        "multigrid": {
+            "proxy": mg,
+            "vcycles_per_sec_128_1core": mg["vcycles_per_sec"],
+            "vcycles_per_sec_128_64rank": mg["vcycles_per_sec"] * nranks,
+        },
+        "nranks_extrapolated": nranks,
+    }
+
+    path = os.path.join(REPO, "BASELINE.json")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["published"] = published
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(published["hydro"], indent=2))
+    print(json.dumps(published["multigrid"], indent=2))
+
+
+if __name__ == "__main__":
+    main()

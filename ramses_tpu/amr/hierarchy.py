@@ -51,6 +51,7 @@ class AmrSim:
         self.dtype = dtype
         self.boxlen = float(params.amr.boxlen)
         spec = bmod.BoundarySpec.from_params(params)
+        self.bspec = spec
         self.bc_kinds = [(f[0].kind, f[1].kind) for f in spec.faces]
         self.lmin = params.amr.levelmin
         self.lmax = params.amr.levelmax
@@ -101,6 +102,19 @@ class AmrSim:
                 noct_pad=self._noct_pad(self.tree.noct(l)))
             self.maps[l] = m
             valid_cell = np.repeat(m.valid_oct, 2 ** self.tree.ndim)
+            if m.complete:
+                # dense path: permutation + restriction only
+                self.dev[l] = dict(
+                    perm=self._place(jnp.asarray(m.perm), "cells"),
+                    inv_perm=self._place(jnp.asarray(m.inv_perm), "cells"),
+                    ok_dense=(self._place(jnp.asarray(m.ok_dense), "cells")
+                              if m.ok_dense is not None else None),
+                    ref_cell=self._place(jnp.asarray(m.ref_cell), "rep"),
+                    son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
+                    valid_cell=self._place(jnp.asarray(valid_cell),
+                                           "cells"),
+                )
+                continue
             self.dev[l] = dict(
                 stencil_src=self._place(jnp.asarray(m.stencil_src), "octs"),
                 vsgn=(self._place(jnp.asarray(m.vsgn), "octs")
@@ -125,16 +139,6 @@ class AmrSim:
                     g_sgn=self._place(jnp.asarray(g.g_sgn), "rep"),
                     g_valid=self._place(jnp.asarray(g.valid_cell),
                                         "cells"))
-                if l == self.lmin:
-                    # flat cell i ↔ dense raveled position map for the
-                    # exact FFT solve on the complete base level
-                    ccb = self.tree.cell_coords(l)
-                    nb_ = 1 << l
-                    self._base_scatter = jnp.asarray(
-                        np.ravel_multi_index(
-                            tuple(ccb[:, d] for d in
-                                  range(self.tree.ndim)),
-                            (nb_,) * self.tree.ndim))
 
     def _ic_state(self, lvl: int) -> jnp.ndarray:
         """Analytic conservative ICs on this level's (padded) cells."""
@@ -193,13 +197,18 @@ class AmrSim:
         for l in self.levels():
             d = self.dev[l]
             m = self.maps[l]
-            interp = self._interp_for(l)
-            fl = K.refine_flags(
-                self.u[l], interp, d["stencil_src"], d["vsgn"],
-                (float(r.err_grad_d), float(r.err_grad_u),
-                 float(r.err_grad_p)),
-                (float(r.floor_d), float(r.floor_u), float(r.floor_p)),
-                self.cfg)
+            eg = (float(r.err_grad_d), float(r.err_grad_u),
+                  float(r.err_grad_p))
+            fls = (float(r.floor_d), float(r.floor_u), float(r.floor_p))
+            if m.complete:
+                fl = K.dense_refine_flags(
+                    self.u[l], d["inv_perm"], d["perm"], eg, fls,
+                    (1 << l,) * self.cfg.ndim, self.bspec, self.cfg)
+            else:
+                interp = self._interp_for(l)
+                fl = K.refine_flags(
+                    self.u[l], interp, d["stencil_src"], d["vsgn"], eg, fls,
+                    self.cfg)
             fl = np.asarray(fl)[:m.noct].reshape(-1)   # flat-cell order
             geo = flagmod.geometry_flags(
                 self.tree.cell_centers(l, self.boxlen), l, self.params)
@@ -290,8 +299,9 @@ class AmrSim:
 
     def solve_gravity(self):
         """Per-level Poisson solve, coarse→fine one-way interface
-        (``multigrid_fine``): exact FFT on the complete base level,
-        Dirichlet-ghost CG above it; then the gradient force."""
+        (``multigrid_fine``): exact periodic FFT on any COMPLETE level
+        (the base always; fully-refined levels above too),
+        Dirichlet-ghost CG on partial levels; then the gradient force."""
         from ramses_tpu.poisson import amr_solve as gs
         from ramses_tpu.poisson.solver import fft_solve
 
@@ -304,16 +314,26 @@ class AmrSim:
             dx = self.dx(l)
             rho = self.u[l][:, 0]
             rhs = self.fourpi * (rho - rho_mean)
-            if l == self.lmin:
+            if m.complete:
+                # whole-box level: exact periodic FFT solve on the dense
+                # grid, force by central-difference rolls
                 nb_ = 1 << l
-                dense = jnp.zeros((nb_ ** nd,), rhs.dtype)
-                dense = dense.at[self._base_scatter].set(
-                    rhs[:m.noct * (1 << nd)])
-                phi_dense = fft_solve(dense.reshape((nb_,) * nd), dx)
+                ncell = m.noct * (1 << nd)
+                dense = rhs[d["inv_perm"]].reshape((nb_,) * nd)
+                phi_dense = fft_solve(dense, dx)
                 phi = jnp.zeros((m.ncell_pad,), rhs.dtype)
-                phi = phi.at[:m.noct * (1 << nd)].set(
-                    phi_dense.reshape(-1)[self._base_scatter])
-                ghosts = jnp.zeros((8,), rhs.dtype)
+                phi = phi.at[:ncell].set(
+                    phi_dense.reshape(-1)[d["perm"]])
+                fg_rows = gs.grad_dense(phi_dense,
+                                        jnp.asarray(dx, rhs.dtype),
+                                        nd)[d["perm"]]
+                if m.ncell_pad > ncell:
+                    fg_rows = jnp.zeros(
+                        (m.ncell_pad, nd), fg_rows.dtype
+                    ).at[:ncell].set(fg_rows)
+                self.phi[l] = phi
+                self.fg[l] = fg_rows.astype(self.dtype)
+                continue
             else:
                 ghosts = K.interp_cells(
                     self.phi[l - 1][:, None], d["g_cell"], d["g_gnb"],
@@ -346,12 +366,20 @@ class AmrSim:
             self._advance(l + 1, 0.5 * dt)             # subcycle ×2
             self._advance(l + 1, 0.5 * dt)
         d = self.dev[l]
-        interp = self._interp_for(l)
-        du, corr = K.level_sweep(
-            self.u[l], interp, d["stencil_src"], d["vsgn"], d["ok_ref"],
-            None, jnp.asarray(dt, self.dtype), self.dx(l), self.cfg)
+        m = self.maps[l]
+        if m.complete:
+            du = K.dense_sweep(
+                self.u[l], d["inv_perm"], d["perm"], d["ok_dense"],
+                jnp.asarray(dt, self.dtype), self.dx(l),
+                (1 << l,) * self.cfg.ndim, self.bspec, self.cfg)
+            corr = None
+        else:
+            interp = self._interp_for(l)
+            du, corr = K.level_sweep(
+                self.u[l], interp, d["stencil_src"], d["vsgn"], d["ok_ref"],
+                None, jnp.asarray(dt, self.dtype), self.dx(l), self.cfg)
         self.unew[l] = self.unew[l] + du
-        if l > self.lmin:
+        if l > self.lmin and corr is not None:
             self.unew[l - 1] = K.scatter_corrections(
                 self.unew[l - 1], corr, d["corr_idx"], self.cfg)
         self.u[l] = self.unew[l]                       # set_uold

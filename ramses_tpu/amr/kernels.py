@@ -9,6 +9,7 @@ level's oct batch instead of nvector chunks.
 
 from __future__ import annotations
 
+from dataclasses import replace as dreplace
 from functools import partial
 from typing import Optional, Tuple
 
@@ -58,20 +59,25 @@ def interp_cells(u_coarse, cell_idx, nb_idx, sgn, cfg: HydroStatic,
 
 
 def _gather_uloc(u_flat, interp_vals, stencil_src, vsgn, cfg: HydroStatic):
-    """Build [nvar, noct, 6^d...] stencil batch from flat cells + interps."""
+    """Build [nvar, 6^d..., noct] stencil batch from flat cells + interps.
+
+    The oct axis is minor-most on purpose: TPU layouts tile the two
+    minor dims to (8, 128), so a [..., 6, 6] minor layout would pad
+    ~28x in HBM while [..., 6, noct] pads ~1.3x.
+    """
     trash = jnp.zeros((1, cfg.nvar), u_flat.dtype)
     src = jnp.concatenate([u_flat, interp_vals, trash], axis=0)
-    ul = src[stencil_src]                              # [noct, 6^d, nvar]
+    srcT = src.T                                       # [nvar, nrows]
+    ul = srcT[:, stencil_src]                          # [nvar, noct, 6^d]
     if vsgn is not None:
         # reflecting boundaries: flip mirrored velocity components
         for d in range(cfg.ndim):
             flip = ((vsgn >> d) & 1).astype(u_flat.dtype)  # [noct, 6^d]
             s = 1.0 - 2.0 * flip
-            ul = ul.at[:, :, 1 + d].multiply(s)
-    noct = ul.shape[0]
-    ul = ul.reshape((noct,) + (6,) * cfg.ndim + (cfg.nvar,))
-    # → [nvar, noct, 6...]
-    return jnp.moveaxis(ul, -1, 0)
+            ul = ul.at[1 + d].multiply(s)
+    noct = ul.shape[1]
+    ul = jnp.swapaxes(ul, 1, 2)                        # [nvar, 6^d, noct]
+    return ul.reshape((cfg.nvar,) + (6,) * cfg.ndim + (noct,))
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -84,22 +90,24 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     scattered ∓/2^ndim into unrefined coarse neighbours.
     """
     ndim, nvar = cfg.ndim, cfg.nvar
+    bcfg = dreplace(cfg, trailing_batch=True)
     uloc = _gather_uloc(u_flat, interp_vals, stencil_src, vsgn, cfg)
-    noct = uloc.shape[1]
-    okl = ok_ref.reshape((noct,) + (6,) * ndim)
+    noct = uloc.shape[-1]
+    # [noct, 6^d] → [6..., noct]
+    okl = ok_ref.T.reshape((6,) * ndim + (noct,))
 
-    flux, _tmp = muscl.unsplit(uloc, gloc, dt, (dx,) * ndim, cfg)
-    # flux[d]: [nvar, noct, 6...], defined at the LOW face of each cell.
+    flux, _tmp = muscl.unsplit(uloc, gloc, dt, (dx,) * ndim, bcfg)
+    # flux[d]: [nvar, 6..., noct], defined at the LOW face of each cell.
 
     # Reset flux along direction at refined interfaces
     # (hydro/godunov_fine.f90:718-747): a face is zeroed when either
     # adjacent cell is refined — its contribution comes from level+1.
     fluxes = []
     for d in range(ndim):
-        keep = ~(okl | jnp.roll(okl, 1, axis=1 + d))   # [noct, 6...]
+        keep = ~(okl | jnp.roll(okl, 1, axis=d))       # [6..., noct]
         fluxes.append(flux[d] * keep[None].astype(flux.dtype))
     # conservative update of the oct's 2^d interior cells (indices 2:4)
-    du = jnp.zeros((nvar, noct) + (2,) * ndim, uloc.dtype)
+    du = jnp.zeros((nvar,) + (2,) * ndim + (noct,), uloc.dtype)
     for d in range(ndim):
         lo = []
         hi = []
@@ -111,17 +119,19 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
                 lo.append(slice(2, 4))
                 hi.append(slice(2, 4))
         f = fluxes[d]
-        du = du + (f[(slice(None), slice(None)) + tuple(lo)]
-                   - f[(slice(None), slice(None)) + tuple(hi)])
-    # [nvar, noct, 2...] → flat [noct*2^d, nvar]
-    du_flat = jnp.moveaxis(du, 0, -1).reshape(noct * 2 ** ndim, nvar)
+        du = du + (f[(slice(None),) + tuple(lo)]
+                   - f[(slice(None),) + tuple(hi)])
+    # [nvar, 2..., noct] → flat [noct*2^d, nvar]
+    du_flat = jnp.transpose(
+        du, (ndim + 1,) + tuple(range(1, ndim + 1)) + (0,)
+    ).reshape(noct * 2 ** ndim, nvar)
 
     # boundary fluxes for the coarse correction: low face idx 2, high idx 4
     corr = []
     for d in range(ndim):
         f = fluxes[d]
-        idx_lo = [slice(None), slice(None)]
-        idx_hi = [slice(None), slice(None)]
+        idx_lo = [slice(None)]
+        idx_hi = [slice(None)]
         for d2 in range(ndim):
             if d2 == d:
                 idx_lo.append(2)
@@ -129,13 +139,77 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
             else:
                 idx_lo.append(slice(2, 4))
                 idx_hi.append(slice(2, 4))
-        red = tuple(range(2, 2 + ndim - 1))
+        red = tuple(range(1, 1 + ndim - 1))
         lo = f[tuple(idx_lo)].sum(axis=red) if ndim > 1 else f[tuple(idx_lo)]
         hi = f[tuple(idx_hi)].sum(axis=red) if ndim > 1 else f[tuple(idx_hi)]
         corr.append(jnp.stack([lo, hi], axis=-1))      # [nvar, noct, 2]
     corr = jnp.stack(corr, axis=-2)                    # [nvar, noct, ndim, 2]
     corr = jnp.moveaxis(corr, 0, -1)                   # [noct, ndim, 2, nvar]
     return du_flat, corr
+
+
+@partial(jax.jit, static_argnames=("cfg", "shape", "bc"))
+def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
+                shape: Tuple[int, ...], bc, cfg: HydroStatic):
+    """Sweep for a COMPLETE level (covers the whole box) as a dense grid.
+
+    The 6^d stencil gather duplicates each cell ~3^d times and its
+    [..., 6, 6] minors tile terribly on TPU; a complete level needs
+    neither ghost interpolation nor coarse corrections, so it runs the
+    roll-based uniform kernel instead (``grid/uniform.py`` path) with
+    refined-face flux zeroing.  Returns du over the flat level rows.
+    """
+    from ramses_tpu.grid import boundary as bmod
+
+    nd, nvar = cfg.ndim, cfg.nvar
+    ncell = 1
+    for s in shape:
+        ncell *= s
+    ud = u_flat[inv_perm]                              # dense row order
+    ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)  # [nvar, *shape]
+    up = bmod.pad(ud, bc, cfg, muscl.NGHOST)
+    flux, _tmp = muscl.unsplit(up, None, dt, (dx,) * nd, cfg)
+    if ok_dense is not None:
+        okp = ok_dense.reshape(shape)
+        for d in range(nd):
+            mode = "wrap" if bc.faces[d][0].kind == 0 else "edge"
+            padw = [(muscl.NGHOST, muscl.NGHOST) if d2 == d else (0, 0)
+                    for d2 in range(nd)]
+            okp = jnp.pad(okp, padw, mode=mode)
+        masked = []
+        for d in range(nd):
+            keep = ~(okp | jnp.roll(okp, 1, axis=d))
+            masked.append(flux[d] * keep[None].astype(flux.dtype))
+        flux = jnp.stack(masked)
+    un = muscl.apply_fluxes(up, flux, cfg)
+    du_dense = bmod.unpad(un, nd, muscl.NGHOST) - ud   # [nvar, *shape]
+    du_rows = jnp.moveaxis(du_dense, 0, -1).reshape(ncell, nvar)[perm]
+    if u_flat.shape[0] > ncell:
+        du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
+    return du_rows
+
+
+@partial(jax.jit, static_argnames=("cfg", "shape", "bc", "err_grad",
+                                   "floors"))
+def dense_refine_flags(u_flat, inv_perm, perm,
+                       err_grad: Tuple[float, float, float],
+                       floors: Tuple[float, float, float],
+                       shape: Tuple[int, ...], bc, cfg: HydroStatic):
+    """Gradient refinement criteria for a complete level on the dense
+    grid (same semantics as :func:`refine_flags`)."""
+    from ramses_tpu.grid import boundary as bmod
+
+    nd, nvar = cfg.ndim, cfg.nvar
+    ncell = 1
+    for s in shape:
+        ncell *= s
+    ud = u_flat[inv_perm]
+    ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)
+    up = bmod.pad(ud, bc, cfg, 1)
+    ok = _grad_flags(up, err_grad, floors, spatial0=0, cfg=cfg)
+    ok = ok[tuple(slice(1, -1) for _ in range(nd))]    # interior
+    flags_flat = ok.reshape(-1)[perm]                  # flat cell order
+    return flags_flat.reshape(ncell // 2 ** nd, 2 ** nd)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -193,6 +267,18 @@ def refine_flags(u_flat, interp_vals, stencil_src, vsgn,
     """
     uloc = _gather_uloc(u_flat, interp_vals, stencil_src, vsgn, cfg)
     nd = cfg.ndim
+    # fields below are [6..., noct]: spatial axes 0..nd-1, oct axis last
+    ok = _grad_flags(uloc, err_grad, floors, spatial0=0, cfg=cfg)
+    interior = tuple(slice(2, 4) for _ in range(nd))
+    okc = ok[interior]                                 # [2..., noct]
+    okc = jnp.moveaxis(okc, -1, 0)                     # [noct, 2...]
+    return okc.reshape(okc.shape[0], 2 ** nd)
+
+
+def _grad_flags(uloc, err_grad, floors, spatial0: int, cfg: HydroStatic):
+    """Shared gradient-criteria evaluation; ``uloc`` is [nvar, ...] with
+    spatial axes starting at ``spatial0`` of the per-field arrays."""
+    nd = cfg.ndim
     r = jnp.maximum(uloc[0], cfg.smallr)
     vels = [uloc[1 + d] / r for d in range(nd)]
     ek = sum(0.5 * r * v * v for v in vels)
@@ -204,7 +290,7 @@ def refine_flags(u_flat, interp_vals, stencil_src, vsgn,
     def two_sided(f, floor):
         err = jnp.zeros_like(f)
         for d in range(nd):
-            ax = 1 + d
+            ax = spatial0 + d
             fl = jnp.roll(f, 1, axis=ax)
             fr = jnp.roll(f, -1, axis=ax)
             e1 = jnp.abs(fr - f) / (jnp.abs(fr) + jnp.abs(f) + floor)
@@ -222,7 +308,7 @@ def refine_flags(u_flat, interp_vals, stencil_src, vsgn,
             v = vels[d]
             err = jnp.zeros_like(v)
             for dd in range(nd):
-                ax = 1 + dd
+                ax = spatial0 + dd
                 vl, vr = jnp.roll(v, 1, axis=ax), jnp.roll(v, -1, axis=ax)
                 cl, cr = jnp.roll(c, 1, axis=ax), jnp.roll(c, -1, axis=ax)
                 e1 = jnp.abs(vr - v) / (cr + c + jnp.abs(vr) + jnp.abs(v)
@@ -231,6 +317,4 @@ def refine_flags(u_flat, interp_vals, stencil_src, vsgn,
                                         + flu)
                 err = jnp.maximum(err, 2.0 * jnp.maximum(e1, e2))
             ok = ok | (err > egu)
-    interior = (slice(None),) + tuple(slice(2, 4) for _ in range(nd))
-    okc = ok[interior]                                 # [noct, 2...]
-    return okc.reshape(okc.shape[0], 2 ** nd)
+    return ok

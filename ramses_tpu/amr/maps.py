@@ -55,6 +55,13 @@ class LevelMaps:
     ref_cell: np.ndarray             # [nref_pad] int32 flat cell idx, -1 pad
     son_oct: np.ndarray              # [nref_pad] int32 oct idx at lvl+1
     valid_oct: np.ndarray            # [noct_pad] bool
+    # COMPLETE level (covers the whole box, e.g. the base level): the
+    # sweep runs dense (roll-based uniform kernel) instead of through the
+    # 6^d stencil gather — stencil/interp/corr maps above are then empty.
+    complete: bool = False
+    perm: Optional[np.ndarray] = None      # [ncell] flat row → dense ravel
+    inv_perm: Optional[np.ndarray] = None  # [ncell] dense ravel → flat row
+    ok_dense: Optional[np.ndarray] = None  # [ncell] bool refined, dense order
 
     @property
     def ndim(self) -> int:
@@ -71,6 +78,27 @@ def stencil_offsets(ndim: int) -> np.ndarray:
     return np.indices((6,) * ndim).reshape(ndim, -1).T.astype(np.int64)
 
 
+def _pad_rows(a: np.ndarray, n: int, fill=0) -> np.ndarray:
+    out = np.full((n,) + a.shape[1:], fill, dtype=a.dtype)
+    out[:len(a)] = a
+    return out
+
+
+def _restriction_maps(tree: Octree, lvl: int):
+    """upload_fine source/target maps: (nref, nref_pad, ref_cell, son_oct,
+    refined_mask-or-None)."""
+    if not tree.has(lvl + 1):
+        return 0, 8, np.full(8, -1, dtype=np.int32), \
+            np.zeros(8, dtype=np.int32), None
+    rmask = tree.refined_mask(lvl)
+    ref_idx = np.nonzero(rmask)[0]
+    son = tree.lookup(lvl + 1, tree.cell_coords(lvl)[ref_idx])
+    nref = len(ref_idx)
+    nref_pad = bucket(nref, 8)
+    return nref, nref_pad, _pad_rows(ref_idx.astype(np.int32), nref_pad, -1), \
+        _pad_rows(son.astype(np.int32), nref_pad), rmask
+
+
 def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
                      noct_pad: Optional[int] = None) -> LevelMaps:
     ndim = tree.ndim
@@ -79,6 +107,8 @@ def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
     noct = lev.noct
     noct_pad = noct_pad or bucket(noct)
     ncell_pad = noct_pad * twotondim
+    if noct == (1 << (lvl - 1)) ** ndim:
+        return _build_complete_level_maps(tree, lvl, noct, noct_pad)
     soff = stencil_offsets(ndim)                       # [6^d, ndim]
     ns = len(soff)
 
@@ -204,18 +234,7 @@ def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
                                                     -1).astype(np.int32)
 
     # --- restriction map (upload_fine at this level) ---
-    if tree.has(lvl + 1):
-        rmask = tree.refined_mask(lvl)
-        ref_idx = np.nonzero(rmask)[0]
-        son = tree.lookup(lvl + 1, tree.cell_coords(lvl)[ref_idx])
-        nref = len(ref_idx)
-        nref_pad = bucket(nref, 8)
-        ref_cell = _pad(ref_idx.astype(np.int32), nref_pad, -1)
-        son_oct = _pad(son.astype(np.int32), nref_pad)
-    else:
-        nref, nref_pad = 0, 8
-        ref_cell = np.full(nref_pad, -1, dtype=np.int32)
-        son_oct = np.zeros(nref_pad, dtype=np.int32)
+    nref, nref_pad, ref_cell, son_oct, _rm = _restriction_maps(tree, lvl)
 
     valid_oct = np.zeros(noct_pad, dtype=bool)
     valid_oct[:noct] = True
@@ -227,6 +246,45 @@ def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
                      corr_idx=corr_idx, nref=nref, nref_pad=nref_pad,
                      ref_cell=ref_cell, son_oct=son_oct,
                      valid_oct=valid_oct)
+
+
+def _build_complete_level_maps(tree: Octree, lvl: int, noct: int,
+                               noct_pad: int) -> LevelMaps:
+    """Maps for a level that covers the whole box: dense permutation +
+    restriction only.  The stencil gather, ghost interpolation, and
+    coarse flux correction are structurally absent — the sweep runs on
+    the dense grid with physical boundaries, and every coarse parent
+    cell is refined so corrections to lvl-1 all drop."""
+    ndim = tree.ndim
+    twotondim = 1 << ndim
+    ncell = noct * twotondim
+    n = 1 << lvl
+    cc = tree.cell_coords(lvl)
+    perm = np.ravel_multi_index(
+        tuple(cc[:, d] for d in range(ndim)), (n,) * ndim)
+    inv_perm = np.empty(ncell, dtype=np.int64)
+    inv_perm[perm] = np.arange(ncell)
+
+    nref, nref_pad, ref_cell, son_oct, rmask = _restriction_maps(tree, lvl)
+    if rmask is not None:
+        ok_dense = np.zeros(ncell, dtype=bool)
+        ok_dense[perm] = rmask
+    else:
+        ok_dense = None
+
+    valid_oct = np.zeros(noct_pad, dtype=bool)
+    valid_oct[:noct] = True
+    return LevelMaps(
+        lvl=lvl, noct=noct, noct_pad=noct_pad, ni=0, ni_pad=8,
+        stencil_src=np.zeros((0, 0), dtype=np.int32), vsgn=None,
+        ok_ref=np.zeros((0, 0), dtype=bool),
+        interp_cell=np.zeros(8, dtype=np.int32),
+        interp_nb=np.zeros((8, ndim, 2), dtype=np.int32),
+        interp_sgn=np.ones((8, ndim), dtype=np.int8),
+        corr_idx=np.full((noct_pad, ndim, 2), -1, dtype=np.int32),
+        nref=nref, nref_pad=nref_pad, ref_cell=ref_cell, son_oct=son_oct,
+        valid_oct=valid_oct, complete=True,
+        perm=perm.astype(np.int64), inv_perm=inv_perm, ok_dense=ok_dense)
 
 
 def build_prolong_maps(tree_new: Octree, tree_old: Octree, lvl: int,

@@ -42,6 +42,12 @@ class HydroStatic:
     courant_factor: float = 0.5
     difmag: float = 0.0
     pressure_fix: bool = False
+    # Array-layout switch: spatial axes 1..ndim with a trailing batch axis
+    # ([nvar, *spatial, batch]) instead of trailing spatial.  The AMR oct
+    # batches use this so the (large) oct axis is minor-most — TPU tiles
+    # the two minor dims to (8, 128), and a [..., 6, 6] minor layout would
+    # waste ~28x HBM in padding.
+    trailing_batch: bool = False
 
     @property
     def nvar(self) -> int:

@@ -31,7 +31,10 @@ NGHOST = 2
 
 
 def _axis(cfg: HydroStatic, d: int, u) -> int:
-    """Spatial axis of direction d for a [nvar, *spatial] array."""
+    """Spatial axis of direction d: trailing spatial axes by default, or
+    axes 1..ndim when ``cfg.trailing_batch`` ([nvar, *spatial, batch])."""
+    if getattr(cfg, "trailing_batch", False):
+        return 1 + d
     return u.ndim - cfg.ndim + d
 
 

@@ -84,6 +84,17 @@ def grad_phi(phi, ghosts, nb, dx, valid, ndim: int):
 
 
 @partial(jax.jit, static_argnames=("ndim",))
+def grad_dense(phi_dense, dx, ndim: int):
+    """f = −∇φ on a dense periodic grid by central differences; returns
+    raveled rows [ncell, ndim] (the complete-level companion of
+    :func:`grad_phi`)."""
+    comps = [-(jnp.roll(phi_dense, -1, axis=d)
+               - jnp.roll(phi_dense, 1, axis=d)) / (2.0 * dx)
+             for d in range(ndim)]
+    return jnp.stack(comps, axis=-1).reshape(-1, ndim)
+
+
+@partial(jax.jit, static_argnames=("ndim",))
 def kick_flat(u, f, dteff, ndim: int, smallr: float):
     """Gravity momentum kick on flat cells [ncell, nvar] at fixed
     internal energy (``synchro_hydro_fine``)."""
