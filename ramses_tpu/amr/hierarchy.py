@@ -18,7 +18,8 @@ standard regrid-interval relaxation).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from functools import partial
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,7 @@ from ramses_tpu.config import Params
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init import regions
+from ramses_tpu.utils.timers import Timers
 
 
 class _Cfg1:
@@ -41,11 +43,105 @@ class _Cfg1:
         self.ndim = ndim
 
 
+class FusedSpec(NamedTuple):
+    """Static description of one coarse step's level structure — the jit
+    cache key for :func:`_fused_coarse_step` (hashable; re-derived per
+    regrid, identical across steady-state steps)."""
+    cfg: HydroStatic
+    bspec: bmod.BoundarySpec
+    lmin: int
+    boxlen: float
+    levels: tuple          # populated levels, ascending
+    complete: tuple        # per-level bool
+    gravity: bool
+    itype: int
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec):
+    """One ENTIRE coarse step (recursive subcycled ``amr_step``) as a
+    single XLA program.
+
+    The host recursion of ``AmrSim._advance`` dispatches ~15 device
+    calls per step; over a remote-tunnel TPU each call costs dispatch
+    latency, which dominated the AMR profile.  Tracing the same
+    recursion here turns a coarse step into ONE dispatch; recompiles
+    happen only when the bucketed level structure changes (the jit key
+    is ``spec`` + array shapes).
+    """
+    cfg = spec.cfg
+    u = dict(u)
+    unew = dict(u)
+    levels = spec.levels
+
+    def dx(l):
+        return spec.boxlen / (1 << l)
+
+    def advance(i, dtl):
+        from ramses_tpu.poisson.amr_solve import kick_flat
+
+        l = levels[i]
+        d = dev[l]
+        if spec.gravity:
+            u[l] = kick_flat(u[l], fg[l], 0.5 * dtl, cfg.ndim, cfg.smallr)
+        unew[l] = u[l]
+        if i + 1 < len(levels):
+            advance(i + 1, 0.5 * dtl)
+            advance(i + 1, 0.5 * dtl)
+        if spec.complete[i]:
+            du = K.dense_sweep(u[l], d["inv_perm"], d["perm"],
+                               d["ok_dense"], dtl, dx(l),
+                               (1 << l,) * cfg.ndim, spec.bspec, cfg)
+            corr = None
+        else:
+            interp = K.interp_cells(u[l - 1], d["interp_cell"],
+                                    d["interp_nb"], d["interp_sgn"], cfg,
+                                    itype=spec.itype)
+            du, corr = K.level_sweep(
+                u[l], interp, d["stencil_src"], d["vsgn"], d["ok_ref"],
+                None, dtl, dx(l), cfg)
+        unew[l] = unew[l] + du
+        if corr is not None and l > spec.lmin:
+            unew[l - 1] = K.scatter_corrections(unew[l - 1], corr,
+                                                d["corr_idx"], cfg)
+        u[l] = unew[l]
+        if spec.gravity:
+            u[l] = kick_flat(u[l], fg[l], 0.5 * dtl, cfg.ndim, cfg.smallr)
+        if i + 1 < len(levels):
+            u[l] = K.restrict_upload(u[l], u[levels[i + 1]], d["ref_cell"],
+                                     d["son_oct"], cfg)
+
+    advance(0, dt)
+    return u
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _fused_courant(u, dev, spec: FusedSpec):
+    """All levels' CFL dts in one dispatch; returns [nlevel] coarse-step
+    equivalents (already scaled by the subcycle factor)."""
+    cfg = spec.cfg
+    dts = []
+    for i, l in enumerate(spec.levels):
+        dt_l = K.level_courant(u[l], dev[l]["valid_cell"],
+                               spec.boxlen / (1 << l), cfg)
+        dts.append(dt_l * (2.0 ** (l - spec.lmin)))
+    return jnp.stack(dts)
+
+
 class AmrSim:
-    """Adaptive simulation: host octree + per-level device states."""
+    """Adaptive simulation: host octree + per-level device states.
+
+    ``particles`` (a :class:`~ramses_tpu.pm.particles.ParticleSet`)
+    enables the particle-mesh layer on the hierarchy: per-coarse-step
+    host-built CIC maps (``pm/amr_pm.py``), deposits into every level's
+    Poisson rhs, force gather at each particle's finest covering level,
+    and a split-kick KDK matching the uniform stepper's order
+    (``amr/amr_step.f90:219-236,268-273,479-486``).
+    """
 
     def __init__(self, params: Params, dtype=jnp.float32,
-                 init_tree: Optional[Octree] = None):
+                 init_tree: Optional[Octree] = None,
+                 particles=None):
         self.params = params
         self.cfg = HydroStatic.from_params(params)
         self.dtype = dtype
@@ -57,7 +153,12 @@ class AmrSim:
         self.lmax = params.amr.levelmax
         self.t = 0.0
         self.nstep = 0
-        self.regrid_interval = 1
+        # regrid cadence: the reference re-flags every level substep but
+        # amortizes the expensive rebuild (load_balance) every ``nremap``
+        # coarse steps (amr/amr_step.f90:100-123); our regrid is the
+        # rebuild, so nremap maps onto its interval (>=1).
+        self.regrid_interval = max(1, int(getattr(params.run, "nremap", 0)))
+        self.timers = Timers()
         # self-gravity (per-level Poisson, SURVEY.md §3.3)
         self.gravity = bool(params.run.poisson)
         if self.gravity:
@@ -67,6 +168,13 @@ class AmrSim:
             self.fourpi = 4.0 * np.pi
         self.phi: Dict[int, jnp.ndarray] = {}
         self.fg: Dict[int, jnp.ndarray] = {}
+        self.poisson_iters: Dict[int, jnp.ndarray] = {}
+        # particle-mesh layer
+        self.p = particles
+        self.pic = bool(params.run.pic) and particles is not None
+        self.dt_old = 0.0
+        self._pm_dev: Dict[int, dict] = {}
+        self._rho_max: Optional[float] = None
 
         if init_tree is not None:
             self.tree = init_tree
@@ -91,12 +199,40 @@ class AmrSim:
         device_puts octs/cells-row arrays across the mesh."""
         return arr
 
-    def _rebuild_maps(self):
+    def _keys_same(self, other: Optional[Octree], l: int) -> bool:
+        """True when level ``l`` has identical oct sets in self.tree and
+        ``other`` (both absent counts as same)."""
+        if other is None:
+            return False
+        ha, hb = self.tree.has(l), other.has(l)
+        if ha != hb:
+            return False
+        if not ha:
+            return True
+        a, b = self.tree.levels[l].keys, other.levels[l].keys
+        return len(a) == len(b) and np.array_equal(a, b)
+
+    def _rebuild_maps(self, old_tree: Optional[Octree] = None,
+                      old_maps: Optional[dict] = None,
+                      old_dev: Optional[dict] = None):
+        """(Re)build per-level index maps, reusing cached maps for levels
+        whose (l-1, l, l+1) oct sets are unchanged — the ``build_comm``
+        amortization: steady-state steps do no host map construction."""
+        prev_maps = old_maps or {}
+        prev_dev = old_dev or {}
+        self._spec = None
         self.maps: Dict[int, mapmod.LevelMaps] = {}
         self.dev: Dict[int, dict] = {}
         for l in range(self.lmin, self.lmax + 1):
             if not self.tree.has(l):
                 break
+            if (l in prev_maps
+                    and self._keys_same(old_tree, l - 1)
+                    and self._keys_same(old_tree, l)
+                    and self._keys_same(old_tree, l + 1)):
+                self.maps[l] = prev_maps[l]
+                self.dev[l] = prev_dev[l]
+                continue
             m = mapmod.build_level_maps(
                 self.tree, l, self.bc_kinds,
                 noct_pad=self._noct_pad(self.tree.noct(l)))
@@ -137,6 +273,7 @@ class AmrSim:
                     g_cell=self._place(jnp.asarray(g.g_cell), "rep"),
                     g_gnb=self._place(jnp.asarray(g.g_nb), "rep"),
                     g_sgn=self._place(jnp.asarray(g.g_sgn), "rep"),
+                    g_octnb=self._place(jnp.asarray(g.oct_nb), "octs"),
                     g_valid=self._place(jnp.asarray(g.valid_cell),
                                         "cells"))
 
@@ -213,8 +350,9 @@ class AmrSim:
             geo = flagmod.geometry_flags(
                 self.tree.cell_centers(l, self.boxlen), l, self.params)
             crit[l] = fl | geo
-        return flagmod.compute_new_tree(self.tree, crit, self.bc_kinds,
-                                        self.params)
+        with self.timers.section("regrid: tree build"):
+            return flagmod.compute_new_tree(self.tree, crit, self.bc_kinds,
+                                            self.params)
 
     def regrid(self):
         """Flag, rebuild the tree, and migrate device state
@@ -222,38 +360,56 @@ class AmrSim:
         ``amr/refine_utils.f90:332,953``)."""
         if self.lmax == self.lmin:
             return
-        newtree = self._flag_and_tree()
+        with self.timers.section("regrid: flag"):
+            newtree = self._flag_and_tree()
         old_u = self.u
         oldtree = self.tree
+        old_maps, old_dev = self.maps, self.dev
         self.tree = newtree
-        self._rebuild_maps()
+        unchanged = all(self._keys_same(oldtree, l)
+                        for l in range(self.lmin, self.lmax + 2))
+        if unchanged:
+            self.tree = oldtree
+            return
+        with self.timers.section("regrid: maps"):
+            self._rebuild_maps(oldtree, old_maps, old_dev)
+        self.timers.timer("regrid: migrate")
         twotondim = 2 ** self.cfg.ndim
         offs = cell_offsets(self.cfg.ndim)
         new_u: Dict[int, jnp.ndarray] = {}
         for l in self.levels():
             m = self.maps[l]
-            if l == self.lmin:
-                # base level is identical (complete, same sorted order)
+            if l == self.lmin or self._keys_same(oldtree, l):
+                # identical oct set (and identical padded layout): reuse
                 new_u[l] = old_u[l]
                 continue
             cd, cs, new_octs, f_cell, nb = mapmod.build_prolong_maps(
                 self.tree, oldtree, l, self.bc_kinds)
-            buf = np.zeros((m.ncell_pad, self.cfg.nvar), dtype=np.float32)
-            u_new = self._place(jnp.asarray(buf, dtype=self.dtype), "cells")
+            # Host-side migration: eager device scatters here would have
+            # continuously varying shapes (cd/new_octs counts change
+            # every regrid), each a fresh XLA compile; numpy fancy
+            # indexing + one bucketed device interpolation avoids that.
+            buf = np.zeros((m.ncell_pad, self.cfg.nvar), dtype=np.float64)
             if len(cd):
+                old_np = np.asarray(old_u[l])
                 rows_d = (cd[:, None] * twotondim
                           + np.arange(twotondim)[None, :]).reshape(-1)
                 rows_s = (cs[:, None] * twotondim
                           + np.arange(twotondim)[None, :]).reshape(-1)
-                u_new = u_new.at[jnp.asarray(rows_d)].set(
-                    old_u[l][jnp.asarray(rows_s)])
+                buf[rows_d] = old_np[rows_s]
             if len(new_octs):
-                # one interpolation request per (new oct, child cell)
+                # one interpolation request per (new oct, child cell),
+                # padded to a bucketed request count (stable jit shapes)
                 nn = len(new_octs)
                 sgn = (offs * 2 - 1).astype(np.float64)  # [2^d, ndim]
-                cell_rep = np.repeat(f_cell, twotondim)
-                nb_rep = np.repeat(nb, twotondim, axis=0)
-                sgn_rep = np.tile(sgn, (nn, 1))
+                nreq = nn * twotondim
+                npad = mapmod.bucket(nreq, 8)
+                cell_rep = np.zeros(npad, dtype=np.int64)
+                cell_rep[:nreq] = np.repeat(f_cell, twotondim)
+                nb_rep = np.zeros((npad, self.cfg.ndim, 2), dtype=np.int64)
+                nb_rep[:nreq] = np.repeat(nb, twotondim, axis=0)
+                sgn_rep = np.ones((npad, self.cfg.ndim))
+                sgn_rep[:nreq] = np.tile(sgn, (nn, 1))
                 vals = K.interp_cells(
                     new_u[l - 1], jnp.asarray(cell_rep),
                     jnp.asarray(nb_rep),
@@ -261,11 +417,20 @@ class AmrSim:
                     itype=int(self.params.refine.interpol_type))
                 rows = (new_octs[:, None] * twotondim
                         + np.arange(twotondim)[None, :]).reshape(-1)
-                u_new = u_new.at[jnp.asarray(rows)].set(
-                    vals.astype(self.dtype))
-            new_u[l] = u_new
+                buf[rows] = np.asarray(vals)[:nreq]
+            new_u[l] = self._place(jnp.asarray(buf, dtype=self.dtype),
+                                   "cells")
         self.u = new_u
+        # prune stale gravity state: a level whose bucketed size changed
+        # (or that vanished) must not seed the next solve's warm start
+        for l in list(self.phi):
+            if (l not in self.maps
+                    or self.phi[l].shape[0] != self.maps[l].ncell_pad):
+                self.phi.pop(l, None)
+                self.fg.pop(l, None)
+                self.poisson_iters.pop(l, None)
         self._restrict_all()
+        self.timers.stop()
 
     def _restrict_all(self):
         """Restriction sweep fine→coarse so non-leaf cells hold son means."""
@@ -288,14 +453,76 @@ class AmrSim:
                               d["interp_nb"], d["interp_sgn"], self.cfg,
                               itype=int(self.params.refine.interpol_type))
 
+    def _fused_spec(self) -> FusedSpec:
+        if self._spec is None:
+            lv = tuple(self.levels())
+            self._spec = FusedSpec(
+                cfg=self.cfg, bspec=self.bspec, lmin=self.lmin,
+                boxlen=self.boxlen, levels=lv,
+                complete=tuple(self.maps[l].complete for l in lv),
+                gravity=self.gravity,
+                itype=int(self.params.refine.interpol_type))
+        return self._spec
+
     def coarse_dt(self) -> float:
-        dts = []
+        with self.timers.section("courant"):
+            dts = [float(d) for d in np.asarray(
+                _fused_courant(self.u, self.dev, self._fused_spec()))]
+            if self.pic:
+                from ramses_tpu.pm import particles as pmod
+                cf = float(self.cfg.courant_factor)
+                # particle Courant: a level-l particle moves cf*dx(l) per
+                # level substep, i.e. cf*dx(lmin) per coarse step
+                # (pm/newdt_fine.f90:186-233 folded through the exact
+                # factor-2 subcycling)
+                dts.append(float(pmod.particle_dt(
+                    self.p, self.dx(self.lmin), cf)))
+                if self.gravity and self._rho_max:
+                    # free-fall cap from the previous step's deposited
+                    # density (one step lagged; pm/newdt_fine.f90:51-60)
+                    dts.append(float(pmod.freefall_dt(
+                        jnp.asarray(self._rho_max), cf, self.fourpi)))
+            return min(dts)
+
+    # ------------------------------------------------------------------
+    # particle-mesh on the hierarchy (pm/amr_pm.py)
+    # ------------------------------------------------------------------
+    def _build_pm(self):
+        """Host CIC metadata pass, once per coarse step
+        (``make_tree_fine`` + the index part of ``cic_amr``)."""
+        from ramses_tpu.pm import amr_pm
+        x_host = np.asarray(self.p.x, dtype=np.float64)
+        ncp = {l: self.maps[l].ncell_pad for l in self.levels()}
+        pm_maps = amr_pm.build_pm_maps(self.tree, x_host, self.boxlen,
+                                       self.bc_kinds, ncp)
+        wdtype = self.dtype if self.p.x.dtype != jnp.float64 \
+            else jnp.float64
+        self._pm_dev = {
+            l: dict(idx=self._place(jnp.asarray(mp.idx), "rep"),
+                    w=self._place(jnp.asarray(mp.w, dtype=wdtype), "rep"),
+                    mask=self._place(jnp.asarray(mp.assigned), "rep"))
+            for l, mp in pm_maps.items()}
+
+    def _pm_rho(self, l: int):
+        """Particle density on level ``l``'s flat cells (``rho_fine``)."""
+        from ramses_tpu.pm import amr_pm
+        pd = self._pm_dev[l]
+        return amr_pm.deposit_flat(
+            pd["idx"], pd["w"], self.p.m.astype(pd["w"].dtype),
+            self.p.active, self.maps[l].ncell_pad,
+            self.dx(l) ** self.cfg.ndim)
+
+    def _pm_force(self):
+        """Force at particle positions, gathered at each particle's
+        finest covering level (``move1``, ``pm/move_fine.f90:193``)."""
+        from ramses_tpu.pm import amr_pm
+        f = None
         for l in self.levels():
-            d = self.dev[l]
-            dt_l = K.level_courant(self.u[l], d["valid_cell"], self.dx(l),
-                                   self.cfg)
-            dts.append(float(dt_l) * (2 ** (l - self.lmin)))
-        return min(dts)
+            pd = self._pm_dev[l]
+            fl = amr_pm.gather_flat(self.fg[l].astype(pd["w"].dtype),
+                                    pd["idx"], pd["w"], pd["mask"])
+            f = fl if f is None else f + fl
+        return f
 
     def solve_gravity(self):
         """Per-level Poisson solve, coarse→fine one-way interface
@@ -306,13 +533,22 @@ class AmrSim:
         from ramses_tpu.poisson.solver import fft_solve
 
         nd = self.cfg.ndim
-        # mean density over leaves (periodic solvability)
-        rho_mean = float(self.totals()[0]) / self.boxlen ** nd
+        # mean density over leaves + particles (periodic solvability)
+        mtot = float(self.totals()[0])
+        if self.pic:
+            mtot += float(jnp.sum(self.p.m * self.p.active))
+        rho_mean = mtot / self.boxlen ** nd
+        rho_max = None
         for l in self.levels():
             m = self.maps[l]
             d = self.dev[l]
             dx = self.dx(l)
             rho = self.u[l][:, 0]
+            if self.pic:
+                rho = rho + self._pm_rho(l).astype(rho.dtype)
+                mx = jnp.max(rho)
+                rho_max = mx if rho_max is None else jnp.maximum(rho_max,
+                                                                 mx)
             rhs = self.fourpi * (rho - rho_mean)
             if m.complete:
                 # whole-box level: exact periodic FFT solve on the dense
@@ -339,59 +575,47 @@ class AmrSim:
                     self.phi[l - 1][:, None], d["g_cell"], d["g_gnb"],
                     d["g_sgn"].astype(self.phi[l - 1].dtype),
                     _Cfg1(nd), itype=1)[:, 0]
-                phi = gs.cg_level(rhs, ghosts, d["g_nb"],
-                                  jnp.asarray(dx, rhs.dtype),
-                                  d["g_valid"], nd, iters=150)
+                phi, nit = gs.pcg_level(
+                    rhs, ghosts, d["g_nb"], d["g_octnb"],
+                    jnp.asarray(dx, rhs.dtype), d["g_valid"], nd,
+                    tol=float(self.params.poisson.epsilon), iters=200,
+                    phi0=self.phi.get(l))
+                self.poisson_iters[l] = nit
             self.phi[l] = phi
             self.fg[l] = gs.grad_phi(phi, ghosts, d["g_nb"],
                                      jnp.asarray(dx, phi.dtype),
                                      d["g_valid"], nd).astype(self.dtype)
+        if self.pic and rho_max is not None:
+            self._rho_max = float(rho_max)   # one host sync per solve
 
     def step_coarse(self, dt: float):
-        self.unew: Dict[int, jnp.ndarray] = {}
-        if self.gravity:
-            self.solve_gravity()
-        self._advance(self.lmin, float(dt))
-        self.t += float(dt)
-        self.nstep += 1
+        from ramses_tpu.pm import particles as pmod
 
-    def _advance(self, l: int, dt: float):
-        if self.gravity:                               # synchro −½dt
-            from ramses_tpu.poisson.amr_solve import kick_flat
-            self.u[l] = kick_flat(self.u[l], self.fg[l],
-                                  jnp.asarray(0.5 * dt, self.dtype),
-                                  self.cfg.ndim, self.cfg.smallr)
-        self.unew[l] = self.u[l]                       # set_unew
-        if self.tree.has(l + 1):
-            self._advance(l + 1, 0.5 * dt)             # subcycle ×2
-            self._advance(l + 1, 0.5 * dt)
-        d = self.dev[l]
-        m = self.maps[l]
-        if m.complete:
-            du = K.dense_sweep(
-                self.u[l], d["inv_perm"], d["perm"], d["ok_dense"],
-                jnp.asarray(dt, self.dtype), self.dx(l),
-                (1 << l,) * self.cfg.ndim, self.bspec, self.cfg)
-            corr = None
-        else:
-            interp = self._interp_for(l)
-            du, corr = K.level_sweep(
-                self.u[l], interp, d["stencil_src"], d["vsgn"], d["ok_ref"],
-                None, jnp.asarray(dt, self.dtype), self.dx(l), self.cfg)
-        self.unew[l] = self.unew[l] + du
-        if l > self.lmin and corr is not None:
-            self.unew[l - 1] = K.scatter_corrections(
-                self.unew[l - 1], corr, d["corr_idx"], self.cfg)
-        self.u[l] = self.unew[l]                       # set_uold
-        if self.gravity:                               # synchro +½dt
-            from ramses_tpu.poisson.amr_solve import kick_flat
-            self.u[l] = kick_flat(self.u[l], self.fg[l],
-                                  jnp.asarray(0.5 * dt, self.dtype),
-                                  self.cfg.ndim, self.cfg.smallr)
-        if self.tree.has(l + 1):
-            self.u[l] = K.restrict_upload(self.u[l], self.u[l + 1],
-                                          d["ref_cell"], d["son_oct"],
-                                          self.cfg)
+        if self.pic:
+            with self.timers.section("particles: maps"):
+                self._build_pm()
+        if self.gravity:
+            with self.timers.section("poisson"):
+                self.solve_gravity()
+        if self.pic and self.gravity:
+            # synchro_fine: complete the previous half-kick with the new
+            # force at x^n, plus this step's opening half-kick
+            with self.timers.section("particles: kick"):
+                f_at_p = self._pm_force()
+                self.p = pmod.kick(self.p, f_at_p,
+                                   0.5 * (self.dt_old + float(dt)))
+        with self.timers.section("hydro - godunov"):
+            self.u = _fused_coarse_step(
+                self.u, self.dev, self.fg if self.gravity else {},
+                jnp.asarray(float(dt), self.dtype), self._fused_spec())
+        if self.pic:
+            # move_fine: drift with the coarse dt (fine levels would
+            # split it into exact halves with the same frozen force)
+            with self.timers.section("particles: drift"):
+                self.p = pmod.drift(self.p, float(dt), self.boxlen)
+        self.t += float(dt)
+        self.dt_old = float(dt)
+        self.nstep += 1
 
     def evolve(self, tend: float, nstepmax: int = 10 ** 9,
                verbose: bool = False):

@@ -354,6 +354,10 @@ class GravityMaps:
     g_nb: np.ndarray         # [ng_pad, ndim, 2] int32 coarse neighbours
     g_sgn: np.ndarray        # [ng_pad, ndim] int8 child offset signs
     valid_cell: np.ndarray   # [ncell_pad] bool
+    # oct-lattice adjacency (the level's own coarse grid, spacing 2*dx):
+    # rows index concat(octs [noct_pad], zero [1]) — the coarse half of
+    # the two-level preconditioner (multigrid_fine's coarse MG levels)
+    oct_nb: Optional[np.ndarray] = None   # [noct_pad, ndim, 2] int32
 
 
 def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
@@ -444,8 +448,28 @@ def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
     nb[:ncell] = nb_rows
     valid = np.zeros(ncell_pad, dtype=bool)
     valid[:ncell] = True
+
+    # oct-lattice adjacency for the coarse preconditioner level
+    oct_nb = np.full((noct_pad, ndim, 2), noct_pad, dtype=np.int32)
+    n_oct_lat = 1 << (lvl - 1)
+    for d in range(ndim):
+        lo_k, hi_k = bc_kinds[d]
+        for side, s in ((0, -1), (1, +1)):
+            oc = lev.og.copy()
+            oc[:, d] += s
+            if lo_k == 0 and hi_k == 0:
+                oc[:, d] = np.mod(oc[:, d], n_oct_lat)
+                inside = np.ones(noct, dtype=bool)
+            else:
+                inside = (oc[:, d] >= 0) & (oc[:, d] < n_oct_lat)
+                oc[:, d] = np.clip(oc[:, d], 0, n_oct_lat - 1)
+            idx = tree.lookup(lvl, oc)
+            found = (idx >= 0) & inside
+            oct_nb[:noct, d, side] = np.where(found, idx,
+                                              noct_pad).astype(np.int32)
+
     return GravityMaps(
         lvl=lvl, ncell=ncell, ncell_pad=ncell_pad, ng=ng, ng_pad=ng_pad,
         nb=nb.astype(np.int32),
         g_cell=_padg(g_cell, ng_pad), g_nb=_padg(g_nb, ng_pad),
-        g_sgn=_padg(g_sgn, ng_pad), valid_cell=valid)
+        g_sgn=_padg(g_sgn, ng_pad), valid_cell=valid, oct_nb=oct_nb)

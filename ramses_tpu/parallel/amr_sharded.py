@@ -35,14 +35,18 @@ class ShardedAmrSim(AmrSim):
 
     def __init__(self, params: Params,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, particles=None):
         devices = list(devices if devices is not None else jax.devices())
         self.ndev = len(devices)
         self.mesh = Mesh(np.array(devices), ("oct",))
         self._row_sharding = NamedSharding(self.mesh, P("oct"))
         self._row2_sharding = NamedSharding(self.mesh, P("oct", None))
         self._rep_sharding = NamedSharding(self.mesh, P())
-        super().__init__(params, dtype=dtype)
+        if particles is not None:
+            # particle rows replicate; deposits scatter into the sharded
+            # level batches (GSPMD inserts the reduction collectives)
+            particles = jax.device_put(particles, self._rep_sharding)
+        super().__init__(params, dtype=dtype, particles=particles)
 
     def _noct_pad(self, noct: int) -> int:
         """Bucketed oct count rounded to a multiple of the device count

@@ -71,6 +71,90 @@ def cg_level(rhs, ghosts, nb, dx, valid, ndim: int, iters: int = 200,
     return jnp.where(valid, x, 0.0)
 
 
+@partial(jax.jit, static_argnames=("ndim", "iters", "nu"))
+def pcg_level(rhs, ghosts, nb, oct_nb, dx, valid, ndim: int,
+              tol: float = 1e-4, iters: int = 200, nu: int = 4,
+              phi0=None):
+    """Preconditioned CG with residual-targeted termination.
+
+    The reference solves each AMR level with masked multigrid to
+    ``epsilon`` (``poisson/multigrid_fine_commons.f90:25-305``) or CG
+    above ``cg_levelmin``.  Here: CG on the masked level system,
+    preconditioned by an additive two-level operator —
+    ``M^-1 r = w_f * D^-1 r  +  P (Jacobi_nu on the oct lattice) P^T r``
+    with P = piecewise-constant prolongation over each oct's 2^ndim
+    cells.  Both terms are symmetric positive definite polynomials of
+    symmetric operators, so CG theory holds.  Iterations freeze once
+    ``|r| <= tol * |r0|`` (the &POISSON_PARAMS epsilon); the live
+    iteration count is returned for the multigrid-iters metric.
+
+    Returns (phi, niter).
+    """
+    ttd = 2 ** ndim
+    zero_g = jnp.zeros_like(ghosts)
+    b = jnp.where(valid,
+                  rhs - laplacian(jnp.zeros_like(rhs), ghosts, nb, dx,
+                                  valid, ndim), 0.0)
+
+    def A(x):
+        return -laplacian(x, zero_g, nb, dx, valid, ndim)
+
+    dxc = 2.0 * dx
+    diag_c = 2.0 * ndim / (dxc * dxc)
+
+    def Minv(r):
+        # coarse half: restrict (adjoint of repeat), nu Jacobi sweeps on
+        # the oct-lattice operator, prolong back
+        rc = r.reshape(-1, ttd).sum(axis=1)              # [noct_pad]
+        ec = jnp.zeros_like(rc)
+        for _ in range(nu):
+            ext = jnp.concatenate([ec, jnp.zeros((1,), ec.dtype)])
+            s = jnp.zeros_like(ec)
+            for d in range(ndim):
+                s = s + ext[oct_nb[:, d, 0]] + ext[oct_nb[:, d, 1]]
+            lap_c = (s - 2.0 * ndim * ec) / (dxc * dxc)
+            ec = ec + 0.6 * (rc / ttd - (-lap_c)) / diag_c
+        e = jnp.repeat(ec, ttd)
+        # fine half: damped diagonal
+        diag_f = 2.0 * ndim / (dx * dx)
+        e = e + 0.6 * r / diag_f
+        return jnp.where(valid, e, 0.0)
+
+    x = (phi0 if phi0 is not None else jnp.zeros_like(rhs))
+    r = jnp.where(valid, -b - A(x), 0.0)
+    z = Minv(r)
+    p = z
+    rz = jnp.sum(r * z)
+    # epsilon is relative to the SYSTEM rhs (the reference's multigrid
+    # convergence norm), not to the warm-start residual — else a good
+    # phi0 would make the target unreachably strict
+    bb = jnp.sum(b * b)
+    cut = jnp.asarray(tol, rhs.dtype) ** 2 * jnp.maximum(
+        bb, jnp.finfo(rhs.dtype).tiny)
+
+    def body(i, state):
+        x, r, p, rz, niter = state
+        rr = jnp.sum(r * r)
+        live = rr > cut
+        Ap = A(p)
+        denom = jnp.sum(p * Ap)
+        alpha = jnp.where(live & (denom != 0.0),
+                          rz / jnp.where(denom == 0.0, 1.0, denom), 0.0)
+        x = x + alpha * p
+        r_new = r - alpha * Ap
+        z_new = Minv(r_new)
+        rz_new = jnp.sum(r_new * z_new)
+        beta = jnp.where(live & (rz != 0.0),
+                         rz_new / jnp.where(rz == 0.0, 1.0, rz), 0.0)
+        p = jnp.where(live, z_new + beta * p, p)
+        return (x, jnp.where(live, r_new, r), p,
+                jnp.where(live, rz_new, rz), niter + live)
+
+    x, r, p, rz, niter = jax.lax.fori_loop(
+        0, iters, body, (x, r, p, rz, jnp.array(0, jnp.int32)))
+    return jnp.where(valid, x, 0.0), niter
+
+
 @partial(jax.jit, static_argnames=("ndim",))
 def grad_phi(phi, ghosts, nb, dx, valid, ndim: int):
     """Central-difference force f = −∇φ, [ncell_pad, ndim]
@@ -85,12 +169,17 @@ def grad_phi(phi, ghosts, nb, dx, valid, ndim: int):
 
 @partial(jax.jit, static_argnames=("ndim",))
 def grad_dense(phi_dense, dx, ndim: int):
-    """f = −∇φ on a dense periodic grid by central differences; returns
-    raveled rows [ncell, ndim] (the complete-level companion of
-    :func:`grad_phi`)."""
-    comps = [-(jnp.roll(phi_dense, -1, axis=d)
-               - jnp.roll(phi_dense, 1, axis=d)) / (2.0 * dx)
-             for d in range(ndim)]
+    """f = −∇φ on a dense periodic grid, 4th-order 5-point stencil
+    (``force_fine``'s gradient, the same operator as
+    ``poisson/force.py:gradient_phi``); returns raveled rows
+    [ncell, ndim] (the complete-level companion of :func:`grad_phi`)."""
+    a = 2.0 / (3.0 * dx)
+    b = 1.0 / (12.0 * dx)
+    comps = []
+    for d in range(ndim):
+        d1 = jnp.roll(phi_dense, -1, axis=d) - jnp.roll(phi_dense, 1, axis=d)
+        d2 = jnp.roll(phi_dense, -2, axis=d) - jnp.roll(phi_dense, 2, axis=d)
+        comps.append(-(a * d1 - b * d2))
     return jnp.stack(comps, axis=-1).reshape(-1, ndim)
 
 

@@ -1,0 +1,170 @@
+"""Particles on the AMR hierarchy (pm/amr_pm.py + hierarchy wiring).
+
+Oracles:
+  * level assignment and CIC deposit bookkeeping against the host tree;
+  * mass conservation of the per-level deposits;
+  * the degenerate single-level AMR run reproduces the uniform-grid
+    coupled stepper (same FFT gravity, same KDK order);
+  * refined-hierarchy momentum bookkeeping and decomposition invariance
+    on the 8-device mesh (the reference's own multi-rank aggregate trick,
+    ``tests/run_test_suite.sh:78-82``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.config import params_from_string
+from ramses_tpu.pm import amr_pm
+from ramses_tpu.pm.particles import ParticleSet
+
+
+def _params(lmin, lmax, ndim=2, refine=""):
+    txt = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "poisson=.true.", "pic=.true.", "/",
+        "&AMR_PARAMS", f"levelmin={lmin}", f"levelmax={lmax}",
+        "boxlen=1.0", "/",
+        "&INIT_PARAMS", "nregion=1", "region_type(1)='square'",
+        "d_region=1.0", "p_region=1.0", "/",
+        "&HYDRO_PARAMS", "riemann='hllc'", "courant_factor=0.5", "/",
+    ] + ([refine] if refine else []))
+    return params_from_string(txt, ndim=ndim)
+
+
+def _pset(n, ndim, seed=0, vmax=0.1):
+    rng = np.random.default_rng(seed)
+    return ParticleSet.make(
+        rng.uniform(0.05, 0.95, (n, ndim)),
+        rng.uniform(-vmax, vmax, (n, ndim)),
+        np.full(n, 1.0 / n))
+
+
+def test_assign_levels_finest_covering():
+    p = _params(3, 5, ndim=2,
+                refine="&REFINE_PARAMS\nx_refine=0,0,0.25,0.25\n"
+                       "y_refine=0,0,0.25,0.25\n"
+                       "r_refine=-1,-1,0.15,0.15\n/")
+    sim = AmrSim(p, dtype=jnp.float64)
+    assert sim.tree.has(5)
+    x = np.array([[0.25, 0.25],    # inside the refined ball -> level 5
+                  [0.9, 0.9]])     # outside -> base level
+    lv = amr_pm.assign_levels(sim.tree, x, 1.0)
+    assert lv[0] == 5
+    assert lv[1] == 3
+
+
+def test_deposit_mass_conserved_per_level():
+    p = _params(3, 5, ndim=2,
+                refine="&REFINE_PARAMS\nx_refine=0,0,0.25,0.25\n"
+                       "y_refine=0,0,0.25,0.25\n"
+                       "r_refine=-1,-1,0.15,0.15\n/")
+    sim = AmrSim(p, dtype=jnp.float64)
+    ps = _pset(64, 2, seed=1)
+    sim.p = jax.device_put(ps)
+    sim.pic = True
+    sim._build_pm()
+    # base level is complete: every corner lands -> exact total mass
+    rho = sim._pm_rho(sim.lmin)
+    vol = sim.dx(sim.lmin) ** 2
+    m = sim.maps[sim.lmin]
+    mass = float(jnp.sum(rho[:m.noct * 4]) * vol)
+    assert abs(mass - float(jnp.sum(ps.m))) < 1e-12
+    # finer levels: deposited mass <= total (corners outside coverage drop)
+    for l in sim.levels():
+        if l == sim.lmin:
+            continue
+        ml = sim.maps[l]
+        mass_l = float(jnp.sum(sim._pm_rho(l)[:ml.noct * 4])
+                       * sim.dx(l) ** 2)
+        assert mass_l <= float(jnp.sum(ps.m)) + 1e-12
+
+
+def test_degenerate_amr_matches_uniform_pm():
+    """lmin=lmax AMR with particles == the uniform coupled stepper."""
+    from ramses_tpu.driver import Simulation
+    from ramses_tpu.pm.coupling import pm_hydro_step
+
+    lvl, ndim = 4, 2
+    ps = _pset(32, ndim, seed=2, vmax=0.05)
+    pu = _params(lvl, lvl, ndim=ndim)
+    sim = AmrSim(pu, dtype=jnp.float64, particles=jax.device_put(ps))
+
+    uni = Simulation(_params(lvl, lvl, ndim=ndim), dtype=jnp.float64,
+                     particles=ps)
+    u, p, f = uni.state.u, uni.state.p, uni.state.f
+    dt = 1e-3
+    for _ in range(3):
+        sim.step_coarse(dt)
+    dt_old = 0.0
+    for _ in range(3):
+        u, p, f = pm_hydro_step(uni.grid, uni.gspec, uni.pspec,
+                                u, p, f, dt, dt_old)
+        dt_old = dt
+    xa = np.asarray(sim.p.x)
+    xu = np.asarray(p.x)
+    # The two steppers are not bit-identical by design: the uniform path
+    # feeds the gravity predictor into the MUSCL trace and uses the
+    # reference's (-0.5*dt_old old force, +0.5*dt new force) hydro kick
+    # split, while the AMR path kicks +-0.5*dt around the sweep with the
+    # per-step force.  Both are second order; trajectories agree to
+    # O(dt^2 * dphi) — observed ~1e-6 over 3 steps at dt=1e-3.
+    np.testing.assert_allclose(xa, xu, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sim.p.v), np.asarray(p.v),
+                               atol=1e-4)
+
+
+def test_refined_run_momentum_and_stability():
+    """Particles through a refined hierarchy: bounded momentum drift."""
+    p = _params(3, 5, ndim=2,
+                refine="&REFINE_PARAMS\nx_refine=0,0,0.5,0.5\n"
+                       "y_refine=0,0,0.5,0.5\n"
+                       "r_refine=-1,-1,0.2,0.2\n/")
+    ps = _pset(48, 2, seed=3, vmax=0.05)
+    sim = AmrSim(p, dtype=jnp.float64, particles=jax.device_put(ps))
+    mom0 = (np.asarray(sim.totals())[1:3]
+            + np.asarray(jnp.sum(sim.p.v * sim.p.m[:, None], axis=0)))
+    for _ in range(4):
+        sim.step_coarse(sim.coarse_dt())
+    assert np.all(np.isfinite(np.asarray(sim.p.x)))
+    mom1 = (np.asarray(sim.totals())[1:3]
+            + np.asarray(jnp.sum(sim.p.v * sim.p.m[:, None], axis=0)))
+    # CIC deposit/gather with a shared kernel conserves momentum up to
+    # the one-way level interface; drift must stay small
+    assert np.all(np.abs(mom1 - mom0) < 2e-3)
+
+
+def test_sharded_amr_pm_matches_single():
+    """Decomposition invariance: 8-device mesh == single device."""
+    from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+
+    p = _params(3, 4, ndim=2,
+                refine="&REFINE_PARAMS\nx_refine=0,0,0.3\ny_refine=0,0,0.3\n"
+                       "r_refine=-1,-1,0.15\n/")
+    ps = _pset(32, 2, seed=4, vmax=0.05)
+    sim1 = AmrSim(p, dtype=jnp.float64, particles=jax.device_put(ps))
+    simN = ShardedAmrSim(p, devices=jax.devices()[:8], dtype=jnp.float64,
+                         particles=ps)
+    dt = 2e-3
+    for _ in range(3):
+        sim1.step_coarse(dt)
+        simN.step_coarse(dt)
+    np.testing.assert_allclose(np.asarray(sim1.p.x),
+                               np.asarray(simN.p.x), atol=1e-12)
+    for l in sim1.levels():
+        np.testing.assert_allclose(np.asarray(sim1.u[l]),
+                                   np.asarray(simN.u[l]),
+                                   atol=1e-11)
+
+
+def test_freefall_and_particle_dt_enter_coarse_dt():
+    p = _params(4, 4, ndim=2)
+    ps = ParticleSet.make(np.array([[0.5, 0.5]]),
+                          np.array([[5.0, 0.0]]), np.array([1.0]))
+    sim = AmrSim(p, dtype=jnp.float64, particles=jax.device_put(ps))
+    dt0 = sim.coarse_dt()
+    # particle courant: cf*dx/vmax = 0.5*(1/16)/5
+    assert dt0 <= 0.5 * sim.dx(4) / 5.0 + 1e-12
+    sim.step_coarse(dt0)
+    assert sim._rho_max is not None and sim._rho_max > 0
