@@ -52,11 +52,14 @@ def bench_uniform(params, dtype, jnp):
     u = sim.state.u
     t = jnp.asarray(0.0, jnp.float32)
     tend = jnp.asarray(1e9, jnp.float32)
-    u1, t1, _ = run_steps(sim.grid, u, t, tend, 2)   # compile + warm
-    u1.block_until_ready()
+    # warm with the SAME static nsteps so the timed region holds zero
+    # compiles, then hard-sync (block_until_ready alone can return early
+    # over a tunneled device)
+    u1, t1, _ = run_steps(sim.grid, u, t, tend, nsteps)
+    float(jnp.sum(u1[0]))
     t0 = time.perf_counter()
     u2, t2, ndone = run_steps(sim.grid, u1, t1, tend, nsteps)
-    u2.block_until_ready()
+    float(jnp.sum(u2[0]))
     wall = time.perf_counter() - t0
     updates = sim.grid.ncell * int(ndone)
     return {

@@ -39,10 +39,39 @@ class UniformGrid:
         return n
 
 
+# Read once at import: jit caches are keyed on static args, not the
+# environment, so a post-import toggle would silently hit stale caches.
+_NO_PALLAS = bool(__import__("os").environ.get("RAMSES_NO_PALLAS"))
+
+
+def _pallas_ok(grid: UniformGrid, dtype) -> bool:
+    """True when the fused Pallas TPU kernel covers this grid."""
+    if _NO_PALLAS:
+        return False
+    if jax.default_backend() != "tpu" or grid.cfg.ndim != 3:
+        return False
+    # the kernel has no GSPMD partitioning rule: the multi-chip sharded
+    # path (parallel/sharded.py) must keep the XLA solver so the SPMD
+    # partitioner can insert halo collectives
+    if jax.device_count() != 1:
+        return False
+    from ramses_tpu.hydro import pallas_muscl as pk
+    kinds = tuple((lo.kind, hi.kind) for lo, hi in grid.bc.faces)
+    return pk.supports(grid.cfg, grid.shape, kinds, dtype)
+
+
 @partial(jax.jit, static_argnames=("grid",))
 def step(grid: UniformGrid, u, dt):
-    """One conservative MUSCL-Hancock step on the active grid."""
+    """One conservative MUSCL-Hancock step on the active grid.
+
+    Dispatches to the fused Pallas kernel
+    (:mod:`ramses_tpu.hydro.pallas_muscl`) when it covers the config;
+    the XLA path below is the reference implementation (bit-identical)."""
     cfg = grid.cfg
+    if _pallas_ok(grid, u.dtype):
+        from ramses_tpu.hydro import pallas_muscl as pk
+        up, _ = pk.pad_xy(u, grid.bc, cfg)
+        return pk.fused_step_padded(up, dt, cfg, grid.dx, grid.shape)
     up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
     flux, _tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
     un = muscl.apply_fluxes(up, flux, cfg)
@@ -74,7 +103,14 @@ def run_steps(grid: UniformGrid, u, t, tend, nsteps: int):
 
     dt is recomputed each step (``courant_fine``), clipped to land exactly
     on ``tend``; steps past ``tend`` are no-ops.  Returns (u, t, n_done).
+
+    On the Pallas path the Courant reduction of the updated state comes
+    out of the step kernel itself (free — the primitives are already in
+    VMEM), so each iteration is exactly one kernel launch.
     """
+    if _pallas_ok(grid, u.dtype):
+        return _run_steps_pallas(grid, u, t, tend, nsteps)
+
     def body(carry, _):
         u, t, ndone = carry
         dt = cfl_dt(grid, u)
@@ -88,6 +124,34 @@ def run_steps(grid: UniformGrid, u, t, tend, nsteps: int):
 
     (u, t, ndone), _ = jax.lax.scan(body, (u, t, jnp.array(0)), None,
                                     length=nsteps)
+    return u, t, ndone
+
+
+@partial(jax.jit, static_argnames=("grid", "nsteps"))
+def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int):
+    from ramses_tpu.hydro import pallas_muscl as pk
+
+    cfg = grid.cfg
+    dtmax = cfg.courant_factor * grid.dx / cfg.smallc
+    dt0 = compute_dt(u, None, grid.dx, cfg)
+
+    def body(carry, _):
+        u, t, ndone, dtc = carry
+        dt = jnp.minimum(dtc, jnp.maximum(tend - t, 0.0))
+        active = t < tend
+        up, _ = pk.pad_xy(u, grid.bc, cfg)
+        un, crt = pk.fused_step_padded(up, jnp.where(active, dt, 0.0),
+                                       cfg, grid.dx, grid.shape,
+                                       courant=True)
+        dtn = jnp.minimum(dtmax, crt[0, 0])
+        u = jnp.where(active, un, u)
+        t = jnp.where(active, t + dt, t)
+        dtc = jnp.where(active, dtn, dtc)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, t, ndone, dtc), None
+
+    (u, t, ndone, _), _ = jax.lax.scan(
+        body, (u, t, jnp.array(0), dt0), None, length=nsteps)
     return u, t, ndone
 
 
