@@ -72,48 +72,67 @@ def bench_uniform(params, dtype, jnp):
 
 def bench_amr(params, dtype, jnp):
     from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.utils.timers import Timers
 
     lmin = int(os.environ.get("BENCH_AMR_LMIN", "7"))
     lmax = int(os.environ.get("BENCH_AMR_LMAX", "9"))
-    nsteps = int(os.environ.get("BENCH_AMR_STEPS", "5"))
+    nsteps = int(os.environ.get("BENCH_AMR_STEPS", "20"))
     params.amr.levelmin, params.amr.levelmax = lmin, lmax
+    # The reference sedov3d.nml carries no refinement criteria (it is a
+    # uniform-grid production file); the driver's AMR variant needs
+    # some — relative density/pressure jumps, the standard shock-
+    # tracking choice (hydro/godunov_utils.f90:125-260 semantics).
     params.refine.err_grad_d = 0.1
     params.refine.err_grad_p = 0.1
     sim = AmrSim(params, dtype=dtype)
-    warm = int(os.environ.get("BENCH_AMR_WARM", "6"))
+    # develop the blast until the refined shell is a real working set
+    warm = int(os.environ.get("BENCH_AMR_WARM", "15"))
     sim.evolve(1e9, nstepmax=warm)       # compile + develop the blast
     sim.timers.acc.clear()
     ttd = 2 ** sim.cfg.ndim
 
     def count_updates():
-        return sum(sim.tree.noct(l) * ttd * 2 ** (l - sim.lmin)
-                   for l in sim.levels())
+        per = {l: sim.tree.noct(l) * ttd * 2 ** (l - sim.lmin)
+               for l in sim.levels()}
+        return sum(per.values()), per
 
     n0 = sim.nstep
     updates = 0
+    upd_fine = 0
     t0 = time.perf_counter()
     while sim.nstep < n0 + nsteps:
-        updates += count_updates()      # octs move per step: count per step
+        tot, per = count_updates()      # octs move per step: count per step
+        updates += tot
+        upd_fine += sum(v for l, v in per.items() if l > lmin)
         if sim.regrid_interval and sim.nstep % sim.regrid_interval == 0:
             sim.regrid()
         sim.step_coarse(sim.coarse_dt())
-    for l in sim.levels():
-        sim.u[l].block_until_ready()
+    sim.drain()
     wall = time.perf_counter() - t0
     sim.timers.stop()
-    # steady-state: frozen tree -> static shapes, no regrid/compile churn.
-    # A production run at fixed levelmax reaches this regime once the
-    # refined region stops moving through bucket sizes; the growth-phase
-    # figure above includes every regrid + recompile cost.
-    sim.regrid_interval = 0
-    sim.step_coarse(sim.coarse_dt())     # compile at the frozen shapes
-    upd1 = count_updates()
-    nss = 5
-    t0 = time.perf_counter()
-    for _ in range(nss):
+    growth_timers = {k: round(v, 3) for k, v in sim.timers.acc.items()}
+
+    # instrumented pass: drain the device at every section switch so the
+    # breakdown attributes device time to the section that enqueued it
+    # (async dispatch otherwise books everything on the next sync)
+    sim.timers = Timers(sync=sim.drain)
+    for _ in range(3):
+        if sim.regrid_interval:
+            sim.regrid()
         sim.step_coarse(sim.coarse_dt())
-    for l in sim.levels():
-        sim.u[l].block_until_ready()
+    sim.timers.stop()
+    inst_timers = {k: round(v, 3) for k, v in sim.timers.acc.items()}
+    sim.timers = Timers()
+
+    # steady-state: frozen tree -> static shapes, the whole window runs
+    # as ONE fused multi-step program (zero host round-trips).
+    sim.regrid_interval = 0
+    sim.evolve(1e9, nstepmax=sim.nstep + 2)   # compile at frozen shapes
+    upd1, _ = count_updates()
+    nss = int(os.environ.get("BENCH_AMR_SS_STEPS", "20"))
+    t0 = time.perf_counter()
+    sim.evolve(1e9, nstepmax=sim.nstep + nss)
+    sim.drain()
     wss = time.perf_counter() - t0
     return {
         "config": f"sedov3d AMR levelmin={lmin} levelmax={lmax}",
@@ -121,7 +140,9 @@ def bench_amr(params, dtype, jnp):
         "cell_updates_per_sec": updates / wall,
         "mus_per_cell_update": 1e6 * wall / max(updates, 1),
         "steps": nsteps, "wall_s": wall,
-        "timers_s": {k: round(v, 3) for k, v in sim.timers.acc.items()},
+        "refined_update_fraction": upd_fine / max(updates, 1),
+        "timers_s": growth_timers,
+        "timers_instrumented_s": inst_timers,
         "octs_per_level": {l: sim.tree.noct(l) for l in sim.levels()},
         "leaf_cells": sim.ncell_leaf(),
         "steady_state": {
@@ -129,6 +150,37 @@ def bench_amr(params, dtype, jnp):
             "mus_per_cell_update": 1e6 * wss / (nss * upd1),
             "steps": nss, "wall_s": wss,
         },
+    }
+
+
+def bench_amr_poisson(params, dtype, jnp):
+    """AMR Poisson: live PCG iterations/sec on the hierarchy (the
+    'multigrid iters/sec' driver metric covering partial levels —
+    multigrid_fine's role; uniform V-cycles are bench_mg)."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+
+    lmin = int(os.environ.get("BENCH_AMR_LMIN", "7"))
+    lmax = int(os.environ.get("BENCH_AMR_LMAX", "9"))
+    params.amr.levelmin, params.amr.levelmax = lmin, lmax
+    params.refine.err_grad_d = 0.1
+    params.refine.err_grad_p = 0.1
+    params.run.poisson = True
+    sim = AmrSim(params, dtype=dtype)
+    sim.evolve(1e9, nstepmax=6)          # compile + develop + warm start
+    nst = 4
+    iters = 0
+    t0 = time.perf_counter()
+    for _ in range(nst):
+        sim.regrid()
+        sim.step_coarse(sim.coarse_dt())
+        iters += sum(int(v) for v in sim.poisson_iters.values())
+    sim.drain()
+    wall = time.perf_counter() - t0
+    return {
+        "config": f"sedov3d AMR+selfgrav levelmin={lmin} levelmax={lmax}",
+        "pcg_iters_per_sec": iters / wall,
+        "pcg_iters_per_step": iters / nst,
+        "steps": nst, "wall_s": wall,
     }
 
 
@@ -169,8 +221,9 @@ def main():
 
     dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16") else jnp.float32
     only = os.environ.get("BENCH_ONLY", "")
-    if only not in ("", "uniform", "amr", "mg"):
-        raise SystemExit(f"BENCH_ONLY={only!r}: expected uniform|amr|mg")
+    if only not in ("", "uniform", "amr", "mg", "amr_poisson"):
+        raise SystemExit(
+            f"BENCH_ONLY={only!r}: expected uniform|amr|mg|amr_poisson")
     nml = os.path.join(HERE, "namelists", "sedov3d.nml")
 
     sub = {}
@@ -180,6 +233,9 @@ def main():
         sub["amr"] = bench_amr(load_params(nml, ndim=3), dtype, jnp)
     if only in ("", "mg"):
         sub["mg"] = bench_mg(dtype, jnp)
+    if only in ("", "amr_poisson"):
+        sub["amr_poisson"] = bench_amr_poisson(load_params(nml, ndim=3),
+                                               dtype, jnp)
 
     published = _load_baseline()
     base_hydro = (published.get("hydro", {})
@@ -193,17 +249,24 @@ def main():
         sub["uniform"]["vs_baseline_64rank"] = (
             sub["uniform"]["cell_updates_per_sec"] / base_hydro)
 
-    head = sub.get("amr") or sub.get("uniform") or sub["mg"]
+    head = (sub.get("amr") or sub.get("uniform") or sub.get("mg")
+            or sub["amr_poisson"])
     hydro_head = "cell_updates_per_sec" in head
-    value = head.get("cell_updates_per_sec", head.get("vcycles_per_sec"))
+    value = head.get("cell_updates_per_sec",
+                     head.get("vcycles_per_sec",
+                              head.get("pcg_iters_per_sec")))
     vs = (value / base_hydro if base_hydro and hydro_head else
-          (value / base_mg if base_mg and not hydro_head else None))
+          (value / base_mg if base_mg and not hydro_head
+           and "vcycles_per_sec" in head else None))
     out = {
         "metric": (f"cell-updates/sec/chip {head['config']}" if hydro_head
-                   else f"vcycles/sec/chip {head['config']}"),
+                   else (f"vcycles/sec/chip {head['config']}"
+                         if "vcycles_per_sec" in head
+                         else f"pcg-iters/sec/chip {head['config']}")),
         "value": value,
         "unit": ("cell-updates/s" if "cell_updates_per_sec" in head
-                 else "vcycles/s"),
+                 else ("vcycles/s" if "vcycles_per_sec" in head
+                       else "pcg-iters/s")),
         "vs_baseline": vs,
         "detail": {
             "device": str(jax.devices()[0].platform),

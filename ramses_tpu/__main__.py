@@ -52,13 +52,20 @@ def main(argv=None) -> int:
         sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
     elif solver == "mhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
-            raise NotImplementedError(
-                "MHD runs are uniform-grid for now (levelmax must equal "
-                "levelmin); AMR MHD needs div-B-preserving prolongation")
-        from ramses_tpu.mhd.driver import MhdSimulation
-        sim = MhdSimulation(params, dtype=dtype)
-        sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
-        sim.dump(1, params.output.output_dir, namelist_path=args.namelist)
+            from ramses_tpu.mhd.amr import MhdAmrSim
+            sim = MhdAmrSim(params, dtype=dtype)
+            tend = (params.output.tout[-1] if params.output.tout
+                    else params.output.tend)
+            sim.evolve(tend, nstepmax=params.run.nstepmax,
+                       verbose=args.verbose)
+            print(f"mhd-amr t={sim.t:.5e} nstep={sim.nstep} "
+                  f"max|divB|/max|B|*dx={sim.max_divb():.3e}")
+        else:
+            from ramses_tpu.mhd.driver import MhdSimulation
+            sim = MhdSimulation(params, dtype=dtype)
+            sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
+            sim.dump(1, params.output.output_dir,
+                     namelist_path=args.namelist)
     elif args.amr or params.amr.levelmax > params.amr.levelmin:
         from ramses_tpu.amr.hierarchy import AmrSim
         sim = AmrSim(params, dtype=dtype)

@@ -57,17 +57,15 @@ class FusedSpec(NamedTuple):
     itype: int
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec):
-    """One ENTIRE coarse step (recursive subcycled ``amr_step``) as a
-    single XLA program.
+def _advance_traced(u, dev, fg, dt, spec: FusedSpec):
+    """One ENTIRE coarse step (recursive subcycled ``amr_step``) traced
+    as straight-line XLA.
 
-    The host recursion of ``AmrSim._advance`` dispatches ~15 device
-    calls per step; over a remote-tunnel TPU each call costs dispatch
-    latency, which dominated the AMR profile.  Tracing the same
-    recursion here turns a coarse step into ONE dispatch; recompiles
-    happen only when the bucketed level structure changes (the jit key
-    is ``spec`` + array shapes).
+    The host recursion of the round-1 driver dispatched ~15 device calls
+    per step; over a remote-tunnel TPU each call costs dispatch latency,
+    which dominated the AMR profile.  Tracing the recursion turns a
+    coarse step into ONE program; recompiles happen only when the
+    bucketed level structure changes (the jit key is ``spec`` + shapes).
     """
     cfg = spec.cfg
     u = dict(u)
@@ -115,10 +113,9 @@ def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec):
     return u
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def _fused_courant(u, dev, spec: FusedSpec):
-    """All levels' CFL dts in one dispatch; returns [nlevel] coarse-step
-    equivalents (already scaled by the subcycle factor)."""
+def _courant_traced(u, dev, spec: FusedSpec):
+    """All levels' CFL dts, [nlevel] coarse-step equivalents (already
+    scaled by the exact factor-2 subcycle count)."""
     cfg = spec.cfg
     dts = []
     for i, l in enumerate(spec.levels):
@@ -128,8 +125,98 @@ def _fused_courant(u, dev, spec: FusedSpec):
     return jnp.stack(dts)
 
 
+@partial(jax.jit, static_argnames=("spec",))
+def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec):
+    """One coarse step + the NEXT step's Courant dt, one dispatch.
+
+    Returning dt(u^{n+1}) from the same program is the reference's
+    ``dtnew`` bookkeeping (``amr/update_time.f90``): the next coarse
+    step starts without a host round-trip to evaluate CFL.
+    """
+    u = _advance_traced(u, dev, fg, dt, spec)
+    return u, jnp.min(_courant_traced(u, dev, spec))
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _fused_courant(u, dev, spec: FusedSpec):
+    return _courant_traced(u, dev, spec)
+
+
+@partial(jax.jit, static_argnames=("ncell_pad", "cfg", "itype"))
+def _migrate_level(old_u, u_coarse, rows_d, rows_s, cell_rep, nb_rep,
+                   sgn_rep, rows_new, ncell_pad: int, cfg, itype: int):
+    """Device-side regrid migration of one level: copy surviving cells
+    from the old batch, interpolate brand-new octs from the (already
+    migrated) coarser level (``make_grid_fine``,
+    ``amr/refine_utils.f90:590``).  All index arrays are bucket-padded
+    with out-of-range targets so jit shapes stay stable; the scatter
+    drops them."""
+    buf = jnp.zeros((ncell_pad, old_u.shape[1]), old_u.dtype)
+    buf = buf.at[rows_d].set(old_u[rows_s], mode="drop")
+    vals = K.interp_cells(u_coarse, cell_rep, nb_rep, sgn_rep, cfg,
+                          itype=itype)
+    return buf.at[rows_new].set(vals.astype(buf.dtype), mode="drop")
+
+
+@partial(jax.jit, static_argnames=("spec", "eg", "fls", "itype"))
+def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
+    """Every level's gradient refinement criteria in ONE dispatch (the
+    per-level ``hydro_refine`` kernels of ``flag_fine``); the host
+    fetches the whole tuple with a single device round-trip."""
+    cfg = spec.cfg
+    out = []
+    for i, l in enumerate(spec.levels):
+        d = dev[l]
+        if spec.complete[i]:
+            fl = K.dense_refine_flags(u[l], d["inv_perm"], d["perm"], eg,
+                                      fls, (1 << l,) * cfg.ndim,
+                                      spec.bspec, cfg)
+        else:
+            if l == spec.lmin:
+                interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
+                                   u[l].dtype)
+            else:
+                interp = K.interp_cells(u[l - 1], d["interp_cell"],
+                                        d["interp_nb"], d["interp_sgn"],
+                                        cfg, itype=itype)
+            fl = K.refine_flags(u[l], interp, d["stencil_src"], d["vsgn"],
+                                eg, fls, cfg)
+        out.append(fl)
+    return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("spec", "nsteps"))
+def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int):
+    """``nsteps`` hydro-only coarse steps as ONE device program
+    (``lax.scan``), zero host round-trips between steps.
+
+    Steps past ``tend`` become no-ops (the ``run_steps`` active-flag
+    pattern).  Only valid while the tree is frozen — callers chunk by
+    the regrid interval.  Returns (u, t, dt_next, n_done).
+    """
+    def body(carry, _):
+        u, t, dtc, ndone = carry
+        dt = jnp.minimum(dtc, jnp.maximum(tend - t, 0.0))
+        active = t < tend
+        # state dtype for the step (t/dt may carry f64 on x64 hosts)
+        sdt = jnp.where(active, dt, 0.0).astype(u[spec.lmin].dtype)
+        un, dtn = _fused_coarse_step(u, dev, {}, sdt, spec)
+        u = {l: jnp.where(active, un[l], u[l]) for l in u}
+        t = jnp.where(active, t + dt, t)
+        dtc = jnp.where(active, dtn.astype(dtc.dtype), dtc)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, t, dtc, ndone), None
+
+    (u, t, dtc, ndone), _ = jax.lax.scan(
+        body, (u, t, dt0, jnp.array(0)), None, length=nsteps)
+    return u, t, dtc, ndone
+
+
 class AmrSim:
     """Adaptive simulation: host octree + per-level device states.
+
+    ``_needs_mig_log``: subclasses carrying extra per-cell state set
+    this to retain the regrid migration maps (see ``regrid``).
 
     ``particles`` (a :class:`~ramses_tpu.pm.particles.ParticleSet`)
     enables the particle-mesh layer on the hierarchy: per-coarse-step
@@ -138,6 +225,8 @@ class AmrSim:
     and a split-kick KDK matching the uniform stepper's order
     (``amr/amr_step.f90:219-236,268-273,479-486``).
     """
+
+    _needs_mig_log = False
 
     def __init__(self, params: Params, dtype=jnp.float32,
                  init_tree: Optional[Octree] = None,
@@ -175,6 +264,15 @@ class AmrSim:
         self.dt_old = 0.0
         self._pm_dev: Dict[int, dict] = {}
         self._rho_max: Optional[float] = None
+        # next-step CFL dt (device scalar) emitted by the previous fused
+        # step; None whenever u changed outside step_coarse (regrid, ICs,
+        # restart) and a fresh synchronous evaluation is needed
+        self._dt_cache = None
+        self._pad_hist: Dict[int, int] = {}
+        # per-regrid migration maps, logged for subclasses that carry
+        # extra per-cell state (the MHD staggered field); gated so the
+        # plain hydro driver doesn't pin ncell-sized index buffers
+        self._mig_log: Dict[int, tuple] = {}
 
         if init_tree is not None:
             self.tree = init_tree
@@ -189,9 +287,18 @@ class AmrSim:
     def dx(self, lvl: int) -> float:
         return self.boxlen / (1 << lvl)
 
-    def _noct_pad(self, noct: int) -> Optional[int]:
-        """Padded oct count; subclasses align it to the device mesh."""
-        return None
+    def _noct_pad(self, lvl: int, noct: int) -> Optional[int]:
+        """Padded oct count with hysteresis: keep the previous bucket
+        while the level still fits in it and fills >1/4 — the growing
+        blast then changes jit shapes (→ recompiles) only on 4x growth,
+        the ``ngridmax`` headroom idea of the reference's static
+        allocation.  Subclasses align the result to the device mesh."""
+        pad = mapmod.bucket(noct)
+        prev = self._pad_hist.get(lvl)
+        if prev is not None and pad <= prev and noct * 4 > prev:
+            pad = prev
+        self._pad_hist[lvl] = pad
+        return pad
 
     def _place(self, arr, kind: str):
         """Placement hook: ``kind`` ∈ {octs, cells, rep} row semantics.
@@ -233,9 +340,26 @@ class AmrSim:
                 self.maps[l] = prev_maps[l]
                 self.dev[l] = prev_dev[l]
                 continue
+            if (l in prev_maps and prev_maps[l].complete
+                    and self._keys_same(old_tree, l)):
+                # COMPLETE level with unchanged oct set: the dense
+                # permutation depends only on this level's keys — only
+                # the restriction/ok_dense maps (which read l+1) need a
+                # rebuild.  This skips the dominant host cost of the
+                # regrid (the base level's 2^(3·lmin)-cell perm).
+                m = mapmod.refresh_restriction(prev_maps[l], self.tree)
+                self.maps[l] = m
+                self.dev[l] = dict(
+                    prev_dev[l],
+                    ok_dense=(self._place(jnp.asarray(m.ok_dense), "cells")
+                              if m.ok_dense is not None else None),
+                    ref_cell=self._place(jnp.asarray(m.ref_cell), "rep"),
+                    son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
+                )
+                continue
             m = mapmod.build_level_maps(
                 self.tree, l, self.bc_kinds,
-                noct_pad=self._noct_pad(self.tree.noct(l)))
+                noct_pad=self._noct_pad(l, self.tree.noct(l)))
             self.maps[l] = m
             valid_cell = np.repeat(m.valid_oct, 2 ** self.tree.ndim)
             if m.complete:
@@ -295,6 +419,7 @@ class AmrSim:
         for l in self.levels():
             self.u[l] = self._ic_state(l)
         self._restrict_all()
+        self._dt_cache = None
 
     def _init_refine(self):
         """Iterative initial mesh build (``amr/init_refine.f90:5-154``):
@@ -328,28 +453,29 @@ class AmrSim:
     # ------------------------------------------------------------------
     # refinement
     # ------------------------------------------------------------------
+    def _criteria_flags(self, spec: FusedSpec):
+        """Device tuple of per-level gradient criteria flags — the
+        solver-specific half of ``flag_fine`` (subclass hook)."""
+        r = self.params.refine
+        eg = (float(r.err_grad_d), float(r.err_grad_u),
+              float(r.err_grad_p))
+        fls = (float(r.floor_d), float(r.floor_u), float(r.floor_p))
+        return _fused_flags(self.u, self.dev, spec, eg, fls,
+                            int(self.params.refine.interpol_type))
+
     def _flag_and_tree(self) -> Octree:
         r = self.params.refine
+        spec = self._fused_spec()
+        flags = jax.device_get(self._criteria_flags(spec))  # ONE trip
         crit: Dict[int, np.ndarray] = {}
-        for l in self.levels():
-            d = self.dev[l]
+        for fl, l in zip(flags, spec.levels):
             m = self.maps[l]
-            eg = (float(r.err_grad_d), float(r.err_grad_u),
-                  float(r.err_grad_p))
-            fls = (float(r.floor_d), float(r.floor_u), float(r.floor_p))
-            if m.complete:
-                fl = K.dense_refine_flags(
-                    self.u[l], d["inv_perm"], d["perm"], eg, fls,
-                    (1 << l,) * self.cfg.ndim, self.bspec, self.cfg)
-            else:
-                interp = self._interp_for(l)
-                fl = K.refine_flags(
-                    self.u[l], interp, d["stencil_src"], d["vsgn"], eg, fls,
-                    self.cfg)
             fl = np.asarray(fl)[:m.noct].reshape(-1)   # flat-cell order
-            geo = flagmod.geometry_flags(
-                self.tree.cell_centers(l, self.boxlen), l, self.params)
-            crit[l] = fl | geo
+            i = l - 1                                  # 1-based level lists
+            if i < len(r.r_refine) and r.r_refine[i] > 0.0:
+                fl = fl | flagmod.geometry_flags(
+                    self.tree.cell_centers(l, self.boxlen), l, self.params)
+            crit[l] = fl
         with self.timers.section("regrid: tree build"):
             return flagmod.compute_new_tree(self.tree, crit, self.bc_kinds,
                                             self.params)
@@ -376,50 +502,59 @@ class AmrSim:
         self.timers.timer("regrid: migrate")
         twotondim = 2 ** self.cfg.ndim
         offs = cell_offsets(self.cfg.ndim)
+        self._mig_log = {}
         new_u: Dict[int, jnp.ndarray] = {}
         for l in self.levels():
             m = self.maps[l]
-            if l == self.lmin or self._keys_same(oldtree, l):
-                # identical oct set (and identical padded layout): reuse
+            if (l == self.lmin or self._keys_same(oldtree, l)) \
+                    and old_u[l].shape[0] == m.ncell_pad:
+                # identical oct set and identical padded layout: reuse
                 new_u[l] = old_u[l]
                 continue
             cd, cs, new_octs, f_cell, nb = mapmod.build_prolong_maps(
                 self.tree, oldtree, l, self.bc_kinds)
-            # Host-side migration: eager device scatters here would have
-            # continuously varying shapes (cd/new_octs counts change
-            # every regrid), each a fresh XLA compile; numpy fancy
-            # indexing + one bucketed device interpolation avoids that.
-            buf = np.zeros((m.ncell_pad, self.cfg.nvar), dtype=np.float64)
-            if len(cd):
-                old_np = np.asarray(old_u[l])
-                rows_d = (cd[:, None] * twotondim
-                          + np.arange(twotondim)[None, :]).reshape(-1)
-                rows_s = (cs[:, None] * twotondim
-                          + np.arange(twotondim)[None, :]).reshape(-1)
-                buf[rows_d] = old_np[rows_s]
-            if len(new_octs):
-                # one interpolation request per (new oct, child cell),
-                # padded to a bucketed request count (stable jit shapes)
-                nn = len(new_octs)
-                sgn = (offs * 2 - 1).astype(np.float64)  # [2^d, ndim]
-                nreq = nn * twotondim
-                npad = mapmod.bucket(nreq, 8)
-                cell_rep = np.zeros(npad, dtype=np.int64)
-                cell_rep[:nreq] = np.repeat(f_cell, twotondim)
-                nb_rep = np.zeros((npad, self.cfg.ndim, 2), dtype=np.int64)
-                nb_rep[:nreq] = np.repeat(nb, twotondim, axis=0)
-                sgn_rep = np.ones((npad, self.cfg.ndim))
-                sgn_rep[:nreq] = np.tile(sgn, (nn, 1))
-                vals = K.interp_cells(
-                    new_u[l - 1], jnp.asarray(cell_rep),
-                    jnp.asarray(nb_rep),
-                    jnp.asarray(sgn_rep, dtype=self.dtype), self.cfg,
-                    itype=int(self.params.refine.interpol_type))
-                rows = (new_octs[:, None] * twotondim
-                        + np.arange(twotondim)[None, :]).reshape(-1)
-                buf[rows] = np.asarray(vals)[:nreq]
-            new_u[l] = self._place(jnp.asarray(buf, dtype=self.dtype),
-                                   "cells")
+            # Device-side migration with bucket-padded index maps: no
+            # whole-level host round-trips, and jit shapes only change
+            # when a bucket boundary is crossed.
+            ncopy = len(cd) * twotondim
+            nnew = len(new_octs) * twotondim
+            cpad = mapmod.bucket(max(ncopy, 1), 1024)
+            npad = mapmod.bucket(max(nnew, 1), 1024)
+            rows_d = np.full(cpad, m.ncell_pad, dtype=np.int64)   # drop
+            rows_s = np.zeros(cpad, dtype=np.int64)
+            if ncopy:
+                rows_d[:ncopy] = (cd[:, None] * twotondim
+                                  + np.arange(twotondim)).reshape(-1)
+                rows_s[:ncopy] = (cs[:, None] * twotondim
+                                  + np.arange(twotondim)).reshape(-1)
+            cell_rep = np.zeros(npad, dtype=np.int64)
+            nb_rep = np.zeros((npad, self.cfg.ndim, 2), dtype=np.int64)
+            sgn_rep = np.ones((npad, self.cfg.ndim))
+            rows_new = np.full(npad, m.ncell_pad, dtype=np.int64)  # drop
+            if nnew:
+                sgn = (offs * 2 - 1).astype(np.float64)   # [2^d, ndim]
+                cell_rep[:nnew] = np.repeat(f_cell, twotondim)
+                nb_rep[:nnew] = np.repeat(nb, twotondim, axis=0)
+                sgn_rep[:nnew] = np.tile(sgn, (len(new_octs), 1))
+                rows_new[:nnew] = (new_octs[:, None] * twotondim
+                                   + np.arange(twotondim)).reshape(-1)
+            old = old_u.get(l)
+            if old is None:
+                old = jnp.zeros((1, new_u[l - 1].shape[1]), self.dtype)
+            rows_d = jnp.asarray(rows_d)
+            rows_s = jnp.asarray(rows_s)
+            cell_rep = jnp.asarray(cell_rep)
+            sgn_dev = jnp.asarray(sgn_rep, dtype=self.dtype)
+            rows_new = jnp.asarray(rows_new)
+            if self._needs_mig_log:
+                self._mig_log[l] = (rows_d, rows_s, cell_rep, sgn_dev,
+                                    rows_new, m.ncell_pad, new_octs,
+                                    f_cell)
+            new_u[l] = self._place(_migrate_level(
+                old, new_u[l - 1], rows_d, rows_s, cell_rep,
+                jnp.asarray(nb_rep), sgn_dev, rows_new, m.ncell_pad,
+                self.cfg,
+                int(self.params.refine.interpol_type)), "cells")
         self.u = new_u
         # prune stale gravity state: a level whose bucketed size changed
         # (or that vanished) must not seed the next solve's warm start
@@ -430,6 +565,7 @@ class AmrSim:
                 self.fg.pop(l, None)
                 self.poisson_iters.pop(l, None)
         self._restrict_all()
+        self._dt_cache = None          # u changed: stale CFL dt
         self.timers.stop()
 
     def _restrict_all(self):
@@ -466,8 +602,13 @@ class AmrSim:
 
     def coarse_dt(self) -> float:
         with self.timers.section("courant"):
-            dts = [float(d) for d in np.asarray(
-                _fused_courant(self.u, self.dev, self._fused_spec()))]
+            if self._dt_cache is not None:
+                # emitted by the previous fused step (dtnew bookkeeping):
+                # u is unchanged since, so this IS the current CFL dt
+                dts = [float(self._dt_cache)]
+            else:
+                dts = [float(jnp.min(_fused_courant(
+                    self.u, self.dev, self._fused_spec())))]
             if self.pic:
                 from ramses_tpu.pm import particles as pmod
                 cf = float(self.cfg.courant_factor)
@@ -605,7 +746,7 @@ class AmrSim:
                 self.p = pmod.kick(self.p, f_at_p,
                                    0.5 * (self.dt_old + float(dt)))
         with self.timers.section("hydro - godunov"):
-            self.u = _fused_coarse_step(
+            self.u, self._dt_cache = _fused_coarse_step(
                 self.u, self.dev, self.fg if self.gravity else {},
                 jnp.asarray(float(dt), self.dtype), self._fused_spec())
         if self.pic:
@@ -617,12 +758,52 @@ class AmrSim:
         self.dt_old = float(dt)
         self.nstep += 1
 
+    def step_chunk(self, nsteps: int, tend: float) -> int:
+        """Run up to ``nsteps`` hydro-only coarse steps in ONE device
+        dispatch (``_fused_multi_step``); returns steps done.  Callers
+        guarantee no regrid is due inside the chunk."""
+        assert not self.gravity and not self.pic
+        spec = self._fused_spec()
+        tdtype = jnp.result_type(float)
+        if self._dt_cache is not None:
+            dt0 = jnp.asarray(self._dt_cache, tdtype)
+        else:
+            dt0 = jnp.min(_fused_courant(self.u, self.dev, spec)) \
+                .astype(tdtype)
+        with self.timers.section("hydro - godunov"):
+            u, t, dtn, ndone = _fused_multi_step(
+                self.u, self.dev, jnp.asarray(self.t, tdtype),
+                jnp.asarray(tend, tdtype), dt0, spec, nsteps)
+            self.u = u
+            self._dt_cache = dtn
+        self.t = float(t)
+        n = int(ndone)
+        self.nstep += n
+        self.dt_old = float(dtn)
+        return n
+
     def evolve(self, tend: float, nstepmax: int = 10 ** 9,
                verbose: bool = False):
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
             if self.regrid_interval and \
                     self.nstep % self.regrid_interval == 0:
                 self.regrid()
+            # chunk until the next regrid / nstepmax boundary: hydro-only
+            # steps need no host work in between, so they run as one
+            # fused multi-step program
+            if self.regrid_interval:
+                to_regrid = self.regrid_interval \
+                    - self.nstep % self.regrid_interval
+            else:
+                to_regrid = 1 << 30
+            # cap: bounds compiled-scan length AND the post-tend no-op
+            # tail (masked steps still execute inside the scan)
+            chunk = min(to_regrid, nstepmax - self.nstep, 64)
+            if not self.gravity and not self.pic and not verbose \
+                    and chunk > 1:
+                if self.step_chunk(chunk, tend) == 0:
+                    break
+                continue
             dt = min(self.coarse_dt(), tend - self.t)
             self.step_coarse(dt)
             if verbose:
@@ -632,6 +813,12 @@ class AmrSim:
     # ------------------------------------------------------------------
     # diagnostics
     # ------------------------------------------------------------------
+    def drain(self):
+        """Hard device sync: fetch one row per level.  (On tunneled
+        devices ``block_until_ready`` can return before completion;
+        a host fetch cannot.)"""
+        jax.device_get([self.u[l][:1, 0] for l in self.levels()])
+
     def totals(self):
         """Conservation audit over leaf cells (``check_cons``)."""
         cfg = self.cfg
@@ -690,6 +877,7 @@ class AmrSim:
             out[:m.noct * ttd] = cells[np.argsort(pos)].reshape(-1, cfg.nvar)
             sim.u[l] = jnp.asarray(out, dtype=dtype)
         sim._restrict_all()
+        sim._dt_cache = None
         sim.t = float(meta["t"])
         sim.nstep = int(meta["nstep"])
         return sim

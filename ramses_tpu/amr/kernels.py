@@ -160,6 +160,7 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
     refined-face flux zeroing.  Returns du over the flat level rows.
     """
     from ramses_tpu.grid import boundary as bmod
+    from ramses_tpu.hydro import pallas_muscl as pk
 
     nd, nvar = cfg.ndim, cfg.nvar
     ncell = 1
@@ -167,6 +168,16 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
         ncell *= s
     ud = u_flat[inv_perm]                              # dense row order
     ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)  # [nvar, *shape]
+    if pk.kernel_available(cfg, shape, bc.faces, ud.dtype):
+        # fused TPU kernel path (same physics, VMEM-resident pipeline);
+        # refined-face flux zeroing rides in as the mask input
+        ok = ok_dense.reshape(shape) if ok_dense is not None else None
+        up, okp = pk.pad_xy(ud, bc, cfg, ok=ok)
+        un = pk.fused_step_padded(up, dt, cfg, dx, shape, ok_pad=okp)
+        du_rows = jnp.moveaxis(un - ud, 0, -1).reshape(ncell, nvar)[perm]
+        if u_flat.shape[0] > ncell:
+            du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
+        return du_rows
     up = bmod.pad(ud, bc, cfg, muscl.NGHOST)
     flux, _tmp = muscl.unsplit(up, None, dt, (dx,) * nd, cfg)
     if ok_dense is not None:

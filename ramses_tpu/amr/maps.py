@@ -86,15 +86,26 @@ def _pad_rows(a: np.ndarray, n: int, fill=0) -> np.ndarray:
 
 def _restriction_maps(tree: Octree, lvl: int):
     """upload_fine source/target maps: (nref, nref_pad, ref_cell, son_oct,
-    refined_mask-or-None)."""
+    refined_mask-or-None).
+
+    Built from the FINE level's oct list (every lvl+1 oct covers exactly
+    one lvl cell), O(noct(lvl+1)) instead of a lookup over every lvl
+    cell — the regrid hot path."""
     if not tree.has(lvl + 1):
         return 0, 8, np.full(8, -1, dtype=np.int32), \
             np.zeros(8, dtype=np.int32), None
-    rmask = tree.refined_mask(lvl)
-    ref_idx = np.nonzero(rmask)[0]
-    son = tree.lookup(lvl + 1, tree.cell_coords(lvl)[ref_idx])
+    ndim = tree.ndim
+    twotondim = 1 << ndim
+    ref_all = tree.son_parent_cells(lvl)       # flat lvl cell per son oct
+    son_all = np.nonzero(ref_all >= 0)[0]
+    ref_idx = ref_all[son_all]
+    order = np.argsort(ref_idx, kind="stable")  # deterministic map order
+    ref_idx = ref_idx[order]
+    son = son_all[order]                        # son octs in tree order
     nref = len(ref_idx)
     nref_pad = bucket(nref, 8)
+    rmask = np.zeros(tree.noct(lvl) * twotondim, dtype=bool)
+    rmask[ref_idx] = True
     return nref, nref_pad, _pad_rows(ref_idx.astype(np.int32), nref_pad, -1), \
         _pad_rows(son.astype(np.int32), nref_pad), rmask
 
@@ -285,6 +296,22 @@ def _build_complete_level_maps(tree: Octree, lvl: int, noct: int,
         nref=nref, nref_pad=nref_pad, ref_cell=ref_cell, son_oct=son_oct,
         valid_oct=valid_oct, complete=True,
         perm=perm.astype(np.int64), inv_perm=inv_perm, ok_dense=ok_dense)
+
+
+def refresh_restriction(m: LevelMaps, tree: Octree) -> LevelMaps:
+    """New LevelMaps with only the lvl+1-dependent parts rebuilt
+    (restriction targets + dense refined mask) — used when a COMPLETE
+    level's own oct set is unchanged across a regrid."""
+    from dataclasses import replace
+
+    nref, nref_pad, ref_cell, son_oct, rmask = _restriction_maps(tree,
+                                                                 m.lvl)
+    ok_dense = None
+    if rmask is not None and m.perm is not None:
+        ok_dense = np.zeros(len(m.perm), dtype=bool)
+        ok_dense[m.perm] = rmask
+    return replace(m, nref=nref, nref_pad=nref_pad, ref_cell=ref_cell,
+                   son_oct=son_oct, ok_dense=ok_dense)
 
 
 def build_prolong_maps(tree_new: Octree, tree_old: Octree, lvl: int,

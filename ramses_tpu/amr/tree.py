@@ -124,13 +124,28 @@ class Octree:
         dx = boxlen / (1 << lvl)
         return (self.cell_coords(lvl) + 0.5) * dx
 
+    def son_parent_cells(self, lvl: int) -> np.ndarray:
+        """Flat lvl-cell index covered by each lvl+1 oct (tree order),
+        -1 where the parent oct is missing (2:1 violation)."""
+        og1 = self.levels[lvl + 1].og
+        f_oct = self.lookup(lvl, og1 >> 1)
+        off = np.zeros(len(og1), dtype=np.int64)
+        for d in range(self.ndim):
+            off = off * 2 + (og1[:, d] & 1)
+        return np.where(f_oct >= 0, f_oct * (1 << self.ndim) + off, -1)
+
     def refined_mask(self, lvl: int) -> np.ndarray:
-        """Bool [ncell_flat]: cell has a son oct at lvl+1."""
-        cc = self.cell_coords(lvl)
-        son = self.lookup(lvl + 1, cc) if self.has(lvl + 1) else None
-        if son is None:
-            return np.zeros(len(cc), dtype=bool)
-        return son >= 0
+        """Bool [ncell_flat]: cell has a son oct at lvl+1.
+
+        Built from the fine level's oct coords (each lvl+1 oct marks
+        exactly one lvl cell): O(noct(lvl+1)), not O(ncell(lvl))."""
+        ncell = self.noct(lvl) * (1 << self.ndim)
+        out = np.zeros(ncell, dtype=bool)
+        if not self.has(lvl + 1):
+            return out
+        rows = self.son_parent_cells(lvl)
+        out[rows[rows >= 0]] = True
+        return out
 
 
 def cell_offsets(ndim: int) -> np.ndarray:

@@ -39,25 +39,12 @@ class UniformGrid:
         return n
 
 
-# Read once at import: jit caches are keyed on static args, not the
-# environment, so a post-import toggle would silently hit stale caches.
-_NO_PALLAS = bool(__import__("os").environ.get("RAMSES_NO_PALLAS"))
-
-
 def _pallas_ok(grid: UniformGrid, dtype) -> bool:
     """True when the fused Pallas TPU kernel covers this grid."""
-    if _NO_PALLAS:
-        return False
-    if jax.default_backend() != "tpu" or grid.cfg.ndim != 3:
-        return False
-    # the kernel has no GSPMD partitioning rule: the multi-chip sharded
-    # path (parallel/sharded.py) must keep the XLA solver so the SPMD
-    # partitioner can insert halo collectives
-    if jax.device_count() != 1:
+    if grid.cfg.ndim != 3:
         return False
     from ramses_tpu.hydro import pallas_muscl as pk
-    kinds = tuple((lo.kind, hi.kind) for lo, hi in grid.bc.faces)
-    return pk.supports(grid.cfg, grid.shape, kinds, dtype)
+    return pk.kernel_available(grid.cfg, grid.shape, grid.bc.faces, dtype)
 
 
 @partial(jax.jit, static_argnames=("grid",))

@@ -35,6 +35,23 @@ from ramses_tpu.hydro.core import HydroStatic
 
 NG = 2  # ghost cells per side (matches muscl.NGHOST)
 
+# Read once at import: jit caches are keyed on static args, not the
+# environment, so a post-import toggle would silently hit stale caches.
+DISABLED = bool(__import__("os").environ.get("RAMSES_NO_PALLAS"))
+
+
+def kernel_available(cfg: HydroStatic, shape, bc_faces, dtype) -> bool:
+    """Full availability gate: env kill-switch, TPU backend, single
+    device (the kernel has no GSPMD partitioning rule — sharded runs
+    must keep the XLA solver so the SPMD partitioner can insert halo
+    collectives), and configuration coverage."""
+    if DISABLED:
+        return False
+    if jax.default_backend() != "tpu" or jax.device_count() != 1:
+        return False
+    kinds = tuple((lo.kind, hi.kind) for lo, hi in bc_faces)
+    return supports(cfg, shape, kinds, dtype)
+
 
 def supports(cfg: HydroStatic, shape, bc_kinds, dtype) -> bool:
     """True when the fused kernel covers this configuration.
@@ -291,11 +308,12 @@ def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
     return kernel
 
 
-@partial(jax.jit, static_argnames=("cfg", "dx", "shape", "courant"))
+@partial(jax.jit,
+         static_argnames=("cfg", "dx", "shape", "courant", "interpret"))
 def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
                       shape: Tuple[int, int, int],
                       ok_pad: Optional[jnp.ndarray] = None,
-                      courant: bool = False):
+                      courant: bool = False, interpret: bool = False):
     """Run the fused kernel on an x/y-ghost-padded state.
 
     u_pad: [5, nx+4, ny+8, nz] from :func:`pad_xy` (x: 2-cell ghosts
@@ -340,6 +358,7 @@ def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        interpret=interpret,           # CPU parity tests
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
     )(*args)

@@ -39,6 +39,9 @@ class MhdStatic:
     riemann: str = "hlld"
     riemann2d: str = "average"
     courant_factor: float = 0.8
+    # arrays carry a trailing batch axis (the AMR oct-stencil path);
+    # read by hydro.muscl._axis which the slope bank shares
+    trailing_batch: bool = False
 
     @property
     def nvar(self) -> int:

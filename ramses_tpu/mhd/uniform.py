@@ -15,7 +15,7 @@ property the reference maintains with face-B pairs + EMF arrays
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dreplace
 from functools import partial
 from typing import Sequence, Tuple
 
@@ -98,10 +98,172 @@ def _rot_perm(cfg: MhdStatic, d: int):
     return perm
 
 
-def step(grid: MhdGrid, u, bf, dt):
+def ct_core(up, bfp, dt, dx: Sequence[float], cfg: MhdStatic,
+            bax: int = 0, bn_faces=None, flux_mask=None,
+            emf_override=None):
+    """The CT MUSCL-Hancock pipeline on already-assembled arrays.
+
+    ``up`` [nvar, *sp(, batch…)] cell conservative with B slots ALREADY
+    cell-centered; ``bfp`` list of NCOMP low-face arrays (same spatial
+    shape).  ``bax`` = number of trailing batch axes (0 for the uniform
+    grid, 1 for the AMR per-oct stencil batch).  ``bn_faces``: optional
+    override of the low-face normal fields fed to the Riemann solver
+    (the AMR path prefers stored fine values on shared coarse-fine
+    faces).  ``flux_mask``: optional per-dim keep factors (0 at refined
+    faces, ``godunov_fine.f90:718`` semantics) applied to the CELL
+    update and the returned fluxes but NOT to the EMF corner average —
+    the fine region's state is restriction-overwritten while its edge
+    EMFs stay whole-level consistent.  Spatial shifts are ``jnp.roll``
+    — callers guarantee enough ghost/stencil margin that
+    wrap-contaminated entries are never read from the region they keep.
+
+    Returns (un, bfn_list, fluxes, e_edges) where ``e_edges[(d1,d2)]``
+    is the final corner EMF field of that staggered pair (the quantity
+    the AMR coarse-fine matching averages, ``mhd/godunov_fine.f90:826``).
+    """
+    nd = cfg.ndim
+
+    def ax_(d, a):
+        return a.ndim - nd - bax + d
+
+    q = core.ctoprim(up, cfg)
+    # the slope bank infers spatial axes from cfg: flag the batch axis
+    scfg = dreplace(cfg, trailing_batch=True) if bax else cfg
+    dq = _slopes(q, scfg)
+
+    # conservative Hancock half-step: the cell's own reconstructed faces
+    du_half = jnp.zeros_like(up)
+    face_q = []
+    for d in range(nd):
+        q_hi = q + 0.5 * dq[d]
+        q_lo = q - 0.5 * dq[d]
+        f_hi = core.flux_along(q_hi, d, cfg)
+        f_lo = core.flux_along(q_lo, d, cfg)
+        du_half = du_half - (0.5 * dt / dx[d]) * (f_hi - f_lo)
+        face_q.append((q_lo, q_hi))
+
+    # half-dt prediction of the staggered field (edge-averaged cell EMFs),
+    # so the Riemann normal field is time-centred like its other inputs —
+    # the role of the reference's induction terms in trace3d
+    # (``mhd/umuscl.f90`` magnetic predictor)
+    base_faces = bn_faces if bn_faces is not None else bfp
+    bf_half = [base_faces[c] for c in range(NCOMP)]
+    for d1 in range(nd):
+        for d2 in range(d1 + 1, nd):
+            ax1 = ax_(d1, bfp[d1])
+            ax2 = ax_(d2, bfp[d1])
+            sig = 1.0 if (d1, d2) in ((0, 1), (1, 2), (2, 0)) else -1.0
+            v1, v2 = q[1 + d1], q[1 + d2]
+            b1, b2 = q[IBX + d1], q[IBX + d2]
+            e_c0 = sig * (v2 * b1 - v1 * b2)
+            e_edge0 = 0.25 * (e_c0 + jnp.roll(e_c0, 1, axis=ax1)
+                              + jnp.roll(e_c0, 1, axis=ax2)
+                              + jnp.roll(jnp.roll(e_c0, 1, axis=ax1),
+                                         1, axis=ax2))
+            bf_half[d1] = bf_half[d1] - sig * (0.5 * dt / dx[d2]) * (
+                jnp.roll(e_edge0, -1, axis=ax2) - e_edge0)
+            bf_half[d2] = bf_half[d2] + sig * (0.5 * dt / dx[d1]) * (
+                jnp.roll(e_edge0, -1, axis=ax1) - e_edge0)
+
+    fluxes = []
+    for d in range(nd):
+        ax = ax_(d, q)
+        q_lo, q_hi = face_q[d]
+        ul_c = core.prim_to_cons(q_hi, cfg) + du_half    # this cell's hi face
+        ur_c = core.prim_to_cons(q_lo, cfg) + du_half    # this cell's lo face
+        ql = core.ctoprim(jnp.roll(ul_c, 1, axis=ax), cfg)
+        qr = core.ctoprim(ur_c, cfg)
+        perm = jnp.array(_rot_perm(cfg, d))
+        bn = bf_half[d]                # staggered, half-dt predicted
+        fg = rsolve.solve(ql[perm], qr[perm], bn, cfg)
+        # scatter to state layout
+        out = [None] * cfg.nvar
+        t1, t2 = (d + 1) % 3, (d + 2) % 3
+        out[0] = fg[0]
+        out[1 + d], out[1 + t1], out[1 + t2] = fg[1], fg[2], fg[3]
+        out[IP] = fg[4]
+        out[IBX + d], out[IBX + t1], out[IBX + t2] = fg[5], fg[6], fg[7]
+        for s in range(cfg.npassive):
+            out[8 + s] = fg[8 + s]
+        fluxes.append(jnp.stack(out))
+
+    # conservative update of cell state (staggered B rows excluded)
+    if flux_mask is not None:
+        fl_cell = [fluxes[d] * flux_mask[d][None] for d in range(nd)]
+    else:
+        fl_cell = fluxes
+    un = up
+    for d in range(nd):
+        ax = ax_(d, up)
+        un = un + (dt / dx[d]) * (fl_cell[d]
+                                  - jnp.roll(fl_cell[d], -1, axis=ax))
+    # half-step primitives for the cell-centered EMF reference
+    q_half = core.ctoprim(up + du_half, cfg)
+
+    # CT induction on staggered components.  The base is the SAME
+    # face-value selection the Riemann solver saw (bn_faces): on the AMR
+    # stencil path this keeps every cell's own (lo, hi) pair evolving
+    # from its own stored values, so per-cell divB is preserved exactly
+    # even where duplicated faces disagree across a coarse-fine seam.
+    bfn = [base_faces[c] for c in range(NCOMP)]
+    e_edges = {}
+    for d1 in range(nd):
+        for d2 in range(d1 + 1, nd):
+            # axes on the scalar (no component dim) EMF arrays
+            ax1 = ax_(d1, bfp[d1])
+            ax2 = ax_(d2, bfp[d1])
+            # face EMFs: E_e on d1-faces and d2-faces
+            sig = 1.0 if (d1, d2) in ((0, 1), (1, 2), (2, 0)) else -1.0
+            # F_d1(B_d2) = -sig*E_e ; F_d2(B_d1) = +sig*E_e
+            e_f1 = -sig * fluxes[d1][IBX + d2]           # at (lo d1, ctr d2)
+            e_f2 = sig * fluxes[d2][IBX + d1]            # at (ctr d1, lo d2)
+            # cell-centered reference EMF from half-step state
+            v1, v2 = q_half[1 + d1], q_half[1 + d2]
+            b1, b2 = q_half[IBX + d1], q_half[IBX + d2]
+            e_c = sig * (v2 * b1 - v1 * b2)              # E_e = -(v×B)_e
+            # Gardiner & Stone (2005) arithmetic corner average
+            e_edge = (0.5 * (e_f1 + jnp.roll(e_f1, 1, axis=ax2)
+                             + e_f2 + jnp.roll(e_f2, 1, axis=ax1))
+                      - 0.25 * (e_c + jnp.roll(e_c, 1, axis=ax1)
+                                + jnp.roll(e_c, 1, axis=ax2)
+                                + jnp.roll(jnp.roll(e_c, 1, axis=ax1),
+                                           1, axis=ax2)))
+            if emf_override is not None and (d1, d2) in emf_override:
+                # coarse-fine EMF matching (godunov_fine.f90:826-973):
+                # edges covered by a refined oct take the time-averaged
+                # fine EMF, so the coarse induction lands EXACTLY on the
+                # restriction of the fine faces
+                msk, vals = emf_override[(d1, d2)]
+                e_edge = jnp.where(msk, vals.astype(e_edge.dtype), e_edge)
+            e_edges[(d1, d2)] = e_edge
+            # dB_d1/dt = -sig * dE_e/d_d2 ; dB_d2/dt = +sig * dE_e/d_d1
+            bfn[d1] = bfn[d1] - sig * (dt / dx[d2]) * (
+                jnp.roll(e_edge, -1, axis=ax2) - e_edge)
+            bfn[d2] = bfn[d2] + sig * (dt / dx[d1]) * (
+                jnp.roll(e_edge, -1, axis=ax1) - e_edge)
+
+    # degenerate (cell-centered) components advance with the conservative
+    # flux update; without this they would be frozen at their ICs
+    for c in range(nd, NCOMP):
+        bfn[c] = un[IBX + c]
+    # refresh cell-centered staggered B components from the new faces
+    bc_new = []
+    for c in range(min(nd, NCOMP)):
+        b = bfn[c]
+        bc_new.append(0.5 * (b + jnp.roll(b, -1, axis=ax_(c, b))))
+    for c in range(min(nd, NCOMP)):
+        un = un.at[IBX + c].set(bc_new[c])
+    return un, bfn, fl_cell, e_edges
+
+
+def step(grid: MhdGrid, u, bf, dt, ok=None, emf_override=None):
     """One CT MUSCL-Hancock step.  ``u`` [nvar, *sp] cell conservative
     (B slots cell-centered, derived), ``bf`` [3, *sp] staggered low-face
-    field.  Returns (u', bf')."""
+    field.  ``ok``: optional refined-cell mask — faces touching a
+    refined cell get zero cell-state flux (AMR complete-level path).
+    ``emf_override``: dict (d1,d2) → (mask, values) on the ACTIVE grid's
+    cell-corner lattice — coarse-fine EMF matching.
+    Returns (u', bf')."""
     cfg = grid.cfg
     nd = cfg.ndim
     dx = (grid.dx,) * nd
@@ -126,112 +288,23 @@ def step(grid: MhdGrid, u, bf, dt):
             bc.append(lo)
     up = up.at[IBX:IBX + NCOMP].set(jnp.stack(bc))
 
-    q = core.ctoprim(up, cfg)
-    dq = _slopes(q, cfg)
-
-    # conservative Hancock half-step: the cell's own reconstructed faces
-    du_half = jnp.zeros_like(up)
-    face_q = []
-    for d in range(nd):
-        q_hi = q + 0.5 * dq[d]
-        q_lo = q - 0.5 * dq[d]
-        f_hi = core.flux_along(q_hi, d, cfg)
-        f_lo = core.flux_along(q_lo, d, cfg)
-        du_half = du_half - (0.5 * dt / dx[d]) * (f_hi - f_lo)
-        face_q.append((q_lo, q_hi))
-
-    # half-dt prediction of the staggered field (edge-averaged cell EMFs),
-    # so the Riemann normal field is time-centred like its other inputs —
-    # the role of the reference's induction terms in trace3d
-    # (``mhd/umuscl.f90`` magnetic predictor)
-    bf_half = [bfp[c] for c in range(NCOMP)]
-    for d1 in range(nd):
-        for d2 in range(d1 + 1, nd):
-            ax1 = bfp[d1].ndim - nd + d1
-            ax2 = bfp[d1].ndim - nd + d2
-            sig = 1.0 if (d1, d2) in ((0, 1), (1, 2), (2, 0)) else -1.0
-            v1, v2 = q[1 + d1], q[1 + d2]
-            b1, b2 = q[IBX + d1], q[IBX + d2]
-            e_c0 = sig * (v2 * b1 - v1 * b2)
-            e_edge0 = 0.25 * (e_c0 + jnp.roll(e_c0, 1, axis=ax1)
-                              + jnp.roll(e_c0, 1, axis=ax2)
-                              + jnp.roll(jnp.roll(e_c0, 1, axis=ax1),
-                                         1, axis=ax2))
-            bf_half[d1] = bf_half[d1] - sig * (0.5 * dt / dx[d2]) * (
-                jnp.roll(e_edge0, -1, axis=ax2) - e_edge0)
-            bf_half[d2] = bf_half[d2] + sig * (0.5 * dt / dx[d1]) * (
-                jnp.roll(e_edge0, -1, axis=ax1) - e_edge0)
-
-    fluxes = []
-    for d in range(nd):
-        ax = _axis(nd, d, q)
-        q_lo, q_hi = face_q[d]
-        ul_c = core.prim_to_cons(q_hi, cfg) + du_half    # this cell's hi face
-        ur_c = core.prim_to_cons(q_lo, cfg) + du_half    # this cell's lo face
-        ql = core.ctoprim(jnp.roll(ul_c, 1, axis=ax), cfg)
-        qr = core.ctoprim(ur_c, cfg)
-        perm = jnp.array(_rot_perm(cfg, d))
-        bn = bf_half[d]                # staggered, half-dt predicted
-        fg = rsolve.solve(ql[perm], qr[perm], bn, cfg)
-        # scatter to state layout
-        out = [None] * cfg.nvar
-        t1, t2 = (d + 1) % 3, (d + 2) % 3
-        out[0] = fg[0]
-        out[1 + d], out[1 + t1], out[1 + t2] = fg[1], fg[2], fg[3]
-        out[IP] = fg[4]
-        out[IBX + d], out[IBX + t1], out[IBX + t2] = fg[5], fg[6], fg[7]
-        for s in range(cfg.npassive):
-            out[8 + s] = fg[8 + s]
-        fluxes.append(jnp.stack(out))
-
-    # conservative update of cell state (staggered B rows excluded)
-    un = up
-    for d in range(nd):
-        ax = _axis(nd, d, up)
-        un = un + (dt / dx[d]) * (fluxes[d] - jnp.roll(fluxes[d], -1, axis=ax))
-    # half-step primitives for the cell-centered EMF reference
-    q_half = core.ctoprim(up + du_half, cfg)
-
-    # CT induction on staggered components
-    bfn = [bfp[c] for c in range(NCOMP)]
-    for d1 in range(nd):
-        for d2 in range(d1 + 1, nd):
-            e = 3 - d1 - d2 if nd == 3 else [c for c in range(3)
-                                             if c not in (d1, d2)][0]
-            # axes on the scalar (no component dim) EMF arrays
-            ax1 = bfp[d1].ndim - nd + d1
-            ax2 = bfp[d1].ndim - nd + d2
-            # face EMFs: E_e on d1-faces and d2-faces
-            sig = 1.0 if (d1, d2) in ((0, 1), (1, 2), (2, 0)) else -1.0
-            # F_d1(B_d2) = -sig*E_e ; F_d2(B_d1) = +sig*E_e
-            e_f1 = -sig * fluxes[d1][IBX + d2]           # at (lo d1, ctr d2)
-            e_f2 = sig * fluxes[d2][IBX + d1]            # at (ctr d1, lo d2)
-            # cell-centered reference EMF from half-step state
-            v1, v2 = q_half[1 + d1], q_half[1 + d2]
-            b1, b2 = q_half[IBX + d1], q_half[IBX + d2]
-            e_c = sig * (v2 * b1 - v1 * b2)              # E_e = -(v×B)_e
-            # Gardiner & Stone (2005) arithmetic corner average
-            e_edge = (0.5 * (e_f1 + jnp.roll(e_f1, 1, axis=ax2)
-                             + e_f2 + jnp.roll(e_f2, 1, axis=ax1))
-                      - 0.25 * (e_c + jnp.roll(e_c, 1, axis=ax1)
-                                + jnp.roll(e_c, 1, axis=ax2)
-                                + jnp.roll(jnp.roll(e_c, 1, axis=ax1),
-                                           1, axis=ax2)))
-            # dB_d1/dt = -sig * dE_e/d_d2 ; dB_d2/dt = +sig * dE_e/d_d1
-            bfn[d1] = bfn[d1] - sig * (dt / dx[d2]) * (
-                jnp.roll(e_edge, -1, axis=ax2) - e_edge)
-            bfn[d2] = bfn[d2] + sig * (dt / dx[d1]) * (
-                jnp.roll(e_edge, -1, axis=ax1) - e_edge)
-
-    # degenerate (cell-centered) components advance with the conservative
-    # flux update; without this they would be frozen at their ICs
-    for c in range(nd, NCOMP):
-        bfn[c] = un[IBX + c]
-    # refresh cell-centered staggered B components from the new faces
-    bc_new = core.cell_center_b(bfn, nd)
-    for c in range(min(nd, NCOMP)):
-        un = un.at[IBX + c].set(bc_new[c])
-
+    flux_mask = None
+    if ok is not None:
+        okp = _pad(ok[None], nd, grid.bc_kinds)[0]
+        flux_mask = []
+        for d in range(nd):
+            ax = okp.ndim - nd + d
+            keep = ~(okp | jnp.roll(okp, 1, axis=ax))
+            flux_mask.append(keep.astype(up.dtype))
+    ovr = None
+    if emf_override is not None:
+        ovr = {}
+        for pair, (msk, vals) in emf_override.items():
+            ovr[pair] = (_pad(msk[None], nd, grid.bc_kinds)[0],
+                         _pad(vals[None], nd, grid.bc_kinds)[0])
+    un, bfn, _fluxes, _e = ct_core(up, [bfp[c] for c in range(NCOMP)],
+                                   dt, dx, cfg, flux_mask=flux_mask,
+                                   emf_override=ovr)
     u_out = _unpad(un, nd)
     bf_out = jnp.stack([_unpad(b, nd) for b in bfn])
     return u_out, bf_out

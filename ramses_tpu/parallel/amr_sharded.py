@@ -48,12 +48,14 @@ class ShardedAmrSim(AmrSim):
             particles = jax.device_put(particles, self._rep_sharding)
         super().__init__(params, dtype=dtype, particles=particles)
 
-    def _noct_pad(self, noct: int) -> int:
-        """Bucketed oct count rounded to a multiple of the device count
-        (shardable rows; cells stay 2^d-aligned automatically)."""
-        b = bucket(noct)
+    def _noct_pad(self, lvl: int, noct: int) -> int:
+        """Bucketed oct count (with the base class's hysteresis) rounded
+        to a multiple of the device count (shardable rows; cells stay
+        2^d-aligned automatically)."""
+        b = super()._noct_pad(lvl, noct)
         if b % self.ndev:
             b += self.ndev - (b % self.ndev)
+            self._pad_hist[lvl] = b
         return b
 
     def _place(self, arr, kind: str):

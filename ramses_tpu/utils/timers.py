@@ -16,14 +16,22 @@ from typing import Dict, Optional
 
 
 class Timers:
-    def __init__(self):
+    def __init__(self, sync=None):
         self.acc: Dict[str, float] = {}
         self.count: Dict[str, int] = {}
         self._label: Optional[str] = None
         self._t0 = 0.0
+        # Optional device-drain callable invoked at every label switch.
+        # Async dispatch misattributes device time to whichever section
+        # happens to block next; with ``sync`` set, each section pays for
+        # exactly the work it enqueued (use for instrumented runs only —
+        # draining costs a device round-trip per switch).
+        self.sync = sync
 
     def timer(self, label: str):
         """Switch the active label (accumulates the previous one)."""
+        if self.sync is not None and self._label is not None:
+            self.sync()
         now = time.perf_counter()
         if self._label is not None:
             self.acc[self._label] = self.acc.get(self._label, 0.0) \
