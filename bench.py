@@ -134,6 +134,19 @@ def bench_amr(params, dtype, jnp):
     sim.evolve(1e9, nstepmax=sim.nstep + nss)
     sim.drain()
     wss = time.perf_counter() - t0
+
+    # run-to-run determinism: the same 3 steps from the same state must
+    # be BITWISE identical on this device (north-star "bitwise-stable")
+    import numpy as np
+    u_saved = dict(sim.u)
+    dt_saved, t_saved, n_saved = sim._dt_cache, sim.t, sim.nstep
+    sim.evolve(1e9, nstepmax=sim.nstep + 3)
+    run1 = {l: np.asarray(sim.u[l]) for l in sim.levels()}
+    sim.u, sim._dt_cache, sim.t, sim.nstep = (dict(u_saved), dt_saved,
+                                              t_saved, n_saved)
+    sim.evolve(1e9, nstepmax=sim.nstep + 3)
+    bitwise = all(run1[l].tobytes() == np.asarray(sim.u[l]).tobytes()
+                  for l in sim.levels())
     return {
         "config": f"sedov3d AMR levelmin={lmin} levelmax={lmax}",
         # headline: all-in growth phase (every regrid + recompile cost)
@@ -150,6 +163,7 @@ def bench_amr(params, dtype, jnp):
             "mus_per_cell_update": 1e6 * wss / (nss * upd1),
             "steps": nss, "wall_s": wss,
         },
+        "bitwise_repeatable": bool(bitwise),
     }
 
 

@@ -29,6 +29,10 @@ def main(argv=None) -> int:
                          "variable); default: mhd when &INIT_PARAMS sets "
                          "A/B/C_region, hydro otherwise")
     ap.add_argument("--verbose", "-v", action="store_true")
+    ap.add_argument("--walltime", type=float, default=None,
+                    help="wall-clock budget in hours; the watchdog dumps "
+                         "a restartable snapshot and stops before it "
+                         "expires (amr/adaptive_loop.f90:216-226)")
     args = ap.parse_args(argv)
 
     import jax.numpy as jnp
@@ -43,6 +47,12 @@ def main(argv=None) -> int:
         solver = ("mhd" if any(params.init.A_region) or
                   any(params.init.B_region) or any(params.init.C_region)
                   else "hydro")
+
+    def make_guard(sim):
+        from ramses_tpu.utils.ops import OpsGuard
+        return OpsGuard(sim, params.output.output_dir,
+                        walltime_s=(args.walltime * 3600.0
+                                    if args.walltime else None))
 
     if solver == "rhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
@@ -63,22 +73,44 @@ def main(argv=None) -> int:
         else:
             from ramses_tpu.mhd.driver import MhdSimulation
             sim = MhdSimulation(params, dtype=dtype)
-            sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
+            sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose,
+                       guard=make_guard(sim))
             sim.dump(1, params.output.output_dir,
                      namelist_path=args.namelist)
     elif args.amr or params.amr.levelmax > params.amr.levelmin:
         from ramses_tpu.amr.hierarchy import AmrSim
-        sim = AmrSim(params, dtype=dtype)
-        tend = (params.output.tout[-1] if params.output.tout
-                else params.output.tend)
-        sim.evolve(tend, nstepmax=params.run.nstepmax, verbose=args.verbose)
+        particles = None
+        dense = None
+        if (params.run.cosmo and params.init.initfile
+                and params.init.filetype in ("grafic", "gadget")):
+            from ramses_tpu.driver import load_cosmo_ics
+            from ramses_tpu.hydro.core import HydroStatic
+            from ramses_tpu.pm.cosmology import Cosmology
+            cosmo = Cosmology.from_params(params)
+            n = 2 ** params.amr.levelmin
+            particles, dense = load_cosmo_ics(
+                params, cosmo, HydroStatic.from_params(params),
+                (n,) * params.ndim)
+        sim = AmrSim(params, dtype=dtype, particles=particles,
+                     init_dense_u=dense)
+        if sim.cosmo is not None and params.output.aout:
+            tend = float(sim.cosmo.tau_of_aexp(
+                min(params.output.aout[-1], 1.0)))
+        else:
+            tend = (params.output.tout[-1] if params.output.tout
+                    else params.output.tend)
+        sim.evolve(tend, nstepmax=params.run.nstepmax,
+                   verbose=args.verbose, guard=make_guard(sim))
+        if sim.cosmo is not None:
+            print(f"cosmo-amr aexp={sim.aexp_now():.4f} nstep={sim.nstep} "
+                  f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
         sim.dump(1, params.output.output_dir, namelist_path=args.namelist)
     else:
         from ramses_tpu.driver import Simulation
         sim = Simulation(params, dtype=dtype)
         sim.on_output = lambda s, i: s.dump(
             i, namelist_path=args.namelist)
-        sim.evolve(verbose=args.verbose)
+        sim.evolve(verbose=args.verbose, guard=make_guard(sim))
     return 0
 
 

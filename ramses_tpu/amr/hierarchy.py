@@ -43,6 +43,28 @@ class _Cfg1:
         self.ndim = ndim
 
 
+def _sample_dense_periodic(dense: np.ndarray, x01: np.ndarray) -> np.ndarray:
+    """Periodic multilinear interpolation of a cell-centred dense field
+    ``[nvar, n, n, …]`` at unit-box positions ``x01 [npts, ndim]`` —
+    used to seed refined levels from base-resolution IC grids."""
+    nvar = dense.shape[0]
+    nd = x01.shape[1]
+    n = dense.shape[1]
+    g = x01 * n - 0.5
+    i0 = np.floor(g).astype(np.int64)
+    w1 = g - i0
+    out = np.zeros((nvar, len(x01)))
+    for corner in range(1 << nd):
+        idx = []
+        w = np.ones(len(x01))
+        for d in range(nd):
+            bit = (corner >> d) & 1
+            idx.append(np.mod(i0[:, d] + bit, n))
+            w = w * (w1[:, d] if bit else 1.0 - w1[:, d])
+        out += dense[(slice(None),) + tuple(idx)] * w
+    return out
+
+
 class FusedSpec(NamedTuple):
     """Static description of one coarse step's level structure — the jit
     cache key for :func:`_fused_coarse_step` (hashable; re-derived per
@@ -230,7 +252,7 @@ class AmrSim:
 
     def __init__(self, params: Params, dtype=jnp.float32,
                  init_tree: Optional[Octree] = None,
-                 particles=None):
+                 particles=None, init_dense_u=None):
         self.params = params
         self.cfg = HydroStatic.from_params(params)
         self.dtype = dtype
@@ -248,6 +270,16 @@ class AmrSim:
         # rebuild, so nremap maps onto its interval (>=1).
         self.regrid_interval = max(1, int(getattr(params.run, "nremap", 0)))
         self.timers = Timers()
+        # cosmology: supercomoving conformal-time integration
+        # (``amr/update_time.f90``; aexp/hexp from the Friedmann tables)
+        self.cosmo = None
+        if bool(params.run.cosmo):
+            from ramses_tpu.pm.cosmology import Cosmology
+            self.cosmo = Cosmology.from_params(params)
+            self.t = float(self.cosmo.tau_ini)
+        # dense base-grid gas ICs (grafic baryons) sampled per level
+        self._init_dense = (np.asarray(init_dense_u)
+                            if init_dense_u is not None else None)
         # self-gravity (per-level Poisson, SURVEY.md §3.3)
         self.gravity = bool(params.run.poisson)
         if self.gravity:
@@ -258,6 +290,7 @@ class AmrSim:
         self.phi: Dict[int, jnp.ndarray] = {}
         self.fg: Dict[int, jnp.ndarray] = {}
         self.poisson_iters: Dict[int, jnp.ndarray] = {}
+        self._rho_dev: Dict[int, jnp.ndarray] = {}
         # particle-mesh layer
         self.p = particles
         self.pic = bool(params.run.pic) and particles is not None
@@ -401,13 +434,42 @@ class AmrSim:
                     g_valid=self._place(jnp.asarray(g.valid_cell),
                                         "cells"))
 
+    # ------------------------------------------------------------------
+    # cosmology helpers (host interpolation of the Friedmann tables)
+    # ------------------------------------------------------------------
+    def aexp_now(self) -> float:
+        if self.cosmo is None:
+            return 1.0
+        return float(np.interp(self.t, self.cosmo.tau_frw,
+                               self.cosmo.axp_frw))
+
+    def hexp_now(self) -> float:
+        if self.cosmo is None:
+            return 0.0
+        return float(np.interp(self.t, self.cosmo.tau_frw,
+                               self.cosmo.hexp_frw))
+
+    def grav_coeff(self) -> float:
+        """Poisson source coefficient: 4π, or the supercomoving
+        ``1.5·Ωm·aexp`` (``poisson/multigrid_fine_commons.f90`` rhs)."""
+        if self.cosmo is None:
+            return self.fourpi
+        return 1.5 * self.cosmo.omega_m * self.aexp_now()
+
     def _ic_state(self, lvl: int) -> jnp.ndarray:
-        """Analytic conservative ICs on this level's (padded) cells."""
+        """Analytic conservative ICs on this level's (padded) cells, or
+        periodic-trilinear samples of a dense IC grid (grafic baryons)."""
         m = self.maps[lvl]
-        centers = self.tree.cell_centers(lvl, self.boxlen)
-        x = [centers[:, d] for d in range(self.cfg.ndim)]
-        q = regions.region_condinit(x, self.dx(lvl), self.params, self.cfg)
-        u = regions.prim_to_cons(q, self.cfg)          # [nvar, ncell]
+        if self._init_dense is not None:
+            centers = self.tree.cell_centers(lvl, self.boxlen)
+            u = _sample_dense_periodic(
+                self._init_dense, centers / self.boxlen)  # [nvar, ncell]
+        else:
+            centers = self.tree.cell_centers(lvl, self.boxlen)
+            x = [centers[:, d] for d in range(self.cfg.ndim)]
+            q = regions.region_condinit(x, self.dx(lvl), self.params,
+                                        self.cfg)
+            u = regions.prim_to_cons(q, self.cfg)      # [nvar, ncell]
         out = np.zeros((m.ncell_pad, self.cfg.nvar))
         out[:u.shape[1]] = u.T
         out[u.shape[1]:, 0] = self.cfg.smallr
@@ -475,6 +537,27 @@ class AmrSim:
             if i < len(r.r_refine) and r.r_refine[i] > 0.0:
                 fl = fl | flagmod.geometry_flags(
                     self.tree.cell_centers(l, self.boxlen), l, self.params)
+            if self.pic and i < len(r.m_refine) and r.m_refine[i] >= 0.0:
+                # quasi-Lagrangian refinement (``flag_utils.f90``
+                # m_refine): flag cells holding more than m_refine mean
+                # particle masses.  Use the gravity solve's cached total
+                # density when available; deposit on demand otherwise
+                # (m_refine must not silently require poisson=.true.)
+                rho_dev = self._rho_dev.get(l)
+                if rho_dev is None or rho_dev.shape[0] < len(fl):
+                    if not self._pm_dev:
+                        self._build_pm()
+                    if l in self._pm_dev:
+                        rho_dev = (self.u[l][:, 0]
+                                   + self._pm_rho(l).astype(
+                                       self.u[l].dtype))
+                if rho_dev is not None and rho_dev.shape[0] >= len(fl):
+                    mp = float(jnp.sum(self.p.m * self.p.active)) \
+                        / max(int(jnp.sum(self.p.active)), 1)
+                    thr = r.m_refine[i] * mp \
+                        / self.dx(l) ** self.tree_ndim
+                    rho_np = np.asarray(rho_dev)[:len(fl)]
+                    fl = fl | (rho_np > thr)
             crit[l] = fl
         with self.timers.section("regrid: tree build"):
             return flagmod.compute_new_tree(self.tree, crit, self.bc_kinds,
@@ -564,6 +647,7 @@ class AmrSim:
                 self.phi.pop(l, None)
                 self.fg.pop(l, None)
                 self.poisson_iters.pop(l, None)
+                self._rho_dev.pop(l, None)
         self._restrict_all()
         self._dt_cache = None          # u changed: stale CFL dt
         self.timers.stop()
@@ -622,7 +706,11 @@ class AmrSim:
                     # free-fall cap from the previous step's deposited
                     # density (one step lagged; pm/newdt_fine.f90:51-60)
                     dts.append(float(pmod.freefall_dt(
-                        jnp.asarray(self._rho_max), cf, self.fourpi)))
+                        jnp.asarray(self._rho_max), cf,
+                        self.grav_coeff())))
+            if self.cosmo is not None:
+                # expansion cap (amr/update_time.f90 cosmo branch)
+                dts.append(0.1 / abs(self.hexp_now()))
             return min(dts)
 
     # ------------------------------------------------------------------
@@ -674,6 +762,7 @@ class AmrSim:
         from ramses_tpu.poisson.solver import fft_solve
 
         nd = self.cfg.ndim
+        coeff = self.grav_coeff()
         # mean density over leaves + particles (periodic solvability)
         mtot = float(self.totals()[0])
         if self.pic:
@@ -687,10 +776,11 @@ class AmrSim:
             rho = self.u[l][:, 0]
             if self.pic:
                 rho = rho + self._pm_rho(l).astype(rho.dtype)
+                self._rho_dev[l] = rho     # m_refine criterion input
                 mx = jnp.max(rho)
                 rho_max = mx if rho_max is None else jnp.maximum(rho_max,
                                                                  mx)
-            rhs = self.fourpi * (rho - rho_mean)
+            rhs = coeff * (rho - rho_mean)
             if m.complete:
                 # whole-box level: exact periodic FFT solve on the dense
                 # grid, force by central-difference rolls
@@ -783,8 +873,17 @@ class AmrSim:
         return n
 
     def evolve(self, tend: float, nstepmax: int = 10 ** 9,
-               verbose: bool = False):
+               verbose: bool = False, guard=None):
+        """Advance to ``tend``.  ``guard``: optional
+        :class:`ramses_tpu.utils.ops.OpsGuard` — signal/walltime/stop-file
+        handling + the per-``ncontrol`` screen block."""
+        ncontrol = max(1, int(self.params.run.ncontrol))
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
+            if guard is not None:
+                if not guard.check():
+                    break
+                if self.nstep % ncontrol == 0:
+                    print(guard.screen_block())
             if self.regrid_interval and \
                     self.nstep % self.regrid_interval == 0:
                 self.regrid()
@@ -800,7 +899,7 @@ class AmrSim:
             # tail (masked steps still execute inside the scan)
             chunk = min(to_regrid, nstepmax - self.nstep, 64)
             if not self.gravity and not self.pic and not verbose \
-                    and chunk > 1:
+                    and self.cosmo is None and chunk > 1:
                 if self.step_chunk(chunk, tend) == 0:
                     break
                 continue
@@ -846,12 +945,14 @@ class AmrSim:
     # snapshot / restart (SURVEY.md §3.4, §5.4)
     # ------------------------------------------------------------------
     def dump(self, iout: int = 1, base_dir: str = ".",
-             namelist_path: Optional[str] = None) -> str:
-        """Write a reference-format ``output_NNNNN/`` snapshot."""
+             namelist_path: Optional[str] = None, ncpu: int = 1) -> str:
+        """Write a reference-format ``output_NNNNN/`` snapshot
+        (``ncpu > 1``: one file set per domain — multi-domain
+        checkpoint restorable onto any device count)."""
         from ramses_tpu.io import snapshot as snapmod
         snap = snapmod.snapshot_from_amr(self, iout)
         return snapmod.dump_all(snap, iout, base_dir,
-                                namelist_path=namelist_path)
+                                namelist_path=namelist_path, ncpu=ncpu)
 
     @classmethod
     def from_snapshot(cls, params: Params, outdir: str,

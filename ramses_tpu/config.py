@@ -182,13 +182,23 @@ class PoissonParams:
 
 @dataclass
 class RtParams:
-    """&RT_PARAMS (rt/rt_init.f90:151-152), reduced to the implemented
-    single-group M1 surface."""
+    """&RT_PARAMS (rt/rt_init.f90:151-152) + the group/SED surface of
+    ``rt/rt_parameters.f90`` (nGroups, group energy bounds, stellar
+    blackbody SED) and a point-source shortcut (the reference injects
+    via stellar particles or &RT_REGIONS; ``rt_src_*`` is the reduced
+    single-source form the Stromgren tests use)."""
     rt_c_fraction: float = 0.01
     rt_courant_factor: float = 0.8
     rt_otsa: bool = True
     rt_nsubcycle: int = 1
     rt_is_outflow_bound: bool = False
+    rt_ngroups: int = 1
+    rt_t_star: float = 1e5            # blackbody SED temperature [K]
+    rt_y_he: float = 0.0              # helium mass fraction in the chem
+    rt_egy_bounds: List[float] = field(
+        default_factory=lambda: [13.60, 1000.0])
+    rt_src_pos: List[float] = field(default_factory=lambda: [0.5, 0.5, 0.5])
+    rt_ndot: float = 0.0              # source photons/s (0: no source)
 
 
 @dataclass

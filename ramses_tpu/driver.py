@@ -38,6 +38,38 @@ class SimState:
     dt_old: float = 0.0            # previous step (split particle kick)
 
 
+def load_cosmo_ics(params, cosmo, cfg, shape):
+    """(ParticleSet, gas u [nvar, *shape] | None) from the namelist's
+    ``initfile``/``filetype`` (``amr/init_time.f90:303-414`` init_file)."""
+    from ramses_tpu.pm import init_part as ip
+
+    path = params.init.initfile[0]
+    want_gas = bool(params.run.hydro)
+    if params.init.filetype == "grafic":
+        x, v, m, ghdr = ip.particles_from_grafic(
+            path, cosmo, omega_b=(cosmo.omega_b if want_gas else None))
+        u0 = None
+        if want_gas:
+            dense, _ = ip.baryons_from_grafic(path, cosmo, cfg.gamma,
+                                              cosmo.omega_b)
+            if dense.shape[1:] != tuple(shape):
+                raise ValueError(
+                    f"grafic grid {dense.shape[1:]} != run grid {shape} "
+                    "(levelmin must match the IC resolution)")
+            u0 = np.zeros((cfg.nvar,) + tuple(shape))
+            u0[:dense.shape[0]] = dense
+        if abs(ghdr.astart - cosmo.aexp_ini) > 1e-3 * ghdr.astart:
+            import warnings
+            warnings.warn(f"grafic astart={ghdr.astart} != namelist "
+                          f"aexp_ini={cosmo.aexp_ini}; file wins for "
+                          "displacements, namelist for the time axis")
+    else:
+        x, v, m, _ = ip.particles_from_gadget(path, cosmo)
+        u0 = None
+    p = ParticleSet.make(jnp.asarray(x), jnp.asarray(v), jnp.asarray(m))
+    return p, u0
+
+
 class Simulation:
     """Single-level simulation (SURVEY.md §7 stage 2).
 
@@ -63,11 +95,20 @@ class Simulation:
         self.bc = bmod.BoundarySpec.from_params(params)
         self.grid = UniformGrid(cfg=self.cfg, shape=shape, dx=self.dx,
                                 bc=self.bc)
-        u0 = condinit(shape, self.dx, params, self.cfg)
-        self.state = SimState(u=jnp.asarray(u0, dtype=dtype))
         self.pspec = PMSpec.from_params(params)
         self.cosmo = (Cosmology.from_params(params) if params.run.cosmo
                       else None)
+        # cosmological IC files (grafic/gadget): particles + baryons
+        # (init_part.f90 / init_flow_fine.f90 'file' branches)
+        u0 = None
+        if (self.cosmo is not None and params.init.initfile
+                and params.init.filetype in ("grafic", "gadget")
+                and particles is None):
+            particles, u0 = load_cosmo_ics(params, self.cosmo, self.cfg,
+                                           shape)
+        if u0 is None:
+            u0 = condinit(shape, self.dx, params, self.cfg)
+        self.state = SimState(u=jnp.asarray(u0, dtype=dtype))
         if self.pspec.enabled:
             self.state.p = particles if particles is not None else \
                 ParticleSet.make(jnp.zeros((0, params.ndim)),
@@ -140,6 +181,15 @@ class Simulation:
         self.turb_spec = TurbSpec.from_params(params)
         self.turb = (TurbForcing(shape, self.turb_spec)
                      if self.turb_spec.enabled else None)
+        # radiative transfer in the driver (rt=.true.): subcycled M1 +
+        # thermochemistry against the live gas (amr_step.f90:594-672)
+        self.rt = None
+        if params.run.rt:
+            from ramses_tpu.rt.coupling import RtCoupled
+            from ramses_tpu.units import units as units_fn
+            self.rt = RtCoupled(params, self.grid,
+                                units_fn(params, cosmo=self.cosmo),
+                                self.state.u)
         if self.sf_spec.enabled and not self.pspec.enabled:
             import dataclasses as _dc
             self.pspec = _dc.replace(self.pspec, enabled=True)
@@ -161,8 +211,10 @@ class Simulation:
             return self.output_times[-1]
         return float("inf")
 
-    def evolve(self, chunk: int = 16, verbose: bool = False):
-        """Run to the final output time, firing outputs on the way."""
+    def evolve(self, chunk: int = 16, verbose: bool = False, guard=None):
+        """Run to the final output time, firing outputs on the way.
+        ``guard``: optional :class:`ramses_tpu.utils.ops.OpsGuard`
+        (signal dumps, stop_run file, walltime watchdog)."""
         st = self.state
         nstepmax = self.params.run.nstepmax
         # Time is integrated in f64 (f32 if x64 is disabled) regardless of
@@ -174,8 +226,17 @@ class Simulation:
             # time, so a relative factor on tout would flip direction
             ttol = 1e-12 * (abs(tout) + 1.0)
             while st.t < tout - ttol and st.nstep < nstepmax:
+                if guard is not None and not guard.check():
+                    return st
                 n = min(chunk, nstepmax - st.nstep)
                 t_before = st.t
+                if self.rt is not None and self.params.run.static:
+                    # frozen gas: pure RT evolution to the output time
+                    # (the reference's static Stromgren tests)
+                    st.u = self.rt.advance(st.u, tout - st.t)
+                    st.t = tout
+                    st.nstep += 1
+                    continue
                 t0 = time.perf_counter()
                 if (self.pspec.enabled or self.gspec.enabled
                         or self.cosmo is not None):
@@ -202,6 +263,8 @@ class Simulation:
                 st.u, st.t, st.nstep = u, float(t), st.nstep + ndone
                 self.cell_updates += ndone * self.grid.ncell
                 self._source_passes(st.t - t_before)
+                if self.rt is not None and st.t > t_before:
+                    st.u = self.rt.advance(st.u, st.t - t_before)
                 if verbose:
                     mus_pt = (1e6 * self.wall_s / max(self.cell_updates, 1))
                     print(f"step {st.nstep:6d}  t={st.t:.6e} "

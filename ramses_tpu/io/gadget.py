@@ -1,0 +1,89 @@
+"""Gadget-1 snapshot reader/writer (dark-matter initial conditions).
+
+Reference: ``pm/gadgetreadfile.f90`` (gadgetreadheader/gadgetreadfile,
+``:301``) used by ``pm/init_part.f90`` when ``filetype='gadget'``.
+Layout (SnapFormat=1, little-endian Fortran records):
+
+  HEAD  : 256 bytes — npart[6] int32, mass[6] float64, time, redshift,
+          flags…, npartTotal[6], …, BoxSize, Omega0, OmegaLambda,
+          HubbleParam (float64)
+  POS   : 3·N float32 (kpc/h comoving, Gadget convention)
+  VEL   : 3·N float32 (km/s · sqrt(a): Gadget internal)
+  ID    : N int32/uint32
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass
+class GadgetHeader:
+    npart: Tuple[int, ...] = (0, 0, 0, 0, 0, 0)
+    mass: Tuple[float, ...] = (0.0,) * 6   # 1e10 Msun/h per particle
+    time: float = 1.0                      # scale factor for cosmo ICs
+    redshift: float = 0.0
+    boxsize: float = 0.0                   # kpc/h comoving
+    omega0: float = 1.0
+    omega_l: float = 0.0
+    hubble: float = 0.7                    # h
+
+
+def _rec(f) -> bytes:
+    n = struct.unpack("<i", f.read(4))[0]
+    data = f.read(n)
+    if struct.unpack("<i", f.read(4))[0] != n:
+        raise IOError("gadget: corrupted record markers")
+    return data
+
+
+def _wrec(f, payload: bytes):
+    f.write(struct.pack("<i", len(payload)))
+    f.write(payload)
+    f.write(struct.pack("<i", len(payload)))
+
+
+def read_gadget(path: str):
+    """(header, pos [N,3] float64 kpc/h, vel [N,3] float64 km/s·√a,
+    ids [N]) — all particle types concatenated (DM ICs carry type 1)."""
+    with open(path, "rb") as f:
+        raw = _rec(f)
+        if len(raw) != 256:
+            raise IOError(f"gadget: header record is {len(raw)} bytes")
+        npart = struct.unpack("<6i", raw[0:24])
+        mass = struct.unpack("<6d", raw[24:72])
+        time, redshift = struct.unpack("<2d", raw[72:88])
+        # flag_sfr, flag_feedback (2i, 88:96), npartTotal (6i, 96:120),
+        # flag_cooling, num_files (2i, 120:128)
+        boxsize, omega0, omega_l, hubble = struct.unpack(
+            "<4d", raw[128:160])
+        n = sum(npart)
+        pos = np.frombuffer(_rec(f), dtype="<f4").reshape(n, 3)
+        vel = np.frombuffer(_rec(f), dtype="<f4").reshape(n, 3)
+        ids = np.frombuffer(_rec(f), dtype="<u4")
+    hdr = GadgetHeader(npart, mass, time, redshift, boxsize, omega0,
+                       omega_l, hubble)
+    return hdr, pos.astype(np.float64), vel.astype(np.float64), ids
+
+
+def write_gadget(path: str, hdr: GadgetHeader, pos: np.ndarray,
+                 vel: np.ndarray, ids: np.ndarray):
+    """SnapFormat=1 writer (tests + IC tooling)."""
+    with open(path, "wb") as f:
+        raw = struct.pack("<6i", *hdr.npart)
+        raw += struct.pack("<6d", *hdr.mass)
+        raw += struct.pack("<2d", hdr.time, hdr.redshift)
+        raw += struct.pack("<2i", 0, 0)
+        raw += struct.pack("<6i", *hdr.npart)      # npartTotal
+        raw += struct.pack("<2i", 0, 1)            # flag_cooling, numfiles
+        raw += struct.pack("<4d", hdr.boxsize, hdr.omega0, hdr.omega_l,
+                           hdr.hubble)
+        raw += b"\x00" * (256 - len(raw))
+        _wrec(f, raw)
+        _wrec(f, np.ascontiguousarray(pos, dtype="<f4").tobytes())
+        _wrec(f, np.ascontiguousarray(vel, dtype="<f4").tobytes())
+        _wrec(f, np.ascontiguousarray(ids, dtype="<u4").tobytes())

@@ -21,28 +21,32 @@ def restore_tree_state(outdir: str, cfg, levelmin: int):
     """(tree_levels, u_levels, meta): per-level oct coords and conservative
     cell arrays (our x-slowest flat order) for levels >= levelmin."""
     snap = rdr.load_snapshot(outdir)
-    if len(snap["amr"]) != 1:
-        raise NotImplementedError(
-            f"restart from multi-cpu snapshots (ncpu={len(snap['amr'])}) "
-            "is not wired yet; domains would be silently dropped")
-    amr = snap["amr"][0]
-    hyd = snap["hydro"][0]
-    h = amr.header
+    ncpu = len(snap["amr"])
+    h = snap["amr"][0].header
     ndim = h["ndim"]
     perm = ref_cell_perm(ndim)
     inv = np.argsort(perm)                  # our off → ref ind
 
+    # concatenate every domain's levels (``init_amr``'s multi-cpu read:
+    # each file holds its own contiguous key range, any count merges)
     tree_og: Dict[int, np.ndarray] = {}
     u_lv: Dict[int, np.ndarray] = {}
-    for l, lev in amr.levels.items():
+    for l in sorted({lv for amr in snap["amr"] for lv in amr.levels}):
         if l < levelmin:
             continue
         scale = 2.0 ** (l - 1)
-        og = np.rint(lev["xg"] * scale - 0.5).astype(np.int64)
-        tree_og[l] = og
-        vals = hyd["levels"][l]             # [n, 2^d, nvar] ref order
-        ours = vals[:, inv]                 # [n, 2^d] our order
-        q = ours.reshape(-1, vals.shape[2])
+        ogs, qs = [], []
+        for amr, hyd in zip(snap["amr"], snap["hydro"]):
+            lev = amr.levels.get(l)
+            if lev is None or len(lev["xg"]) == 0:
+                continue
+            ogs.append(np.rint(lev["xg"] * scale - 0.5).astype(np.int64))
+            vals = hyd["levels"][l]         # [n, 2^d, nvar] ref order
+            qs.append(vals[:, inv])         # our cell order
+        if not ogs:
+            continue
+        tree_og[l] = np.concatenate(ogs)
+        q = np.concatenate(qs).reshape(-1, qs[0].shape[2])
         u_lv[l] = prim_out_to_cons(q, cfg)
     meta = dict(t=h["t"], nstep=h["nstep"], iout=h["iout"],
                 aexp=h["aexp"], boxlen=h["boxlen"],
@@ -50,7 +54,18 @@ def restore_tree_state(outdir: str, cfg, levelmin: int):
                 dtnew=h["dtnew"], info=snap["info"])
     parts = None
     if "part" in snap:
-        parts = snap["part"][0]
+        # concatenate array fields across domains; scalar header
+        # entries (ncpu, npart, nstar_tot, …) come from file 1 with the
+        # count totals recomputed
+        first = snap["part"][0]
+        parts = {}
+        for k, v in first.items():
+            if isinstance(v, np.ndarray):
+                parts[k] = np.concatenate([p[k] for p in snap["part"]])
+            else:
+                parts[k] = v
+        if "npart" in first:
+            parts["npart"] = sum(int(p["npart"]) for p in snap["part"])
         parts["fields"] = snap["part_fields"]
     return tree_og, u_lv, meta, parts
 

@@ -372,12 +372,14 @@ def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
             hydro=hyd.reshape(noct, 1 << ndim, -1)[:, perm])
 
     un = units_fn(params)
+    parts = (particles_dict(sim.p)
+             if getattr(sim, "p", None) is not None else None)
     return Snapshot(
         ndim=ndim, nlevelmax=lmax, levels=levels,
         boxlen=sim.boxlen, t=float(sim.t), gamma=cfg.gamma,
         var_names=hydro_var_names(cfg), units=un, levelmin=lmin,
         nstep=int(sim.nstep), nstep_coarse=int(sim.nstep),
-        tout=[params.output.tend or 0.0])
+        tout=[params.output.tend or 0.0], particles=parts)
 
 
 def particles_dict(p) -> dict:
@@ -405,8 +407,15 @@ def _fname(outdir: str, ftype: str, iout: int, icpu: int) -> str:
 
 
 def write_amr_file(path: str, snap: Snapshot, iout: int,
-                   ncpu: int = 1, icpu: int = 1) -> None:
-    """``backup_amr`` record sequence (``amr/output_amr.f90:268-393``)."""
+                   ncpu: int = 1, icpu: int = 1,
+                   partial_links: bool = False) -> None:
+    """``backup_amr`` record sequence (``amr/output_amr.f90:268-393``).
+
+    ``partial_links``: the snapshot holds only one domain's octs, so
+    father/nbor grid ids pointing into other domains cannot be
+    resolved — write 0 (the reference's null link) instead of a wrong
+    clipped lookup.  Our restart path rebuilds topology from ``xg``
+    coordinates and never reads these records."""
     ndim = snap.ndim
     nlevelmax = snap.nlevelmax
     twotondim = 1 << ndim
@@ -494,6 +503,8 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
             # father cell index
             if l == 1:
                 father = np.ones(n, dtype=np.int32)
+            elif partial_links:
+                father = np.zeros(n, dtype=np.int32)
             else:
                 pog = lv.og // 2
                 coff = lv.og - 2 * pog
@@ -510,6 +521,9 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
                 d, sgn = idir // 2, (-1 if idir % 2 == 0 else 1)
                 if l == 1:
                     frt.write_record(f, np.ones(n, dtype=np.int32))
+                    continue
+                if partial_links:
+                    frt.write_record(f, np.zeros(n, dtype=np.int32))
                     continue
                 cc = lv.og.copy()
                 cc[:, d] += sgn
@@ -545,7 +559,7 @@ def _lookup_ids(og_sorted: np.ndarray, q: np.ndarray, base: int) -> np.ndarray:
 
 
 def write_hydro_file(path: str, snap: Snapshot, desc_path: Optional[str],
-                     ncpu: int = 1) -> None:
+                     ncpu: int = 1, icpu: int = 1) -> None:
     """``backup_hydro`` record sequence (``hydro/output_hydro.f90:54-160``)."""
     ndim = snap.ndim
     twotondim = 1 << ndim
@@ -559,7 +573,8 @@ def write_hydro_file(path: str, snap: Snapshot, desc_path: Optional[str],
         frt.write_reals(f, snap.gamma)
         for l in range(1, snap.nlevelmax + 1):
             for ibound in range(ncpu):
-                lv = snap.levels.get(l)
+                # a domain's file carries data only in its own slot
+                lv = snap.levels.get(l) if ibound == icpu - 1 else None
                 ncache = lv.noct if lv is not None else 0
                 frt.write_ints(f, l)
                 frt.write_ints(f, ncache)
@@ -572,7 +587,8 @@ def write_hydro_file(path: str, snap: Snapshot, desc_path: Optional[str],
         write_descriptor(desc_path, [(v, "d") for v in snap.var_names])
 
 
-def write_grav_file(path: str, snap: Snapshot, ncpu: int = 1) -> None:
+def write_grav_file(path: str, snap: Snapshot, ncpu: int = 1,
+                    icpu: int = 1) -> None:
     """``backup_poisson`` record sequence (``poisson/output_poisson.f90``):
     header ncpu/nvar/nlevelmax/nboundary then per (level, domain)
     ilevel, ncache, and per cell slot phi + ndim force records."""
@@ -585,7 +601,7 @@ def write_grav_file(path: str, snap: Snapshot, ncpu: int = 1) -> None:
         frt.write_ints(f, 0)
         for l in range(1, snap.nlevelmax + 1):
             for ibound in range(ncpu):
-                lv = snap.levels.get(l)
+                lv = snap.levels.get(l) if ibound == icpu - 1 else None
                 ncache = lv.noct if lv is not None else 0
                 frt.write_ints(f, l)
                 frt.write_ints(f, ncache)
@@ -599,8 +615,13 @@ def write_grav_file(path: str, snap: Snapshot, ncpu: int = 1) -> None:
 
 
 def write_part_file(path: str, snap: Snapshot, desc_path: Optional[str],
-                    ncpu: int = 1) -> None:
-    """``backup_part`` record sequence (``pm/output_part.f90``)."""
+                    ncpu: int = 1,
+                    has_star: Optional[bool] = None) -> None:
+    """``backup_part`` record sequence (``pm/output_part.f90``).
+
+    ``has_star`` must be decided from the FULL particle set when
+    writing multi-domain files — a per-domain decision would make the
+    record layout disagree with the shared descriptor."""
     p = snap.particles
     ndim = snap.ndim
     npart = len(p["m"])
@@ -617,7 +638,8 @@ def write_part_file(path: str, snap: Snapshot, desc_path: Optional[str],
     fields.append(("levelp", np.asarray(p["level"], dtype=np.int32), "i"))
     fields.append(("family", np.asarray(p["family"], dtype=np.int8), "b"))
     fields.append(("tag", np.asarray(p["tag"], dtype=np.int8), "b"))
-    has_star = bool(np.any(p["family"] == 2)) or np.any(p.get("tp", 0))
+    if has_star is None:
+        has_star = bool(np.any(p["family"] == 2)) or np.any(p.get("tp", 0))
     if has_star:
         fields.append(("birth_time",
                        np.asarray(p["tp"], dtype=np.float64), "d"))
@@ -701,26 +723,73 @@ def write_header_file(path: str, snap: Snapshot) -> None:
         f.write("pos vel mass iord level family tag \n")
 
 
+def split_snapshot(snap: Snapshot, ncpu: int) -> List[Snapshot]:
+    """Split into ``ncpu`` per-domain snapshots: each level's octs cut
+    into ``ncpu`` contiguous equal row ranges of the Morton/Hilbert
+    storage order — the row-sharded device layout IS the domain
+    decomposition (``parallel/amr_sharded.py``), so a sharded run's
+    checkpoint writers each own exactly their shard
+    (``amr/output_amr.f90:256-400``'s per-cpu files, token ring
+    replaced by independent writers).  Particles split the same way."""
+    from dataclasses import replace
+
+    def _ranges(n):
+        edges = np.linspace(0, n, ncpu + 1).round().astype(int)
+        return list(zip(edges[:-1], edges[1:]))
+
+    out = []
+    p = snap.particles
+    pranges = _ranges(len(p["m"])) if p is not None else None
+    for k in range(ncpu):
+        levels = {}
+        for l, lv in snap.levels.items():
+            a, b = _ranges(lv.noct)[k]
+            levels[l] = SnapLevel(
+                og=lv.og[a:b], son=lv.son[a:b], hydro=lv.hydro[a:b],
+                grav=None if lv.grav is None else lv.grav[a:b])
+        pk = None
+        if p is not None:
+            a, b = pranges[k]
+            pk = {key: val[a:b] for key, val in p.items()}
+        out.append(replace(snap, levels=levels, particles=pk))
+    return out
+
+
 def dump_all(snap: Snapshot, iout: int, base_dir: str = ".",
              namelist_path: Optional[str] = None,
-             write_grav: bool = False) -> str:
+             write_grav: bool = False, ncpu: int = 1) -> str:
     """Write ``output_NNNNN/`` with the full reference file set; returns
-    the output directory path (``dump_all``, ``amr/output_amr.f90:5-206``)."""
+    the output directory path (``dump_all``, ``amr/output_amr.f90:5-206``).
+
+    ``ncpu > 1`` writes one file set per domain (multi-domain
+    checkpoint); the restore path re-concatenates any domain count onto
+    any device count."""
     outdir = os.path.join(base_dir, f"output_{iout:05d}")
     os.makedirs(outdir, exist_ok=True)
     suffix = f"{iout:05d}"
-    write_info_file(os.path.join(outdir, f"info_{suffix}.txt"), snap)
-    write_amr_file(_fname(outdir, "amr", iout, 1), snap, iout)
-    write_hydro_file(
-        _fname(outdir, "hydro", iout, 1), snap,
-        os.path.join(outdir, "hydro_file_descriptor.txt"))
-    if write_grav or any(lv.grav is not None for lv in snap.levels.values()):
-        write_grav_file(_fname(outdir, "grav", iout, 1), snap)
+    write_info_file(os.path.join(outdir, f"info_{suffix}.txt"), snap,
+                    ncpu=ncpu)
+    parts = split_snapshot(snap, ncpu) if ncpu > 1 else [snap]
+    for icpu, sub in enumerate(parts, start=1):
+        write_amr_file(_fname(outdir, "amr", iout, icpu), sub, iout,
+                       ncpu=ncpu, icpu=icpu, partial_links=ncpu > 1)
+        write_hydro_file(
+            _fname(outdir, "hydro", iout, icpu), sub,
+            os.path.join(outdir, "hydro_file_descriptor.txt")
+            if icpu == 1 else None, ncpu=ncpu, icpu=icpu)
+        if write_grav or any(lv.grav is not None
+                             for lv in sub.levels.values()):
+            write_grav_file(_fname(outdir, "grav", iout, icpu), sub,
+                            ncpu=ncpu, icpu=icpu)
+        if snap.particles is not None and len(snap.particles["m"]) > 0:
+            pfull = snap.particles
+            has_star = bool(np.any(pfull["family"] == 2)) \
+                or bool(np.any(pfull.get("tp", 0)))
+            write_part_file(
+                _fname(outdir, "part", iout, icpu), sub,
+                os.path.join(outdir, "part_file_descriptor.txt")
+                if icpu == 1 else None, ncpu=ncpu, has_star=has_star)
     write_header_file(os.path.join(outdir, f"header_{suffix}.txt"), snap)
-    if snap.particles is not None and len(snap.particles["m"]) > 0:
-        write_part_file(
-            _fname(outdir, "part", iout, 1), snap,
-            os.path.join(outdir, "part_file_descriptor.txt"))
     if namelist_path and os.path.exists(namelist_path):
         shutil.copy(namelist_path, os.path.join(outdir, "namelist.txt"))
     return outdir

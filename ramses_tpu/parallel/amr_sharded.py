@@ -35,7 +35,8 @@ class ShardedAmrSim(AmrSim):
 
     def __init__(self, params: Params,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 dtype=jnp.float32, particles=None):
+                 dtype=jnp.float32, particles=None, init_tree=None,
+                 init_dense_u=None):
         devices = list(devices if devices is not None else jax.devices())
         self.ndev = len(devices)
         self.mesh = Mesh(np.array(devices), ("oct",))
@@ -46,7 +47,15 @@ class ShardedAmrSim(AmrSim):
             # particle rows replicate; deposits scatter into the sharded
             # level batches (GSPMD inserts the reduction collectives)
             particles = jax.device_put(particles, self._rep_sharding)
-        super().__init__(params, dtype=dtype, particles=particles)
+        super().__init__(params, dtype=dtype, particles=particles,
+                         init_tree=init_tree, init_dense_u=init_dense_u)
+
+    def dump(self, iout: int = 1, base_dir: str = ".",
+             namelist_path=None, ncpu: Optional[int] = None) -> str:
+        """Per-shard checkpoint files by default (one writer per domain,
+        the pario/§2.10 role)."""
+        return super().dump(iout, base_dir, namelist_path=namelist_path,
+                            ncpu=self.ndev if ncpu is None else ncpu)
 
     def _noct_pad(self, lvl: int, noct: int) -> int:
         """Bucketed oct count (with the base class's hysteresis) rounded

@@ -23,7 +23,9 @@ import jax.numpy as jnp
 from ramses_tpu.units import kB
 
 EV = 1.602177e-12
-E_ION_HI = 13.60 * EV
+# canonical ionization thresholds [eV] — shared with rt.spectra
+ION_EV = (13.5984, 24.5874, 54.4178)     # HI, HeI, HeII
+E_ION_HI = ION_EV[0] * EV
 
 
 @dataclass(frozen=True)
@@ -59,6 +61,113 @@ def cool_rec_B(T):
             / (1.0 + (lam / 2.25) ** 0.376) ** 3.72)
 
 
+def alpha_B_HeII(T):
+    """Case-B He+ recombination [cm^3/s] (Hui & Gnedin 1997)."""
+    lam = 2.0 * 285335.0 / jnp.maximum(T, 1.0)
+    return 1.26e-14 * lam ** 0.75
+
+
+def alpha_B_HeIII(T):
+    """Case-B He++ recombination: hydrogenic Z=2 scaling of HG97."""
+    lam = 2.0 * 631515.0 / jnp.maximum(T, 1.0)
+    return 2.0 * 2.753e-14 * lam ** 1.5 \
+        / (1.0 + (lam / 2.74) ** 0.407) ** 2.242
+
+
+def beta_ci_HeI(T):
+    T = jnp.maximum(T, 1.0)
+    return (2.38e-11 * jnp.sqrt(T) * jnp.exp(-285335.4 / T)
+            / (1.0 + jnp.sqrt(T / 1e5)))
+
+
+def beta_ci_HeII(T):
+    T = jnp.maximum(T, 1.0)
+    return (5.68e-12 * jnp.sqrt(T) * jnp.exp(-631515.0 / T)
+            / (1.0 + jnp.sqrt(T / 1e5)))
+
+
+E_ION = tuple(e * EV for e in ION_EV)
+
+
+def chem_step_3ion(Ns, xs, T, nH, nHe, dt, c_red, groups,
+                   otsa: bool = True, niter: int = 5,
+                   heating: bool = True):
+    """Multigroup, 3-ion (HII, HeII, HeIII) implicit chemistry substep —
+    the ``rt_cooling_module.f90`` system with helium.
+
+    ``Ns``: list of per-group photon densities; ``xs`` = (xHII, xHeII,
+    xHeIII) fractional abundances (of H and He respectively); ``groups``:
+    :class:`ramses_tpu.rt.spectra.Group3` tuple.  Returns (Ns', xs', T').
+    """
+    xH0, xHe20, xHe30 = [jnp.clip(x, 1e-10, 1.0 - 1e-10) for x in xs]
+    xH, xHe2, xHe3 = xH0, xHe20, xHe30
+    aH = alpha_B(T) if otsa else alpha_A(T)
+    aHe2 = alpha_B_HeII(T)
+    aHe3 = alpha_B_HeIII(T)
+
+    def densities(xH, xHe2, xHe3):
+        nHI = nH * (1.0 - xH)
+        nHeI = nHe * jnp.clip(1.0 - xHe2 - xHe3, 1e-10, 1.0)
+        nHeII = nHe * xHe2
+        ne = nH * xH + nHe * (xHe2 + 2.0 * xHe3)
+        return nHI, nHeI, nHeII, ne
+
+    for _ in range(niter):
+        nHI, nHeI, nHeII, ne = densities(xH, xHe2, xHe3)
+        # implicit absorption per group at fixed ion densities
+        Gam = [jnp.zeros_like(T) for _ in range(3)]
+        N_new = []
+        for g, N in zip(groups, Ns):
+            tau = (g.sigmaN[0] * nHI + g.sigmaN[1] * nHeI
+                   + g.sigmaN[2] * nHeII)
+            Np = N / (1.0 + dt * c_red * tau)
+            N_new.append(Np)
+            for sp in range(3):
+                Gam[sp] = Gam[sp] + c_red * g.sigmaN[sp] * Np
+        # H: (Γ + β ne)(1-x) = α ne x — implicit from the FIXED initial
+        # state, rates refined at the current guess (see chem_step)
+        creH = Gam[0] + beta_ci(T) * ne
+        xH = jnp.clip((xH0 + dt * creH) / (1.0 + dt * (creH + aH * ne)),
+                      1e-10, 1.0 - 1e-10)
+        # He ladder: HeI→HeII (Γ1+β ne), HeII→HeIII (Γ2+β ne),
+        # HeIII→HeII (α3 ne), HeII→HeI (α2 ne); linearized implicit
+        cre1 = Gam[1] + beta_ci_HeI(T) * ne
+        cre2 = Gam[2] + beta_ci_HeII(T) * ne
+        xHeI = jnp.clip(1.0 - xHe2 - xHe3, 1e-10, 1.0)
+        xHe2 = jnp.clip(
+            (xHe20 + dt * (cre1 * xHeI + aHe3 * ne * xHe3))
+            / (1.0 + dt * (cre2 + aHe2 * ne)), 1e-10, 1.0)
+        xHe3 = jnp.clip((xHe30 + dt * cre2 * xHe2)
+                        / (1.0 + dt * aHe3 * ne), 1e-10, 1.0)
+        s = xHe2 + xHe3
+        over = s > 1.0 - 1e-10
+        xHe2 = jnp.where(over, xHe2 / s * (1.0 - 1e-10), xHe2)
+        xHe3 = jnp.where(over, xHe3 / s * (1.0 - 1e-10), xHe3)
+
+    nHI, nHeI, nHeII, ne = densities(xH, xHe2, xHe3)
+    N_out = []
+    heat = jnp.zeros_like(T)
+    for g, N in zip(groups, Ns):
+        tau_sp = [g.sigmaN[0] * nHI, g.sigmaN[1] * nHeI,
+                  g.sigmaN[2] * nHeII]
+        tau = tau_sp[0] + tau_sp[1] + tau_sp[2]
+        Np = N / (1.0 + dt * c_red * tau)
+        N_out.append(Np)
+        if heating:
+            absorbed = jnp.maximum(N - Np, 0.0) / dt
+            frac = [t / jnp.maximum(tau, 1e-300) for t in tau_sp]
+            for sp in range(3):
+                heat = heat + absorbed * frac[sp] * jnp.maximum(
+                    g.e_photon - E_ION[sp], 0.0)
+    if heating:
+        cool = (cool_rec_B(T) * ne * nH * xH
+                + 1.55e-26 * T ** 0.3647 * ne * nHeII)   # He+ rec (Cen92)
+        ntot = nH * (1.0 + xH) + nHe * (1.0 + xHe2 + 2.0 * xHe3)
+        dT = dt * (heat - cool) / (1.5 * kB * jnp.maximum(ntot, 1e-30))
+        T = jnp.maximum(T + dT, 1.0)
+    return N_out, (xH, xHe2, xHe3), T
+
+
 def chem_step(N, xHII, T, nH, dt, c_red, group: GroupSpec,
               otsa: bool = True, niter: int = 5, heating: bool = True):
     """One implicitly-coupled chemistry substep.  Returns (N', x', T').
@@ -67,9 +176,13 @@ def chem_step(N, xHII, T, nH, dt, c_red, group: GroupSpec,
     ``rt_cooling_module`` order absorption → ionization → thermal),
     fixed-point iterated ``niter`` times for the x↔ne coupling.
     """
-    x = jnp.clip(xHII, 1e-10, 1.0 - 1e-10)
+    x0 = jnp.clip(xHII, 1e-10, 1.0 - 1e-10)
+    x = x0
     alpha = alpha_B(T) if otsa else alpha_A(T)
 
+    # fixed-point refinement of the IMPLICIT update: rates evaluate at
+    # the current guess, but the step always starts from x0 (iterating
+    # the update itself would compound niter timesteps of ionization)
     for _ in range(niter):
         nHI = nH * (1.0 - x)
         # implicit absorption at fixed nHI
@@ -78,8 +191,7 @@ def chem_step(N, xHII, T, nH, dt, c_red, group: GroupSpec,
         ne = nH * x
         cre = gamma + beta_ci(T) * ne
         dst = alpha * ne
-        # implicit linearized x update
-        x = jnp.clip((x + dt * cre) / (1.0 + dt * (cre + dst)),
+        x = jnp.clip((x0 + dt * cre) / (1.0 + dt * (cre + dst)),
                      1e-10, 1.0 - 1e-10)
 
     nHI = nH * (1.0 - x)

@@ -1,0 +1,88 @@
+"""RT ↔ hydro coupling on the uniform grid.
+
+The in-driver role of the reference's ``rt_step`` call chain
+(``amr/amr_step.f90:594-672``: rho/T from the hydro state → subcycled
+M1 transport + thermochemistry → photoheated temperature written back
+into the gas energy).  Unit bridging follows ``amr/units.f90`` /
+``rt/rt_init.f90``: the RT system runs in cgs, the gas in user units.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.rt.driver import RtSim, RtSpec
+from ramses_tpu.units import X_frac, mH, kB
+
+
+class RtCoupled:
+    """Owns an :class:`RtSim` whose density/temperature track the gas."""
+
+    def __init__(self, params, grid, un, u0):
+        self.params = params
+        self.grid = grid
+        self.un = un
+        spec = RtSpec.from_params(params)
+        self.spec = spec
+        x_frac = 1.0 - spec.y_he if spec.y_he > 0 else X_frac
+        self.x_frac = x_frac
+        dx_cgs = grid.dx * un.scale_l
+        nH = np.asarray(u0[0], np.float64) * un.scale_d * x_frac / mH
+        self.sim = RtSim(grid.shape, dx_cgs, spec, nH,
+                         T=self._gas_T(u0))
+        r = params.rt
+        if float(r.rt_ndot) > 0.0:
+            # rt_src_pos is in box-fraction units → cgs position
+            pos = [float(v) * dx_cgs * grid.shape[d]
+                   for d, v in enumerate(r.rt_src_pos[:spec.ndim])]
+            self.sim.point_source(pos, float(r.rt_ndot))
+
+    # ------------------------------------------------------------------
+    def _mu(self):
+        """Mean molecular weight from the current ion state."""
+        x = np.asarray(self.sim.x, np.float64)
+        y = self.spec.y_he
+        if y > 0:
+            xh2 = np.asarray(self.sim.xHe2, np.float64)
+            xh3 = np.asarray(self.sim.xHe3, np.float64)
+            denom = (1.0 - y) * (1.0 + x) + 0.25 * y * (1.0 + xh2
+                                                        + 2.0 * xh3)
+        else:
+            denom = (1.0 + x)
+        return 1.0 / np.maximum(denom, 1e-10)
+
+    def _gas_T(self, u):
+        """Temperature [K] from the conservative gas state."""
+        cfg = self.grid.cfg
+        rho = np.maximum(np.asarray(u[0], np.float64), cfg.smallr)
+        mom2 = sum(np.asarray(u[1 + d], np.float64) ** 2
+                   for d in range(cfg.ndim))
+        eint = np.asarray(u[cfg.ndim + 1], np.float64) - 0.5 * mom2 / rho
+        p = (cfg.gamma - 1.0) * np.maximum(eint, 1e-300)
+        t2 = p / rho * self.un.scale_T2          # T/mu
+        mu = self._mu() if hasattr(self, "sim") else 1.0   # neutral H
+        return np.maximum(t2 * mu, 0.1)
+
+    def advance(self, u, dt_code: float):
+        """Advance RT by ``dt_code`` (user units) against the current
+        gas and return the gas state with the photoheated energy."""
+        cfg = self.grid.cfg
+        un = self.un
+        # refresh density + temperature from the (possibly moved) gas
+        rho = np.maximum(np.asarray(u[0], np.float64), cfg.smallr)
+        self.sim.nH = jnp.asarray(rho * un.scale_d * self.x_frac / mH)
+        self.sim.T = jnp.asarray(self._gas_T(u))
+        self.sim.advance(float(dt_code) * un.scale_t)
+        if not self.spec.heating:
+            return u
+        # write the updated temperature back into the gas energy
+        T_new = np.asarray(self.sim.T, np.float64)
+        mu = self._mu()
+        p_code = rho * (T_new / mu) / un.scale_T2
+        mom2 = sum(np.asarray(u[1 + d], np.float64) ** 2
+                   for d in range(cfg.ndim))
+        e_new = p_code / (cfg.gamma - 1.0) + 0.5 * mom2 / rho
+        return u.at[cfg.ndim + 1].set(jnp.asarray(e_new, u.dtype))

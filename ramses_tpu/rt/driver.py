@@ -33,23 +33,46 @@ class RtSpec:
     heating: bool = True
     periodic: bool = True
     group: GroupSpec = field(default_factory=GroupSpec)
+    # multigroup + helium surface (rt_parameters.f90 nGroups/X,Y):
+    # SED-averaged Group3 tuple; empty → legacy single gray group
+    groups3: tuple = ()
+    y_he: float = 0.0
 
     @property
     def c_red(self) -> float:
         return self.c_fraction * C_CGS
 
+    @property
+    def full3(self) -> bool:
+        """True when the multigroup/3-ion system is active."""
+        return len(self.groups3) > 1 or self.y_he > 0.0
+
     @classmethod
     def from_params(cls, p, ndim: Optional[int] = None) -> "RtSpec":
+        from ramses_tpu.rt import spectra
         r = p.rt
+        bounds = list(r.rt_egy_bounds)
+        if len(bounds) != int(r.rt_ngroups) + 1:
+            bounds = list(spectra.DEFAULT_BOUNDS[:int(r.rt_ngroups)]) \
+                + [spectra.DEFAULT_BOUNDS[-1]]
+        groups3 = spectra.blackbody_groups(float(r.rt_t_star), bounds)
         return cls(ndim=ndim or p.ndim,
                    c_fraction=float(r.rt_c_fraction),
                    courant=float(r.rt_courant_factor),
                    otsa=bool(r.rt_otsa),
-                   periodic=not bool(r.rt_is_outflow_bound))
+                   periodic=not bool(r.rt_is_outflow_bound),
+                   groups3=groups3,
+                   y_he=float(r.rt_y_he))
 
 
 class RtSim:
-    """Standalone RT problem on a uniform grid (cgs units)."""
+    """Standalone RT problem on a uniform grid (cgs units).
+
+    Legacy mode (default spec): single gray group, H-only chemistry —
+    ``N``/``F``/``x`` are plain per-cell arrays.  With
+    ``spec.full3`` (multigroup and/or helium): ``N`` gains a leading
+    group axis, ``F`` becomes [ng, ndim, …], and the chemistry runs the
+    3-ion ladder (``xHe2``/``xHe3`` join ``x``)."""
 
     def __init__(self, shape: Sequence[int], dx: float, spec: RtSpec,
                  nH, T=None, xHII=None):
@@ -63,39 +86,85 @@ class RtSim:
                   else jnp.full(self.shape, 100.0))
         self.x = (jnp.asarray(xHII, jnp.float64) if xHII is not None
                   else jnp.full(self.shape, 1.2e-3))
-        self.N = jnp.full(self.shape, m1.SMALL_NP)
-        self.F = jnp.zeros((ndim,) + self.shape)
-        self.src = jnp.zeros(self.shape)
+        if spec.full3:
+            ng = len(spec.groups3)
+            self.N = jnp.full((ng,) + self.shape, m1.SMALL_NP)
+            self.F = jnp.zeros((ng, ndim) + self.shape)
+            self.xHe2 = jnp.full(self.shape, 1e-6)
+            self.xHe3 = jnp.full(self.shape, 1e-8)
+            self.src = jnp.zeros((ng,) + self.shape)
+        else:
+            self.N = jnp.full(self.shape, m1.SMALL_NP)
+            self.F = jnp.zeros((ndim,) + self.shape)
+            self.src = jnp.zeros(self.shape)
         self.t = 0.0
         self._step_fn = None
 
+    @property
+    def nHe(self):
+        """Helium number density from the mass fractions (X = 1 - Y)."""
+        y = self.spec.y_he
+        return self.nH * (y / (4.0 * max(1.0 - y, 1e-10)))
+
     def point_source(self, pos: Sequence[float], ndot: float):
         """Add a point source of ``ndot`` photons/s (one-cell injection,
-        the reference's cloud-smoothed stellar injection reduced)."""
+        the reference's cloud-smoothed stellar injection reduced);
+        multigroup sources split by the SED's photon-count shares."""
         idx = tuple(int(p / self.dx) for p in pos)
         vol = self.dx ** self.spec.ndim
         src = np.array(self.src)
-        src[idx] += ndot / vol
+        if self.spec.full3:
+            for g, grp in enumerate(self.spec.groups3):
+                src[(g,) + idx] += grp.frac * ndot / vol
+        else:
+            src[idx] += ndot / vol
         self.src = jnp.asarray(src)
 
     def _build_step(self):
         spec = self.spec
         dx = self.dx
 
+        if not spec.full3:
+            @partial(jax.jit, static_argnames=("nsub",))
+            def run(N, F, x, xh2, xh3, T, nH, nHe, src, dt_sub,
+                    nsub: int):
+                def body(carry, _):
+                    N, F, x, T = carry
+                    N = N + dt_sub * src
+                    N, F = m1.transport_step(N, F, dt_sub, dx, spec.c_red,
+                                             spec.ndim, spec.periodic)
+                    N, x, T = chem_mod.chem_step(
+                        N, x, T, nH, dt_sub, spec.c_red, spec.group,
+                        spec.otsa, heating=spec.heating)
+                    return (N, F, x, T), None
+                (N, F, x, T), _ = jax.lax.scan(body, (N, F, x, T), None,
+                                               length=nsub)
+                return N, F, x, xh2, xh3, T
+            return run
+
+        groups = spec.groups3
+        ng = len(groups)
+
         @partial(jax.jit, static_argnames=("nsub",))
-        def run(N, F, x, T, nH, src, dt_sub, nsub: int):
+        def run(N, F, x, xh2, xh3, T, nH, nHe, src, dt_sub, nsub: int):
             def body(carry, _):
-                N, F, x, T = carry
+                N, F, x, xh2, xh3, T = carry
                 N = N + dt_sub * src
-                N, F = m1.transport_step(N, F, dt_sub, dx, spec.c_red,
-                                         spec.ndim, spec.periodic)
-                N, x, T = chem_mod.chem_step(
-                    N, x, T, nH, dt_sub, spec.c_red, spec.group,
-                    spec.otsa, heating=spec.heating)
-                return (N, F, x, T), None
-            (N, F, x, T), _ = jax.lax.scan(body, (N, F, x, T), None,
-                                           length=nsub)
-            return N, F, x, T
+                Ns, Fs = [], []
+                for g in range(ng):          # per-group GLF transport
+                    Ng, Fg = m1.transport_step(
+                        N[g], F[g], dt_sub, dx, spec.c_red, spec.ndim,
+                        spec.periodic)
+                    Ns.append(Ng)
+                    Fs.append(Fg)
+                Ns, (x, xh2, xh3), T = chem_mod.chem_step_3ion(
+                    Ns, (x, xh2, xh3), T, nH, nHe, dt_sub, spec.c_red,
+                    groups, spec.otsa, heating=spec.heating)
+                return (jnp.stack(Ns), jnp.stack(Fs), x, xh2, xh3,
+                        T), None
+            (N, F, x, xh2, xh3, T), _ = jax.lax.scan(
+                body, (N, F, x, xh2, xh3, T), None, length=nsub)
+            return N, F, x, xh2, xh3, T
         return run
 
     def advance(self, dt: float):
@@ -106,9 +175,14 @@ class RtSim:
                                 self.spec.courant)
         nsub = max(1, int(np.ceil(dt / dt_c)))
         dt_sub = dt / nsub
-        self.N, self.F, self.x, self.T = self._step_fn(
-            self.N, self.F, self.x, self.T, self.nH, self.src,
-            jnp.asarray(dt_sub), nsub)
+        xh2 = getattr(self, "xHe2", jnp.zeros(self.shape))
+        xh3 = getattr(self, "xHe3", jnp.zeros(self.shape))
+        out = self._step_fn(self.N, self.F, self.x, xh2, xh3, self.T,
+                            self.nH, self.nHe, self.src,
+                            jnp.asarray(dt_sub), nsub)
+        self.N, self.F, self.x, xh2, xh3, self.T = out
+        if self.spec.full3:
+            self.xHe2, self.xHe3 = xh2, xh3
         self.t += dt
 
     # diagnostics ------------------------------------------------------

@@ -1,0 +1,132 @@
+"""Operational hygiene: signal-triggered dumps, walltime watchdog,
+clean-stop file, the per-step screen block, and memory accounting.
+
+Reference behaviours reproduced:
+  * ``amr/ramses.f90:17-48`` — trap signals, dump a valid snapshot,
+    exit cleanly.
+  * ``amr/adaptive_loop.f90:216-226`` — walltime watchdog: when the
+    remaining allocation can't fit another coarse step, dump + stop.
+  * ``amr/adaptive_loop.f90:199-214`` + ``amr/memory.f90`` — the
+    per-``ncontrol`` screen block: step, time, dt, mesh census, µs/pt,
+    memory high-water mark.
+  * clean_stop: the reference stops when ``stop_run`` appears in the
+    run directory (the operator's brake).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def rss_mb() -> float:
+    """Resident set size [MiB] (the reference's getmem RSS probe)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def device_mb() -> float:
+    """Total bytes of live device arrays [MiB]."""
+    import jax
+    try:
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.live_arrays()) / 2 ** 20
+    except Exception:
+        return 0.0
+
+
+class OpsGuard:
+    """Attachable run guard: call :meth:`check` once per coarse step.
+
+    Returns False when the run must stop (walltime exhausted or the
+    clean-stop file appeared); fires a snapshot dump first.  SIGUSR1
+    requests an immediate snapshot without stopping; SIGTERM/SIGINT
+    request dump-and-stop.
+    """
+
+    def __init__(self, sim, base_dir: str = ".",
+                 walltime_s: Optional[float] = None,
+                 stop_file: str = "stop_run",
+                 install_signals: bool = True):
+        self.sim = sim
+        self.base_dir = base_dir
+        self.walltime_s = walltime_s
+        self.stop_file = stop_file
+        self.t0 = time.perf_counter()
+        self._dump_requested = False
+        self._stop_requested = False
+        self._iout = 900               # emergency outputs: high numbers
+        self._max_rss = 0.0
+        self._step_wall = self.t0
+        if install_signals:
+            signal.signal(signal.SIGUSR1, self._on_dump)
+            signal.signal(signal.SIGTERM, self._on_stop)
+
+    # -- signal handlers ------------------------------------------------
+    def _on_dump(self, _sig, _frm):
+        self._dump_requested = True
+
+    def _on_stop(self, _sig, _frm):
+        self._stop_requested = True
+
+    def _dump(self) -> Optional[str]:
+        try:
+            out = self.sim.dump(self._iout, self.base_dir)
+            self._iout += 1
+            return out
+        except Exception as e:          # keep the run alive on IO issues
+            print(f"ops: emergency dump failed: {e}")
+            return None
+
+    # -- per-step hook --------------------------------------------------
+    def check(self) -> bool:
+        self._max_rss = max(self._max_rss, rss_mb())
+        if self._dump_requested:
+            self._dump_requested = False
+            out = self._dump()
+            print(f"ops: SIGUSR1 snapshot -> {out}")
+        if self._stop_requested:
+            out = self._dump()
+            print(f"ops: stop signal: snapshot -> {out}")
+            return False
+        if os.path.exists(os.path.join(self.base_dir, self.stop_file)):
+            out = self._dump()
+            print(f"ops: {self.stop_file} found: snapshot -> {out}")
+            return False
+        if self.walltime_s is not None:
+            used = time.perf_counter() - self.t0
+            # leave room for one more step (reference: 2x the mean step)
+            last = time.perf_counter() - self._step_wall
+            if used + 2.0 * last > self.walltime_s:
+                out = self._dump()
+                print(f"ops: walltime watchdog: snapshot -> {out}")
+                return False
+        self._step_wall = time.perf_counter()
+        return True
+
+    # -- screen block ---------------------------------------------------
+    def screen_block(self, extra: str = "") -> str:
+        """The reference's per-ncontrol control line
+        (``adaptive_loop.f90:199-214`` + memory census)."""
+        sim = self.sim
+        octs = {l: sim.tree.noct(l) for l in sim.levels()} \
+            if hasattr(sim, "tree") else {}
+        line = (f" Main step={getattr(sim, 'nstep', 0):7d} "
+                f"t={getattr(sim, 't', 0.0):13.6e} "
+                f"dt={getattr(sim, 'dt_old', 0.0):11.4e} "
+                f"mem={self._max_rss:8.1f}M/{device_mb():8.1f}M")
+        if hasattr(sim, "aexp_now") and sim.cosmo is not None:
+            line += f" a={sim.aexp_now():8.5f}"
+        if octs:
+            line += f" octs={octs}"
+        return line + (" " + extra if extra else "")

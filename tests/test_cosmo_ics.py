@@ -1,0 +1,170 @@
+"""Cosmological IC pipeline: grafic/Gadget readers, Zel'dovich particle
+initialization, and linear growth through the PM solvers.
+
+Oracle strategy (SURVEY.md §4 style): the IC writers are exact inverses
+of the readers (round-trip bitwise); the physics oracle is linear
+perturbation theory — in an EdS universe a single-mode density
+perturbation must grow with D(a) ∝ a through the full PM + gravity
+stack (``pm/init_part.f90`` + ``amr/init_time.f90`` conventions).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ramses_tpu.config import Params
+from ramses_tpu.io import gadget as gio
+from ramses_tpu.io import grafic as gf
+from ramses_tpu.pm import init_part as ip
+from ramses_tpu.pm.cosmology import Cosmology
+
+
+def test_grafic_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    hdr = gf.GraficHeader(8, 8, 8, dx=1.5, astart=0.02, omega_m=1.0,
+                          omega_v=0.0, h0=70.0)
+    field = rng.standard_normal((8, 8, 8)).astype(np.float32)
+    p = str(tmp_path / "ic_deltab")
+    gf.write_grafic(p, hdr, field)
+    h2, f2 = gf.read_grafic(p)
+    assert (h2.np1, h2.np2, h2.np3) == (8, 8, 8)
+    assert h2.dx == pytest.approx(1.5)
+    assert h2.astart == pytest.approx(0.02)
+    np.testing.assert_array_equal(f2, field)
+
+
+def test_gadget_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 64
+    hdr = gio.GadgetHeader(npart=(0, n, 0, 0, 0, 0),
+                           mass=(0, 0.1, 0, 0, 0, 0), time=0.05,
+                           redshift=19.0, boxsize=10000.0, omega0=1.0,
+                           omega_l=0.0, hubble=0.7)
+    pos = rng.random((n, 3)) * 10000.0
+    vel = rng.standard_normal((n, 3)) * 100.0
+    ids = np.arange(n, dtype=np.uint32)
+    p = str(tmp_path / "ic_gadget")
+    gio.write_gadget(p, hdr, pos, vel, ids)
+    h2, pos2, vel2, ids2 = gio.read_gadget(p)
+    assert h2.boxsize == pytest.approx(10000.0)
+    assert h2.time == pytest.approx(0.05)
+    np.testing.assert_allclose(pos2, pos, rtol=1e-6)
+    np.testing.assert_allclose(vel2, vel, rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(ids2, ids)
+    x, v, m, _ = ip.particles_from_gadget(p, None)
+    assert x.shape == (n, 3) and (x >= 0).all() and (x < 1).all()
+    assert m.sum() == pytest.approx(1.0)
+
+
+def _single_mode_ics(dirname, n=32, amp=0.01, astart=0.02):
+    """grafic directory holding δ = amp·cos(2πx) + matched Zel'dovich
+    velocities (EdS)."""
+    x = (np.arange(n) + 0.5) / n
+    delta = (amp * np.cos(2 * np.pi * x))[:, None, None] \
+        * np.ones((1, n, n))
+    hdr = gf.GraficHeader(n, n, n, dx=100.0 / n, astart=astart,
+                          omega_m=1.0, omega_v=0.0, h0=70.0)
+    f = ip.fpeebl(astart, 1.0, 0.0, 0.0)
+    gf.write_zeldovich_ics(dirname, delta, hdr, f)
+    return hdr
+
+
+def _cosmo_params(n_level, lmax=None, initdir=""):
+    p = Params(ndim=3)
+    p.run.cosmo = True
+    p.run.pic = True
+    p.run.poisson = True
+    p.run.hydro = False
+    p.amr.levelmin = n_level
+    p.amr.levelmax = lmax if lmax is not None else n_level
+    p.amr.boxlen = 1.0
+    p.init.filetype = "grafic"
+    p.init.initfile = [initdir]
+    p.init.aexp_ini = 0.02
+    p.raw = {"cosmo_params": {"omega_m": 1.0, "omega_l": 0.0,
+                              "omega_b": 0.0, "h0": 70.0, "aexp": 0.02,
+                              "boxlen_ini": 100.0}}
+    return p
+
+
+def _mode_amplitude(rho, n):
+    """Amplitude of the cos(2πx) mode of a deposited density field."""
+    prof = np.asarray(rho).mean(axis=(1, 2))
+    x = (np.arange(n) + 0.5) / n
+    return 2.0 * np.mean(prof * np.cos(2 * np.pi * x))
+
+
+def test_zeldovich_particles_match_delta(tmp_path):
+    """Depositing the displaced particles recovers δ at astart."""
+    from ramses_tpu.pm import particles as pmod
+
+    d = str(tmp_path / "ics")
+    _single_mode_ics(d, n=32, amp=0.01)
+    cosmo = Cosmology(omega_m=1.0, omega_l=0.0, omega_k=0.0,
+                      aexp_ini=0.02)
+    x, v, m, hdr = ip.particles_from_grafic(d, cosmo)
+    assert len(x) == 32 ** 3
+    assert m.sum() == pytest.approx(1.0)
+    p = pmod.ParticleSet.make(jnp.asarray(x), jnp.asarray(v),
+                              jnp.asarray(m))
+    rho = pmod.deposit_cic(p, (32, 32, 32), 1.0 / 32)
+    amp = _mode_amplitude(rho, 32)
+    assert amp == pytest.approx(0.01, rel=0.05)
+
+
+def test_linear_growth_uniform_pm(tmp_path):
+    """EdS single mode grows as D ∝ a through the full PM stack."""
+    from ramses_tpu.driver import Simulation
+    from ramses_tpu.pm import particles as pmod
+
+    d = str(tmp_path / "ics")
+    n = 32
+    _single_mode_ics(d, n=n, amp=0.01)
+    p = _cosmo_params(5, initdir=d)
+    a_end = 0.06
+    tau_end = float(Cosmology.from_params(p).tau_of_aexp(a_end))
+    p.output.tout = [tau_end]
+    p.output.noutput = 1
+    sim = Simulation(p, dtype=jnp.float64)
+    sim.evolve(chunk=8)
+    aexp = float(sim.cosmo.aexp_of_tau(sim.state.t))
+    assert aexp == pytest.approx(a_end, rel=1e-2)
+    rho = pmod.deposit_cic(sim.state.p, (n, n, n), 1.0 / n)
+    amp = _mode_amplitude(rho, n)
+    growth = amp / 0.01
+    assert growth == pytest.approx(a_end / 0.02, rel=0.12)
+
+
+def test_cosmo_amr_growth(tmp_path):
+    """The same oracle through the AMR driver (hierarchy PM + cosmo
+    supercomoving stepping + m_refine quasi-Lagrangian criterion)."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.driver import load_cosmo_ics
+    from ramses_tpu.hydro.core import HydroStatic
+    from ramses_tpu.pm import particles as pmod
+
+    d = str(tmp_path / "ics")
+    n = 16
+    _single_mode_ics(d, n=n, amp=0.02)
+    p = _cosmo_params(4, lmax=5, initdir=d)
+    p.run.hydro = True           # AMR driver carries a gas field
+    p.refine.m_refine = [4.0] * 10
+    cosmo = Cosmology.from_params(p)
+    parts, dense = load_cosmo_ics(p, cosmo, HydroStatic.from_params(p),
+                                  (n, n, n))
+    assert dense is None or dense.shape[1:] == (n, n, n)
+    sim = AmrSim(p, dtype=jnp.float64, particles=parts,
+                 init_dense_u=dense)
+    assert sim.cosmo is not None
+    a0 = sim.aexp_now()
+    assert a0 == pytest.approx(0.02, rel=0.05)
+    amp0 = _mode_amplitude(pmod.deposit_cic(sim.p, (n, n, n), 1.0 / n), n)
+    a_end = 0.05
+    tau_end = float(sim.cosmo.tau_of_aexp(a_end))
+    sim.evolve(tau_end, nstepmax=400)
+    assert sim.aexp_now() == pytest.approx(a_end, rel=0.02)
+    rho = pmod.deposit_cic(sim.p, (n, n, n), 1.0 / n)
+    growth = _mode_amplitude(rho, n) / amp0
+    assert growth == pytest.approx(a_end / 0.02, rel=0.2)

@@ -1,0 +1,94 @@
+"""f32 decomposition invariance — the north star's "bitwise-stable L1".
+
+The claim (BASELINE.md): answers do not depend on the device
+decomposition.  These tests run the SAME f32 problem on 1 device and
+sharded over the 8-device virtual mesh and require exact float32
+equality — they fail if any reduction (stencil gather collectives,
+flux-correction scatter-adds, CIC segment sums) reorders between the
+two layouts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.config import load_params
+from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+
+NML = "namelists/sedov3d.nml"
+
+needs_mesh = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs the 8-device virtual mesh")
+
+
+def _params(lmin=4, lmax=5):
+    p = load_params(NML, ndim=3)
+    p.amr.levelmin, p.amr.levelmax = lmin, lmax
+    p.refine.err_grad_d = 0.1
+    p.refine.err_grad_p = 0.1
+    return p
+
+
+def _state_bits(sim):
+    out = {}
+    for l in sim.levels():
+        n = sim.maps[l].noct * 2 ** sim.tree_ndim
+        out[l] = np.asarray(sim.u[l])[:n].astype(np.float32)
+    return out
+
+
+@needs_mesh
+def test_amr_f32_1dev_vs_8dev_bitwise():
+    """Hydro AMR with flux-correction scatter-adds: 3 coarse steps with
+    regrids must agree BITWISE between layouts."""
+    one = AmrSim(_params(), dtype=jnp.float32,
+                 )
+    eight = ShardedAmrSim(_params(), dtype=jnp.float32)
+    for _ in range(3):
+        one.regrid()
+        eight.regrid()
+        one.step_coarse(one.coarse_dt())
+        eight.step_coarse(eight.coarse_dt())
+    a = _state_bits(one)
+    b = _state_bits(eight)
+    assert set(a) == set(b)
+    for l in a:
+        same = a[l].view(np.uint32) == b[l].view(np.uint32)
+        frac = same.mean()
+        assert frac == 1.0, (
+            f"level {l}: {100 * (1 - frac):.4f}% of f32 words differ "
+            "between 1-device and 8-device runs (reduction reorder)")
+
+
+@needs_mesh
+def test_amr_pm_f32_deposit_invariance():
+    """Particle CIC deposits (segment sums) must not depend on the
+    mesh: compare the per-level Poisson rhs densities bitwise."""
+    from ramses_tpu.pm.particles import ParticleSet
+
+    rng = np.random.default_rng(7)
+    npart = 4096
+    x = rng.random((npart, 3))
+    v = np.zeros((npart, 3))
+    m = np.full(npart, 1.0 / npart)
+
+    def build(cls):
+        p = _params(4, 5)
+        p.run.pic = True
+        p.run.poisson = True
+        parts = ParticleSet.make(jnp.asarray(x, jnp.float32),
+                                 jnp.asarray(v, jnp.float32),
+                                 jnp.asarray(m, jnp.float32))
+        return cls(p, dtype=jnp.float32, particles=parts)
+
+    one = build(AmrSim)
+    eight = build(ShardedAmrSim)
+    one._build_pm()
+    eight._build_pm()
+    for l in one.levels():
+        r1 = np.asarray(one._pm_rho(l)).astype(np.float32)
+        r8 = np.asarray(eight._pm_rho(l)).astype(np.float32)
+        assert (r1.view(np.uint32) == r8.view(np.uint32)).all(), \
+            f"level {l} deposit differs between layouts"

@@ -1,0 +1,69 @@
+"""Operational hygiene: signal dumps, stop file, walltime watchdog,
+screen block, memory accounting (``amr/ramses.f90:17-48``,
+``adaptive_loop.f90:199-226``)."""
+
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.config import load_params
+from ramses_tpu.utils.ops import OpsGuard, device_mb, rss_mb
+
+NML = "namelists/sedov3d.nml"
+
+
+def _sim(lmin=4, lmax=5):
+    p = load_params(NML, ndim=3)
+    p.amr.levelmin, p.amr.levelmax = lmin, lmax
+    p.refine.err_grad_d = 0.1
+    p.refine.err_grad_p = 0.1
+    return AmrSim(p, dtype=jnp.float64)
+
+
+def test_sigusr1_snapshot(tmp_path):
+    """SIGUSR1 mid-run produces a valid restartable snapshot."""
+    sim = _sim()
+    guard = OpsGuard(sim, str(tmp_path))
+    sim.evolve(1e9, nstepmax=1, guard=guard)
+    os.kill(os.getpid(), signal.SIGUSR1)
+    sim.evolve(1e9, nstepmax=sim.nstep + 2, guard=guard)
+    outs = [d for d in os.listdir(tmp_path) if d.startswith("output_")]
+    assert outs, "no snapshot written after SIGUSR1"
+    p2 = load_params(NML, ndim=3)
+    p2.amr.levelmin, p2.amr.levelmax = 4, 5
+    back = AmrSim.from_snapshot(p2, os.path.join(tmp_path, sorted(outs)[0]),
+                                dtype=jnp.float64)
+    assert np.isfinite(np.asarray(back.totals())).all()
+
+
+def test_stop_file_halts(tmp_path):
+    sim = _sim()
+    guard = OpsGuard(sim, str(tmp_path), install_signals=False)
+    (tmp_path / "stop_run").write_text("")
+    sim.evolve(1e9, nstepmax=50, guard=guard)
+    assert sim.nstep == 0                  # stopped before stepping
+    outs = [d for d in os.listdir(tmp_path) if d.startswith("output_")]
+    assert outs                            # but dumped a snapshot first
+
+
+def test_walltime_watchdog(tmp_path):
+    sim = _sim()
+    guard = OpsGuard(sim, str(tmp_path), walltime_s=1e-6,
+                     install_signals=False)
+    sim.evolve(1e9, nstepmax=50, guard=guard)
+    assert sim.nstep <= 1
+    assert any(d.startswith("output_") for d in os.listdir(tmp_path))
+
+
+def test_screen_block_and_memory():
+    sim = _sim()
+    guard = OpsGuard(sim, install_signals=False)
+    guard.check()
+    line = guard.screen_block()
+    assert "Main step=" in line and "mem=" in line and "octs=" in line
+    assert rss_mb() > 10.0                 # a real python process
+    assert device_mb() > 0.0               # live device arrays exist
