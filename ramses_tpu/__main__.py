@@ -56,10 +56,19 @@ def main(argv=None) -> int:
 
     if solver == "rhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
-            raise NotImplementedError("rhd runs are uniform-grid for now")
-        from ramses_tpu.rhd.driver import RhdSimulation
-        sim = RhdSimulation(params, dtype=dtype)
-        sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
+            from ramses_tpu.rhd.amr import RhdAmrSim
+            sim = RhdAmrSim(params, dtype=dtype)
+            tend = (params.output.tout[-1] if params.output.tout
+                    else params.output.tend)
+            sim.evolve(tend, nstepmax=params.run.nstepmax,
+                       verbose=args.verbose)
+            print(f"rhd-amr t={sim.t:.5e} nstep={sim.nstep} "
+                  f"lor_max={sim.max_lorentz():.3f} "
+                  f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
+        else:
+            from ramses_tpu.rhd.driver import RhdSimulation
+            sim = RhdSimulation(params, dtype=dtype)
+            sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
     elif solver == "mhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
             from ramses_tpu.mhd.amr import MhdAmrSim

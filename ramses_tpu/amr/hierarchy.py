@@ -250,11 +250,17 @@ class AmrSim:
 
     _needs_mig_log = False
 
+    @staticmethod
+    def _make_cfg(params: Params):
+        """Static solver cfg — the physics of the hierarchy (subclass
+        hook; ``RhdAmrSim`` swaps in :class:`rhd.core.RhdStatic`)."""
+        return HydroStatic.from_params(params)
+
     def __init__(self, params: Params, dtype=jnp.float32,
                  init_tree: Optional[Octree] = None,
                  particles=None, init_dense_u=None):
         self.params = params
-        self.cfg = HydroStatic.from_params(params)
+        self.cfg = self._make_cfg(params)
         self.dtype = dtype
         self.boxlen = float(params.amr.boxlen)
         spec = bmod.BoundarySpec.from_params(params)

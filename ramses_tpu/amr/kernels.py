@@ -21,6 +21,30 @@ from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.hydro.timestep import cell_dt
 
 
+def _unsplit_fn(cfg):
+    """Physics dispatch: the cfg class selects the sweep kernel family
+    (hydro default; ``physics="rhd"`` → the SRHD set with the same
+    low-face dt/dx-scaled flux convention)."""
+    if getattr(cfg, "physics", "hydro") == "rhd":
+        from ramses_tpu.rhd import sweeps
+        return sweeps.unsplit
+    return muscl.unsplit
+
+
+def _cell_dt_fn(cfg):
+    if getattr(cfg, "physics", "hydro") == "rhd":
+        from ramses_tpu.rhd import sweeps
+        return sweeps.cell_dt
+    return cell_dt
+
+
+def _flags_fn(cfg):
+    if getattr(cfg, "physics", "hydro") == "rhd":
+        from ramses_tpu.rhd import sweeps
+        return sweeps.grad_flags
+    return _grad_flags
+
+
 @partial(jax.jit, static_argnames=("cfg", "itype"))
 def interp_cells(u_coarse, cell_idx, nb_idx, sgn, cfg: HydroStatic,
                  itype: int = 1):
@@ -96,7 +120,7 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     # [noct, 6^d] → [6..., noct]
     okl = ok_ref.T.reshape((6,) * ndim + (noct,))
 
-    flux, _tmp = muscl.unsplit(uloc, gloc, dt, (dx,) * ndim, bcfg)
+    flux, _tmp = _unsplit_fn(cfg)(uloc, gloc, dt, (dx,) * ndim, bcfg)
     # flux[d]: [nvar, 6..., noct], defined at the LOW face of each cell.
 
     # Reset flux along direction at refined interfaces
@@ -179,7 +203,7 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
             du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
         return du_rows
     up = bmod.pad(ud, bc, cfg, muscl.NGHOST)
-    flux, _tmp = muscl.unsplit(up, None, dt, (dx,) * nd, cfg)
+    flux, _tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
     if ok_dense is not None:
         okp = ok_dense.reshape(shape)
         for d in range(nd):
@@ -217,7 +241,7 @@ def dense_refine_flags(u_flat, inv_perm, perm,
     ud = u_flat[inv_perm]
     ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)
     up = bmod.pad(ud, bc, cfg, 1)
-    ok = _grad_flags(up, err_grad, floors, spatial0=0, cfg=cfg)
+    ok = _flags_fn(cfg)(up, err_grad, floors, spatial0=0, cfg=cfg)
     ok = ok[tuple(slice(1, -1) for _ in range(nd))]    # interior
     flags_flat = ok.reshape(-1)[perm]                  # flat cell order
     return flags_flat.reshape(ncell // 2 ** nd, 2 ** nd)
@@ -260,7 +284,7 @@ def restrict_upload(u_level, u_fine, ref_cell, son_oct, cfg: HydroStatic):
 def level_courant(u_flat, valid_cell, dx: float, cfg: HydroStatic):
     """Min CFL dt over the level's (valid) cells — ``courant_fine``."""
     u = jnp.moveaxis(u_flat, -1, 0)                    # [nvar, ncell]
-    dtc = cell_dt(u, None, dx, cfg)
+    dtc = _cell_dt_fn(cfg)(u, None, dx, cfg)
     dtc = jnp.where(valid_cell, dtc, jnp.inf)
     return jnp.minimum(cfg.courant_factor * dx / cfg.smallc, jnp.min(dtc))
 
@@ -279,11 +303,26 @@ def refine_flags(u_flat, interp_vals, stencil_src, vsgn,
     uloc = _gather_uloc(u_flat, interp_vals, stencil_src, vsgn, cfg)
     nd = cfg.ndim
     # fields below are [6..., noct]: spatial axes 0..nd-1, oct axis last
-    ok = _grad_flags(uloc, err_grad, floors, spatial0=0, cfg=cfg)
+    ok = _flags_fn(cfg)(uloc, err_grad, floors, spatial0=0, cfg=cfg)
     interior = tuple(slice(2, 4) for _ in range(nd))
     okc = ok[interior]                                 # [2..., noct]
     okc = jnp.moveaxis(okc, -1, 0)                     # [noct, 2...]
     return okc.reshape(okc.shape[0], 2 ** nd)
+
+
+def two_sided_rel_err(f, floor, nd: int, spatial0: int):
+    """Max-over-directions relative two-sided difference — the error
+    metric of ``hydro_refine`` (``hydro/godunov_utils.f90:152-210``),
+    shared by the hydro and SRHD flag kernels."""
+    err = jnp.zeros_like(f)
+    for d in range(nd):
+        ax = spatial0 + d
+        fl = jnp.roll(f, 1, axis=ax)
+        fr = jnp.roll(f, -1, axis=ax)
+        e1 = jnp.abs(fr - f) / (jnp.abs(fr) + jnp.abs(f) + floor)
+        e2 = jnp.abs(f - fl) / (jnp.abs(f) + jnp.abs(fl) + floor)
+        err = jnp.maximum(err, 2.0 * jnp.maximum(e1, e2))
+    return err
 
 
 def _grad_flags(uloc, err_grad, floors, spatial0: int, cfg: HydroStatic):
@@ -299,15 +338,7 @@ def _grad_flags(uloc, err_grad, floors, spatial0: int, cfg: HydroStatic):
     fld, flu, flp = floors
 
     def two_sided(f, floor):
-        err = jnp.zeros_like(f)
-        for d in range(nd):
-            ax = spatial0 + d
-            fl = jnp.roll(f, 1, axis=ax)
-            fr = jnp.roll(f, -1, axis=ax)
-            e1 = jnp.abs(fr - f) / (jnp.abs(fr) + jnp.abs(f) + floor)
-            e2 = jnp.abs(f - fl) / (jnp.abs(f) + jnp.abs(fl) + floor)
-            err = jnp.maximum(err, 2.0 * jnp.maximum(e1, e2))
-        return err
+        return two_sided_rel_err(f, floor, nd, spatial0)
 
     if egd >= 0.0:
         ok = ok | (two_sided(r, fld) > egd)

@@ -58,6 +58,8 @@ def supports(cfg: HydroStatic, shape, bc_kinds, dtype) -> bool:
 
     ``bc_kinds``: per-dim (low, high) boundary kinds (grid.boundary codes).
     """
+    if getattr(cfg, "physics", "hydro") != "hydro":
+        return False
     if cfg.ndim != 3 or cfg.nener != 0 or cfg.npassive != 0:
         return False
     if cfg.scheme != "muscl" or cfg.slope_type not in (1, 2, 8):

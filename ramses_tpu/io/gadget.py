@@ -20,6 +20,9 @@ from typing import Tuple
 
 import numpy as np
 
+from ramses_tpu.io.fortran import read_record as _rec
+from ramses_tpu.io.fortran import write_record as _wrec
+
 
 @dataclass
 class GadgetHeader:
@@ -31,20 +34,6 @@ class GadgetHeader:
     omega0: float = 1.0
     omega_l: float = 0.0
     hubble: float = 0.7                    # h
-
-
-def _rec(f) -> bytes:
-    n = struct.unpack("<i", f.read(4))[0]
-    data = f.read(n)
-    if struct.unpack("<i", f.read(4))[0] != n:
-        raise IOError("gadget: corrupted record markers")
-    return data
-
-
-def _wrec(f, payload: bytes):
-    f.write(struct.pack("<i", len(payload)))
-    f.write(payload)
-    f.write(struct.pack("<i", len(payload)))
 
 
 def read_gadget(path: str):

@@ -24,7 +24,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-_HDR = "<iii" + "ffff" + "fff" + "f"  # unused; headers via records below
+from ramses_tpu.io.fortran import read_record as _read_record
+from ramses_tpu.io.fortran import write_record as _write_record
 
 
 @dataclass
@@ -44,21 +45,6 @@ class GraficHeader:
     @property
     def boxlen_mpc(self) -> float:
         return self.np1 * self.dx
-
-
-def _read_record(f) -> bytes:
-    n = struct.unpack("<i", f.read(4))[0]
-    data = f.read(n)
-    n2 = struct.unpack("<i", f.read(4))[0]
-    if n != n2:
-        raise IOError("grafic: corrupted Fortran record markers")
-    return data
-
-
-def _write_record(f, payload: bytes):
-    f.write(struct.pack("<i", len(payload)))
-    f.write(payload)
-    f.write(struct.pack("<i", len(payload)))
 
 
 def read_grafic(path: str) -> Tuple[GraficHeader, np.ndarray]:

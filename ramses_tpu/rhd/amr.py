@@ -1,0 +1,98 @@
+"""Special-relativistic hydrodynamics on the AMR hierarchy.
+
+The rhd solver family of the reference shadows the amr driver files with
+relativistic kernels (``rhd/`` own umuscl/godunov_utils/condinit,
+SURVEY.md §2.4); here the same inversion happens through the physics
+dispatch in ``amr/kernels.py``: :class:`RhdAmrSim` IS :class:`AmrSim`
+with the static cfg swapped to :class:`~ramses_tpu.rhd.core.RhdStatic`,
+so prolongation/restriction/flux-correction/subcycling/regrid machinery
+is shared and only the sweep kernels, the Courant evaluation, and the
+refinement criteria (Lorentz-gradient) are relativistic.
+
+Restrictions (the reference rhd solver has the same shape): no
+self-gravity coupling, no particles, no cosmology — SRHD in c=1 units.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.config import Params
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.rhd import core
+from ramses_tpu.rhd.core import RhdStatic
+from ramses_tpu.rhd.driver import rhd_region_prims
+
+
+class RhdAmrSim(AmrSim):
+    """Adaptive SRHD run: region ICs, Lorentz/gradient refinement."""
+
+    @staticmethod
+    def _make_cfg(params: Params):
+        return RhdStatic.from_params(params)
+
+    def __init__(self, params: Params, dtype=jnp.float64, **kw):
+        if bool(params.run.poisson) or bool(params.run.pic):
+            raise NotImplementedError(
+                "rhd-amr: self-gravity/particles are not part of the "
+                "SRHD solver family (reference rhd/ has no poisson "
+                "coupling)")
+        if bool(params.run.cosmo):
+            raise NotImplementedError("rhd-amr: no cosmology (c=1 units)")
+        spec = bmod.BoundarySpec.from_params(params)
+        for lo, hi in ((f[0].kind, f[1].kind) for f in spec.faces):
+            for k in (lo, hi):
+                if k == bmod.INFLOW:
+                    raise NotImplementedError(
+                        "rhd boundaries: periodic/outflow/reflect only")
+        super().__init__(params, dtype=dtype, **kw)
+
+    def _ic_state(self, lvl: int) -> jnp.ndarray:
+        """Relativistic conservative ICs on this level's padded cells."""
+        m = self.maps[lvl]
+        centers = self.tree.cell_centers(lvl, self.boxlen)
+        x = [centers[:, d] for d in range(self.cfg.ndim)]
+        q = rhd_region_prims(x, self.params, self.cfg)   # [nvar, ncell]
+        u = np.asarray(core.prim_to_cons(jnp.asarray(q), self.cfg))
+        # pad rows: floor-state vacuum (D=smallr at rest)
+        qvac = np.zeros((self.cfg.nvar, 1))
+        qvac[0] = self.cfg.smallr
+        qvac[4] = self.cfg.smallp
+        uvac = np.asarray(core.prim_to_cons(jnp.asarray(qvac), self.cfg))
+        out = np.tile(uvac.T, (m.ncell_pad, 1))
+        out[:u.shape[1]] = u.T
+        return self._place(jnp.asarray(out, dtype=self.dtype), "cells")
+
+    # ------------------------------------------------------------------
+    # snapshot guard: the inherited writer converts with the Newtonian
+    # prim/cons relations (io/snapshot.cons_to_prim_out) which would
+    # silently corrupt (D, S, τ) state — refuse until the rhd format
+    # (the reference rhd solver's own output_hydro shadow) exists
+    # ------------------------------------------------------------------
+    def dump(self, *a, **kw):
+        raise NotImplementedError("rhd-amr snapshots: not yet supported")
+
+    @classmethod
+    def from_snapshot(cls, *a, **kw):
+        raise NotImplementedError("rhd-amr restart: not yet supported")
+
+    # ------------------------------------------------------------------
+    # diagnostics
+    # ------------------------------------------------------------------
+    def leaf_prims(self, lvl: int):
+        """(centers, primitives [n, nvar]) of leaf cells at one level."""
+        xc, u = self.leaf_sample(lvl)
+        q = np.asarray(core.cons_to_prim(jnp.asarray(u.T), self.cfg))
+        return xc, q.T
+
+    def max_lorentz(self) -> float:
+        w = 1.0
+        for l in self.levels():
+            _, q = self.leaf_prims(l)
+            if len(q):
+                v2 = (q[:, 1:4] ** 2).sum(axis=1)
+                w = max(w, float(
+                    (1.0 / np.sqrt(np.maximum(1.0 - v2, 1e-14))).max()))
+        return w

@@ -28,16 +28,23 @@ NCOMP = 3
 
 @dataclass(frozen=True)
 class RhdStatic:
+    # class tag the AMR kernels dispatch on (``amr/kernels._physics``)
+    physics = "rhd"
+
     ndim: int = 1
     npassive: int = 0
     gamma: float = 5.0 / 3.0
     eos: str = "ideal"          # ideal | tm
     smallr: float = 1e-10
     smallp: float = 1e-14
+    smallc: float = 1e-10       # dtmax-cap floor (c=1 units)
     slope_type: int = 1
     slope_theta: float = 1.5
     courant_factor: float = 0.8
     niter: int = 30             # con→prim Newton iterations
+    # trailing-batch layout flag for the AMR oct batches (see
+    # ``hydro/muscl._axis`` / ``hydro/core.HydroStatic.trailing_batch``)
+    trailing_batch: bool = False
 
     @property
     def nvar(self) -> int:

@@ -16,14 +16,14 @@ from ramses_tpu.rhd import core, uniform as ru
 from ramses_tpu.rhd.core import NCOMP, RhdStatic
 
 
-def rhd_condinit(shape, dx: float, p: Params, cfg: RhdStatic):
-    """Conservative ICs from &INIT_PARAMS regions (d, u/v/w = velocities
-    in units of c, P)."""
+def rhd_region_prims(xc, p: Params, cfg: RhdStatic):
+    """Primitive state [nvar, *shape] from &INIT_PARAMS regions at the
+    given coordinate arrays ``xc`` (d, u/v/w = velocities in units of c,
+    P) — the rhd test-suite ``condinit`` on arbitrary cell centres (the
+    AMR driver passes flat per-level centre lists)."""
     init = p.init
     ndim = cfg.ndim
-    axes = [(np.arange(n) + 0.5) * dx for n in shape]
-    xc = np.meshgrid(*axes, indexing="ij")
-    q = np.zeros((cfg.nvar,) + tuple(shape))
+    q = np.zeros((cfg.nvar,) + tuple(xc[0].shape))
     q[0] = cfg.smallr
     q[4] = cfg.smallp
     vels = [init.u_region, init.v_region, init.w_region]
@@ -43,6 +43,14 @@ def rhd_condinit(shape, dx: float, p: Params, cfg: RhdStatic):
         for c in range(NCOMP):
             q[1 + c][m] = vels[c][k]
         q[4][m] = init.p_region[k]
+    return q
+
+
+def rhd_condinit(shape, dx: float, p: Params, cfg: RhdStatic):
+    """Conservative ICs from &INIT_PARAMS regions on a uniform grid."""
+    axes = [(np.arange(n) + 0.5) * dx for n in shape]
+    xc = np.meshgrid(*axes, indexing="ij")
+    q = rhd_region_prims(xc, p, cfg)
     return np.asarray(core.prim_to_cons(jnp.asarray(q), cfg))
 
 

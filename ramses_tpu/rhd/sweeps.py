@@ -1,0 +1,114 @@
+"""SRHD sweep kernels with the hydro ``muscl.unsplit`` interface.
+
+The AMR level machinery (``amr/kernels.py``) is physics-parametric: it
+needs ``unsplit`` (per-direction low-face fluxes already scaled by
+dt/dx), ``cell_dt`` and ``grad_flags`` with the hydro signatures, keyed
+off the static cfg.  This module provides the special-relativistic set —
+the rhd solver's own ``umuscl.f90``/``godunov_utils.f90`` re-expressed
+as whole-array ops (same pipeline as ``rhd/uniform.py``: primitive TVD
+slopes, conservative Hancock half-step, relativistic HLL), valid on
+ghost-padded grids AND on the AMR 6^d oct-stencil batches (via
+``cfg.trailing_batch``, see ``hydro/muscl._axis``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ramses_tpu.hydro import muscl as hmuscl
+from ramses_tpu.rhd import core
+from ramses_tpu.rhd.core import RhdStatic
+
+
+def _hll(ql, qr, d: int, cfg: RhdStatic):
+    """Relativistic HLL flux (Mignone-Bodo wave-speed bounds)."""
+    lm_l, lp_l = core.wave_speeds(ql, d, cfg)
+    lm_r, lp_r = core.wave_speeds(qr, d, cfg)
+    SL = jnp.minimum(jnp.minimum(lm_l, lm_r), 0.0)
+    SR = jnp.maximum(jnp.maximum(lp_l, lp_r), 0.0)
+    fl = core.flux_along(ql, d, cfg)
+    fr = core.flux_along(qr, d, cfg)
+    ul = core.prim_to_cons(ql, cfg)
+    ur = core.prim_to_cons(qr, cfg)
+    den = SR - SL + 1e-30
+    return (SR * fl - SL * fr + SL * SR * (ur - ul)) / den
+
+
+def unsplit(u, grav, dt, dx: Sequence[float], cfg: RhdStatic):
+    """One unsplit SRHD MUSCL-Hancock step on a (ghost-padded) array.
+
+    Matches ``hydro/muscl.unsplit``: returns (flux, tmp) with
+    ``flux[d]`` the Godunov flux at the LOW face of each cell along
+    direction d, pre-scaled by dt/dx — the conservative update is then
+    ``u += flux[d] - roll(flux[d], -1)`` per direction.  ``grav`` is
+    ignored (RHD-AMR runs without self-gravity).  ``tmp`` is None (no
+    dual-energy machinery in the SRHD solver).
+    """
+    nd = cfg.ndim
+    q = core.cons_to_prim(u, cfg)
+    dq = hmuscl.uslope(q, cfg)                       # [ndim, nvar, ...]
+
+    # conservative Hancock predictor from the face-extrapolated fluxes
+    du_half = jnp.zeros_like(u)
+    face = []
+    for d in range(nd):
+        q_hi = q + 0.5 * dq[d]
+        q_lo = q - 0.5 * dq[d]
+        f_hi = core.flux_along(q_hi, d, cfg)
+        f_lo = core.flux_along(q_lo, d, cfg)
+        du_half = du_half - (0.5 * dt / dx[d]) * (f_hi - f_lo)
+        face.append((q_lo, q_hi))
+
+    fluxes = []
+    for d in range(nd):
+        ax = hmuscl._axis(cfg, d, u)
+        q_lo, q_hi = face[d]
+        ul_c = core.prim_to_cons(q_hi, cfg) + du_half
+        ur_c = core.prim_to_cons(q_lo, cfg) + du_half
+        ql = core.cons_to_prim(jnp.roll(ul_c, 1, axis=ax), cfg)
+        qr = core.cons_to_prim(ur_c, cfg)
+        fluxes.append(_hll(ql, qr, d, cfg) * (dt / dx[d]))
+    return jnp.stack(fluxes), None
+
+
+def cell_dt(u, grav, dx: float, cfg: RhdStatic):
+    """Per-cell Courant dt from the relativistic characteristic speeds
+    (the rhd ``cmpdt``; wave speeds are bounded by c=1 so
+    dt >= courant_factor*dx)."""
+    q = core.cons_to_prim(u, cfg)
+    ws = jnp.zeros(u.shape[1:], u.dtype)
+    for d in range(cfg.ndim):
+        lm, lp = core.wave_speeds(q, d, cfg)
+        ws = ws + jnp.maximum(jnp.abs(lm), jnp.abs(lp))
+    return cfg.courant_factor * dx / jnp.maximum(ws, 1e-10)
+
+
+def grad_flags(uloc, err_grad, floors, spatial0: int, cfg: RhdStatic):
+    """Refinement criteria: relative two-sided gradients of the rest-mass
+    density, pressure, and Lorentz factor (the rhd ``hydro_flag`` with
+    the Lorentz-gradient criterion of ``rhd/uniform.lorentz_refine_flags``
+    taking the role of the Mach-normalized velocity test)."""
+    nd = cfg.ndim
+    q = core.cons_to_prim(uloc, cfg)
+    rho = q[0]
+    p = q[4]
+    lor = core.lorentz(q)
+    egd, egu, egp = err_grad
+    fld, flu, flp = floors
+    ok = jnp.zeros_like(rho, dtype=bool)
+
+    def two_sided(f, floor):
+        from ramses_tpu.amr.kernels import two_sided_rel_err
+        return two_sided_rel_err(f, floor, nd, spatial0)
+
+    if egd >= 0.0:
+        ok = ok | (two_sided(rho, fld) > egd)
+    if egp >= 0.0:
+        ok = ok | (two_sided(p, flp) > egp)
+    if egu >= 0.0:
+        # W >= 1 always, so the relative two-sided difference is already
+        # well-conditioned; flu guards the ultra-cold static case
+        ok = ok | (two_sided(lor, max(flu, 1e-10)) > egu)
+    return ok

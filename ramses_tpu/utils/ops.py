@@ -71,6 +71,7 @@ class OpsGuard:
         if install_signals:
             signal.signal(signal.SIGUSR1, self._on_dump)
             signal.signal(signal.SIGTERM, self._on_stop)
+            signal.signal(signal.SIGINT, self._on_stop)
 
     # -- signal handlers ------------------------------------------------
     def _on_dump(self, _sig, _frm):
