@@ -77,9 +77,12 @@ class FusedSpec(NamedTuple):
     complete: tuple        # per-level bool
     gravity: bool
     itype: int
+    # static cooling config; None disables the in-step cooling source
+    # (``cooling_fine`` after ``godunov_fine``, amr/amr_step.f90:448-474)
+    cool: Optional[object] = None
 
 
-def _advance_traced(u, dev, fg, dt, spec: FusedSpec):
+def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
     """One ENTIRE coarse step (recursive subcycled ``amr_step``) traced
     as straight-line XLA.
 
@@ -127,6 +130,17 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec):
         u[l] = unew[l]
         if spec.gravity:
             u[l] = kick_flat(u[l], fg[l], 0.5 * dtl, cfg.ndim, cfg.smallr)
+        if spec.cool is not None:
+            # cooling_fine follows godunov_fine at every level substep
+            # (amr/amr_step.f90:448-474); pointwise, so the flat cell
+            # batch transposes straight into the dense-grid kernel.
+            # cool_tables = (tables, [scale_T2, scale_nH, scale_t]) —
+            # the scales ride as traced values so cosmological epochs
+            # don't recompile the fused program
+            from ramses_tpu.hydro.cooling import cooling_step
+            tabs, scl = cool_tables
+            u[l] = cooling_step(u[l].T, tabs, spec.cool, dtl, cfg,
+                                scales=scl).T
         if i + 1 < len(levels):
             u[l] = K.restrict_upload(u[l], u[levels[i + 1]], d["ref_cell"],
                                      d["son_oct"], cfg)
@@ -135,33 +149,37 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec):
     return u
 
 
-def _courant_traced(u, dev, spec: FusedSpec):
+def _courant_traced(u, dev, spec: FusedSpec, fg=None):
     """All levels' CFL dts, [nlevel] coarse-step equivalents (already
-    scaled by the exact factor-2 subcycle count)."""
+    scaled by the exact factor-2 subcycle count).  ``fg`` enables the
+    gravity-strength correction (one solve stale, like the reference's
+    ``courant_fine`` reading the last force)."""
     cfg = spec.cfg
     dts = []
     for i, l in enumerate(spec.levels):
         dt_l = K.level_courant(u[l], dev[l]["valid_cell"],
-                               spec.boxlen / (1 << l), cfg)
+                               spec.boxlen / (1 << l), cfg,
+                               fg.get(l) if fg else None)
         dts.append(dt_l * (2.0 ** (l - spec.lmin)))
     return jnp.stack(dts)
 
 
 @partial(jax.jit, static_argnames=("spec",))
-def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec):
+def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
     """One coarse step + the NEXT step's Courant dt, one dispatch.
 
     Returning dt(u^{n+1}) from the same program is the reference's
     ``dtnew`` bookkeeping (``amr/update_time.f90``): the next coarse
     step starts without a host round-trip to evaluate CFL.
     """
-    u = _advance_traced(u, dev, fg, dt, spec)
-    return u, jnp.min(_courant_traced(u, dev, spec))
+    u = _advance_traced(u, dev, fg, dt, spec, cool_tables)
+    return u, jnp.min(_courant_traced(u, dev, spec,
+                                      fg if spec.gravity else None))
 
 
 @partial(jax.jit, static_argnames=("spec",))
-def _fused_courant(u, dev, spec: FusedSpec):
-    return _courant_traced(u, dev, spec)
+def _fused_courant(u, dev, spec: FusedSpec, fg=None):
+    return _courant_traced(u, dev, spec, fg)
 
 
 @partial(jax.jit, static_argnames=("ncell_pad", "cfg", "itype"))
@@ -208,7 +226,8 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
 
 
 @partial(jax.jit, static_argnames=("spec", "nsteps"))
-def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int):
+def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int,
+                      cool_tables=None):
     """``nsteps`` hydro-only coarse steps as ONE device program
     (``lax.scan``), zero host round-trips between steps.
 
@@ -222,7 +241,7 @@ def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int):
         active = t < tend
         # state dtype for the step (t/dt may carry f64 on x64 hosts)
         sdt = jnp.where(active, dt, 0.0).astype(u[spec.lmin].dtype)
-        un, dtn = _fused_coarse_step(u, dev, {}, sdt, spec)
+        un, dtn = _fused_coarse_step(u, dev, {}, sdt, spec, cool_tables)
         u = {l: jnp.where(active, un[l], u[l]) for l in u}
         t = jnp.where(active, t + dt, t)
         dtc = jnp.where(active, dtn.astype(dtc.dtype), dtc)
@@ -249,6 +268,9 @@ class AmrSim:
     """
 
     _needs_mig_log = False
+    # solver families whose state layout differs from the hydro
+    # [rho, mom, E, ...] convention opt out of the shared SF/sink passes
+    _pm_physics = True
 
     @staticmethod
     def _make_cfg(params: Params):
@@ -286,6 +308,30 @@ class AmrSim:
         # dense base-grid gas ICs (grafic baryons) sampled per level
         self._init_dense = (np.asarray(init_dense_u)
                             if init_dense_u is not None else None)
+        # cooling microphysics inside the fused step (&COOLING_PARAMS)
+        self.cool_spec = None
+        self.cool_tables = None
+        self._cool_aexp = 1.0
+        if getattr(params.cooling, "cooling", False) \
+                and getattr(self.cfg, "physics", "hydro") == "hydro" \
+                and self._pm_physics:
+            from ramses_tpu.hydro.cooling import CoolingSpec, build_tables
+            from ramses_tpu.units import units as units_fn
+            cosmo0 = None
+            if bool(params.run.cosmo):
+                from ramses_tpu.pm.cosmology import Cosmology
+                cosmo0 = Cosmology.from_params(params)
+            aexp0 = cosmo0.aexp_ini if cosmo0 is not None else 1.0
+            un = units_fn(params, cosmo=cosmo0, aexp=aexp0)
+            self.cool_spec = CoolingSpec.from_params(params, un)
+            c = params.cooling
+            self._cool_aexp = aexp0
+            self._cool_scales = jnp.asarray(
+                [un.scale_T2, un.scale_nH, un.scale_t])
+            self.cool_tables = build_tables(
+                aexp=aexp0, J21=float(c.J21), a_spec=float(c.a_spec),
+                z_reion=float(c.z_reion),
+                haardt_madau=bool(c.haardt_madau))
         # self-gravity (per-level Poisson, SURVEY.md §3.3)
         self.gravity = bool(params.run.poisson)
         if self.gravity:
@@ -300,6 +346,41 @@ class AmrSim:
         # particle-mesh layer
         self.p = particles
         self.pic = bool(params.run.pic) and particles is not None
+        # star formation / feedback / sinks / tracers on the hierarchy
+        # (pm/amr_physics.py; coarse-step cadence like the reference's
+        # per-level calls folded through the subcycle)
+        from ramses_tpu.pm.particles import ParticleSet
+        from ramses_tpu.pm.sinks import SinkSet, SinkSpec
+        from ramses_tpu.pm.star_formation import SfSpec
+        from ramses_tpu.units import units as units_fn
+        self.sf_spec = SfSpec.from_params(params)
+        self.sink_spec = SinkSpec.from_params(params)
+        self.sinks = (SinkSet.empty(params.ndim)
+                      if self.sink_spec.enabled else None)
+        self.tracer_x = None          # optional [ntr, ndim] host array
+        self._sf_rng = np.random.default_rng(1234)
+        self._next_star_id = 1
+        if (getattr(self.cfg, "physics", "hydro") != "hydro"
+                or not self._pm_physics):
+            self.sf_spec = SfSpec(enabled=False)
+            self.sinks = None
+        self.units = None
+        if (self.sf_spec.enabled or self.sinks is not None
+                or getattr(params.cooling, "cooling", False)):
+            cosmo0 = None
+            if bool(params.run.cosmo):
+                from ramses_tpu.pm.cosmology import Cosmology
+                cosmo0 = Cosmology.from_params(params)
+            self.units = units_fn(
+                params, cosmo=cosmo0,
+                aexp=(cosmo0.aexp_ini if cosmo0 is not None else 1.0))
+        if self.sf_spec.enabled and self.p is None:
+            npmax = params.amr.npartmax or 100000
+            self.p = ParticleSet.make(
+                jnp.zeros((0, params.ndim)), jnp.zeros((0, params.ndim)),
+                jnp.zeros((0,)), nmax=npmax)
+        if self.sf_spec.enabled:
+            self.pic = True           # stars deposit/drift like DM
         self.dt_old = 0.0
         self._pm_dev: Dict[int, dict] = {}
         self._rho_max: Optional[float] = None
@@ -687,8 +768,16 @@ class AmrSim:
                 boxlen=self.boxlen, levels=lv,
                 complete=tuple(self.maps[l].complete for l in lv),
                 gravity=self.gravity,
-                itype=int(self.params.refine.interpol_type))
+                itype=int(self.params.refine.interpol_type),
+                cool=self.cool_spec)
         return self._spec
+
+    def _cool_bundle(self):
+        """(tables, traced [scale_T2, scale_nH, scale_t]) for the fused
+        step, or None when cooling is off."""
+        if self.cool_tables is None:
+            return None
+        return (self.cool_tables, self._cool_scales)
 
     def coarse_dt(self) -> float:
         with self.timers.section("courant"):
@@ -698,7 +787,8 @@ class AmrSim:
                 dts = [float(self._dt_cache)]
             else:
                 dts = [float(jnp.min(_fused_courant(
-                    self.u, self.dev, self._fused_spec())))]
+                    self.u, self.dev, self._fused_spec(),
+                    self.fg if (self.gravity and self.fg) else None)))]
             if self.pic:
                 from ramses_tpu.pm import particles as pmod
                 cf = float(self.cfg.courant_factor)
@@ -828,6 +918,29 @@ class AmrSim:
     def step_coarse(self, dt: float):
         from ramses_tpu.pm import particles as pmod
 
+        if self.cosmo is not None and (self.cool_tables is not None
+                                       or self.units is not None):
+            # supercomoving unit scales are aexp-dependent
+            # (``amr/units.f90``): refresh the host Units (SF/sinks) and
+            # the traced cooling scales EVERY coarse step, and
+            # re-tabulate the UV/cooling tables at 2% aexp granularity
+            # (``set_table(aexp)`` per coarse step)
+            from ramses_tpu.units import units as units_fn
+            a = self.aexp_now()
+            un = units_fn(self.params, cosmo=self.cosmo, aexp=a)
+            if self.units is not None:
+                self.units = un
+            if self.cool_tables is not None:
+                self._cool_scales = jnp.asarray(
+                    [un.scale_T2, un.scale_nH, un.scale_t])
+                if abs(a - self._cool_aexp) > 0.02 * self._cool_aexp:
+                    from ramses_tpu.hydro.cooling import build_tables
+                    c = self.params.cooling
+                    self.cool_tables = build_tables(
+                        aexp=a, J21=float(c.J21), a_spec=float(c.a_spec),
+                        z_reion=float(c.z_reion),
+                        haardt_madau=bool(c.haardt_madau))
+                    self._cool_aexp = a
         if self.pic:
             with self.timers.section("particles: maps"):
                 self._build_pm()
@@ -844,15 +957,40 @@ class AmrSim:
         with self.timers.section("hydro - godunov"):
             self.u, self._dt_cache = _fused_coarse_step(
                 self.u, self.dev, self.fg if self.gravity else {},
-                jnp.asarray(float(dt), self.dtype), self._fused_spec())
+                jnp.asarray(float(dt), self.dtype), self._fused_spec(),
+                self._cool_bundle())
         if self.pic:
             # move_fine: drift with the coarse dt (fine levels would
             # split it into exact halves with the same frozen force)
             with self.timers.section("particles: drift"):
                 self.p = pmod.drift(self.p, float(dt), self.boxlen)
         self.t += float(dt)
+        self._source_passes(float(dt))
         self.dt_old = float(dt)
         self.nstep += 1
+
+    def _source_passes(self, dt: float):
+        """Coarse-cadence source physics on the hierarchy: star
+        formation, SN feedback, sink passes, tracer advection
+        (``amr_step`` order ``:369-380,493,549-567``)."""
+        from ramses_tpu.pm import amr_physics as ap
+
+        if self.sf_spec.enabled:
+            with self.timers.section("star formation"):
+                ap.star_formation_amr(self, dt)
+                ap.thermal_feedback_amr(self)
+        if self.sinks is not None:
+            with self.timers.section("sinks"):
+                ap.sink_passes_amr(self, dt)
+        if self.tracer_x is not None:
+            with self.timers.section("tracers"):
+                ap.tracer_drift_amr(self, dt)
+        if self.sf_spec.enabled or self.sinks is not None:
+            # the passes changed u AFTER the fused step emitted the next
+            # CFL dt — an SN dump makes that cached dt ~1000x too large
+            # (the reference re-evaluates courant_fine after the source
+            # sweep for the same reason); force a fresh evaluation
+            self._dt_cache = None
 
     def step_chunk(self, nsteps: int, tend: float) -> int:
         """Run up to ``nsteps`` hydro-only coarse steps in ONE device
@@ -869,7 +1007,8 @@ class AmrSim:
         with self.timers.section("hydro - godunov"):
             u, t, dtn, ndone = _fused_multi_step(
                 self.u, self.dev, jnp.asarray(self.t, tdtype),
-                jnp.asarray(tend, tdtype), dt0, spec, nsteps)
+                jnp.asarray(tend, tdtype), dt0, spec, nsteps,
+                self._cool_bundle())
             self.u = u
             self._dt_cache = dtn
         self.t = float(t)
@@ -905,7 +1044,8 @@ class AmrSim:
             # tail (masked steps still execute inside the scan)
             chunk = min(to_regrid, nstepmax - self.nstep, 64)
             if not self.gravity and not self.pic and not verbose \
-                    and self.cosmo is None and chunk > 1:
+                    and self.cosmo is None and self.sinks is None \
+                    and self.tracer_x is None and chunk > 1:
                 if self.step_chunk(chunk, tend) == 0:
                     break
                 continue

@@ -281,10 +281,18 @@ def restrict_upload(u_level, u_fine, ref_cell, son_oct, cfg: HydroStatic):
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def level_courant(u_flat, valid_cell, dx: float, cfg: HydroStatic):
-    """Min CFL dt over the level's (valid) cells — ``courant_fine``."""
+def level_courant(u_flat, valid_cell, dx: float, cfg: HydroStatic,
+                  fg=None):
+    """Min CFL dt over the level's (valid) cells — ``courant_fine``.
+
+    ``fg`` [ncell, ndim]: gravitational acceleration; enables the
+    gravity-strength dt correction of ``cmpdt``
+    (``hydro/godunov_utils.f90:100-110``) that keeps a collapsing
+    self-gravitating cell from outrunning its own kick."""
     u = jnp.moveaxis(u_flat, -1, 0)                    # [nvar, ncell]
-    dtc = _cell_dt_fn(cfg)(u, None, dx, cfg)
+    grav = ([fg[:, d] for d in range(cfg.ndim)]
+            if fg is not None else None)
+    dtc = _cell_dt_fn(cfg)(u, grav, dx, cfg)
     dtc = jnp.where(valid_cell, dtc, jnp.inf)
     return jnp.minimum(cfg.courant_factor * dx / cfg.smallc, jnp.min(dtc))
 

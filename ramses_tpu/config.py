@@ -195,8 +195,8 @@ class RtParams:
     rt_ngroups: int = 1
     rt_t_star: float = 1e5            # blackbody SED temperature [K]
     rt_y_he: float = 0.0              # helium mass fraction in the chem
-    rt_egy_bounds: List[float] = field(
-        default_factory=lambda: [13.60, 1000.0])
+    # empty = unset → group defaults from rt/spectra.DEFAULT_BOUNDS
+    rt_egy_bounds: List[float] = field(default_factory=list)
     rt_src_pos: List[float] = field(default_factory=lambda: [0.5, 0.5, 0.5])
     rt_ndot: float = 0.0              # source photons/s (0: no source)
 

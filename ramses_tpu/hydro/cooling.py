@@ -497,13 +497,22 @@ class CoolingSpec:
 
 
 def cooling_step(u, tables: CoolingTables, spec: CoolingSpec, dt, cfg,
-                 t2_floor=None):
+                 t2_floor=None, scales=None):
     """Apply cooling over dt (code units) to a dense conservative state
     ``u [nvar, *sp]`` — the vectorized ``cooling_fine`` pass: separate
     thermal from kinetic energy, convert to (nH, T2) in cgs, integrate,
     convert back.  ``t2_floor`` (same shape as rho, K) is the polytrope
-    temperature subtracted before and re-added after (``:329-355``)."""
+    temperature subtracted before and re-added after (``:329-355``).
+
+    ``scales``: optional traced [scale_T2, scale_nH, scale_t] overriding
+    the static spec values — cosmological runs pass the CURRENT epoch's
+    supercomoving conversions (units.f90 scales are aexp-dependent)
+    without recompiling per epoch."""
     ndim = cfg.ndim
+    if scales is None:
+        s_T2, s_nH, s_t = spec.scale_T2, spec.scale_nH, spec.scale_t
+    else:
+        s_T2, s_nH, s_t = scales[0], scales[1], scales[2]
     rho = jnp.maximum(u[0], cfg.smallr)
     ekin = sum(0.5 * u[1 + d] ** 2 for d in range(ndim)) / rho
     eother = jnp.zeros_like(rho)
@@ -511,8 +520,8 @@ def cooling_step(u, tables: CoolingTables, spec: CoolingSpec, dt, cfg,
         eother = eother + u[ndim + 2 + n]
     eint = u[ndim + 1] - ekin - eother
     T2_code = (cfg.gamma - 1.0) * eint / rho
-    T2 = T2_code * spec.scale_T2
-    nH = rho * spec.scale_nH
+    T2 = T2_code * s_T2
+    nH = rho * s_nH
 
     if t2_floor is None:
         if spec.floor_form:
@@ -529,7 +538,7 @@ def cooling_step(u, tables: CoolingTables, spec: CoolingSpec, dt, cfg,
     zsolar = jnp.full_like(nH, spec.z_ave)
 
     T2_new = solve_cooling(tables, nH, T2_excess, zsolar, boost,
-                           dt * spec.scale_t)
+                           dt * s_t)
     T2_out = jnp.minimum(T2_new + t2_floor, spec.T2max)
-    eint_new = T2_out / spec.scale_T2 * rho / (cfg.gamma - 1.0)
+    eint_new = T2_out / s_T2 * rho / (cfg.gamma - 1.0)
     return u.at[ndim + 1].set(eint_new + ekin + eother)

@@ -606,6 +606,7 @@ class MhdAmrSim(AmrSim):
     migration/restriction to carry the staggered field."""
 
     _needs_mig_log = True
+    _pm_physics = False      # MHD state layout carries cell-centred B
 
     def __init__(self, params: Params, dtype=jnp.float32):
         self.mcfg = MhdStatic.from_params(params)

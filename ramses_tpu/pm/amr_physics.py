@@ -1,0 +1,367 @@
+"""Star formation, SN feedback, sinks, and tracers on the AMR hierarchy.
+
+The reference runs these passes per level inside ``amr_step``
+(``pm/star_formation.f90:2-954`` called at ``amr/amr_step.f90:369``,
+``pm/feedback.f90:472-1029`` thermal_feedback, ``pm/sink_particle.f90``
+create/grow/merge, ``pm/move_tracer.f90`` tracer advection).  Here they
+run at coarse-step cadence over per-level *flat* cell batches: particle
+creation and sink bookkeeping are data-dependent appends — the one
+operation that fights XLA's static shapes — so, exactly like the
+reference's scalar bookkeeping between vectorized sweeps, they live on
+the host, while mass removal/injection transfers back as device arrays.
+
+Level semantics:
+  * SF samples only LEAF cells (``star_formation.f90`` runs on active
+    grids whose cells have no sons) — covered cells are overwritten by
+    restriction anyway;
+  * feedback/accretion target the particle's FINEST covering level; the
+    containing cell there is a leaf by construction (a refined cell
+    would imply a finer covering oct);
+  * tracers advect with the CIC-gathered gas velocity at their finest
+    covering level (the velocity-tracer scheme of ``move_tracer.f90``;
+    the flux-probability MC scheme stays uniform-grid, see
+    ``pm/tracers.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dreplace
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.amr.tree import Octree, map_coords
+from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
+from ramses_tpu.pm.star_formation import (FLAG_SN_DONE, M_SUN, SfSpec,
+                                          mstar_quantum,
+                                          sf_timescale_code)
+from ramses_tpu.units import Units, factG_in_cgs, yr2sec
+
+
+def ngp_rows(tree: Octree, x: np.ndarray, lvl: int, boxlen: float,
+             bc_kinds) -> np.ndarray:
+    """Flat cell row of the cell CONTAINING each position at ``lvl``
+    (-1 where the level does not cover it) — the NGP analogue of the
+    CIC corner maps in :mod:`ramses_tpu.pm.amr_pm`."""
+    ndim = tree.ndim
+    ttd = 1 << ndim
+    dx = boxlen / (1 << lvl)
+    cc = np.floor(x / dx).astype(np.int64)
+    cc, _ = map_coords(cc, lvl, bc_kinds, ndim)
+    og = cc >> 1
+    oi = tree.lookup(lvl, og)
+    off = np.zeros(len(x), dtype=np.int64)
+    for d in range(ndim):
+        off = (off << 1) | (cc[:, d] & 1)
+    rows = np.where(oi >= 0, oi * ttd + off, -1)
+    return rows
+
+
+def star_formation_amr(sim, dt: float):
+    """Schmidt-law SF over every level's leaf cells (coarse cadence).
+
+    Mirrors the uniform pass (``pm/star_formation.py``) on flat batches:
+    Poisson-samples N ~ P(mgas/mstar · dt/t_star(ρ)) per eligible leaf
+    cell, caps at 90% of the cell gas, removes mass at the cell
+    velocity, appends FAM_STAR particles to ``sim.p``.
+    """
+    spec: SfSpec = sim.sf_spec
+    units: Units = sim.units
+    nd = sim.cfg.ndim
+    ttd = 2 ** nd
+    mstar = mstar_quantum(spec, units, sim.dx(sim.lmax), nd)
+    rng = sim._sf_rng
+    for l in sim.levels():
+        m = sim.maps[l]
+        ncell = m.noct * ttd
+        dx = sim.dx(l)
+        vol = dx ** nd
+        u = np.array(sim.u[l], dtype=np.float64)
+        rho = u[:ncell, 0]
+        nH = rho * units.scale_nH
+        leaf = ~sim.tree.refined_mask(l)
+        eligible = leaf & (nH > spec.n_star)
+        if not eligible.any():
+            continue
+        tstar_code = sf_timescale_code(rho, nH, spec, units)
+        lam = np.where(eligible, rho * vol / mstar * dt / tstar_code, 0.0)
+        cap = np.maximum((0.9 * rho * vol / mstar).astype(np.int64), 0)
+        # the draw is capped at 90% of the cell gas anyway; clamping λ
+        # there also keeps it inside the Poisson sampler's range (λ→∞
+        # would mean converting the whole cell, i.e. the cap)
+        lam = np.minimum(np.where(np.isfinite(lam), lam, 0.0), cap)
+        big = lam > 1e6             # Poisson(λ)≈λ: deterministic draw
+        nnew = np.where(big, lam.astype(np.int64),
+                        rng.poisson(np.where(big, 0.0, lam)))
+        nnew = np.minimum(nnew, cap)
+        rows = np.nonzero(nnew > 0)[0]
+        if len(rows) == 0:
+            continue
+        counts = nnew[rows]
+        ntot = int(counts.sum())
+        active = np.asarray(sim.p.active)
+        free = np.where(~active)[0]
+        if len(free) < ntot:          # truncate: keep the earliest cells
+            keep = np.cumsum(counts) <= len(free)
+            rows, counts = rows[keep], counts[keep]
+            ntot = int(counts.sum())
+            if ntot == 0:
+                continue
+        slots = free[:ntot]
+
+        dm = counts * mstar / vol
+        frac = 1.0 - dm / rho[rows]
+        u[rows] *= frac[:, None]
+        sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+
+        centers = sim.tree.cell_centers(l, sim.boxlen)[rows]
+        vel = u[rows, 1:1 + nd] / np.maximum(u[rows, :1], 1e-300)
+        rep = np.repeat(np.arange(len(rows)), counts)
+
+        p = sim.p
+        x_arr = np.array(p.x)
+        v_arr = np.array(p.v)
+        m_arr = np.array(p.m)
+        act = active.copy()
+        fam = np.array(p.family)
+        tp = np.array(p.tp)
+        idp = np.array(p.idp)
+        flg = np.array(p.flags)
+        x_arr[slots] = centers[rep]
+        v_arr[slots] = vel[rep]
+        m_arr[slots] = mstar
+        act[slots] = True
+        fam[slots] = FAM_STAR
+        tp[slots] = sim.t
+        idp[slots] = sim._next_star_id + np.arange(ntot)
+        flg[slots] = 0
+        sim.p = dreplace(p, x=jnp.asarray(x_arr), v=jnp.asarray(v_arr),
+                         m=jnp.asarray(m_arr), active=jnp.asarray(act),
+                         family=jnp.asarray(fam), tp=jnp.asarray(tp),
+                         idp=jnp.asarray(idp), flags=jnp.asarray(flg))
+        sim._next_star_id += ntot
+
+
+def thermal_feedback_amr(sim):
+    """Delayed thermal SN dumps into each star's finest covering cell
+    (``pm/feedback.f90:6-231,351``): stars older than t_sne return
+    eta_sn of their mass + 1e51 erg / 10 Msun specific energy, once."""
+    from ramses_tpu.pm.amr_pm import assign_levels
+
+    spec: SfSpec = sim.sf_spec
+    if spec.eta_sn <= 0:
+        return
+    units: Units = sim.units
+    nd = sim.cfg.ndim
+    p = sim.p
+    age_code = sim.t - np.asarray(p.tp)
+    t_sne_code = spec.t_sne * 1e6 * yr2sec / units.scale_t
+    due = (np.asarray(p.active)
+           & (np.asarray(p.family) == FAM_STAR)
+           & (np.asarray(p.flags) & FLAG_SN_DONE == 0)
+           & (age_code > t_sne_code))
+    if not due.any():
+        return
+    x = np.asarray(p.x, dtype=np.float64)[due]
+    mdue = np.asarray(p.m)[due]
+    vstar = np.asarray(p.v)[due]
+    mej = spec.eta_sn * mdue
+    esn_code = (1e51 / (10.0 * M_SUN)) / units.scale_v ** 2
+    lv = assign_levels(sim.tree, x, sim.boxlen)
+    for l in sim.levels():
+        sel = lv == l
+        if not sel.any():
+            continue
+        rows = ngp_rows(sim.tree, x[sel], l, sim.boxlen, sim.bc_kinds)
+        ok = rows >= 0
+        if not ok.any():
+            continue
+        r = rows[ok]
+        vol = sim.dx(l) ** nd
+        u = np.array(sim.u[l], dtype=np.float64)
+        me = mej[sel][ok]
+        vs = vstar[sel][ok]
+        np.add.at(u[:, 0], r, me / vol)
+        for d in range(nd):
+            np.add.at(u[:, 1 + d], r, me * vs[:, d] / vol)
+        ek = 0.5 * me * (vs ** 2).sum(axis=1)
+        np.add.at(u[:, 1 + nd], r, (ek + me * esn_code) / vol)
+        sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+
+    m_arr = np.array(p.m)
+    m_arr[due] = m_arr[due] - mej
+    flg = np.array(p.flags)
+    flg[due] |= FLAG_SN_DONE
+    sim.p = dreplace(p, m=jnp.asarray(m_arr), flags=jnp.asarray(flg))
+
+
+def sink_passes_amr(sim, dt: float):
+    """Sink creation/accretion/merging/motion on the hierarchy
+    (``pm/sink_particle.f90`` create_sink:6, grow_sink:575,
+    accrete_sink:722): threshold creation on leaf cells with an
+    exclusion radius, Bondi/threshold accretion from the sink's finest
+    covering cell, pairwise merging, leapfrog drift in the AMR gravity
+    field (NGP gather at the covering level)."""
+    from ramses_tpu.pm.amr_pm import assign_levels
+    from ramses_tpu.pm.sinks import SinkSet, merge_sinks
+
+    spec = sim.sink_spec
+    units: Units = sim.units
+    sinks: SinkSet = sim.sinks
+    nd = sim.cfg.ndim
+    ttd = 2 ** nd
+    gamma = float(sim.cfg.gamma)
+    d_thr = spec.n_sink / units.scale_nH
+
+    # ---- creation: leaf cells above n_sink, outside the exclusion radius
+    for l in sim.levels():
+        if sinks.n >= spec.nsinkmax:
+            break
+        m = sim.maps[l]
+        ncell = m.noct * ttd
+        dx = sim.dx(l)
+        vol = dx ** nd
+        u = np.array(sim.u[l], dtype=np.float64)
+        rho = u[:ncell, 0]
+        leaf = ~sim.tree.refined_mask(l)
+        cand = leaf & (rho * units.scale_nH > spec.n_sink)
+        rows = np.nonzero(cand)[0]
+        if len(rows) == 0:
+            continue
+        xnew = sim.tree.cell_centers(l, sim.boxlen)[rows]
+        # greedy density-ordered exclusion: the densest candidate wins
+        # its merge-radius neighbourhood (the flat-batch stand-in for
+        # create_sink's local-maximum test — a resolved clump spawns ONE
+        # sink, not one per cell above threshold), also enforced against
+        # pre-existing sinks
+        order = np.argsort(-rho[rows])
+        r2 = (spec.merging_cells * dx) ** 2
+        accepted = []
+        acc_x = [] if sinks.n == 0 else [sinks.x]
+        room = spec.nsinkmax - sinks.n
+        for k in order:
+            if len(accepted) >= room:
+                break
+            xs = np.concatenate(acc_x) if acc_x else \
+                np.zeros((0, nd))
+            if len(xs) and (((xs - xnew[k]) ** 2).sum(-1) < r2).any():
+                continue
+            accepted.append(k)
+            acc_x.append(xnew[k:k + 1])
+        if not accepted:
+            continue
+        accepted = np.asarray(accepted)
+        rows, xnew = rows[accepted], xnew[accepted]
+        dm_rho = np.maximum(rho[rows] - d_thr, 0.0)
+        mnew = dm_rho * vol
+        vel = u[rows, 1:1 + nd] / np.maximum(rho[rows, None], 1e-300)
+        u[rows] *= (1.0 - dm_rho / rho[rows])[:, None]
+        sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+        sinks = SinkSet(
+            x=np.concatenate([sinks.x, xnew]),
+            v=np.concatenate([sinks.v, vel]),
+            m=np.concatenate([sinks.m, mnew]),
+            tform=np.concatenate([sinks.tform,
+                                  np.full(len(rows), sim.t)]),
+            idp=np.concatenate([sinks.idp, sinks.next_id
+                                + np.arange(len(rows), dtype=np.int64)]),
+            next_id=sinks.next_id + len(rows))
+
+    # ---- accretion from the finest covering cell
+    if sinks.n and spec.accretion_scheme != "none":
+        lv = assign_levels(sim.tree, sinks.x, sim.boxlen)
+        for l in sim.levels():
+            sel = np.nonzero(lv == l)[0]
+            if len(sel) == 0:
+                continue
+            rows = ngp_rows(sim.tree, sinks.x[sel], l, sim.boxlen,
+                            sim.bc_kinds)
+            ok = rows >= 0
+            sel, rows = sel[ok], rows[ok]
+            if len(sel) == 0:
+                continue
+            dx = sim.dx(l)
+            vol = dx ** nd
+            u = np.array(sim.u[l], dtype=np.float64)
+            rho = np.maximum(u[rows, 0], 1e-300)
+            mom = u[rows, 1:1 + nd]
+            vgas = mom / rho[:, None]
+            ek = 0.5 * (mom ** 2).sum(1) / rho
+            press = (gamma - 1.0) * (u[rows, 1 + nd] - ek)
+            cs2 = gamma * np.maximum(press, 1e-300) / rho
+            if spec.accretion_scheme == "bondi":
+                g_code = factG_in_cgs * units.scale_d * units.scale_t ** 2
+                vrel2 = ((sinks.v[sel] - vgas) ** 2).sum(1)
+                mdot = (4 * np.pi * g_code ** 2 * sinks.m[sel] ** 2 * rho
+                        / np.maximum(cs2 + vrel2, 1e-300) ** 1.5)
+                dm = np.minimum(mdot * dt, 0.9 * rho * vol)
+            else:   # threshold
+                dm = np.minimum(
+                    spec.c_acc * np.maximum(rho - d_thr, 0.0) * vol,
+                    0.9 * rho * vol)
+            # two sinks sharing a cell must debit the gas ONCE for their
+            # combined draw (fancy-index *= is last-write-wins): group
+            # requests per unique cell, cap the TOTAL at 90% of the
+            # cell's gas, and hand each sink its proportional share
+            uniq, inv = np.unique(rows, return_inverse=True)
+            tot_req = np.bincount(inv, weights=dm)
+            rho_u = np.maximum(u[uniq, 0], 1e-300)
+            tot_allowed = np.minimum(tot_req, 0.9 * rho_u * vol)
+            scale = tot_allowed / np.maximum(tot_req, 1e-300)
+            dm = dm * scale[inv]
+            p_acc = vgas * dm[:, None]
+            frac_u = 1.0 - (tot_allowed / vol) / rho_u
+            u[uniq] *= frac_u[:, None]
+            sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+            newm = sinks.m[sel] + dm
+            sinks.v[sel] = (sinks.v[sel] * sinks.m[sel, None] + p_acc) \
+                / np.maximum(newm, 1e-300)[:, None]
+            sinks.m[sel] = newm
+
+    sinks = merge_sinks(sinks, spec, sim.dx(sim.lmax))
+
+    # ---- leapfrog motion in the AMR gravity field
+    if sinks.n:
+        if sim.gravity and sim.fg:
+            lv = assign_levels(sim.tree, sinks.x, sim.boxlen)
+            acc = np.zeros_like(sinks.v)
+            for l in sim.levels():
+                sel = np.nonzero(lv == l)[0]
+                if len(sel) == 0 or l not in sim.fg:
+                    continue
+                rows = ngp_rows(sim.tree, sinks.x[sel], l, sim.boxlen,
+                                sim.bc_kinds)
+                ok = rows >= 0
+                fg = np.asarray(sim.fg[l], dtype=np.float64)
+                acc[sel[ok]] = fg[rows[ok]]
+            sinks.v = sinks.v + acc * dt
+        sinks.x = np.mod(sinks.x + sinks.v * dt, sim.boxlen)
+    sim.sinks = sinks
+
+
+def tracer_drift_amr(sim, dt: float):
+    """Advect passive tracers with the CIC-gathered gas velocity at each
+    tracer's finest covering level (velocity-tracer scheme,
+    ``pm/move_tracer.f90`` pre-MC path)."""
+    from ramses_tpu.pm import amr_pm
+
+    x = sim.tracer_x
+    if x is None or len(x) == 0:
+        return
+    x_host = np.asarray(x, dtype=np.float64)
+    ncp = {l: sim.maps[l].ncell_pad for l in sim.levels()}
+    maps = amr_pm.build_pm_maps(sim.tree, x_host, sim.boxlen,
+                                sim.bc_kinds, ncp)
+    nd = sim.cfg.ndim
+    v = np.zeros((len(x_host), nd))
+    for l, mp in maps.items():
+        sel = mp.assigned
+        if not sel.any():
+            continue
+        u = np.array(sim.u[l], dtype=np.float64)
+        vel_field = u[:, 1:1 + nd] / np.maximum(u[:, :1], 1e-300)
+        vals = np.concatenate([vel_field, np.zeros((1, nd))])[mp.idx]
+        gathered = (vals * mp.w[..., None]).sum(axis=1)
+        v[sel] = gathered[sel]
+    sim.tracer_x = np.mod(x_host + v * dt, sim.boxlen)

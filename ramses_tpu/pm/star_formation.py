@@ -71,6 +71,21 @@ def mstar_quantum(spec: SfSpec, units: Units, dx_min: float,
     return base if spec.m_star <= 0 else spec.m_star * base
 
 
+def sf_timescale_code(rho, nH, spec: SfSpec, units: Units):
+    """SF timescale in code units: t_star·(nH/n_star)^-1/2, or
+    t_ff/eps_star (``star_formation.f90:536-560``) — shared by the
+    uniform and AMR passes."""
+    if spec.t_star > 0:
+        tstar_s = (spec.t_star * 1e9 * yr2sec
+                   * np.sqrt(spec.n_star / np.maximum(nH, 1e-30)))
+    else:
+        rho_cgs = rho * units.scale_d
+        t_ff = np.sqrt(3 * np.pi / (32 * factG_in_cgs
+                                    * np.maximum(rho_cgs, 1e-300)))
+        tstar_s = t_ff / max(spec.eps_star, 1e-10)
+    return tstar_s / units.scale_t
+
+
 def star_formation(u, p: ParticleSet, rng: np.random.Generator,
                    spec: SfSpec, units: Units, dx: float, t: float,
                    dt: float, next_id: int):
@@ -91,16 +106,7 @@ def star_formation(u, p: ParticleSet, rng: np.random.Generator,
         return u, p, next_id
 
     mstar = mstar_quantum(spec, units, dx, ndim)
-    # SF timescale: t_star·(nH/n_star)^-1/2, or t_ff/eps_star
-    if spec.t_star > 0:
-        tstar_s = (spec.t_star * 1e9 * yr2sec
-                   * np.sqrt(spec.n_star / np.maximum(nH, 1e-30)))
-    else:
-        rho_cgs = rho * units.scale_d
-        t_ff = np.sqrt(3 * np.pi / (32 * factG_in_cgs
-                                    * np.maximum(rho_cgs, 1e-300)))
-        tstar_s = t_ff / max(spec.eps_star, 1e-10)
-    tstar_code = tstar_s / units.scale_t
+    tstar_code = sf_timescale_code(rho, nH, spec, units)
 
     lam = np.where(eligible, rho * vol / mstar * dt / tstar_code, 0.0)
     nnew = rng.poisson(lam)

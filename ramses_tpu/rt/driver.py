@@ -52,16 +52,16 @@ class RtSpec:
         from ramses_tpu.rt import spectra
         r = p.rt
         bounds = list(r.rt_egy_bounds)
-        if len(bounds) != int(r.rt_ngroups) + 1:
-            if bounds and bounds != [13.60, 1000.0]:
-                # user-supplied but fencepost-wrong: ngroups groups need
-                # ngroups+1 bin edges — error out loudly instead of
-                # silently substituting the defaults
-                raise ValueError(
-                    f"rt_egy_bounds has {len(bounds)} values; "
-                    f"rt_ngroups={int(r.rt_ngroups)} needs "
-                    f"{int(r.rt_ngroups) + 1} bin edges "
-                    f"(rt/rt_parameters.f90 group bounds)")
+        if bounds and len(bounds) != int(r.rt_ngroups) + 1:
+            # user-supplied but fencepost-wrong: ngroups groups need
+            # ngroups+1 bin edges — error out loudly instead of
+            # silently substituting the defaults
+            raise ValueError(
+                f"rt_egy_bounds has {len(bounds)} values; "
+                f"rt_ngroups={int(r.rt_ngroups)} needs "
+                f"{int(r.rt_ngroups) + 1} bin edges "
+                f"(rt/rt_parameters.f90 group bounds)")
+        if not bounds:
             bounds = list(spectra.DEFAULT_BOUNDS[:int(r.rt_ngroups)]) \
                 + [spectra.DEFAULT_BOUNDS[-1]]
         groups3 = spectra.blackbody_groups(float(r.rt_t_star), bounds)

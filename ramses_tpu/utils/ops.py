@@ -77,7 +77,13 @@ class OpsGuard:
     def _on_dump(self, _sig, _frm):
         self._dump_requested = True
 
-    def _on_stop(self, _sig, _frm):
+    def _on_stop(self, sig, _frm):
+        if self._stop_requested and sig == signal.SIGINT:
+            # second Ctrl-C: the run is stuck inside a step (compile or
+            # hung device call) and will never reach the next check();
+            # escalate to the default KeyboardInterrupt
+            signal.signal(signal.SIGINT, signal.default_int_handler)
+            raise KeyboardInterrupt
         self._stop_requested = True
 
     def _dump(self) -> Optional[str]:

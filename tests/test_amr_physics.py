@@ -1,0 +1,132 @@
+"""Source physics on the AMR hierarchy: in-step cooling, star
+formation + SN feedback, sinks, tracer advection
+(``amr/amr_step.f90:369-380,448-474,493,549-567`` ordering)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.amr.hierarchy import AmrSim
+
+UNITS = {"units_density": 1.66e-24, "units_time": 3.15e13,
+         "units_length": 3.08e18}
+
+
+def _blob_groups(lmin=4, lmax=4, d_in=10.0, p_in=100.0, tend=0.01,
+                 **extra):
+    g = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": lmin, "levelmax": lmax, "boxlen": 1.0,
+                       "npartmax": 10000},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "z_center": [0.5, 0.5],
+                        "length_x": [10.0, 0.25], "length_y": [10.0, 0.25],
+                        "length_z": [10.0, 0.25],
+                        "exp_region": [10.0, 2.0],
+                        "d_region": [0.1, d_in],
+                        "p_region": [0.05, p_in]},
+        "hydro_params": {"gamma": 5.0 / 3.0, "courant_factor": 0.5},
+        "units_params": dict(UNITS),
+        "output_params": {"tend": tend},
+    }
+    g.update(extra)
+    return g
+
+
+def test_amr_cooling_matches_uniform():
+    """Complete-level AMR cooling == the uniform run_steps_cool path."""
+    from ramses_tpu.driver import Simulation
+
+    g = _blob_groups(cooling_params={"cooling": True})
+    sim = AmrSim(params_from_dict({k: dict(v) for k, v in g.items()},
+                                  ndim=3), dtype=jnp.float64)
+    assert sim.cool_tables is not None
+    sim.evolve(0.01)
+    e_amr = sim.totals()[4]
+
+    us = Simulation(params_from_dict({k: dict(v) for k, v in g.items()},
+                                     ndim=3), dtype=jnp.float64)
+    us.evolve()
+    e_uni = float(np.asarray(us.state.u)[4].sum()) * us.dx ** 3
+    assert np.isclose(e_amr, e_uni, rtol=1e-12)
+
+
+def test_amr_cooling_radiates():
+    """Hot dense gas must lose energy vs the adiabatic run."""
+    g = _blob_groups(d_in=100.0, p_in=10000.0, tend=0.02,
+                     cooling_params={"cooling": True})
+    cool = AmrSim(params_from_dict({k: dict(v) for k, v in g.items()},
+                                   ndim=3), dtype=jnp.float64)
+    cool.evolve(0.02, nstepmax=8)
+    g2 = _blob_groups(d_in=100.0, p_in=10000.0, tend=0.02)
+    adia = AmrSim(params_from_dict({k: dict(v) for k, v in g2.items()},
+                                   ndim=3), dtype=jnp.float64)
+    adia.evolve(0.02, nstepmax=8)
+    assert cool.totals()[4] < adia.totals()[4] * (1 - 1e-6)
+
+
+def test_star_formation_on_hierarchy():
+    """Stars form in the refined dense blob at its finest covering
+    level; gas+stars mass is conserved; SN feedback fires once."""
+    g = _blob_groups(lmin=4, lmax=6, d_in=50.0, p_in=0.5, tend=0.05,
+                     refine_params={"err_grad_d": 0.2},
+                     sf_params={"n_star": 1.0, "t_star": 0.1,
+                                "m_star": 1.0},
+                     feedback_params={"eta_sn": 0.1, "t_sne": 0.001})
+    g["run_params"]["poisson"] = True
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    m0 = sim.totals()[0]
+    sim.evolve(0.05, nstepmax=20)
+    act = np.asarray(sim.p.active)
+    nstars = int(act.sum())
+    assert nstars > 0
+    m_stars = float((np.asarray(sim.p.m) * act).sum())
+    m1 = sim.totals()[0]
+    assert abs(m1 + m_stars - m0) < 1e-11
+
+    from ramses_tpu.pm.amr_pm import assign_levels
+    lv = assign_levels(sim.tree, np.asarray(sim.p.x)[act], sim.boxlen)
+    assert (lv > sim.lmin).all()          # blob is refined: stars too
+    assert int((np.asarray(sim.p.flags) & 1).sum()) > 0   # SNe fired
+
+
+def test_sinks_on_hierarchy():
+    """Threshold sinks form in the refined blob and accrete; gas+sink
+    mass conserved."""
+    g = _blob_groups(lmin=4, lmax=5, d_in=100.0, p_in=1.0, tend=0.02,
+                     refine_params={"err_grad_d": 0.2},
+                     sink_params={"create_sinks": True, "n_sink": 10.0,
+                                  "accretion_scheme": "threshold",
+                                  "c_acc": 0.1})
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    m0 = sim.totals()[0]
+    sim.evolve(0.02, nstepmax=10)
+    assert sim.sinks.n > 0
+    ms = sim.sinks.m.sum()
+    assert ms > 0
+    m1 = sim.totals()[0]
+    assert abs(m1 + ms - m0) < 1e-11
+
+
+def test_tracers_follow_gas_on_hierarchy():
+    """Velocity tracers advect with the flow: a tracer in the expanding
+    blast moves outward, all positions stay finite/periodic."""
+    g = _blob_groups(lmin=4, lmax=5, d_in=1.0, p_in=100.0, tend=0.05,
+                     refine_params={"err_grad_p": 0.2})
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    rng = np.random.default_rng(0)
+    # ring of tracers just outside the hot blob: the blast pushes them out
+    th = rng.uniform(0, 2 * np.pi, 64)
+    r0 = 0.16
+    x0 = np.stack([0.5 + r0 * np.cos(th), 0.5 + r0 * np.sin(th),
+                   np.full(64, 0.5)], axis=1)
+    sim.tracer_x = x0.copy()
+    sim.evolve(0.05, nstepmax=15)
+    r1 = np.sqrt(((sim.tracer_x[:, :2] - 0.5) ** 2).sum(axis=1))
+    assert np.isfinite(sim.tracer_x).all()
+    assert (sim.tracer_x >= 0).all() and (sim.tracer_x <= 1).all()
+    assert r1.mean() > r0 + 1e-4          # net outward advection
