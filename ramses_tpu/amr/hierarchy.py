@@ -332,12 +332,24 @@ class AmrSim:
                 aexp=aexp0, J21=float(c.J21), a_spec=float(c.a_spec),
                 z_reion=float(c.z_reion),
                 haardt_madau=bool(c.haardt_madau))
-        # self-gravity (per-level Poisson, SURVEY.md §3.3)
+        # self-gravity (per-level Poisson, SURVEY.md §3.3): periodic
+        # boxes solve the zero-mean problem; any non-periodic face
+        # switches the base solve to the isolated multipole-Dirichlet
+        # path (poisson/isolated.py; boundary_potential.f90)
         self.gravity = bool(params.run.poisson)
+        self.grav_periodic = all(k == 0 for pair in self.bc_kinds
+                                 for k in pair)
         if self.gravity:
-            if any(k != 0 for pair in self.bc_kinds for k in pair):
+            if not self.grav_periodic and bool(params.run.cosmo):
                 raise NotImplementedError(
-                    "AMR self-gravity requires periodic boundaries")
+                    "cosmology requires a periodic box")
+            if any(k == 1 for pair in self.bc_kinds for k in pair):
+                # mirror walls need image masses, which the isolated
+                # multipole solve does not provide — refuse rather than
+                # silently drop the image attraction
+                raise NotImplementedError(
+                    "self-gravity with reflecting walls is unsupported "
+                    "(isolated solve covers outflow/inflow boxes)")
             self.fourpi = 4.0 * np.pi
         self.phi: Dict[int, jnp.ndarray] = {}
         self.fg: Dict[int, jnp.ndarray] = {}
@@ -859,11 +871,14 @@ class AmrSim:
 
         nd = self.cfg.ndim
         coeff = self.grav_coeff()
-        # mean density over leaves + particles (periodic solvability)
-        mtot = float(self.totals()[0])
-        if self.pic:
-            mtot += float(jnp.sum(self.p.m * self.p.active))
-        rho_mean = mtot / self.boxlen ** nd
+        if self.grav_periodic:
+            # mean density over leaves + particles (periodic solvability)
+            mtot = float(self.totals()[0])
+            if self.pic:
+                mtot += float(jnp.sum(self.p.m * self.p.active))
+            rho_mean = mtot / self.boxlen ** nd
+        else:
+            rho_mean = 0.0       # isolated problem is well-posed as-is
         rho_max = None
         for l in self.levels():
             m = self.maps[l]
@@ -879,17 +894,29 @@ class AmrSim:
             rhs = coeff * (rho - rho_mean)
             if m.complete:
                 # whole-box level: exact periodic FFT solve on the dense
-                # grid, force by central-difference rolls
+                # grid (or the isolated multipole-Dirichlet CG when the
+                # box is open), force by central differences
                 nb_ = 1 << l
                 ncell = m.noct * (1 << nd)
                 dense = rhs[d["inv_perm"]].reshape((nb_,) * nd)
-                phi_dense = fft_solve(dense, dx)
+                if self.grav_periodic:
+                    phi_dense = fft_solve(dense, dx)
+                    fg_rows = gs.grad_dense(phi_dense,
+                                            jnp.asarray(dx, rhs.dtype),
+                                            nd)[d["perm"]]
+                else:
+                    from ramses_tpu.poisson.isolated import (
+                        grad_isolated, isolated_solve)
+                    # dense already includes coeff: pass rho = dense/coeff
+                    phi_dense, gh = isolated_solve(
+                        dense / coeff, dx, jnp.asarray(coeff, rhs.dtype),
+                        iters=300, tol=float(self.params.poisson.epsilon))
+                    fg_rows = jnp.moveaxis(
+                        grad_isolated(phi_dense, gh, dx), 0, -1
+                    ).reshape(-1, nd)[d["perm"]]
                 phi = jnp.zeros((m.ncell_pad,), rhs.dtype)
                 phi = phi.at[:ncell].set(
                     phi_dense.reshape(-1)[d["perm"]])
-                fg_rows = gs.grad_dense(phi_dense,
-                                        jnp.asarray(dx, rhs.dtype),
-                                        nd)[d["perm"]]
                 if m.ncell_pad > ncell:
                     fg_rows = jnp.zeros(
                         (m.ncell_pad, nd), fg_rows.dtype
@@ -963,7 +990,8 @@ class AmrSim:
             # move_fine: drift with the coarse dt (fine levels would
             # split it into exact halves with the same frozen force)
             with self.timers.section("particles: drift"):
-                self.p = pmod.drift(self.p, float(dt), self.boxlen)
+                self.p = pmod.drift(self.p, float(dt), self.boxlen,
+                                    periodic=self.grav_periodic)
         self.t += float(dt)
         self._source_passes(float(dt))
         self.dt_old = float(dt)

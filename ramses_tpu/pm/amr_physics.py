@@ -34,7 +34,7 @@ import numpy as np
 from ramses_tpu.amr.tree import Octree, map_coords
 from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
 from ramses_tpu.pm.star_formation import (FLAG_SN_DONE, M_SUN, SfSpec,
-                                          mstar_quantum,
+                                          append_stars, mstar_quantum,
                                           sf_timescale_code)
 from ramses_tpu.units import Units, factG_in_cgs, yr2sec
 
@@ -77,8 +77,10 @@ def star_formation_amr(sim, dt: float):
         ncell = m.noct * ttd
         dx = sim.dx(l)
         vol = dx ** nd
-        u = np.array(sim.u[l], dtype=np.float64)
-        rho = u[:ncell, 0]
+        # fetch the density column only — most levels on a quiet
+        # hierarchy have no eligible cell, and the full [ncell, nvar]
+        # host copy would dominate the pass
+        rho = np.asarray(sim.u[l][:ncell, 0], dtype=np.float64)
         nH = rho * units.scale_nH
         leaf = ~sim.tree.refined_mask(l)
         eligible = leaf & (nH > spec.n_star)
@@ -99,48 +101,18 @@ def star_formation_amr(sim, dt: float):
         if len(rows) == 0:
             continue
         counts = nnew[rows]
-        ntot = int(counts.sum())
-        active = np.asarray(sim.p.active)
-        free = np.where(~active)[0]
-        if len(free) < ntot:          # truncate: keep the earliest cells
-            keep = np.cumsum(counts) <= len(free)
-            rows, counts = rows[keep], counts[keep]
-            ntot = int(counts.sum())
-            if ntot == 0:
-                continue
-        slots = free[:ntot]
-
-        dm = counts * mstar / vol
+        u = np.array(sim.u[l], dtype=np.float64)
+        centers = sim.tree.cell_centers(l, sim.boxlen)[rows]
+        vel = u[rows, 1:1 + nd] / np.maximum(u[rows, :1], 1e-300)
+        sim.p, sim._next_star_id, kept = append_stars(
+            sim.p, centers, vel, counts, mstar, sim.t,
+            sim._next_star_id)
+        if kept.sum() == 0:
+            continue
+        dm = kept * mstar / vol
         frac = 1.0 - dm / rho[rows]
         u[rows] *= frac[:, None]
         sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
-
-        centers = sim.tree.cell_centers(l, sim.boxlen)[rows]
-        vel = u[rows, 1:1 + nd] / np.maximum(u[rows, :1], 1e-300)
-        rep = np.repeat(np.arange(len(rows)), counts)
-
-        p = sim.p
-        x_arr = np.array(p.x)
-        v_arr = np.array(p.v)
-        m_arr = np.array(p.m)
-        act = active.copy()
-        fam = np.array(p.family)
-        tp = np.array(p.tp)
-        idp = np.array(p.idp)
-        flg = np.array(p.flags)
-        x_arr[slots] = centers[rep]
-        v_arr[slots] = vel[rep]
-        m_arr[slots] = mstar
-        act[slots] = True
-        fam[slots] = FAM_STAR
-        tp[slots] = sim.t
-        idp[slots] = sim._next_star_id + np.arange(ntot)
-        flg[slots] = 0
-        sim.p = dreplace(p, x=jnp.asarray(x_arr), v=jnp.asarray(v_arr),
-                         m=jnp.asarray(m_arr), active=jnp.asarray(act),
-                         family=jnp.asarray(fam), tp=jnp.asarray(tp),
-                         idp=jnp.asarray(idp), flags=jnp.asarray(flg))
-        sim._next_star_id += ntot
 
 
 def thermal_feedback_amr(sim):
@@ -222,13 +194,14 @@ def sink_passes_amr(sim, dt: float):
         ncell = m.noct * ttd
         dx = sim.dx(l)
         vol = dx ** nd
-        u = np.array(sim.u[l], dtype=np.float64)
-        rho = u[:ncell, 0]
+        # density column first: quiet levels skip the full host copy
+        rho = np.asarray(sim.u[l][:ncell, 0], dtype=np.float64)
         leaf = ~sim.tree.refined_mask(l)
         cand = leaf & (rho * units.scale_nH > spec.n_sink)
         rows = np.nonzero(cand)[0]
         if len(rows) == 0:
             continue
+        u = np.array(sim.u[l], dtype=np.float64)
         xnew = sim.tree.cell_centers(l, sim.boxlen)[rows]
         # greedy density-ordered exclusion: the densest candidate wins
         # its merge-radius neighbourhood (the flat-batch stand-in for
@@ -336,7 +309,16 @@ def sink_passes_amr(sim, dt: float):
                 fg = np.asarray(sim.fg[l], dtype=np.float64)
                 acc[sel[ok]] = fg[rows[ok]]
             sinks.v = sinks.v + acc * dt
-        sinks.x = np.mod(sinks.x + sinks.v * dt, sim.boxlen)
+        x = sinks.x + sinks.v * dt
+        if sim.grav_periodic:
+            sinks.x = np.mod(x, sim.boxlen)
+        else:
+            # open box: sinks leaving the domain are removed (same
+            # policy as escaping particles)
+            keep = ((x >= 0.0) & (x < sim.boxlen)).all(axis=1)
+            sinks = SinkSet(x=x[keep], v=sinks.v[keep], m=sinks.m[keep],
+                            tform=sinks.tform[keep], idp=sinks.idp[keep],
+                            next_id=sinks.next_id)
     sim.sinks = sinks
 
 
@@ -364,4 +346,10 @@ def tracer_drift_amr(sim, dt: float):
         vals = np.concatenate([vel_field, np.zeros((1, nd))])[mp.idx]
         gathered = (vals * mp.w[..., None]).sum(axis=1)
         v[sel] = gathered[sel]
-    sim.tracer_x = np.mod(x_host + v * dt, sim.boxlen)
+    x = x_host + v * dt
+    if sim.grav_periodic:
+        sim.tracer_x = np.mod(x, sim.boxlen)
+    else:
+        # open box: tracers leave the domain and are dropped
+        keep = ((x >= 0.0) & (x < sim.boxlen)).all(axis=1)
+        sim.tracer_x = x[keep]

@@ -78,12 +78,16 @@ def build_pm_maps(tree: Octree, x: np.ndarray, boxlen: float,
     """
     ndim = tree.ndim
     ttd = 1 << ndim
-    if any(k != 0 for pair in bc_kinds for k in pair):
+    if any(k == 1 for pair in bc_kinds for k in pair):
         # reflecting walls need the wall-normal force sign flip on
         # mirrored corners and a bouncing (not wrapping) drift — neither
         # is implemented; reject loudly rather than silently mis-force
         raise NotImplementedError(
-            "AMR particles require periodic boundaries")
+            "AMR particles: reflecting boundaries unsupported")
+    # open (outflow/inflow) dims: CIC corners falling outside the box
+    # are dropped — mass near the edge leaks like in the reference's
+    # isolated runs; escaped particles are deactivated by the drift
+    open_dim = [bc_kinds[d] != (0, 0) for d in range(ndim)]
     levels = assign_levels(tree, x, boxlen)
     out: Dict[int, PmLevelMap] = {}
     for l in range(tree.levelmin, tree.levelmax + 1):
@@ -103,7 +107,13 @@ def build_pm_maps(tree: Octree, x: np.ndarray, boxlen: float,
                 b = (corner >> d) & 1
                 cc[:, d] += b
                 wc *= frac[:, d] if b else (1.0 - frac[:, d])
+            nl = 1 << l
+            oob = np.zeros(npart, dtype=bool)
+            for d in range(ndim):
+                if open_dim[d]:
+                    oob |= (cc[:, d] < 0) | (cc[:, d] >= nl)
             cc, _refl = map_coords(cc, l, bc_kinds, ndim)
+            wc = np.where(oob, 0.0, wc)
             og = cc >> 1
             oi = tree.lookup(l, og)
             off = np.zeros(npart, dtype=np.int64)

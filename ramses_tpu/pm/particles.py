@@ -193,11 +193,21 @@ def kick(p: ParticleSet, f_at_p, dteff) -> ParticleSet:
     return dreplace(p, v=v)
 
 
-def drift(p: ParticleSet, dt, boxlen: float) -> ParticleSet:
-    """x += v*dt with periodic wrap (``move_fine:540-550``)."""
+def drift(p: ParticleSet, dt, boxlen: float,
+          periodic: bool = True) -> ParticleSet:
+    """x += v*dt with periodic wrap (``move_fine:540-550``).
+
+    ``periodic=False``: open box — positions do not wrap; particles
+    that leave [0, boxlen) are DEACTIVATED (the reference removes
+    escapers from non-periodic domains in ``kill_tree_fine``)."""
     x = p.x + p.v * dt * p.active[:, None]
-    x = x % boxlen
-    return dreplace(p, x=x)
+    if periodic:
+        return dreplace(p, x=x % boxlen)
+    inside = jnp.all((x >= 0.0) & (x < boxlen), axis=1)
+    act = p.active & inside
+    # park escaped rows at the origin so stale coords can't alias maps
+    x = jnp.where(act[:, None], x, 0.0)
+    return dreplace(p, x=x, active=act)
 
 
 def particle_dt(p: ParticleSet, dx: float, courant_factor: float):

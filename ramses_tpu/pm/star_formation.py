@@ -86,6 +86,54 @@ def sf_timescale_code(rho, nH, spec: SfSpec, units: Units):
     return tstar_s / units.scale_t
 
 
+def append_stars(p: ParticleSet, xnew: np.ndarray, vnew: np.ndarray,
+                 counts: np.ndarray, mstar: float, t: float,
+                 next_id: int):
+    """Append ``counts[i]`` FAM_STAR particles at ``xnew[i]``/``vnew[i]``
+    into free slots of ``p`` (truncating at capacity, keeping the
+    earliest cells — the reference's ``nstar_tot`` overflow policy).
+
+    Returns (p', next_id', kept_counts) where ``kept_counts`` mirrors
+    ``counts`` after truncation so callers remove exactly the gas that
+    became stars.  Shared by the uniform and AMR SF passes.
+    """
+    active = np.asarray(p.active)
+    free = np.where(~active)[0]
+    ntot = int(counts.sum())
+    kept = counts.copy()
+    if len(free) < ntot:
+        keep = np.cumsum(counts) <= len(free)
+        kept = np.where(keep, counts, 0)
+        ntot = int(kept.sum())
+    if ntot == 0:
+        return p, next_id, kept
+    slots = free[:ntot]
+    sel = kept > 0
+    rep = np.repeat(np.arange(len(counts))[sel], kept[sel])
+
+    x_arr = np.array(p.x)
+    v_arr = np.array(p.v)
+    m_arr = np.array(p.m)
+    act = active.copy()
+    fam = np.array(p.family)
+    tp = np.array(p.tp)
+    idp = np.array(p.idp)
+    flg = np.array(p.flags)
+    x_arr[slots] = xnew[rep]
+    v_arr[slots] = vnew[rep]
+    m_arr[slots] = mstar
+    act[slots] = True
+    fam[slots] = FAM_STAR
+    tp[slots] = t
+    idp[slots] = next_id + np.arange(ntot)
+    flg[slots] = 0
+    p2 = dreplace(p, x=jnp.asarray(x_arr), v=jnp.asarray(v_arr),
+                  m=jnp.asarray(m_arr), active=jnp.asarray(act),
+                  family=jnp.asarray(fam), tp=jnp.asarray(tp),
+                  idp=jnp.asarray(idp), flags=jnp.asarray(flg))
+    return p2, next_id + ntot, kept
+
+
 def star_formation(u, p: ParticleSet, rng: np.random.Generator,
                    spec: SfSpec, units: Units, dx: float, t: float,
                    dt: float, next_id: int):
@@ -118,52 +166,22 @@ def star_formation(u, p: ParticleSet, rng: np.random.Generator,
         return u, p, next_id
 
     counts = nnew[tuple(idx.T)]
-    ntot = int(counts.sum())
-    # free capacity in the particle arrays
-    active = np.asarray(p.active)
-    free = np.where(~active)[0]
-    if len(free) < ntot:     # truncate: keep the earliest cells
-        keep = np.cumsum(counts) <= len(free)
-        idx, counts = idx[keep], counts[keep]
-        ntot = int(counts.sum())
-        if ntot == 0:
-            return u, p, next_id
-    slots = free[:ntot]
-
-    # remove gas at the cell velocity (momentum/energy proportionally)
-    dm = counts * mstar / vol                        # density removed
     cells = tuple(idx.T)
-    frac = 1.0 - dm / rho[cells]
-    for iv in range(u.shape[0]):
-        u[iv][cells] = u[iv][cells] * frac
-
-    # new particles at cell centres, gas velocity
     xnew = (idx + 0.5) * dx
     vel = np.stack([u[1 + d][cells] / np.maximum(u[0][cells], 1e-300)
                     for d in range(ndim)], axis=1)
-    rep = np.repeat(np.arange(len(idx)), counts)
+    p2, next_id, kept = append_stars(p, xnew, vel, counts, mstar, t,
+                                     next_id)
+    if kept.sum() == 0:
+        return u, p, next_id
 
-    x_arr = np.array(p.x)
-    v_arr = np.array(p.v)
-    m_arr = np.array(p.m)
-    act = active.copy()
-    fam = np.array(p.family)
-    tp = np.array(p.tp)
-    idp = np.array(p.idp)
-    flg = np.array(p.flags)
-    x_arr[slots] = xnew[rep]
-    v_arr[slots] = vel[rep]
-    m_arr[slots] = mstar
-    act[slots] = True
-    fam[slots] = FAM_STAR
-    tp[slots] = t
-    idp[slots] = next_id + np.arange(ntot)
-    flg[slots] = 0
-    p2 = dreplace(p, x=jnp.asarray(x_arr), v=jnp.asarray(v_arr),
-                  m=jnp.asarray(m_arr), active=jnp.asarray(act),
-                  family=jnp.asarray(fam), tp=jnp.asarray(tp),
-                  idp=jnp.asarray(idp), flags=jnp.asarray(flg))
-    return u, p2, next_id + ntot
+    # remove exactly the gas that became stars, at the cell velocity
+    # (momentum/energy scale proportionally)
+    dm = kept * mstar / vol                          # density removed
+    frac = 1.0 - dm / rho[cells]
+    for iv in range(u.shape[0]):
+        u[iv][cells] = u[iv][cells] * frac
+    return u, p2, next_id
 
 
 def thermal_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,

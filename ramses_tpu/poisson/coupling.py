@@ -41,6 +41,9 @@ class GravitySpec:
     cg_iters: int = 150
     boxlen: float = 1.0
     fourpi: float = 4.0 * 3.14159265358979323846  # rhs factor (cosmo varies)
+    # False: any non-periodic face → isolated multipole-Dirichlet solve
+    # (poisson/boundary_potential.f90 path, poisson/isolated.py)
+    periodic: bool = True
 
     @classmethod
     def from_params(cls, p) -> "GravitySpec":
@@ -58,7 +61,9 @@ class GravitySpec:
                                         for v in p.poisson.gravity_params),
                    epsilon=float(p.poisson.epsilon),
                    solver=solver,
-                   boxlen=float(p.amr.boxlen))
+                   boxlen=float(p.amr.boxlen),
+                   periodic=_all_periodic(
+                       bmod.BoundarySpec.from_params(p)))
 
 
 def solve_phi(spec: GravitySpec, rho, dx: float, fourpi=None):
@@ -84,6 +89,13 @@ def gravity_field(spec: GravitySpec, rho, dx: float, fourpi=None):
         x = cell_centers(rho.shape, dx, dtype=rho.dtype)
         return gravana(x, spec.gravity_type, spec.gravity_params,
                        spec.boxlen)
+    if not spec.periodic:
+        from ramses_tpu.poisson.isolated import (grad_isolated,
+                                                 isolated_solve)
+        factor = spec.fourpi if fourpi is None else fourpi
+        phi, gh = isolated_solve(rho, dx, factor, iters=spec.cg_iters,
+                                 tol=spec.epsilon)
+        return grad_isolated(phi, gh, dx)
     phi = solve_phi(spec, rho, dx, fourpi)
     return fmod.force(phi, dx)
 

@@ -1,0 +1,180 @@
+"""Isolated-boundary Poisson solve: multipole Dirichlet + dense CG.
+
+Reference: ``pm/rho_fine.f90:666`` (multipole_fine — mass moments of the
+density) + ``poisson/boundary_potential.f90:5-341`` (phi_boundary: the
+ghost potential on non-periodic faces from the multipole expansion),
+then the usual interior solve.  Here the expansion is monopole +
+quadrupole about the centre of mass (the dipole vanishes there), the
+ghost layer enters the right-hand side of a zero-Dirichlet 7-point
+Laplacian (SPD), and a fixed-iteration CG solves it — all dense
+whole-grid ops, jit-friendly.
+
+Sign convention matches the rest of the package: ``Lap(phi) = coeff*rho``
+with attractive force ``-grad phi`` applied as ``+f`` in the kick, i.e.
+``f = -grad phi``; a positive mass produces ``phi < 0`` wells via the
+Green's function ``phi = -coeff M / (4 pi r)`` (3D).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _shift0(a, s: int, ax: int):
+    """Shift with zero fill (Dirichlet-0 ghost)."""
+    z = jnp.zeros_like(a)
+    if s == 1:
+        sl_src = [slice(None)] * a.ndim
+        sl_dst = [slice(None)] * a.ndim
+        sl_src[ax] = slice(0, -1)
+        sl_dst[ax] = slice(1, None)
+    else:
+        sl_src = [slice(None)] * a.ndim
+        sl_dst = [slice(None)] * a.ndim
+        sl_src[ax] = slice(1, None)
+        sl_dst[ax] = slice(0, -1)
+    return z.at[tuple(sl_dst)].set(a[tuple(sl_src)])
+
+
+def lap_dirichlet0(phi, dx: float):
+    """7-point Laplacian with zero Dirichlet ghosts (SPD operator)."""
+    nd = phi.ndim
+    out = -2.0 * nd * phi
+    for ax in range(nd):
+        out = out + _shift0(phi, 1, ax) + _shift0(phi, -1, ax)
+    return out / (dx * dx)
+
+
+def multipole_phi(rho, dx: float, coeff, points):
+    """Multipole potential at ``points`` [n, ndim] (box coordinates).
+
+    Monopole + quadrupole about the centre of mass (the dipole is zero
+    there) — ``boundary_potential.f90`` keeps the same orders.  3D uses
+    the 1/r kernel, 2D the log kernel.
+    """
+    nd = rho.ndim
+    vol = dx ** nd
+    axes = [(jnp.arange(n) + 0.5) * dx for n in rho.shape]
+    grids = jnp.meshgrid(*axes, indexing="ij")
+    M = jnp.sum(rho) * vol
+    Msafe = jnp.where(jnp.abs(M) > 1e-300, M, 1.0)
+    com = jnp.stack([jnp.sum(rho * g) * vol / Msafe for g in grids])
+    rel = [g - com[d] for d, g in enumerate(grids)]
+    r = points - com[None, :]                       # [n, ndim]
+    r2 = jnp.maximum((r ** 2).sum(axis=1), (0.5 * dx) ** 2)
+    if nd == 3:
+        # Q_ij = sum rho (3 x_i x_j - |x|^2 delta_ij) dV
+        x2 = sum(x * x for x in rel)
+        quad = jnp.zeros(points.shape[0], rho.dtype)
+        for i in range(3):
+            for j in range(3):
+                qij = jnp.sum(rho * (3.0 * rel[i] * rel[j]
+                                     - (x2 if i == j else 0.0))) * vol
+                quad = quad + qij * r[:, i] * r[:, j]
+        rr = jnp.sqrt(r2)
+        return -coeff / (4.0 * jnp.pi) * (M / rr + 0.5 * quad / rr ** 5)
+    if nd == 2:
+        return coeff / (2.0 * jnp.pi) * 0.5 * M * jnp.log(r2)
+    # 1D: |x| kernel (phi'' = coeff*rho → phi = coeff*M*|x|/2)
+    return coeff * 0.5 * M * jnp.sqrt(r2)
+
+
+def _face_points(shape: Tuple[int, ...], dx: float, d: int, side: int,
+                 dtype):
+    """Ghost-cell centre coordinates of one face, flat [nface, ndim]."""
+    nd = len(shape)
+    axes = []
+    for dd in range(nd):
+        if dd == d:
+            x = jnp.asarray([-0.5 * dx if side == 0
+                             else (shape[d] + 0.5) * dx], dtype)
+        else:
+            x = (jnp.arange(shape[dd], dtype=dtype) + 0.5) * dx
+        axes.append(x)
+    grids = jnp.meshgrid(*axes, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=1)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def isolated_solve(rho, dx: float, coeff, iters: int = 300, tol: float = 1e-6,
+                   phi0=None):
+    """Solve ``Lap(phi) = coeff*rho`` with open (isolated) boundaries.
+
+    Returns (phi, ghost_faces) where ``ghost_faces[d][side]`` is the
+    multipole Dirichlet layer used — callers feed it to
+    :func:`grad_isolated` so the boundary force is consistent with the
+    solve.  No mean subtraction: the isolated problem is well-posed.
+    """
+    nd = rho.ndim
+    rhs = coeff * rho
+    ghosts: List[List[jnp.ndarray]] = []
+    for d in range(nd):
+        pair = []
+        for side in (0, 1):
+            pts = _face_points(rho.shape, dx, d, side, rho.dtype)
+            g = multipole_phi(rho, dx, coeff, pts)
+            fshape = tuple(1 if dd == d else rho.shape[dd]
+                           for dd in range(nd))
+            pair.append(g.reshape(fshape))
+        ghosts.append(pair)
+
+    # Dirichlet layer folds into the rhs: Lap0(phi) = rhs - ghosts/dx^2
+    rhs_adj = rhs
+    dx2 = dx * dx
+    for d in range(nd):
+        lo_idx = [slice(None)] * nd
+        hi_idx = [slice(None)] * nd
+        lo_idx[d] = slice(0, 1)
+        hi_idx[d] = slice(-1, None)
+        rhs_adj = rhs_adj.at[tuple(lo_idx)].add(-ghosts[d][0] / dx2)
+        rhs_adj = rhs_adj.at[tuple(hi_idx)].add(-ghosts[d][1] / dx2)
+
+    phi = jnp.zeros_like(rhs) if phi0 is None else phi0
+    r = rhs_adj - lap_dirichlet0(phi, dx)
+    p = r
+    rs = jnp.vdot(r, r)
+    rs0 = rs
+    eps = jnp.asarray(jnp.finfo(rhs.dtype).eps, rhs.dtype)
+    cut = jnp.maximum(eps * eps, jnp.asarray(tol * tol, rhs.dtype))
+    floor = cut * jnp.maximum(rs0, 1e-300)
+
+    def body(carry, _):
+        phi, r, p, rs = carry
+        live = rs > floor
+        ap = lap_dirichlet0(p, dx)
+        denom = jnp.vdot(p, ap)
+        alpha = jnp.where(live & (denom != 0.0),
+                          rs / jnp.where(denom == 0, 1, denom), 0.0)
+        phi = phi + alpha * p
+        r_new = r - alpha * ap
+        rs_new = jnp.vdot(r_new, r_new)
+        beta = jnp.where(live, rs_new / jnp.where(rs == 0, 1, rs), 0.0)
+        p = jnp.where(live, r_new + beta * p, p)
+        return (phi, jnp.where(live, r_new, r), p,
+                jnp.where(live, rs_new, rs)), None
+
+    (phi, r, p, rs), _ = jax.lax.scan(body, (phi, r, p, rs), None,
+                                      length=iters)
+    return phi, ghosts
+
+
+@jax.jit
+def grad_isolated(phi, ghosts, dx: float):
+    """Central-difference force ``f = -grad(phi)`` [ndim, *sp] using the
+    multipole Dirichlet ghost layers at the boundary."""
+    nd = phi.ndim
+    comps = []
+    for d in range(nd):
+        padded = jnp.concatenate([ghosts[d][0], phi, ghosts[d][1]], axis=d)
+        lo = [slice(None)] * nd
+        hi = [slice(None)] * nd
+        lo[d] = slice(0, -2)
+        hi[d] = slice(2, None)
+        comps.append(-(padded[tuple(hi)] - padded[tuple(lo)])
+                     / (2.0 * dx))
+    return jnp.stack(comps)
