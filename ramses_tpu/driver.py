@@ -115,20 +115,26 @@ class Simulation:
                                  jnp.zeros((0, params.ndim)),
                                  jnp.zeros((0,)), nmax=1)
         self.gspec = GravitySpec.from_params(params)
-        if self.gspec.enabled and self.gspec.gravity_type == 0 \
-                and not self.gspec.periodic:
-            if any(f.kind == bmod.REFLECTING
-                   for pair in self.bc.faces for f in pair):
-                raise NotImplementedError(
-                    "self-gravity with reflecting walls is unsupported "
-                    "(isolated solve covers outflow/inflow boxes)")
+        box_periodic = all(f.kind == bmod.PERIODIC
+                           for pair in self.bc.faces for f in pair)
+        if not box_periodic:
             if self.pspec.enabled:
                 # the uniform PM stepper (pm/coupling.run_steps_pm)
                 # wraps drift and CIC indices periodically — an open box
-                # would teleport escapers to the far wall
+                # would teleport escapers to the far wall (gravity on or
+                # off makes no difference to the drift)
                 raise NotImplementedError(
                     "uniform-grid particles require a periodic box; "
                     "use the AMR driver for open-box PM runs")
+            if self.cosmo is not None:
+                raise NotImplementedError(
+                    "cosmology requires a periodic box")
+            if self.gspec.enabled and self.gspec.gravity_type == 0 \
+                    and any(f.kind == bmod.REFLECTING
+                            for pair in self.bc.faces for f in pair):
+                raise NotImplementedError(
+                    "self-gravity with reflecting walls is unsupported "
+                    "(isolated solve covers outflow/inflow boxes)")
         if self.gspec.enabled:
             # initial force so the first -0.5dt "un-kick" cancels exactly
             # (the reference's nstep==0 save_phi_old, amr/amr_step.f90:260);

@@ -1,0 +1,247 @@
+"""Halo-analysis chain: clump membership, unbinding, merger trees.
+
+Reference: ``pm/clump_merger.f90`` (clump properties + output tables),
+``pm/unbinding.f90:1-2296`` (iterative particle unbinding against the
+clump's own potential), ``pm/merger_tree.f90:1-4312`` (progenitor /
+descendant links via shared particle IDs across snapshots).
+
+All passes are host-side numpy over particle arrays — halos are few and
+the per-clump work is O(members log members); the expensive part
+(density deposition + watershed labelling) already runs on device
+(:mod:`ramses_tpu.pm.clumps`).  The unbinding potential uses the
+monopole (spherical mass-profile) approximation of the reference
+(``unbinding.f90`` 'potential from the cumulative mass profile').
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# ----------------------------------------------------------------------
+# membership
+# ----------------------------------------------------------------------
+
+def particle_labels(x: np.ndarray, labels_grid: np.ndarray, dx: float,
+                    boxlen: float) -> np.ndarray:
+    """Clump label of each particle = label of its NGP cell on the
+    dense labelled grid (-1 = unlabelled background)."""
+    shape = labels_grid.shape
+    nd = x.shape[1]
+    idx = tuple(
+        np.clip((np.mod(x[:, d], boxlen) / dx).astype(np.int64), 0,
+                shape[d] - 1) for d in range(nd))
+    return labels_grid[idx]
+
+
+# ----------------------------------------------------------------------
+# unbinding (pm/unbinding.f90)
+# ----------------------------------------------------------------------
+
+def _sphere_potential(r: np.ndarray, m: np.ndarray, G: float):
+    """Monopole potential at each member's radius from the cumulative
+    mass profile: phi(r_i) = -G [ M(<r_i)/r_i + sum_{r_j>r_i} m_j/r_j ]
+    (the reference's spherical unbinding potential)."""
+    order = np.argsort(r)
+    rs = np.maximum(r[order], 1e-12)
+    ms = m[order]
+    mcum = np.cumsum(ms) - ms            # mass strictly inside r_i
+    inv_term = np.cumsum((ms / rs)[::-1])[::-1] - ms / rs  # shells outside
+    phi_sorted = -G * ((mcum + ms) / rs + inv_term)
+    phi = np.empty_like(phi_sorted)
+    phi[order] = phi_sorted
+    return phi
+
+
+def unbind_clump(x: np.ndarray, v: np.ndarray, m: np.ndarray,
+                 center: np.ndarray, boxlen: float, G: float = 1.0,
+                 periodic: bool = True, max_iter: int = 10,
+                 keep_frac_min: float = 0.0):
+    """Iterative unbinding of one clump's member particles.
+
+    Returns a bool mask of BOUND members.  Each iteration recomputes
+    the bulk velocity and the monopole potential from the currently
+    bound set, then strips particles with
+    ``0.5|v - vbulk|^2 + phi > 0`` (``unbinding.f90`` iterative mode,
+    ``:1400-1600``) until the bound set is stable.
+    """
+    n = len(m)
+    bound = np.ones(n, dtype=bool)
+    rel = x - center
+    if periodic:
+        rel = rel - boxlen * np.round(rel / boxlen)
+    r = np.sqrt((rel ** 2).sum(axis=1))
+    for _ in range(max_iter):
+        nb = bound.sum()
+        if nb < 2:
+            break
+        mtot = m[bound].sum()
+        vbulk = (v[bound] * m[bound, None]).sum(0) / mtot
+        phi = np.zeros(n)
+        phi[bound] = _sphere_potential(r[bound], m[bound], G)
+        ekin = 0.5 * ((v - vbulk) ** 2).sum(axis=1)
+        new_bound = bound & (ekin + phi < 0.0)
+        if new_bound.sum() < max(2, int(keep_frac_min * n)):
+            break                        # keep the last stable set
+        if new_bound.sum() == nb:
+            bound = new_bound
+            break
+        bound = new_bound
+    return bound
+
+
+# ----------------------------------------------------------------------
+# clump catalogue with particle membership
+# ----------------------------------------------------------------------
+
+@dataclass
+class Halo:
+    """One halo/clump with particle membership (the clump_merger table
+    row + the unbinding particle lists)."""
+    index: int
+    mass: float                  # bound mass
+    npart: int
+    pos: np.ndarray              # mass-weighted bound centre
+    vel: np.ndarray              # bulk velocity
+    ekin: float                  # internal kinetic energy (bulk removed)
+    epot: float                  # monopole potential energy estimate
+    ids: np.ndarray              # bound particle IDs (sorted)
+
+
+def build_catalogue(x: np.ndarray, v: np.ndarray, m: np.ndarray,
+                    ids: np.ndarray, plabels: np.ndarray, boxlen: float,
+                    G: float = 1.0, periodic: bool = True,
+                    unbind: bool = True,
+                    npart_min: int = 10) -> List[Halo]:
+    """Halo catalogue from labelled particles (one entry per clump with
+    >= ``npart_min`` bound members), heaviest first."""
+    halos: List[Halo] = []
+    for lbl in np.unique(plabels[plabels >= 0]):
+        sel = np.nonzero(plabels == lbl)[0]
+        if len(sel) < npart_min:
+            continue
+        xs, vs, ms = x[sel], v[sel], m[sel]
+        # provisional centre: mass-weighted with periodic unwrap about
+        # the first member
+        rel = xs - xs[0]
+        if periodic:
+            rel = rel - boxlen * np.round(rel / boxlen)
+        center = xs[0] + (rel * ms[:, None]).sum(0) / ms.sum()
+        if unbind:
+            bound = unbind_clump(xs, vs, ms, center, boxlen, G, periodic)
+        else:
+            bound = np.ones(len(sel), dtype=bool)
+        if bound.sum() < npart_min:
+            continue
+        xs, vs, ms = xs[bound], vs[bound], ms[bound]
+        sid = ids[sel][bound]
+        mtot = ms.sum()
+        rel = xs - center
+        if periodic:
+            rel = rel - boxlen * np.round(rel / boxlen)
+        pos = center + (rel * ms[:, None]).sum(0) / mtot
+        if periodic:
+            pos = np.mod(pos, boxlen)
+        vel = (vs * ms[:, None]).sum(0) / mtot
+        r = np.sqrt(((rel - (pos - center)) ** 2).sum(axis=1))
+        phi = _sphere_potential(np.maximum(r, 1e-12), ms, G)
+        ekin = float(0.5 * (ms * ((vs - vel) ** 2).sum(axis=1)).sum())
+        epot = float(0.5 * (ms * phi).sum())
+        halos.append(Halo(index=int(lbl), mass=float(mtot),
+                          npart=int(bound.sum()), pos=pos, vel=vel,
+                          ekin=ekin, epot=epot,
+                          ids=np.sort(sid.astype(np.int64))))
+    halos.sort(key=lambda h: -h.mass)
+    return halos
+
+
+def write_halo_table(halos: List[Halo], path: str):
+    """``clump_masses.txt``-style ascii catalogue."""
+    with open(path, "w") as f:
+        f.write("# index npart mass x y z vx vy vz ekin epot 2T/|U|\n")
+        for h in halos:
+            p3 = list(h.pos) + [0.0] * (3 - len(h.pos))
+            v3 = list(h.vel) + [0.0] * (3 - len(h.vel))
+            vir = 2.0 * h.ekin / max(abs(h.epot), 1e-300)
+            f.write(f"{h.index:8d} {h.npart:8d} {h.mass:14.6e} "
+                    f"{p3[0]:12.6f} {p3[1]:12.6f} {p3[2]:12.6f} "
+                    f"{v3[0]:12.5e} {v3[1]:12.5e} {v3[2]:12.5e} "
+                    f"{h.ekin:12.5e} {h.epot:12.5e} {vir:8.3f}\n")
+
+
+# ----------------------------------------------------------------------
+# merger trees (pm/merger_tree.f90)
+# ----------------------------------------------------------------------
+
+@dataclass
+class TreeLink:
+    """One progenitor→descendant link between consecutive catalogues."""
+    desc: int                    # descendant halo index (later snapshot)
+    prog: int                    # progenitor halo index (earlier)
+    shared: int                  # shared particle count
+    main: bool                   # True: prog is desc's main progenitor
+
+
+def link_catalogues(progs: List[Halo], descs: List[Halo],
+                    ) -> List[TreeLink]:
+    """Progenitor/descendant links via shared particle IDs.
+
+    The reference tracks ``nmost_bound`` tracer particles per clump
+    across snapshots and links each progenitor to the descendant
+    holding most of them (``merger_tree.f90`` make_merger_tree); here
+    every bound particle is a tracer.  The main progenitor of a
+    descendant is the one contributing the most shared particles.
+    """
+    id2prog: Dict[int, int] = {}
+    for hp in progs:
+        for pid in hp.ids:
+            id2prog[int(pid)] = hp.index
+    links: List[TreeLink] = []
+    for hd in descs:
+        counts: Dict[int, int] = {}
+        for pid in hd.ids:
+            pr = id2prog.get(int(pid))
+            if pr is not None:
+                counts[pr] = counts.get(pr, 0) + 1
+        if not counts:
+            continue
+        main = max(counts, key=lambda k: counts[k])
+        for pr, c in sorted(counts.items(), key=lambda kv: -kv[1]):
+            links.append(TreeLink(desc=hd.index, prog=pr, shared=c,
+                                  main=(pr == main)))
+    return links
+
+
+class MergerTree:
+    """Accumulates catalogues over outputs and writes the tree table
+    (``mergertree_txt`` output of ``merger_tree.f90``)."""
+
+    def __init__(self):
+        self.snapshots: List[Tuple[float, List[Halo]]] = []
+        self.links: List[Tuple[int, List[TreeLink]]] = []
+
+    def add_snapshot(self, t: float, halos: List[Halo]):
+        self.snapshots.append((t, halos))
+        if len(self.snapshots) > 1:
+            prev = self.snapshots[-2][1]
+            self.links.append((len(self.snapshots) - 1,
+                               link_catalogues(prev, halos)))
+
+    def progenitors(self, snap: int, halo_index: int) -> List[TreeLink]:
+        """Links into ``halo_index`` of snapshot ``snap`` (1-based on
+        the second snapshot onward)."""
+        for s, links in self.links:
+            if s == snap:
+                return [l for l in links if l.desc == halo_index]
+        return []
+
+    def write(self, path: str):
+        with open(path, "w") as f:
+            f.write("# snap desc_index prog_index shared main\n")
+            for s, links in self.links:
+                for l in links:
+                    f.write(f"{s:6d} {l.desc:8d} {l.prog:8d} "
+                            f"{l.shared:8d} {int(l.main):2d}\n")

@@ -50,13 +50,11 @@ def lap_dirichlet0(phi, dx: float):
     return out / (dx * dx)
 
 
-def multipole_phi(rho, dx: float, coeff, points):
-    """Multipole potential at ``points`` [n, ndim] (box coordinates).
-
-    Monopole + quadrupole about the centre of mass (the dipole is zero
-    there) — ``boundary_potential.f90`` keeps the same orders.  3D uses
-    the 1/r kernel, 2D the log kernel.
-    """
+def multipole_moments(rho, dx: float):
+    """(M, com, Q) — total mass, centre of mass, and (3D) the symmetric
+    quadrupole tensor about it (``multipole_fine``; 6 unique components,
+    Q_ij = Σ ρ (3 x_i x_j − |x|² δ_ij) dV).  One set of whole-grid
+    reductions, shared by every boundary-face evaluation."""
     nd = rho.ndim
     vol = dx ** nd
     axes = [(jnp.arange(n) + 0.5) * dx for n in rho.shape]
@@ -64,18 +62,36 @@ def multipole_phi(rho, dx: float, coeff, points):
     M = jnp.sum(rho) * vol
     Msafe = jnp.where(jnp.abs(M) > 1e-300, M, 1.0)
     com = jnp.stack([jnp.sum(rho * g) * vol / Msafe for g in grids])
-    rel = [g - com[d] for d, g in enumerate(grids)]
+    Q = None
+    if nd == 3:
+        rel = [g - com[d] for d, g in enumerate(grids)]
+        x2 = sum(x * x for x in rel)
+        Q = jnp.zeros((3, 3), rho.dtype)
+        for i in range(3):
+            for j in range(i, 3):
+                qij = jnp.sum(rho * (3.0 * rel[i] * rel[j]
+                                     - (x2 if i == j else 0.0))) * vol
+                Q = Q.at[i, j].set(qij)
+                if i != j:
+                    Q = Q.at[j, i].set(qij)
+    return M, com, Q
+
+
+def multipole_phi(rho, dx: float, coeff, points, moments=None):
+    """Multipole potential at ``points`` [n, ndim] (box coordinates).
+
+    Monopole + quadrupole about the centre of mass (the dipole is zero
+    there) — ``boundary_potential.f90`` keeps the same orders.  3D uses
+    the 1/r kernel, 2D the log kernel.  Pass precomputed ``moments``
+    to amortize the grid reductions over many evaluation batches.
+    """
+    nd = rho.ndim
+    M, com, Q = (multipole_moments(rho, dx) if moments is None
+                 else moments)
     r = points - com[None, :]                       # [n, ndim]
     r2 = jnp.maximum((r ** 2).sum(axis=1), (0.5 * dx) ** 2)
     if nd == 3:
-        # Q_ij = sum rho (3 x_i x_j - |x|^2 delta_ij) dV
-        x2 = sum(x * x for x in rel)
-        quad = jnp.zeros(points.shape[0], rho.dtype)
-        for i in range(3):
-            for j in range(3):
-                qij = jnp.sum(rho * (3.0 * rel[i] * rel[j]
-                                     - (x2 if i == j else 0.0))) * vol
-                quad = quad + qij * r[:, i] * r[:, j]
+        quad = jnp.einsum("ni,ij,nj->n", r, Q, r)
         rr = jnp.sqrt(r2)
         return -coeff / (4.0 * jnp.pi) * (M / rr + 0.5 * quad / rr ** 5)
     if nd == 2:
@@ -112,12 +128,13 @@ def isolated_solve(rho, dx: float, coeff, iters: int = 300, tol: float = 1e-6,
     """
     nd = rho.ndim
     rhs = coeff * rho
+    moments = multipole_moments(rho, dx)   # grid reductions ONCE
     ghosts: List[List[jnp.ndarray]] = []
     for d in range(nd):
         pair = []
         for side in (0, 1):
             pts = _face_points(rho.shape, dx, d, side, rho.dtype)
-            g = multipole_phi(rho, dx, coeff, pts)
+            g = multipole_phi(rho, dx, coeff, pts, moments=moments)
             fshape = tuple(1 if dd == d else rho.shape[dd]
                            for dd in range(nd))
             pair.append(g.reshape(fshape))

@@ -1,0 +1,164 @@
+"""Halo chain: membership, unbinding, catalogue, merger trees
+(``pm/unbinding.f90``, ``pm/clump_merger.f90``, ``pm/merger_tree.f90``)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ramses_tpu.pm.clumps import find_clumps
+from ramses_tpu.pm.halo import (MergerTree, build_catalogue,
+                                link_catalogues, particle_labels,
+                                unbind_clump, write_halo_table)
+
+
+def _blob(rng, center, n, sigma_x, sigma_v, m=1.0):
+    x = rng.normal(center, sigma_x, (n, 3))
+    v = rng.normal(0.0, sigma_v, (n, 3))
+    return x, v, np.full(n, m)
+
+
+def _make_two_halo_system(rng, n1=400, n2=200):
+    """Two bound blobs + diffuse background in a unit box."""
+    x1, v1, m1 = _blob(rng, [0.3, 0.5, 0.5], n1, 0.02, 0.5)
+    x2, v2, m2 = _blob(rng, [0.7, 0.5, 0.5], n2, 0.02, 0.35)
+    xb = rng.uniform(0, 1, (100, 3))
+    vb = rng.normal(0, 0.1, (100, 3))
+    mb = np.full(100, 1.0)
+    x = np.mod(np.concatenate([x1, x2, xb]), 1.0)
+    v = np.concatenate([v1, v2, vb])
+    m = np.concatenate([m1, m2, mb])
+    ids = np.arange(len(m), dtype=np.int64)
+    return x, v, m, ids
+
+
+def _label_particles(x, m, n=32):
+    """NGP density on an n^3 grid → watershed labels → per-particle."""
+    dx = 1.0 / n
+    idx = tuple(np.clip((x[:, d] / dx).astype(int), 0, n - 1)
+                for d in range(3))
+    rho = np.zeros((n, n, n))
+    np.add.at(rho, idx, m / dx ** 3)
+    thr = float(rho.mean()) * 3.0
+    labels, _clumps = find_clumps(rho, thr, relevance=1.5, dx=dx)
+    return particle_labels(x, labels, dx, 1.0)
+
+
+def test_unbind_strips_fast_interloper():
+    rng = np.random.default_rng(2)
+    n = 300
+    x, v, m = _blob(rng, [0.5, 0.5, 0.5], n, 0.02, 0.0)
+    # G*M ~ 300 over r~0.02: escape speed ~ sqrt(2GM/r) ~ 170
+    v[0] = [1e4, 0.0, 0.0]            # far beyond escape speed
+    bound = unbind_clump(x, v, m, np.array([0.5, 0.5, 0.5]), 1.0, G=1.0)
+    assert not bound[0]
+    assert bound.sum() > 0.8 * n
+
+
+def test_catalogue_two_halos():
+    rng = np.random.default_rng(3)
+    x, v, m, ids = _make_two_halo_system(rng)
+    pl = _label_particles(x, m)
+    halos = build_catalogue(x, v, m, ids, pl, 1.0, G=1.0)
+    assert len(halos) >= 2
+    # heaviest first; the two blobs dominate
+    assert halos[0].mass > halos[1].mass
+    assert halos[0].npart > 200 and halos[1].npart > 100
+    # centres near the seeded blobs (in some order)
+    cx = sorted([halos[0].pos[0], halos[1].pos[0]])
+    assert abs(cx[0] - 0.3) < 0.05 and abs(cx[1] - 0.7) < 0.05
+    # bound sets: ids are disjoint
+    assert len(np.intersect1d(halos[0].ids, halos[1].ids)) == 0
+
+
+def test_merger_tree_links_and_merger():
+    rng = np.random.default_rng(4)
+    x, v, m, ids = _make_two_halo_system(rng)
+    pl = _label_particles(x, m)
+    cat1 = build_catalogue(x, v, m, ids, pl, 1.0, G=1.0)[:2]
+
+    # snapshot 2: the two blobs have merged at the midpoint
+    x2 = x.copy()
+    sel1 = np.isin(ids, cat1[0].ids)
+    sel2 = np.isin(ids, cat1[1].ids)
+    mid = np.array([0.5, 0.5, 0.5])
+    x2[sel1] = mid + rng.normal(0, 0.015, (sel1.sum(), 3))
+    x2[sel2] = mid + rng.normal(0, 0.015, (sel2.sum(), 3))
+    pl2 = _label_particles(x2, m)
+    cat2 = build_catalogue(x2, v, m, ids, pl2, 1.0, G=1.0)[:1]
+
+    links = link_catalogues(cat1, cat2)
+    descs = {l.desc for l in links}
+    assert len(descs) == 1                      # one descendant
+    progs = {l.prog for l in links}
+    assert cat1[0].index in progs and cat1[1].index in progs
+    mains = [l for l in links if l.main]
+    assert len(mains) == 1
+    # main progenitor contributes the most particles (the heavier blob)
+    assert mains[0].prog == cat1[0].index
+
+    tree = MergerTree()
+    tree.add_snapshot(0.0, cat1)
+    tree.add_snapshot(1.0, cat2)
+    got = tree.progenitors(1, cat2[0].index)
+    assert {l.prog for l in got} == progs
+
+
+def test_halo_cli_on_snapshots(tmp_path):
+    """End-to-end: PM sim → two dumps → halos CLI → tables + tree."""
+    import jax.numpy as jnp
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import params_from_dict
+    from ramses_tpu.pm.particles import ParticleSet
+    from ramses_tpu.utils.halos import main as halos_main
+
+    rng = np.random.default_rng(7)
+    x1 = np.mod(rng.normal([0.4, 0.5, 0.5], 0.03, (300, 3)), 1.0)
+    xb = rng.uniform(0, 1, (100, 3))
+    x = np.concatenate([x1, xb])
+    v = np.zeros_like(x)
+    m = np.full(400, 1.0 / 400)
+    p = ParticleSet.make(jnp.asarray(x), jnp.asarray(v), jnp.asarray(m))
+    groups = {
+        "run_params": {"hydro": True, "poisson": True, "pic": True},
+        "amr_params": {"levelmin": 4, "levelmax": 5, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "y_center": [0.5],
+                        "z_center": [0.5],
+                        "length_x": [10.0], "length_y": [10.0],
+                        "length_z": [10.0],
+                        "exp_region": [10.0],
+                        "d_region": [0.05], "p_region": [0.05]},
+        "hydro_params": {"gamma": 5.0 / 3.0, "courant_factor": 0.5},
+        "refine_params": {"err_grad_d": 0.3},
+        "output_params": {"tend": 0.2},
+    }
+    sim = AmrSim(params_from_dict(groups, ndim=3), dtype=jnp.float64,
+                 particles=p)
+    sim.evolve(0.02, nstepmax=2)
+    d1 = sim.dump(1, str(tmp_path))
+    sim.evolve(0.05, nstepmax=5)
+    d2 = sim.dump(2, str(tmp_path))
+    tree = tmp_path / "tree.txt"
+    rc = halos_main([d1, d2, "--nx", "32", "--threshold-over-mean", "3",
+                     "--tree", str(tree)])
+    assert rc == 0
+    rows = np.atleast_2d(np.loadtxt(tmp_path / "output_00001"
+                                    / "halos.txt"))
+    assert rows.shape[0] >= 1 and rows[0, 1] >= 200   # blob captured
+    tl = np.atleast_2d(np.loadtxt(tree))
+    assert tl.shape[0] >= 1 and tl[0, 3] >= 200       # shared tracers
+    assert tl[0, 4] == 1                              # main progenitor
+
+
+def test_halo_table_roundtrip(tmp_path):
+    rng = np.random.default_rng(5)
+    x, v, m, ids = _make_two_halo_system(rng)
+    pl = _label_particles(x, m)
+    halos = build_catalogue(x, v, m, ids, pl, 1.0, G=1.0)
+    path = tmp_path / "halos.txt"
+    write_halo_table(halos, str(path))
+    rows = np.loadtxt(path)
+    rows = np.atleast_2d(rows)
+    assert rows.shape[0] == len(halos)
+    np.testing.assert_allclose(rows[0, 2], halos[0].mass, rtol=1e-5)
