@@ -28,6 +28,11 @@ def main(argv=None) -> int:
                     help="solver family (the reference's SOLVER= make "
                          "variable); default: mhd when &INIT_PARAMS sets "
                          "A/B/C_region, hydro otherwise")
+    ap.add_argument("--patch", default=None,
+                    help="user plug-in file overriding condinit/gravana/"
+                         "boundana/source hooks (the runtime equivalent "
+                         "of the reference's compile-time PATCH= VPATH "
+                         "shadowing, bin/Makefile:153-160)")
     ap.add_argument("--verbose", "-v", action="store_true")
     ap.add_argument("--walltime", type=float, default=None,
                     help="wall-clock budget in hours; the watchdog dumps "
@@ -41,6 +46,10 @@ def main(argv=None) -> int:
 
     dtype = getattr(jnp, args.dtype)
     params = load_params(args.namelist, ndim=args.ndim)
+
+    if args.patch:
+        from ramses_tpu import patch
+        patch.install(args.patch, verbose=True)
 
     solver = args.solver
     if solver is None:

@@ -281,6 +281,8 @@ class AmrSim:
     def __init__(self, params: Params, dtype=jnp.float32,
                  init_tree: Optional[Octree] = None,
                  particles=None, init_dense_u=None):
+        from ramses_tpu import patch
+        patch.maybe_install_from_params(params)
         self.params = params
         self.cfg = self._make_cfg(params)
         self.dtype = dtype
@@ -1013,7 +1015,13 @@ class AmrSim:
         if self.tracer_x is not None:
             with self.timers.section("tracers"):
                 ap.tracer_drift_amr(self, dt)
-        if self.sf_spec.enabled or self.sinks is not None:
+        from ramses_tpu import patch
+        user_source = patch.hook("source")
+        if user_source is not None:
+            with self.timers.section("patch source"):
+                user_source(self, dt)
+        if (self.sf_spec.enabled or self.sinks is not None
+                or user_source is not None):
             # the passes changed u AFTER the fused step emitted the next
             # CFL dt — an SN dump makes that cached dt ~1000x too large
             # (the reference re-evaluates courant_fine after the source
@@ -1070,10 +1078,12 @@ class AmrSim:
                 to_regrid = 1 << 30
             # cap: bounds compiled-scan length AND the post-tend no-op
             # tail (masked steps still execute inside the scan)
+            from ramses_tpu import patch as _patch
             chunk = min(to_regrid, nstepmax - self.nstep, 64)
             if not self.gravity and not self.pic and not verbose \
                     and self.cosmo is None and self.sinks is None \
-                    and self.tracer_x is None and chunk > 1:
+                    and self.tracer_x is None \
+                    and _patch.hook("source") is None and chunk > 1:
                 if self.step_chunk(chunk, tend) == 0:
                     break
                 continue

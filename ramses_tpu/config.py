@@ -41,6 +41,9 @@ class RunParams:
     nsubcycle: List[int] = field(default_factory=lambda: [2] * MAXLEVEL)
     ordering: str = "hilbert"
     cost_weighting: bool = True
+    # runtime plug-in overlay (ramses_tpu/patch.py) — the namelist
+    # equivalent of the reference's compile-time PATCH= VPATH shadowing
+    patch: str = ""
 
 
 @dataclass

@@ -80,6 +80,8 @@ class Simulation:
 
     def __init__(self, params: Params, dtype=jnp.float32,
                  particles: Optional[ParticleSet] = None):
+        from ramses_tpu import patch
+        patch.maybe_install_from_params(params)
         self.params = params
         for flag in ("pressure_fix", "difmag"):
             if getattr(params.hydro, flag):
@@ -329,6 +331,13 @@ class Simulation:
             self.sinks = drift_kick(self.sinks, st.f, self.dx, dt_chunk,
                                     self.params.amr.boxlen)
             st.u = jnp.asarray(u_np, st.u.dtype)
+        from ramses_tpu import patch
+        user_source = patch.hook("source")
+        if user_source is not None:
+            # AFTER the stock passes, like the AMR driver — a hook that
+            # post-processes this step's SF/feedback sees the same state
+            # in both drivers
+            user_source(self, dt_chunk)
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)

@@ -81,6 +81,8 @@ def _inflow_state(bc: FaceBC, cfg: HydroStatic, dtype):
 
 def pad(u, spec: BoundarySpec, cfg: HydroStatic, ng: int = 2):
     """Pad an active [nvar, *spatial] grid with ``ng`` ghost cells/side."""
+    from ramses_tpu import patch
+    boundana = patch.hook("boundana")
     for d in range(cfg.ndim):
         ax = u.ndim - cfg.ndim + d
         lo_bc, hi_bc = spec.faces[d]
@@ -109,6 +111,9 @@ def pad(u, spec: BoundarySpec, cfg: HydroStatic, ng: int = 2):
                 reps[ax] = ng
                 return jnp.tile(edge, reps)
             # INFLOW
+            if boundana is not None:
+                vals = tuple(float(v) for v in boundana(d, side, cfg))
+                bc = FaceBC(INFLOW, vals)
             state = _inflow_state(bc, cfg, u.dtype)
             shape = [1] * u.ndim
             shape[0] = cfg.nvar

@@ -30,7 +30,13 @@ def cell_centers(shape: Sequence[int], dx: float, ndim: int):
 
 def region_condinit(x: Sequence[np.ndarray], dx: float, p: Params,
                     cfg: HydroStatic) -> np.ndarray:
-    """Primitive state [nvar, *shape] from &INIT_PARAMS regions."""
+    """Primitive state [nvar, *shape] from &INIT_PARAMS regions (or the
+    installed patch's ``condinit`` hook, which replaces it wholesale —
+    the ``hydro/condinit.f90`` shadowing point)."""
+    from ramses_tpu import patch
+    hk = patch.hook("condinit")
+    if hk is not None:
+        return np.asarray(hk(x, dx, p, cfg))
     init = p.init
     shape = x[0].shape
     q = np.zeros((cfg.nvar,) + shape, dtype=np.float64)

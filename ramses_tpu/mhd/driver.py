@@ -40,6 +40,14 @@ def _region_mask(x, k, init, ndim):
 def mhd_condinit(shape, dx: float, p: Params, cfg: MhdStatic):
     """(u [nvar, *sp], bf [3, *sp]): conservative cell state + staggered
     faces from &INIT_PARAMS regions (uniform B per region)."""
+    from ramses_tpu import patch
+    if patch.hook("condinit") is not None:
+        import warnings
+        warnings.warn(
+            "patch condinit hook is not applied to the MHD solver: MHD "
+            "ICs need divergence-free STAGGERED face fields, which the "
+            "primitive-state hook cannot provide; using &INIT_PARAMS "
+            "regions instead")
     init = p.init
     ndim = cfg.ndim
     axes_c = [(np.arange(n) + 0.5) * dx for n in shape]

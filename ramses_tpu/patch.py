@@ -1,0 +1,125 @@
+"""User patch (plug-in overlay) mechanism.
+
+The reference's entire extensibility story is compile-time file
+shadowing: ``make PATCH=../mypatch`` prepends the patch directory to
+VPATH so a user-provided ``condinit.f90``/``gravana.f90``/
+``boundana.f90``/extra ``amr_step`` physics replaces the stock one
+(``bin/Makefile:153-160``; ``patch/`` tree ships dozens of examples).
+
+The runtime equivalent here: a plain Python file named in the namelist
+(``&RUN_PARAMS patch='mypatch.py'``) or on the CLI (``--patch``),
+imported at startup.  Any function it defines whose name matches a
+known hook overrides the stock implementation:
+
+  ``condinit(x, dx, params, cfg) -> q [nvar, ...]``
+      primitive ICs at the given cell-centre coordinate arrays —
+      replaces the &INIT_PARAMS region machinery
+      (``hydro/condinit.f90``).  ``x`` is a list of ndim coordinate
+      arrays (uniform grids pass meshgrids, the AMR driver flat
+      per-level centre lists): write it shape-generically.  ``dx`` may
+      be None (the rhd paths evaluate on arbitrary centre lists).  The
+      hydro and SRHD solvers consult it; MHD warns and keeps regions
+      (its ICs need divergence-free staggered faces).
+  ``gravana(x, gravity_type, gravity_params, boxlen) -> g [ndim, ...]``
+      analytic gravity field (``poisson/gravana.f90``); consulted for
+      every ``gravity_type > 0``.
+  ``boundana(d, side, cfg) -> primitive values (rho, v..., P)``
+      imposed-inflow state for face (dimension, side) — replaces the
+      &BOUNDARY_PARAMS d/u/v/w/p_bound constants with computed ones
+      (``hydro/boundana.f90``; position-dependent profiles are not yet
+      plumbed through the ghost padding).
+  ``source(sim, dt) -> None``
+      arbitrary extra physics at coarse-step cadence, mutating the
+      simulation in place — the runtime analogue of patching extra
+      calls into ``amr_step`` (both the uniform ``Simulation`` and
+      ``AmrSim`` call it after their stock source passes).
+
+Hooks are optional and independent; unknown names are ignored (a patch
+may carry helpers).  ``install(None)`` / ``clear()`` resets to stock
+behaviour (tests use this).
+
+CAVEAT (mirrors the compile-time nature of the reference mechanism):
+hooks that run inside jitted kernels (``gravana``, ``boundana``) are
+bound at TRACE time — install the patch before constructing the
+simulation, and do not swap patches mid-process while reusing compiled
+functions; the jit cache will not notice.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Any, Callable, Dict, Optional
+
+HOOK_NAMES = ("condinit", "gravana", "boundana", "source")
+
+_active: Dict[str, Callable] = {}
+_module = None
+_source: Optional[str] = None      # file path when loaded from disk
+_auto = False                      # True: installed from a namelist
+
+
+def install(path_or_module, verbose: bool = False, _from_params=False):
+    """Load a patch file (or accept a ready module) and register its
+    hooks.  Replaces any previously installed patch."""
+    global _module, _source, _auto
+    clear()
+    if not path_or_module:
+        return None
+    if isinstance(path_or_module, str):
+        path = path_or_module
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"patch file not found: {path}")
+        name = "ramses_tpu_patch_" + \
+            os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _source = os.path.abspath(path)
+    else:
+        mod = path_or_module
+    _module = mod
+    _auto = _from_params
+    found = []
+    for h in HOOK_NAMES:
+        fn = getattr(mod, h, None)
+        if callable(fn):
+            _active[h] = fn
+            found.append(h)
+    if verbose:
+        print(f"patch: {getattr(mod, '__name__', mod)} overrides "
+              f"{found or 'nothing'}")
+    return mod
+
+
+def clear():
+    global _module, _source, _auto
+    _active.clear()
+    _module = None
+    _source = None
+    _auto = False
+
+
+def hook(name: str) -> Optional[Callable]:
+    """The installed override for ``name``, or None (stock behaviour)."""
+    return _active.get(name)
+
+
+def maybe_install_from_params(params, verbose: bool = False):
+    """Reconcile the active patch with the namelist's ``&RUN_PARAMS
+    patch=``; drivers call this on construction.
+
+    Explicit :func:`install` calls (CLI ``--patch``, tests) win over
+    the namelist.  A namelist-auto-installed patch is swapped out when
+    a later simulation names a different file, and cleared when a later
+    simulation names none — a second sim in the same process must not
+    silently inherit the first one's hooks."""
+    path = str(getattr(params.run, "patch", "") or "").strip("'\" ")
+    if _module is not None and not _auto:
+        return                     # explicit install wins
+    if not path:
+        if _auto:
+            clear()
+        return
+    if _source != os.path.abspath(path):
+        install(path, verbose=verbose, _from_params=True)

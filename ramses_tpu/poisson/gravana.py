@@ -29,7 +29,13 @@ def cell_centers(shape: Sequence[int], dx: float, dtype=jnp.float64):
 
 def gravana(x, gravity_type: int, gravity_params: Sequence[float],
             boxlen: float):
-    """Analytic acceleration at positions x [ndim, *spatial]."""
+    """Analytic acceleration at positions x [ndim, *spatial] (the
+    installed patch's ``gravana`` hook replaces the stock models —
+    the ``poisson/gravana.f90`` shadowing point)."""
+    from ramses_tpu import patch
+    hk = patch.hook("gravana")
+    if hk is not None:
+        return jnp.asarray(hk(x, gravity_type, gravity_params, boxlen))
     nd = x.shape[0]
     gp = list(gravity_params) + [0.0] * 10
     if gravity_type == 1:

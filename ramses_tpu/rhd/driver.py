@@ -20,7 +20,12 @@ def rhd_region_prims(xc, p: Params, cfg: RhdStatic):
     """Primitive state [nvar, *shape] from &INIT_PARAMS regions at the
     given coordinate arrays ``xc`` (d, u/v/w = velocities in units of c,
     P) — the rhd test-suite ``condinit`` on arbitrary cell centres (the
-    AMR driver passes flat per-level centre lists)."""
+    AMR driver passes flat per-level centre lists).  A patch ``condinit``
+    hook replaces it (the rhd ``condinit.f90`` shadowing point)."""
+    from ramses_tpu import patch
+    hk = patch.hook("condinit")
+    if hk is not None:
+        return np.asarray(hk(xc, None, p, cfg))
     init = p.init
     ndim = cfg.ndim
     q = np.zeros((cfg.nvar,) + tuple(xc[0].shape))

@@ -1,0 +1,181 @@
+"""User patch overlay — the runtime equivalent of the reference's
+compile-time PATCH= VPATH shadowing (``bin/Makefile:153-160``)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu import patch
+from ramses_tpu.config import params_from_dict
+
+
+@pytest.fixture(autouse=True)
+def _clean_patch():
+    patch.clear()
+    yield
+    patch.clear()
+
+
+def _base_groups(**extra):
+    g = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "length_x": [10.0],
+                        "exp_region": [10.0],
+                        "d_region": [1.0], "p_region": [1.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5},
+        "output_params": {"tend": 0.01},
+    }
+    g.update(extra)
+    return g
+
+
+PATCH_SRC = '''
+import numpy as np
+
+def condinit(x, dx, params, cfg):
+    """Linear density ramp along x — not expressible as regions."""
+    q = np.zeros((cfg.nvar,) + x[0].shape)
+    q[0] = 1.0 + x[0]
+    q[cfg.ndim + 1] = 2.5
+    return q
+
+def gravana(x, gravity_type, gravity_params, boxlen):
+    import jax.numpy as jnp
+    g = jnp.zeros_like(x)
+    return g.at[0].set(-3.0)          # uniform -x acceleration
+
+def source(sim, dt):
+    sim._patch_calls = getattr(sim, "_patch_calls", 0) + 1
+'''
+
+
+def test_install_from_file_and_hooks(tmp_path):
+    pf = tmp_path / "mypatch.py"
+    pf.write_text(PATCH_SRC)
+    patch.install(str(pf))
+    assert patch.hook("condinit") is not None
+    assert patch.hook("gravana") is not None
+    assert patch.hook("source") is not None
+    assert patch.hook("boundana") is None
+    patch.clear()
+    assert patch.hook("condinit") is None
+
+
+def test_namelist_patch_reconciliation(tmp_path):
+    """A second sim with a different (or no) namelist patch must not
+    inherit the first one's hooks; explicit installs win."""
+    from ramses_tpu.driver import Simulation
+    pf = tmp_path / "a.py"
+    pf.write_text(PATCH_SRC)
+    p1 = params_from_dict(_base_groups(), ndim=1)
+    p1.run.patch = str(pf)
+    Simulation(p1, dtype=jnp.float64)
+    assert patch.hook("condinit") is not None
+    # second sim, no patch: hooks cleared
+    p2 = params_from_dict(_base_groups(), ndim=1)
+    sim2 = Simulation(p2, dtype=jnp.float64)
+    assert patch.hook("condinit") is None
+    rho = np.asarray(sim2.state.u)[0]
+    np.testing.assert_allclose(rho, 1.0)      # stock region ICs
+    # explicit install survives a namelist-less sim
+    patch.install(str(pf))
+    Simulation(params_from_dict(_base_groups(), ndim=1),
+               dtype=jnp.float64)
+    assert patch.hook("condinit") is not None
+
+
+def test_rhd_condinit_hook(tmp_path):
+    """The patch condinit also shadows the rhd solver's IC path."""
+    from ramses_tpu.rhd.core import RhdStatic
+    from ramses_tpu.rhd.driver import rhd_condinit
+    pf = tmp_path / "rhdpatch.py"
+    pf.write_text("""
+import numpy as np
+
+def condinit(x, dx, params, cfg):
+    q = np.zeros((cfg.nvar,) + x[0].shape)
+    q[0] = 2.0 + x[0]
+    q[4] = 0.5
+    return q
+""")
+    patch.install(str(pf))
+    p = params_from_dict(_base_groups(), ndim=1)
+    cfg = RhdStatic(ndim=1)
+    u = rhd_condinit((8,), 1.0 / 8, p, cfg)
+    # D = rho*Gamma = rho at rest: the ramp survives the conversion
+    x = (np.arange(8) + 0.5) / 8
+    np.testing.assert_allclose(u[0], 2.0 + x, rtol=1e-12)
+
+
+def test_condinit_hook_replaces_regions(tmp_path):
+    from ramses_tpu.driver import Simulation
+    pf = tmp_path / "mypatch.py"
+    pf.write_text(PATCH_SRC)
+    p = params_from_dict(_base_groups(), ndim=1)
+    p.run.patch = str(pf)
+    sim = Simulation(p, dtype=jnp.float64)
+    rho = np.asarray(sim.state.u)[0]
+    x = (np.arange(16) + 0.5) / 16
+    np.testing.assert_allclose(rho, 1.0 + x, rtol=1e-6)
+
+
+def test_gravana_hook(tmp_path):
+    from ramses_tpu.poisson.coupling import GravitySpec, gravity_field
+    pf = tmp_path / "mypatch.py"
+    pf.write_text(PATCH_SRC)
+    patch.install(str(pf))
+    spec = GravitySpec(enabled=True, gravity_type=1,
+                       gravity_params=(9.9,))
+    f = gravity_field(spec, jnp.ones((8, 8)), 1.0 / 8)
+    assert float(f[0][0, 0]) == -3.0          # hook, not the 9.9 const
+
+
+def test_source_hook_called_amr(tmp_path):
+    from ramses_tpu.amr.hierarchy import AmrSim
+    pf = tmp_path / "mypatch.py"
+    pf.write_text(PATCH_SRC)
+    g = _base_groups()
+    g["run_params"]["patch"] = str(pf)
+    p = params_from_dict(g, ndim=1)
+    sim = AmrSim(p, dtype=jnp.float64)
+    sim.evolve(0.01, nstepmax=4)
+    assert getattr(sim, "_patch_calls", 0) == sim.nstep
+
+
+def test_cli_patch_flag(tmp_path):
+    import ramses_tpu.__main__ as main_mod
+    pf = tmp_path / "mypatch.py"
+    pf.write_text(PATCH_SRC)
+    nml = tmp_path / "run.nml"
+    nml.write_text(f"""
+&RUN_PARAMS
+hydro=.true.
+nstepmax=2
+/
+&AMR_PARAMS
+levelmin=4
+levelmax=4
+boxlen=1.0
+/
+&INIT_PARAMS
+nregion=1
+region_type='square'
+x_center=0.5
+length_x=10.0
+exp_region=10.0
+d_region=1.0
+p_region=1.0
+/
+&HYDRO_PARAMS
+gamma=1.4
+/
+&OUTPUT_PARAMS
+tend=0.005
+output_dir='{tmp_path}'
+/
+""")
+    assert main_mod.main([str(nml), "--ndim", "1", "--dtype", "float64",
+                          "--patch", str(pf)]) == 0
