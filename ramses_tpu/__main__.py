@@ -88,6 +88,8 @@ def main(argv=None) -> int:
                        verbose=args.verbose)
             print(f"mhd-amr t={sim.t:.5e} nstep={sim.nstep} "
                   f"max|divB|/max|B|*dx={sim.max_divb():.3e}")
+            sim.dump(1, params.output.output_dir,
+                     namelist_path=args.namelist)
         else:
             from ramses_tpu.mhd.driver import MhdSimulation
             sim = MhdSimulation(params, dtype=dtype)

@@ -232,6 +232,12 @@ class Simulation:
         (signal dumps, stop_run file, walltime watchdog)."""
         st = self.state
         nstepmax = self.params.run.nstepmax
+        from ramses_tpu import patch
+        if patch.hook("source") is not None:
+            # the source hook is documented at coarse-step cadence
+            # (patch.py): fused multi-step chunks would hand it one
+            # aggregated ~chunk*dt — run step-at-a-time instead
+            chunk = 1
         # Time is integrated in f64 (f32 if x64 is disabled) regardless of
         # the state dtype: with a bf16 state, t += dt would stall once
         # dt < eps(t) and the run would spin to nstepmax.

@@ -17,9 +17,14 @@ from ramses_tpu.io import reader as rdr
 from ramses_tpu.io.snapshot import prim_out_to_cons, ref_cell_perm
 
 
-def restore_tree_state(outdir: str, cfg, levelmin: int):
+def restore_tree_state(outdir: str, cfg, levelmin: int, to_cons=None):
     """(tree_levels, u_levels, meta): per-level oct coords and conservative
-    cell arrays (our x-slowest flat order) for levels >= levelmin."""
+    cell arrays (our x-slowest flat order) for levels >= levelmin.
+
+    ``to_cons(q_rows)``: output-variable → stored-state conversion;
+    defaults to the hydro ``prim_out_to_cons``.  MHD restores pass a
+    converter for its extended column set (or identity to get the raw
+    output rows)."""
     snap = rdr.load_snapshot(outdir)
     ncpu = len(snap["amr"])
     h = snap["amr"][0].header
@@ -47,7 +52,8 @@ def restore_tree_state(outdir: str, cfg, levelmin: int):
             continue
         tree_og[l] = np.concatenate(ogs)
         q = np.concatenate(qs).reshape(-1, qs[0].shape[2])
-        u_lv[l] = prim_out_to_cons(q, cfg)
+        u_lv[l] = (prim_out_to_cons(q, cfg) if to_cons is None
+                   else to_cons(q))
     meta = dict(t=h["t"], nstep=h["nstep"], iout=h["iout"],
                 aexp=h["aexp"], boxlen=h["boxlen"],
                 nlevelmax=h["nlevelmax"], dtold=h["dtold"],

@@ -117,6 +117,86 @@ def prim_out_to_cons(q: np.ndarray, cfg) -> np.ndarray:
 
 
 # ----------------------------------------------------------------------
+# MHD output variables (mhd/output_hydro.f90:82-150: density, velocity,
+# B_left, B_right, [non-thermal], thermal_pressure, scalars)
+# ----------------------------------------------------------------------
+
+def mhd_var_names(mcfg) -> List[str]:
+    dim_keys = ["x", "y", "z"]
+    names = ["density"]
+    names += [f"velocity_{k}" for k in dim_keys]
+    names += [f"B_{k}_left" for k in dim_keys]
+    names += [f"B_{k}_right" for k in dim_keys]
+    names += ["thermal_pressure"]
+    names += [f"scalar_{i:02d}" for i in range(mcfg.npassive)]
+    return names
+
+
+def mhd_rows_to_out(raw: np.ndarray, mcfg) -> np.ndarray:
+    """Raw rows [n, nvar+6] = [u | bf_left(3) | bf_right(3)] → the
+    reference MHD output columns (``mhd/output_hydro.f90:82-150``)."""
+    raw = np.asarray(raw, dtype=np.float64)
+    nv = mcfg.nvar
+    rho = np.maximum(raw[:, 0], mcfg.smallr)
+    out = np.empty((len(raw), 11 + mcfg.npassive))
+    out[:, 0] = raw[:, 0]
+    ekin = np.zeros_like(rho)
+    for c in range(3):
+        out[:, 1 + c] = raw[:, 1 + c] / rho
+        ekin += 0.5 * raw[:, 1 + c] ** 2 / rho
+    emag = 0.5 * (raw[:, 5:8] ** 2).sum(axis=1)
+    out[:, 4:7] = raw[:, nv:nv + 3]          # B_left
+    out[:, 7:10] = raw[:, nv + 3:nv + 6]     # B_right
+    out[:, 10] = (mcfg.gamma - 1.0) * (raw[:, 4] - ekin - emag)
+    for i in range(mcfg.npassive):
+        out[:, 11 + i] = raw[:, 8 + i] / rho
+    return out
+
+
+def mhd_out_to_state(q: np.ndarray, mcfg):
+    """Inverse of :func:`mhd_rows_to_out`: output columns → (u rows
+    [n, nvar], bf rows [n, 3, 2]) with cell-centred B rebuilt as the
+    face mean (``mhd/init_hydro.f90`` restart read)."""
+    q = np.asarray(q, dtype=np.float64)
+    n = len(q)
+    u = np.zeros((n, mcfg.nvar))
+    bf = np.zeros((n, 3, 2))
+    rho = q[:, 0]
+    u[:, 0] = rho
+    ekin = np.zeros(n)
+    for c in range(3):
+        u[:, 1 + c] = rho * q[:, 1 + c]
+        ekin += 0.5 * rho * q[:, 1 + c] ** 2
+    bf[:, :, 0] = q[:, 4:7]
+    bf[:, :, 1] = q[:, 7:10]
+    bc = 0.5 * (bf[:, :, 0] + bf[:, :, 1])
+    u[:, 5:8] = bc
+    emag = 0.5 * (bc ** 2).sum(axis=1)
+    u[:, 4] = q[:, 10] / (mcfg.gamma - 1.0) + ekin + emag
+    for i in range(mcfg.npassive):
+        u[:, 8 + i] = rho * q[:, 11 + i]
+    return u, bf
+
+
+def snapshot_from_mhd_amr(sim, iout: int = 1) -> Snapshot:
+    """Snapshot of an :class:`~ramses_tpu.mhd.amr.MhdAmrSim` — the raw
+    rows append both duplicated face fields to the cell state so the
+    staggered field round-trips exactly."""
+    mcfg = sim.mcfg
+
+    def raw_of(l, nc):
+        u = np.asarray(sim.u[l], dtype=np.float64)[:nc]
+        bf = np.asarray(sim.bfs[l], dtype=np.float64)[:nc]
+        return np.concatenate([u, bf[:, :, 0], bf[:, :, 1]], axis=1)
+
+    return snapshot_from_amr(
+        sim, iout, raw_of=raw_of,
+        to_out=lambda rows: mhd_rows_to_out(rows, mcfg),
+        names=mhd_var_names(mcfg), nvar_raw=mcfg.nvar + 6,
+        gamma=mcfg.gamma)
+
+
+# ----------------------------------------------------------------------
 # snapshot tree model
 # ----------------------------------------------------------------------
 
@@ -304,8 +384,20 @@ def snapshot_from_uniform(sim, iout: int = 1) -> Snapshot:
     return snap
 
 
-def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
-    """Build a snapshot from an :class:`AmrSim` (host octree + levels)."""
+def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
+                      names: Optional[List[str]] = None,
+                      nvar_raw: Optional[int] = None,
+                      gamma: Optional[float] = None) -> Snapshot:
+    """Build a snapshot from an :class:`AmrSim` (host octree + levels).
+
+    The optional hooks generalize the cell-state handling for solver
+    families whose stored state is not the hydro [ncell, nvar] array
+    (MHD carries staggered faces): ``raw_of(l, nc)`` returns the raw
+    per-cell rows of a level, ``to_out(rows)`` converts raw rows to the
+    reference output variables, ``names`` the matching column names,
+    ``nvar_raw`` the raw column count.  Defaults implement the hydro
+    behaviour (``cons_to_prim_out`` on ``sim.u``).
+    """
     from ramses_tpu.amr import keys as kmod
     from ramses_tpu.amr.tree import cell_offsets
     from ramses_tpu.units import units as units_fn
@@ -313,6 +405,13 @@ def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
     cfg = sim.cfg
     params = sim.params
     ndim = cfg.ndim
+    if raw_of is None:
+        raw_of = lambda l, nc: np.asarray(sim.u[l], dtype=np.float64)[:nc]
+    if to_out is None:
+        to_out = lambda rows: cons_to_prim_out(rows, cfg)
+    names = names if names is not None else hydro_var_names(cfg)
+    nvar_raw = nvar_raw if nvar_raw is not None else cfg.nvar
+    gamma = gamma if gamma is not None else cfg.gamma
     lmin, lmax = sim.lmin, sim.lmax
     perm = ref_cell_perm(ndim)
     offs = cell_offsets(ndim)
@@ -338,13 +437,13 @@ def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
             continue
         m = sim.maps[l]
         nc = m.noct * (1 << ndim)
-        cellvals[l] = np.asarray(sim.u[l], dtype=np.float64)[:nc]
+        cellvals[l] = raw_of(l, nc)
     dense = None
     for l in range(lmin - 1, 0, -1):
         if dense is None:
             # build dense array at lmin (complete base level)
             n = 1 << lmin
-            nv = cfg.nvar
+            nv = nvar_raw
             dense = np.zeros((n,) * ndim + (nv,))
             cc = tree.cell_coords(lmin)
             dense[tuple(cc[:, d] for d in range(ndim))] = cellvals[lmin]
@@ -366,7 +465,7 @@ def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
             son = np.where(hit, id_base[l + 1] + pos + 1, 0).astype(np.int32)
         else:
             son = np.zeros(noct * (1 << ndim), dtype=np.int32)
-        hyd = cons_to_prim_out(cellvals[l], cfg)
+        hyd = to_out(cellvals[l])
         levels[l] = SnapLevel(
             og=og, son=son.reshape(noct, -1)[:, perm],
             hydro=hyd.reshape(noct, 1 << ndim, -1)[:, perm])
@@ -376,8 +475,8 @@ def snapshot_from_amr(sim, iout: int = 1) -> Snapshot:
              if getattr(sim, "p", None) is not None else None)
     return Snapshot(
         ndim=ndim, nlevelmax=lmax, levels=levels,
-        boxlen=sim.boxlen, t=float(sim.t), gamma=cfg.gamma,
-        var_names=hydro_var_names(cfg), units=un, levelmin=lmin,
+        boxlen=sim.boxlen, t=float(sim.t), gamma=gamma,
+        var_names=names, units=un, levelmin=lmin,
         nstep=int(sim.nstep), nstep_coarse=int(sim.nstep),
         tout=[params.output.tend or 0.0], particles=parts)
 

@@ -608,7 +608,15 @@ class MhdAmrSim(AmrSim):
     _needs_mig_log = True
     _pm_physics = False      # MHD state layout carries cell-centred B
 
-    def __init__(self, params: Params, dtype=jnp.float32):
+    def __init__(self, params: Params, dtype=jnp.float32, **kw):
+        from ramses_tpu import patch
+        patch.maybe_install_from_params(params)
+        if patch.hook("condinit") is not None:
+            import warnings
+            warnings.warn(
+                "patch condinit hook is not applied to the MHD solver: "
+                "MHD ICs need divergence-free STAGGERED face fields; "
+                "using &INIT_PARAMS regions instead")
         self.mcfg = MhdStatic.from_params(params)
         if params.run.poisson or params.run.pic:
             raise NotImplementedError("MHD-AMR: gravity/particles TBD")
@@ -618,7 +626,7 @@ class MhdAmrSim(AmrSim):
                 if k not in (bmod.PERIODIC, bmod.OUTFLOW):
                     raise NotImplementedError(
                         "MHD-AMR boundaries: periodic/outflow only")
-        super().__init__(params, dtype=dtype)
+        super().__init__(params, dtype=dtype, **kw)
 
     # ---- state allocation -------------------------------------------
     def _mhd_region_state(self, lvl: int):
@@ -872,6 +880,9 @@ class MhdAmrSim(AmrSim):
                 self.u, self.bfs, self.dev,
                 jnp.asarray(float(dt), self.dtype), self._fused_spec())
         self.t += float(dt)
+        # coarse-cadence source passes (for MHD only the patch 'source'
+        # hook is live — SF/sinks/tracers are _pm_physics-gated)
+        self._source_passes(float(dt))
         self.dt_old = float(dt)
         self.nstep += 1
 
@@ -925,9 +936,51 @@ class MhdAmrSim(AmrSim):
             worst = max(worst, float(np.abs(div[leaf]).max()) / bscale)
         return worst
 
-    def dump(self, *a, **k):
-        raise NotImplementedError("MHD-AMR snapshots: next round")
+    def dump(self, iout: int = 1, base_dir: str = ".",
+             namelist_path=None, ncpu: int = 1) -> str:
+        """Reference-format snapshot with the MHD column set (density,
+        velocity, B_left/right faces, pressure —
+        ``mhd/output_hydro.f90:82-150``); the duplicated staggered
+        faces round-trip exactly."""
+        from ramses_tpu.io import snapshot as snapmod
+        snap = snapmod.snapshot_from_mhd_amr(self, iout)
+        return snapmod.dump_all(snap, iout, base_dir,
+                                namelist_path=namelist_path, ncpu=ncpu)
 
     @classmethod
-    def from_snapshot(cls, *a, **k):
-        raise NotImplementedError("MHD-AMR restart: next round")
+    def from_snapshot(cls, params: Params, outdir: str,
+                      dtype=jnp.float32) -> "MhdAmrSim":
+        """Resume from an MHD snapshot (``mhd/init_hydro.f90`` restart
+        read: the face fields come back verbatim, the cell-centred B is
+        their mean)."""
+        from ramses_tpu.amr.tree import Octree
+        from ramses_tpu.io.restart import restore_tree_state
+        from ramses_tpu.io.snapshot import mhd_out_to_state
+        mcfg = MhdStatic.from_params(params)
+        tree_og, q_lv, meta, _parts = restore_tree_state(
+            outdir, None, params.amr.levelmin, to_cons=lambda q: q)
+        tree = Octree(params.ndim, params.amr.levelmin,
+                      params.amr.levelmax)
+        for l, og in tree_og.items():
+            tree.set_level(l, og)
+        sim = cls(params, dtype=dtype, init_tree=tree)
+        ttd = 2 ** params.ndim
+        for l, q in q_lv.items():
+            og = tree_og[l]
+            pos = tree.lookup(l, og)
+            m = sim.maps[l]
+            u_rows, bf_rows = mhd_out_to_state(q, mcfg)
+            order = np.argsort(pos)
+            u_out = np.array(sim.u[l])
+            bf_out = np.array(sim.bfs[l])
+            u_out[:m.noct * ttd] = u_rows.reshape(
+                len(og), ttd, mcfg.nvar)[order].reshape(-1, mcfg.nvar)
+            bf_out[:m.noct * ttd] = bf_rows.reshape(
+                len(og), ttd, 3, 2)[order].reshape(-1, 3, 2)
+            sim.u[l] = jnp.asarray(u_out, dtype=dtype)
+            sim.bfs[l] = jnp.asarray(bf_out, dtype=dtype)
+        sim._restrict_all()
+        sim._dt_cache = None
+        sim.t = float(meta["t"])
+        sim.nstep = int(meta["nstep"])
+        return sim

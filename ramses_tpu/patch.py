@@ -38,11 +38,13 @@ Hooks are optional and independent; unknown names are ignored (a patch
 may carry helpers).  ``install(None)`` / ``clear()`` resets to stock
 behaviour (tests use this).
 
-CAVEAT (mirrors the compile-time nature of the reference mechanism):
-hooks that run inside jitted kernels (``gravana``, ``boundana``) are
-bound at TRACE time — install the patch before constructing the
-simulation, and do not swap patches mid-process while reusing compiled
-functions; the jit cache will not notice.
+Hooks that run inside jitted kernels (``gravana``, ``boundana``) are
+bound at TRACE time; installing/clearing a patch whose trace-time
+hooks differ therefore drops JAX's compilation caches so the next
+simulation re-traces with the new behaviour (a same-shape second sim
+would otherwise silently reuse the previous patch's compiled kernels).
+Swapping patches while a simulation object is mid-run remains
+unsupported.
 """
 
 from __future__ import annotations
@@ -52,6 +54,23 @@ import os
 from typing import Any, Callable, Dict, Optional
 
 HOOK_NAMES = ("condinit", "gravana", "boundana", "source")
+# hooks whose lookup happens at jit TRACE time: swapping them must
+# drop compiled kernels or a same-shape second sim silently reuses the
+# previous patch's traced behaviour
+_TRACED_HOOKS = ("gravana", "boundana")
+
+
+def _drop_jit_caches_if_needed(before: dict):
+    """Clear JAX's compilation caches when the set/identity of
+    trace-time hooks changed (install/clear between simulations)."""
+    changed = any(before.get(h) is not _active.get(h)
+                  for h in _TRACED_HOOKS)
+    if changed:
+        try:
+            import jax
+            jax.clear_caches()
+        except Exception:
+            pass
 
 _active: Dict[str, Callable] = {}
 _module = None
@@ -63,8 +82,10 @@ def install(path_or_module, verbose: bool = False, _from_params=False):
     """Load a patch file (or accept a ready module) and register its
     hooks.  Replaces any previously installed patch."""
     global _module, _source, _auto
-    clear()
+    before = dict(_active)
+    _clear_state()
     if not path_or_module:
+        _drop_jit_caches_if_needed(before)
         return None
     if isinstance(path_or_module, str):
         path = path_or_module
@@ -89,15 +110,22 @@ def install(path_or_module, verbose: bool = False, _from_params=False):
     if verbose:
         print(f"patch: {getattr(mod, '__name__', mod)} overrides "
               f"{found or 'nothing'}")
+    _drop_jit_caches_if_needed(before)
     return mod
 
 
-def clear():
+def _clear_state():
     global _module, _source, _auto
     _active.clear()
     _module = None
     _source = None
     _auto = False
+
+
+def clear():
+    before = dict(_active)
+    _clear_state()
+    _drop_jit_caches_if_needed(before)
 
 
 def hook(name: str) -> Optional[Callable]:
