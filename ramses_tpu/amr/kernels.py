@@ -120,31 +120,29 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     # [noct, 6^d] → [6..., noct]
     okl = ok_ref.T.reshape((6,) * ndim + (noct,))
 
-    flux, _tmp = _unsplit_fn(cfg)(uloc, gloc, dt, (dx,) * ndim, bcfg)
+    flux, tmp = _unsplit_fn(cfg)(uloc, gloc, dt, (dx,) * ndim, bcfg)
     # flux[d]: [nvar, 6..., noct], defined at the LOW face of each cell.
 
     # Reset flux along direction at refined interfaces
     # (hydro/godunov_fine.f90:718-747): a face is zeroed when either
-    # adjacent cell is refined — its contribution comes from level+1.
+    # adjacent cell is refined — its contribution comes from level+1;
+    # the reference zeroes the tmp (divu/eint-flux) faces the same way.
     fluxes = []
+    tmps = []
     for d in range(ndim):
         keep = ~(okl | jnp.roll(okl, 1, axis=d))       # [6..., noct]
         fluxes.append(flux[d] * keep[None].astype(flux.dtype))
-    # conservative update of the oct's 2^d interior cells (indices 2:4)
-    du = jnp.zeros((nvar,) + (2,) * ndim + (noct,), uloc.dtype)
-    for d in range(ndim):
-        lo = []
-        hi = []
-        for d2 in range(ndim):
-            if d2 == d:
-                lo.append(slice(2, 4))
-                hi.append(slice(3, 5))
-            else:
-                lo.append(slice(2, 4))
-                hi.append(slice(2, 4))
-        f = fluxes[d]
-        du = du + (f[(slice(None),) + tuple(lo)]
-                   - f[(slice(None),) + tuple(hi)])
+        if tmp is not None:
+            tmps.append(tmp[d] * keep[None].astype(flux.dtype))
+    # conservative update over the whole block (outer cells hold
+    # wrapped garbage the interior never consumes), then the optional
+    # dual-energy fix, then the interior extraction
+    un_blk = muscl.apply_fluxes(uloc, jnp.stack(fluxes), bcfg)
+    if tmp is not None and (cfg.pressure_fix or cfg.nener):
+        un_blk = muscl.dual_energy_fix(uloc, un_blk, jnp.stack(tmps),
+                                       dt, (dx,) * ndim, bcfg)
+    interior = (slice(None),) + tuple(slice(2, 4) for _ in range(ndim))
+    du = un_blk[interior] - uloc[interior]
     # [nvar, 2..., noct] → flat [noct*2^d, nvar]
     du_flat = jnp.transpose(
         du, (ndim + 1,) + tuple(range(1, ndim + 1)) + (0,)
@@ -203,7 +201,7 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
             du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
         return du_rows
     up = bmod.pad(ud, bc, cfg, muscl.NGHOST)
-    flux, _tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
+    flux, tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
     if ok_dense is not None:
         okp = ok_dense.reshape(shape)
         for d in range(nd):
@@ -212,11 +210,18 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
                     for d2 in range(nd)]
             okp = jnp.pad(okp, padw, mode=mode)
         masked = []
+        masked_tmp = []
         for d in range(nd):
             keep = ~(okp | jnp.roll(okp, 1, axis=d))
             masked.append(flux[d] * keep[None].astype(flux.dtype))
+            if tmp is not None:
+                masked_tmp.append(tmp[d] * keep[None].astype(flux.dtype))
         flux = jnp.stack(masked)
+        if tmp is not None:
+            tmp = jnp.stack(masked_tmp)
     un = muscl.apply_fluxes(up, flux, cfg)
+    if tmp is not None and (cfg.pressure_fix or cfg.nener):
+        un = muscl.dual_energy_fix(up, un, tmp, dt, (dx,) * nd, cfg)
     du_dense = bmod.unpad(un, nd, muscl.NGHOST) - ud   # [nvar, *shape]
     du_rows = jnp.moveaxis(du_dense, 0, -1).reshape(ncell, nvar)[perm]
     if u_flat.shape[0] > ncell:

@@ -83,11 +83,10 @@ class Simulation:
         from ramses_tpu import patch
         patch.maybe_install_from_params(params)
         self.params = params
-        for flag in ("pressure_fix", "difmag"):
-            if getattr(params.hydro, flag):
-                import warnings
-                warnings.warn(f"HYDRO_PARAMS {flag} requested but not yet "
-                              "implemented in this solver; running without.")
+        if getattr(params.hydro, "difmag", 0.0):
+            import warnings
+            warnings.warn("HYDRO_PARAMS difmag requested but not yet "
+                          "implemented in this solver; running without.")
         self.cfg = HydroStatic.from_params(params)
         lmin = params.amr.levelmin
         n = 2 ** lmin

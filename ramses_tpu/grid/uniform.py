@@ -55,13 +55,19 @@ def step(grid: UniformGrid, u, dt):
     (:mod:`ramses_tpu.hydro.pallas_muscl`) when it covers the config;
     the XLA path below is the reference implementation (bit-identical)."""
     cfg = grid.cfg
+    # the time axis runs in f64 while the state may be f32/bf16: keep
+    # the sweep in the state dtype
+    dt = jnp.asarray(dt, u.dtype)
     if _pallas_ok(grid, u.dtype):
         from ramses_tpu.hydro import pallas_muscl as pk
         up, _ = pk.pad_xy(u, grid.bc, cfg)
         return pk.fused_step_padded(up, dt, cfg, grid.dx, grid.shape)
     up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
-    flux, _tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
+    flux, tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
     un = muscl.apply_fluxes(up, flux, cfg)
+    if cfg.pressure_fix or cfg.nener:
+        un = muscl.dual_energy_fix(up, un, tmp, dt,
+                                   (grid.dx,) * cfg.ndim, cfg)
     return bmod.unpad(un, cfg.ndim, muscl.NGHOST)
 
 
@@ -71,9 +77,13 @@ def step_with_flux(grid: UniformGrid, u, dt):
     face of every active cell, ``[ndim, *sp]`` — the quantity the
     Monte-Carlo tracers sample (``hydro/godunov_fine.f90:685-715``)."""
     cfg = grid.cfg
+    dt = jnp.asarray(dt, u.dtype)
     up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
-    flux, _tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
+    flux, tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
     un = muscl.apply_fluxes(up, flux, cfg)
+    if cfg.pressure_fix or cfg.nener:
+        un = muscl.dual_energy_fix(up, un, tmp, dt,
+                                   (grid.dx,) * cfg.ndim, cfg)
     mass_flux = jnp.stack([bmod.unpad(flux[d][0], cfg.ndim, muscl.NGHOST)
                            for d in range(cfg.ndim)])
     return bmod.unpad(un, cfg.ndim, muscl.NGHOST), mass_flux

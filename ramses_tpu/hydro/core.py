@@ -42,6 +42,7 @@ class HydroStatic:
     courant_factor: float = 0.5
     difmag: float = 0.0
     pressure_fix: bool = False
+    beta_fix: float = 0.0       # truncation-error threshold coefficient
     # Array-layout switch: spatial axes 1..ndim with a trailing batch axis
     # ([nvar, *spatial, batch]) instead of trailing spatial.  The AMR oct
     # batches use this so the (large) oct axis is minor-most — TPU tiles
@@ -83,4 +84,5 @@ class HydroStatic:
                    niter_riemann=int(h.niter_riemann),
                    courant_factor=float(h.courant_factor),
                    difmag=float(h.difmag),
-                   pressure_fix=bool(h.pressure_fix))
+                   pressure_fix=bool(h.pressure_fix),
+                   beta_fix=float(getattr(h, "beta_fix", 0.0)))

@@ -198,6 +198,71 @@ def trace(q, dq, dt, dx: Sequence[float], cfg: HydroStatic):
     return jnp.stack(qm), jnp.stack(qp)
 
 
+def trace_plmde(q, c, dq, dt, dx: Sequence[float], cfg: HydroStatic):
+    """PLMDE predictor: per-direction characteristic projection
+    (``hydro/uplmde.f90`` tracex/tracexy/tracexyz unified over ndim).
+
+    Unlike the MUSCL-Hancock trace, each direction's face states are
+    built by projecting the (ρ, v_n, P) slopes onto the acoustic
+    characteristics and keeping only the waves that reach the face
+    (``project_out`` = 1 drops outgoing ones); tangential velocities,
+    non-thermal energies, and passives ride the entropy wave.  Returns
+    (qm, qp) in the :func:`trace` convention — ``qm[d]`` the high-side
+    face state, ``qp[d]`` the low-side one.
+    """
+    nd = cfg.ndim
+    ip = nd + 1
+    r = q[0]
+    p = q[ip]
+    csq = cfg.gamma * p / jnp.maximum(r, cfg.smallr)
+    qm, qp = [], []
+    for d in range(nd):
+        dtdx = dt / dx[d]
+        u = q[1 + d]
+        dr = dq[d][0]
+        du = dq[d][1 + d]
+        dp = dq[d][ip]
+        # supersonic fix: strong velocity gradients drop the acoustic
+        # spread (uplmde.f90 'Supersonic fix')
+        ccc = jnp.where(jnp.abs(du) > 3.0 * c, 0.0, c)
+        alpham = 0.5 * (dp / csq - du * r / c)
+        alphap = 0.5 * (dp / csq + du * r / c)
+        alpha0 = dr - dp / csq
+
+        def face(sgn):
+            # sgn=-1: right state at the LOW face (left-moving waves);
+            # sgn=+1: left state at the HIGH face (right-moving waves)
+            if sgn < 0:
+                spp = jnp.where(u + ccc > 0.0, -1.0, (u + ccc) * dtdx)
+                spm = jnp.where(u - ccc > 0.0, -1.0, (u - ccc) * dtdx)
+                spz = jnp.where(u > 0.0, -1.0, u * dtdx)
+                wp = 0.5 * (-1.0 - spp)
+                wm = 0.5 * (-1.0 - spm)
+                wz = 0.5 * (-1.0 - spz)
+            else:
+                spp = jnp.where(u + ccc <= 0.0, 1.0, (u + ccc) * dtdx)
+                spm = jnp.where(u - ccc <= 0.0, 1.0, (u - ccc) * dtdx)
+                spz = jnp.where(u <= 0.0, 1.0, u * dtdx)
+                wp = 0.5 * (1.0 - spp)
+                wm = 0.5 * (1.0 - spm)
+                wz = 0.5 * (1.0 - spz)
+            ap = wp * alphap
+            am = wm * alpham
+            az = wz * alpha0
+            comps = [None] * q.shape[0]
+            comps[0] = jnp.maximum(r + (ap + am + az), cfg.smallr)
+            comps[1 + d] = u + (ap - am) * c / r
+            comps[ip] = p + (ap + am) * csq
+            for j in range(q.shape[0]):
+                if comps[j] is None:     # entropy-wave riders
+                    comps[j] = q[j] + wz * dq[d][j]
+            return jnp.stack(comps)
+
+        qm.append(face(+1.0))
+        qp.append(face(-1.0))
+    return jnp.stack(qm), jnp.stack(qp)
+
+
 def _iface_perm(cfg: HydroStatic, d: int) -> List[int]:
     """State-layout → interface-layout component permutation for dir d.
 
@@ -256,16 +321,93 @@ def unsplit(u, grav, dt, dx: Sequence[float], cfg: HydroStatic):
     per-direction face fluxes already scaled by dt/dx, plus the tmp array.
     The conservative update itself is :func:`apply_fluxes`.
     """
-    q, _c = ctoprim(u, grav, dt, cfg)
+    q, c = ctoprim(u, grav, dt, cfg)
     dq = uslope(q, cfg)
-    if cfg.scheme != "muscl":
+    if cfg.scheme == "muscl":
+        qm, qp = trace(q, dq, dt, dx, cfg)
+    elif cfg.scheme == "plmde":
+        qm, qp = trace_plmde(q, c, dq, dt, dx, cfg)
+    else:
         raise NotImplementedError(f"scheme={cfg.scheme}")
-    qm, qp = trace(q, dq, dt, dx, cfg)
     flux, tmp = face_fluxes(qm, qp, cfg)
     scale = jnp.stack([jnp.full((), dt / dx[d], u.dtype)
                        for d in range(cfg.ndim)])
     bshape = (cfg.ndim,) + (1,) * (flux.ndim - 1)
     return flux * scale.reshape(bshape), tmp * scale.reshape(bshape)
+
+
+def eint_of(u, cfg: HydroStatic):
+    """Thermal internal energy density from a conservative state."""
+    r = jnp.maximum(u[0], cfg.smallr)
+    e = u[cfg.ndim + 1] - sum(0.5 * u[1 + d] ** 2
+                              for d in range(cfg.ndim)) / r
+    for n in range(cfg.nener):
+        e = e - u[cfg.ndim + 2 + n]
+    return e
+
+
+def dual_energy_fix(up, un, tmp, dt, dx: Sequence[float],
+                    cfg: HydroStatic, hexp: float = 0.0):
+    """Dual-energy pressure fix + non-thermal pdV sources on a padded
+    block (``pressure_fix`` machinery of ``hydro/godunov_fine.f90``:
+    divu/enew accumulation :735-790, ``add_pdv_source_terms`` :294-430,
+    the set_uold correction :203-226).
+
+    ``up``: padded OLD state; ``un``: padded UPDATED state (same
+    layout); ``tmp``: per-direction [2, ...] (face normal velocity,
+    internal-energy flux), both ×dt/dx as returned by :func:`unsplit`.
+    Valid on the active interior (ghost results are wrapped garbage,
+    like :func:`apply_fluxes`).  Returns ``un`` with the corrected
+    total energy and pdV-updated non-thermal energies.
+    """
+    nd = cfg.ndim
+    ie = nd + 1
+    dt = jnp.asarray(dt, up.dtype)     # keep the state dtype (f32 runs)
+    r_old = jnp.maximum(up[0], cfg.smallr)
+    eint_old = eint_of(up, cfg)
+
+    # field arrays ([*sp] / [*sp, batch]) drop the leading nvar axis of
+    # the state layout _axis describes
+    def axf(d):
+        return _axis(cfg, d, up) - 1
+
+    # face-flux accumulation: enew advection + divu (= -div·u·dt)
+    enew = eint_old
+    divu_acc = jnp.zeros_like(eint_old)
+    for d in range(nd):
+        ax = axf(d)
+        enew = enew + (tmp[d][1] - jnp.roll(tmp[d][1], -1, axis=ax))
+        divu_acc = divu_acc + (tmp[d][0]
+                               - jnp.roll(tmp[d][0], -1, axis=ax))
+
+    # centered -pdV source from the OLD velocity field
+    # (add_pdv_source_terms' Trace G over 2dx)
+    divu_c = jnp.zeros_like(eint_old)
+    for d in range(nd):
+        ax = axf(d)
+        v = up[1 + d] / r_old
+        divu_c = divu_c + (jnp.roll(v, -1, axis=ax)
+                           - jnp.roll(v, 1, axis=ax)) / (2.0 * dx[d])
+    enew = enew - (cfg.gamma - 1.0) * eint_old * divu_c * dt
+    for n in range(cfg.nener):
+        i = nd + 2 + n
+        un = un.at[i].add(-(cfg.gamma_rad[n] - 1.0) * up[i]
+                          * divu_c * dt)
+
+    if not cfg.pressure_fix:
+        return un
+
+    # truncation test on the UPDATED state
+    r_new = jnp.maximum(un[0], cfg.smallr)
+    ekin_new = sum(0.5 * un[1 + d] ** 2 for d in range(nd)) / r_new
+    for n in range(cfg.nener):
+        ekin_new = ekin_new + un[nd + 2 + n]
+    e_cons = un[ie] - ekin_new
+    div = jnp.abs(divu_acc) * dx[0] / jnp.maximum(dt, 1e-300)
+    e_trunc = cfg.beta_fix * r_new * jnp.maximum(
+        div, 3.0 * hexp * dx[0]) ** 2
+    fixed = jnp.where(e_cons < e_trunc, enew + ekin_new, un[ie])
+    return un.at[ie].set(fixed)
 
 
 def apply_fluxes(u, flux, cfg: HydroStatic):

@@ -64,6 +64,8 @@ def supports(cfg: HydroStatic, shape, bc_kinds, dtype) -> bool:
         return False
     if cfg.scheme != "muscl" or cfg.slope_type not in (1, 2, 8):
         return False
+    if cfg.pressure_fix:
+        return False
     if cfg.riemann not in ("llf", "hllc"):
         return False
     if tuple(bc_kinds[2]) != (0, 0):  # z handled by in-kernel periodic roll

@@ -117,8 +117,13 @@ def pm_hydro_step(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
         mode = "wrap" if _all_periodic(grid.bc) else "edge"
         fp = _pad_force(f, cfg.ndim, mode)
         grav = [fp[d] for d in range(cfg.ndim)] if gspec.enabled else None
-        flux, _ = muscl.unsplit(up, grav, dt, (grid.dx,) * cfg.ndim, cfg)
+        flux, tmp = muscl.unsplit(up, grav, dt, (grid.dx,) * cfg.ndim,
+                                  cfg)
         un = muscl.apply_fluxes(up, flux, cfg)
+        if cfg.pressure_fix or cfg.nener:
+            un = muscl.dual_energy_fix(up, un, tmp, dt,
+                                       (grid.dx,) * cfg.ndim, cfg,
+                                       hexp=0.0)
         u = bmod.unpad(un, cfg.ndim, muscl.NGHOST)
         if gspec.enabled:
             u = kick(u, f, +0.5 * dt, cfg)

@@ -1,0 +1,223 @@
+"""PLMDE scheme (``hydro/uplmde.f90``), dual-energy pressure fix
+(``hydro/godunov_fine.f90`` divu/enew + add_pdv + set_uold), and ISM
+cooling (``hydro/cooling_module_ism.f90``)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.driver import Simulation
+
+
+def _sod_groups(scheme="muscl", lmin=7, **hydro_extra):
+    h = {"gamma": 1.4, "courant_factor": 0.5, "riemann": "hllc",
+         "slope_type": 1, "scheme": scheme}
+    h.update(hydro_extra)
+    return {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": lmin, "levelmax": lmin, "boxlen": 1.0},
+        "boundary_params": {"nboundary": 2,
+                            "ibound_min": [-1, 1], "ibound_max": [-1, 1],
+                            "bound_type": [2, 2]},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75], "length_x": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 0.125],
+                        "p_region": [1.0, 0.1]},
+        "hydro_params": h,
+        "output_params": {"noutput": 1, "tout": [0.2], "tend": 0.2},
+    }
+
+
+def test_plmde_sod_matches_muscl_accuracy():
+    """PLMDE solves Sod with accuracy comparable to MUSCL-Hancock."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from exact_riemann import exact_riemann
+
+    sols = {}
+    for scheme in ("muscl", "plmde"):
+        sim = Simulation(params_from_dict(_sod_groups(scheme), ndim=1),
+                         dtype=jnp.float64)
+        sim.evolve()
+        sols[scheme] = np.asarray(sim.state.u)[0]
+    n = len(sols["muscl"])
+    x = (np.arange(n) + 0.5) / n
+    rho_ex = exact_riemann(1.0, 0.0, 1.0, 0.125, 0.0, 0.1, 1.4,
+                           x, 0.2)[0]
+    l1 = {s: np.abs(v - rho_ex).mean() for s, v in sols.items()}
+    assert l1["plmde"] < 1.5 * l1["muscl"], l1
+    assert l1["plmde"] < 0.01
+
+
+def test_plmde_conservation_2d():
+    g = _sod_groups("plmde", lmin=5)
+    g["boundary_params"] = {}
+    g["init_params"]["y_center"] = [0.5, 0.5]
+    g["init_params"]["length_y"] = [10.0, 0.3]
+    g["init_params"]["exp_region"] = [10.0, 2.0]
+    g["output_params"] = {"tend": 0.05}
+    sim = Simulation(params_from_dict(g, ndim=2), dtype=jnp.float64)
+    u0 = np.asarray(sim.state.u).copy()
+    sim.evolve()
+    u1 = np.asarray(sim.state.u)
+    assert sim.state.nstep > 3
+    for iv in range(u0.shape[0]):
+        assert np.isclose(u1[iv].sum(), u0[iv].sum(), rtol=1e-11,
+                          atol=1e-12)
+
+
+def test_pressure_fix_cold_supersonic_flow():
+    """Cold hypersonic advection in float32: eint/ekin ~ 5e-8 sits
+    below single-precision epsilon, so E-ekin is pure truncation noise
+    — the regime the dual-energy fix exists for.  With pressure_fix +
+    beta_fix the recovered pressure stays positive and near its
+    initial value; the unfixed run's is garbage (or negative)."""
+    def run(pfix):
+        g = {
+            "run_params": {"hydro": True},
+            "amr_params": {"levelmin": 6, "levelmax": 6, "boxlen": 1.0},
+            "init_params": {"nregion": 2,
+                            "region_type": ["square", "square"],
+                            "x_center": [0.5, 0.5],
+                            "length_x": [10.0, 0.25],
+                            "exp_region": [10.0, 2.0],
+                            "d_region": [1.0, 10.0],
+                            "p_region": [1e-6, 1e-6],
+                            "u_region": [10.0, 10.0]},
+            "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                             "riemann": "hllc",
+                             "pressure_fix": pfix, "beta_fix": 0.5},
+            "output_params": {"tend": 0.02},
+        }
+        sim = Simulation(params_from_dict(g, ndim=1), dtype=jnp.float32)
+        sim.evolve()
+        u = np.asarray(sim.state.u, dtype=np.float64)
+        rho = u[0]
+        p = 0.4 * (u[2] - 0.5 * u[1] ** 2 / rho)
+        return rho, p
+
+    rho_f, p_f = run(True)
+    rho_n, p_n = run(False)
+    # the fix guarantees positive recovered pressure where truncation
+    # noise drives E - ekin negative; the unfixed run goes negative.
+    # (Absolute f32 pressure accuracy at eint/ekin ~ 5e-8 is limited by
+    # the per-step E - ekin rounding either way — the reference runs
+    # this machinery in f64, where the enew replacement is exact.)
+    assert p_f.min() > 0, p_f.min()
+    assert p_n.min() < 0, p_n.min()
+    # density profile essentially unaffected by the fix
+    np.testing.assert_allclose(rho_f, rho_n, rtol=1e-4, atol=1e-5)
+
+
+def test_pressure_fix_enew_accuracy_f64():
+    """In f64 the separately-advected internal energy recovers the
+    tiny pressure accurately through a strong compression where the
+    total-energy route is still fine — the two must agree closely
+    (consistency of the enew path with the conservative one)."""
+    g = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 7, "levelmax": 7, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.25, 0.75],
+                        "length_x": [0.5, 0.5],
+                        "exp_region": [10.0, 10.0],
+                        "d_region": [1.0, 1.0],
+                        "p_region": [1.0, 0.1],
+                        "u_region": [0.5, -0.5]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc",
+                         "pressure_fix": True, "beta_fix": 0.5},
+        "output_params": {"tend": 0.1},
+    }
+    sim_f = Simulation(params_from_dict(
+        {k: dict(v) for k, v in g.items()}, ndim=1), dtype=jnp.float64)
+    sim_f.evolve()
+    g["hydro_params"]["pressure_fix"] = False
+    sim_n = Simulation(params_from_dict(g, ndim=1), dtype=jnp.float64)
+    sim_n.evolve()
+    uf = np.asarray(sim_f.state.u)
+    un = np.asarray(sim_n.state.u)
+    pf = 0.4 * (uf[2] - 0.5 * uf[1] ** 2 / uf[0])
+    pn = 0.4 * (un[2] - 0.5 * un[1] ** 2 / un[0])
+    # subsonic colliding flows: fix must not alter a well-resolved run
+    np.testing.assert_allclose(pf, pn, rtol=1e-6)
+
+
+def test_pressure_fix_on_amr_blast():
+    """The fix rides the AMR stencil + dense sweeps without breaking
+    mass conservation."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+    g = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 6, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "z_center": [0.5, 0.5],
+                        "length_x": [10.0, 0.25], "length_y": [10.0, 0.25],
+                        "length_z": [10.0, 0.25],
+                        "exp_region": [10.0, 2.0],
+                        "d_region": [1.0, 1.0],
+                        "p_region": [1e-5, 10.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "pressure_fix": True, "beta_fix": 0.5},
+        "refine_params": {"err_grad_p": 0.2},
+        "output_params": {"tend": 0.02},
+    }
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    m0 = sim.totals()[0]
+    sim.evolve(0.02, nstepmax=8)
+    m1 = sim.totals()[0]
+    assert np.isclose(m1, m0, rtol=1e-11)      # fix touches E only
+    assert sim.tree.noct(5) > 0
+    for l in sim.levels():
+        assert np.isfinite(np.asarray(sim.u[l])).all()
+
+
+def test_ism_cooling_two_phase_equilibrium():
+    """The Audit & Hennebelle net rate supports the classic two-phase
+    ISM: warm (~7000 K) equilibrium at n=0.5, cold (~40 K) at n=100."""
+    from ramses_tpu.hydro.cooling import _ism_rate, solve_cooling_ism
+    for n, lo, hi in ((0.5, 4000.0, 12000.0), (100.0, 10.0, 120.0)):
+        Ts = np.logspace(1, 4.3, 200)
+        r = np.asarray(_ism_rate(jnp.asarray(Ts), jnp.full(200, n)))
+        sc = np.where(np.diff(np.sign(r)))[0]
+        assert len(sc) >= 1
+        Teq = Ts[sc[0]]
+        assert lo < Teq < hi, (n, Teq)
+    # integrator relaxes toward equilibrium from both sides
+    out = np.asarray(solve_cooling_ism(
+        jnp.asarray([100.0, 100.0]), jnp.asarray([1e5, 3.0]), 3.15e13))
+    assert out[0] < 1e4        # hot gas cooled hard at n=100
+    assert out[1] > 3.0        # cold gas heated
+
+
+def test_ism_cooling_through_driver():
+    """cooling_ism=.true. routes cooling_step to the ISM module."""
+    g = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "y_center": [0.5],
+                        "z_center": [0.5],
+                        "length_x": [10.0], "length_y": [10.0],
+                        "length_z": [10.0], "exp_region": [10.0],
+                        "d_region": [100.0], "p_region": [100.0]},
+        "hydro_params": {"gamma": 1.6666667, "courant_factor": 0.5},
+        "cooling_params": {"cooling": True, "cooling_ism": True},
+        "units_params": {"units_density": 1.66e-24,
+                         "units_time": 3.15e13,
+                         "units_length": 3.08e18},
+        "output_params": {"tend": 0.05},
+    }
+    sim = Simulation(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    assert sim.cool_spec.ism
+    e0 = float(np.asarray(sim.state.u)[4].sum())
+    sim.evolve()
+    e1 = float(np.asarray(sim.state.u)[4].sum())
+    assert e1 < e0 * (1 - 1e-6)       # dense hot box radiates
