@@ -371,6 +371,13 @@ class AmrSim:
         self.sink_spec = SinkSpec.from_params(params)
         self.sinks = (SinkSet.empty(params.ndim)
                       if self.sink_spec.enabled else None)
+        # stellar objects from sinks (&STELLAR_PARAMS,
+        # pm/stellar_particle.f90 + sink_sn_feedback.f90)
+        from ramses_tpu.pm.stellar import StellarSet, StellarSpec
+        self.stellar_spec = StellarSpec.from_params(params)
+        self.stellar = (StellarSet.empty(params.ndim)
+                        if (self.stellar_spec.enabled
+                            and self.sinks is not None) else None)
         self.tracer_x = None          # optional [ntr, ndim] host array
         self._sf_rng = np.random.default_rng(1234)
         self._next_star_id = 1
@@ -1012,6 +1019,14 @@ class AmrSim:
         if self.sinks is not None:
             with self.timers.section("sinks"):
                 ap.sink_passes_amr(self, dt)
+        if self.stellar is not None:
+            from ramses_tpu.pm import stellar as stmod
+            with self.timers.section("stellar"):
+                self.stellar = stmod.make_stellar_from_sinks(
+                    self.sinks, self.stellar, self.stellar_spec,
+                    self._sf_rng, self.t)
+                self.stellar = stmod.sn_from_stellar(
+                    self, self.stellar, self.stellar_spec)
         if self.tracer_x is not None:
             with self.timers.section("tracers"):
                 ap.tracer_drift_amr(self, dt)
@@ -1129,12 +1144,24 @@ class AmrSim:
     # snapshot / restart (SURVEY.md §3.4, §5.4)
     # ------------------------------------------------------------------
     def dump(self, iout: int = 1, base_dir: str = ".",
-             namelist_path: Optional[str] = None, ncpu: int = 1) -> str:
+             namelist_path: Optional[str] = None, ncpu: int = 1,
+             dumper=None) -> str:
         """Write a reference-format ``output_NNNNN/`` snapshot
         (``ncpu > 1``: one file set per domain — multi-domain
-        checkpoint restorable onto any device count)."""
+        checkpoint restorable onto any device count).
+
+        ``dumper``: optional :class:`~ramses_tpu.io.async_writer.
+        AsyncDumper` — the host-resident snapshot is assembled
+        synchronously, the file writing happens on its background
+        thread (the ``pario`` offload, SURVEY.md §2.10)."""
+        import os
+
         from ramses_tpu.io import snapshot as snapmod
         snap = snapmod.snapshot_from_amr(self, iout)
+        if dumper is not None:
+            dumper.submit(snap, iout, base_dir,
+                          namelist_path=namelist_path, ncpu=ncpu)
+            return os.path.join(base_dir, f"output_{iout:05d}")
         return snapmod.dump_all(snap, iout, base_dir,
                                 namelist_path=namelist_path, ncpu=ncpu)
 

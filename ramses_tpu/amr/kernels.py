@@ -170,7 +170,7 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     return du_flat, corr
 
 
-@partial(jax.jit, static_argnames=("cfg", "shape", "bc"))
+@partial(jax.jit, static_argnames=("cfg", "shape", "bc", "dx"))
 def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
                 shape: Tuple[int, ...], bc, cfg: HydroStatic):
     """Sweep for a COMPLETE level (covers the whole box) as a dense grid.

@@ -244,7 +244,9 @@ def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
         dtdx2 = 0.5 * dt / dx
 
         if masked:
-            ok = ok_ref[:] != 0
+            # 0/1 mask already in the state dtype (see pad_xy): Mosaic
+            # supports neither i1 vector rolls nor u8->f32 casts here
+            okf = ok_ref[:]
 
         # ---- per-direction face flux + conservative update ----
         du = [None] * 5
@@ -268,8 +270,9 @@ def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
                    jnp.maximum(qr5[4], qr5[0] * cfg.smallp))
             flux = solver(ql5, qr5, d, cfg)
             if masked:
-                keep = jnp.logical_not(jnp.logical_or(ok, _roll(ok, 1, d)))
-                keepf = keep.astype(flux[0].dtype)
+                # face kept iff neither adjacent cell is refined:
+                # (1-ok_i)(1-ok_{i-1}) — pure arithmetic, no i1 vectors
+                keepf = (1.0 - okf) * (1.0 - _roll(okf, 1, d))
                 flux = tuple(f * keepf for f in flux)
             scale = dt / dx
             for c in range(5):
@@ -374,7 +377,9 @@ def pad_xy(u, bc, cfg: HydroStatic, ok=None):
     up = _pad_leading2(u, bc, cfg)
     if ok is None:
         return up, None
-    okp = _pad_leading2(ok[None].astype(jnp.uint8), bc, cfg)[0]
+    # ship the mask in the STATE dtype: Mosaic supports neither i1
+    # vector rolls nor u8->f32 casts inside the kernel
+    okp = _pad_leading2(ok[None].astype(u.dtype), bc, cfg)[0]
     return up, okp
 
 

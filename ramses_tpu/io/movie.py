@@ -59,43 +59,123 @@ def read_frame(path: str):
     return dict(t=head[0], bounds=tuple(head[1:5]), data=data)
 
 
-class MovieWriter:
-    """Camera config + frame emission (the &MOVIE_PARAMS NMOV cameras)."""
+class Camera:
+    """One movie camera (&MOVIE_PARAMS per-NMOV entry): projection
+    axis, shader kind, and an optional zoom window given as BOX
+    FRACTIONS in [0, 1] (``xcentre_frame``/``deltax_frame`` of
+    ``amr/movie.f90`` divided by boxlen) — boxlen-independent, so the
+    default covers the whole grid for any box size."""
 
-    def __init__(self, outdir: str, axis: int = 2, kind: str = "mean",
-                 fields: Sequence[str] = ("density",)):
-        self.outdir = outdir
+    def __init__(self, axis: int = 2, kind: str = "mean",
+                 center=(0.5, 0.5, 0.5), delta=(1.0, 1.0, 1.0)):
         self.axis = axis
         self.kind = kind
-        self.fields = list(fields)
-        self.iframe = 0
-        os.makedirs(outdir, exist_ok=True)
+        self.center = tuple(center)
+        self.delta = tuple(delta)
 
-    def emit(self, sim) -> list:
-        """Write one frame set from a uniform Simulation-like object."""
-        u = np.asarray(sim.state.u if hasattr(sim, "state") else sim.u)
+    def window(self, n: int, d: int):
+        """[i0, i1) cell range of this camera's zoom along dim d."""
+        lo = self.center[d] - 0.5 * self.delta[d]
+        hi = self.center[d] + 0.5 * self.delta[d]
+        i0 = max(int(round(lo * n)), 0)
+        i1 = min(max(int(round(hi * n)), i0 + 1), n)
+        return i0, i1
+
+
+def _extract_field(u, name: str, cfg, ndim: int):
+    if name == "density":
+        return u[0]
+    if name.startswith("velocity_"):
+        d = "xyz".index(name[-1])
+        return u[1 + d] / np.maximum(u[0], 1e-300)
+    if name == "pressure":
+        ek = sum(u[1 + d] ** 2 for d in range(ndim)) \
+            / (2 * np.maximum(u[0], 1e-300))
+        return (cfg.gamma - 1.0) * (u[1 + ndim] - ek)
+    if name == "temperature":
+        ek = sum(u[1 + d] ** 2 for d in range(ndim)) \
+            / (2 * np.maximum(u[0], 1e-300))
+        return ((cfg.gamma - 1.0) * (u[1 + ndim] - ek)
+                / np.maximum(u[0], 1e-300))
+    raise ValueError(f"unknown movie field {name!r}")
+
+
+class MovieWriter:
+    """Multi-camera frame emission (the &MOVIE_PARAMS NMOV cameras:
+    one ``movieN/`` directory per camera like ``amr/movie.f90``'s
+    proj_axis string, each with its own axis/shader/zoom)."""
+
+    def __init__(self, outdir: str, axis: int = 2, kind: str = "mean",
+                 fields: Sequence[str] = ("density",), cameras=None):
+        self.outdir = outdir
+        self.fields = list(fields)
+        self.cameras = (list(cameras) if cameras
+                        else [Camera(axis=axis, kind=kind)])
+        self.iframe = 0
+        for i in range(len(self.cameras)):
+            os.makedirs(self._camdir(i), exist_ok=True)
+
+    def _camdir(self, i: int) -> str:
+        if len(self.cameras) == 1:
+            return self.outdir
+        return os.path.join(self.outdir, f"movie{i + 1}")
+
+    def _emit_dense(self, u, cfg, t: float) -> list:
         ndim = u.ndim - 1
-        cfg = sim.cfg
+        n = u.shape[1]
         paths = []
-        for name in self.fields:
-            if name == "density":
-                field = u[0]
-            elif name.startswith("velocity_"):
-                d = "xyz".index(name[-1])
-                field = u[1 + d] / np.maximum(u[0], 1e-300)
-            elif name == "pressure":
-                ek = sum(u[1 + d] ** 2 for d in range(ndim)) \
-                    / (2 * np.maximum(u[0], 1e-300))
-                field = (cfg.gamma - 1.0) * (u[1 + ndim] - ek)
-            else:
-                raise ValueError(f"unknown movie field {name!r}")
-            m = project(field, self.axis if ndim == 3 else 0,
-                        self.kind, weights=u[0]
-                        if self.kind == "mean" else None)
-            path = os.path.join(
-                self.outdir, f"{name}_{self.iframe:05d}.map")
-            t = float(sim.state.t if hasattr(sim, "state") else sim.t)
-            write_frame(path, np.asarray(m), t=t)
-            paths.append(path)
+        for ic, cam in enumerate(self.cameras):
+            # zoom: crop the camera window before projecting
+            idx = [slice(None)]
+            for d in range(ndim):
+                i0, i1 = cam.window(u.shape[1 + d], d)
+                idx.append(slice(i0, i1))
+            uc = u[tuple(idx)]
+            axis = cam.axis if ndim == 3 else 0
+            for name in self.fields:
+                field = _extract_field(uc, name, cfg, ndim)
+                m = project(field, axis, cam.kind,
+                            weights=uc[0] if cam.kind == "mean" else None)
+                path = os.path.join(
+                    self._camdir(ic), f"{name}_{self.iframe:05d}.map")
+                ax2 = [d for d in range(ndim) if d != axis][:2]
+                bnd = []
+                for d in ax2:
+                    i0, i1 = cam.window(n, d)
+                    bnd += [i0 / n, i1 / n]
+                bnd += [0.0] * (4 - len(bnd))
+                write_frame(path, np.asarray(m), t=t, bounds=bnd)
+                paths.append(path)
         self.iframe += 1
         return paths
+
+    def emit(self, sim) -> list:
+        """Write one frame set from a uniform Simulation-like object
+        (needs only ``.state.u``/``.state.t`` — or ``.u``/``.t`` —
+        and ``.cfg``)."""
+        u = np.asarray(sim.state.u if hasattr(sim, "state") else sim.u)
+        t = float(sim.state.t if hasattr(sim, "state") else sim.t)
+        return self._emit_dense(u, sim.cfg, t)
+
+    def emit_amr(self, sim) -> list:
+        """Write one frame set from a live :class:`AmrSim`: leaves are
+        block-filled onto the finest-level dense grid, then each camera
+        projects its window (``amr/movie.f90`` leaf walk)."""
+        nd = sim.cfg.ndim
+        lmax_used = max(sim.levels())
+        n = 1 << lmax_used
+        dense = np.zeros((sim.cfg.nvar,) + (n,) * nd)
+        for l in sim.levels():
+            xc, uvals = sim.leaf_sample(l)
+            if not len(xc):
+                continue
+            span = 1 << (lmax_used - l)
+            dxl = sim.boxlen / (1 << l)
+            i0 = np.clip(((xc - 0.5 * dxl) / sim.boxlen * n)
+                         .round().astype(int), 0, n - span)
+            for k in range(len(xc)):
+                sl = tuple(slice(i0[k, d], i0[k, d] + span)
+                           for d in range(nd))
+                dense[(slice(None),) + sl] = \
+                    uvals[k].reshape((-1,) + (1,) * nd)
+        return self._emit_dense(dense, sim.cfg, float(sim.t))

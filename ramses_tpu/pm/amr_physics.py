@@ -231,14 +231,18 @@ def sink_passes_amr(sim, dt: float):
         vel = u[rows, 1:1 + nd] / np.maximum(rho[rows, None], 1e-300)
         u[rows] *= (1.0 - dm_rho / rho[rows])[:, None]
         sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+        new_idp = sinks.next_id + np.arange(len(rows), dtype=np.int64)
+        stellar = getattr(sim, "stellar", None)
+        if stellar is not None:
+            for sid, mass in zip(new_idp, mnew):
+                stellar.add_accreted(sid, float(mass))
         sinks = SinkSet(
             x=np.concatenate([sinks.x, xnew]),
             v=np.concatenate([sinks.v, vel]),
             m=np.concatenate([sinks.m, mnew]),
             tform=np.concatenate([sinks.tform,
                                   np.full(len(rows), sim.t)]),
-            idp=np.concatenate([sinks.idp, sinks.next_id
-                                + np.arange(len(rows), dtype=np.int64)]),
+            idp=np.concatenate([sinks.idp, new_idp]),
             next_id=sinks.next_id + len(rows))
 
     # ---- accretion from the finest covering cell
@@ -287,6 +291,10 @@ def sink_passes_amr(sim, dt: float):
             frac_u = 1.0 - (tot_allowed / vol) / rho_u
             u[uniq] *= frac_u[:, None]
             sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+            stellar = getattr(sim, "stellar", None)
+            if stellar is not None:
+                for sid, dmi in zip(sinks.idp[sel], dm):
+                    stellar.add_accreted(sid, float(dmi))
             newm = sinks.m[sel] + dm
             sinks.v[sel] = (sinks.v[sel] * sinks.m[sel, None] + p_acc) \
                 / np.maximum(newm, 1e-300)[:, None]

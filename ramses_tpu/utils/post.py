@@ -1,0 +1,307 @@
+"""Snapshot post-processing toolbox: the ``utils/f90`` workhorses.
+
+The reference ships 56 standalone analysis programs (SURVEY.md §2.11);
+beyond the projection tools in :mod:`ramses_tpu.utils.maps` and the
+halo chain in :mod:`ramses_tpu.utils.halos`, this CLI covers the
+remaining everyday set as subcommands over ``output_NNNNN``
+directories:
+
+  amr2cube   — resample leaf cells onto a uniform cube at a chosen
+               level (``amr2cube.f90``)
+  amr2cell   — dump the leaf-cell table as ascii
+               (``amr2cell.f90``)
+  part2cube  — CIC particle density cube (``part2cube.f90``)
+  part2list  — ascii particle table (``part2list.f90``)
+  histo      — mass-weighted 2D histogram of two cell fields, e.g.
+               the rho-T phase diagram (``histo.f90``)
+  amr2prof   — spherical radial profiles of cell fields about a
+               centre (``amr2prof.f90``)
+  part2prof  — radial profiles of particle mass/velocity
+               (``part2prof.f90``)
+  header     — print the snapshot header (``header.f90``)
+
+Everything reads through :mod:`ramses_tpu.io.reader` and writes plain
+ascii / .npy — small host-side numpy passes, like the originals.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+import numpy as np
+
+from ramses_tpu.io import reader as rdr
+
+
+def _cells(outdir: str):
+    snap = rdr.load_snapshot(outdir)
+    return snap, rdr.leaf_cells(snap)
+
+
+def amr2cube(outdir: str, var: str = "density",
+             lmax: Optional[int] = None) -> np.ndarray:
+    """Uniform cube of ``var`` at level ``lmax``: leaves coarser than
+    lmax block-fill their 2^(d·Δl) covered cells, finer ones (none, by
+    leaf definition, unless lmax < levelmax) volume-average."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+    boxlen = snap["amr"][0].header["boxlen"]
+    levels = cells["level"].astype(int)
+    if lmax is None:
+        lmax = int(levels.max())
+    n = 1 << lmax
+    dxf = boxlen / n
+    acc = np.zeros((n,) * ndim)
+    wacc = np.zeros((n,) * ndim)
+    vals = cells[var]
+    for l in np.unique(levels):
+        sel = levels == l
+        if not sel.any():
+            continue
+        pos = np.stack([cells["xyz"[d]][sel] for d in range(ndim)],
+                       axis=1)
+        v = vals[sel]
+        if l >= lmax:
+            # deposit (volume-weighted average inside the fine cell)
+            idx = tuple(np.clip((pos[:, d] / dxf).astype(int), 0, n - 1)
+                        for d in range(ndim))
+            w = (2.0 ** (lmax - l)) ** ndim
+            np.add.at(acc, idx, v * w)
+            np.add.at(wacc, idx, w)
+        else:
+            # block-fill the 2^Δl span of each coarse leaf
+            span = 1 << (lmax - l)
+            i0 = np.clip(((pos - 0.5 * cells["dx"][sel][:, None])
+                          / dxf).round().astype(int), 0, n - span)
+            for k in range(len(v)):
+                sl = tuple(slice(i0[k, d], i0[k, d] + span)
+                           for d in range(ndim))
+                acc[sl] += v[k]
+                wacc[sl] += 1.0
+    return acc / np.maximum(wacc, 1e-300)
+
+
+def amr2cell(outdir: str, path: str, variables=None) -> int:
+    """Leaf-cell ascii table: x y z dx level vars..."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+    variables = variables or snap["var_names"]
+    cols = (["xyz"[d] for d in range(ndim)] + ["dx", "level"]
+            + list(variables))
+    data = np.stack([cells[c] for c in cols], axis=1)
+    np.savetxt(path, data, header=" ".join(cols))
+    return len(data)
+
+
+def part2cube(outdir: str, n: int = 64) -> np.ndarray:
+    """CIC particle density cube [code mass / code volume]."""
+    from ramses_tpu.utils.halos import load_particles
+    x, _v, m, _i, boxlen, _t = load_particles(outdir)
+    ndim = x.shape[1]
+    dx = boxlen / n
+    s = x / dx - 0.5
+    i0 = np.floor(s).astype(int)
+    frac = s - i0
+    cube = np.zeros((n,) * ndim)
+    for corner in range(1 << ndim):
+        idx = []
+        w = m.copy()
+        for d in range(ndim):
+            b = (corner >> d) & 1
+            idx.append(np.mod(i0[:, d] + b, n))
+            w = w * (frac[:, d] if b else 1.0 - frac[:, d])
+        np.add.at(cube, tuple(idx), w)
+    return cube / dx ** ndim
+
+
+def part2list(outdir: str, path: str) -> int:
+    """Ascii particle table: id x.. v.. m."""
+    from ramses_tpu.utils.halos import load_particles
+    x, v, m, ids, _bl, _t = load_particles(outdir)
+    data = np.concatenate([ids[:, None], x, v, m[:, None]], axis=1)
+    nd = x.shape[1]
+    hdr = ("id " + " ".join("xyz"[:nd]) + " "
+           + " ".join("v" + c for c in "xyz"[:nd]) + " m")
+    np.savetxt(path, data, header=hdr)
+    return len(data)
+
+
+def histo(outdir: str, var_x: str = "density", var_y: str = "pressure",
+          nbins: int = 64, logx: bool = True, logy: bool = True):
+    """Mass-weighted 2D histogram (the rho-T phase diagram of
+    ``histo.f90``).  Returns (H, x_edges, y_edges)."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+
+    def field(name):
+        if name == "temperature":              # P/rho convenience alias
+            return cells["pressure"] / np.maximum(cells["density"],
+                                                  1e-300)
+        return cells[name]
+
+    vx = field(var_x)
+    vy = field(var_y)
+    w = cells["density"] * cells["dx"] ** ndim
+    fx = np.log10(np.maximum(vx, 1e-300)) if logx else vx
+    fy = np.log10(np.maximum(vy, 1e-300)) if logy else vy
+    H, xe, ye = np.histogram2d(fx, fy, bins=nbins, weights=w)
+    return H, xe, ye
+
+
+def _radial_bins(r, w, vals, nbins, rmax):
+    edges = np.linspace(0.0, rmax, nbins + 1)
+    which = np.clip(np.digitize(r, edges) - 1, 0, nbins - 1)
+    wsum = np.bincount(which, weights=w, minlength=nbins)
+    out = {}
+    for name, v in vals.items():
+        s = np.bincount(which, weights=w * v, minlength=nbins)
+        out[name] = s / np.maximum(wsum, 1e-300)
+    r_mid = 0.5 * (edges[:-1] + edges[1:])
+    return r_mid, wsum, out
+
+
+def amr2prof(outdir: str, center, nbins: int = 32,
+             rmax: Optional[float] = None):
+    """Mass-weighted spherical profiles of density/pressure/|v| about
+    ``center`` (``amr2prof.f90``).  Returns (r, m_shell, profiles)."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+    boxlen = snap["amr"][0].header["boxlen"]
+    rmax = rmax if rmax is not None else 0.5 * boxlen
+    pos = np.stack([cells["xyz"[d]] for d in range(ndim)], axis=1)
+    rel = pos - np.asarray(center)[:ndim]
+    rel = rel - boxlen * np.round(rel / boxlen)
+    r = np.sqrt((rel ** 2).sum(axis=1))
+    vol = cells["dx"] ** ndim
+    mass = cells["density"] * vol
+    vmag = np.sqrt(sum(cells[f"velocity_{'xyz'[d]}"] ** 2
+                       for d in range(ndim)))
+    vals = {"density": cells["density"],
+            "pressure": cells["pressure"], "v": vmag}
+    return _radial_bins(r, mass, vals, nbins, rmax)
+
+
+def part2prof(outdir: str, center, nbins: int = 32,
+              rmax: Optional[float] = None):
+    """Radial particle mass/velocity profiles (``part2prof.f90``)."""
+    from ramses_tpu.utils.halos import load_particles
+    x, v, m, _i, boxlen, _t = load_particles(outdir)
+    nd = x.shape[1]
+    rmax = rmax if rmax is not None else 0.5 * boxlen
+    rel = x - np.asarray(center)[:nd]
+    rel = rel - boxlen * np.round(rel / boxlen)
+    r = np.sqrt((rel ** 2).sum(axis=1))
+    vr = (rel * v).sum(axis=1) / np.maximum(r, 1e-300)
+    return _radial_bins(r, m, {"vr": vr,
+                               "v": np.sqrt((v ** 2).sum(axis=1))},
+                        nbins, rmax)
+
+
+def header(outdir: str) -> dict:
+    """Snapshot header summary (``header.f90``)."""
+    snap = rdr.load_snapshot(outdir)
+    h = snap["amr"][0].header
+    info = snap["info"]
+    out = dict(ndim=h["ndim"], nlevelmax=h["nlevelmax"],
+               boxlen=h["boxlen"], t=h["t"], aexp=h.get("aexp", 1.0),
+               nstep=h["nstep"], ncpu=len(snap["amr"]),
+               vars=snap["var_names"])
+    if "part" in snap:
+        out["npart"] = sum(len(p["mass"]) for p in snap["part"])
+    out.update({k: info[k] for k in ("unit_l", "unit_d", "unit_t")
+                if k in info})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ramses_tpu.utils.post")
+    sub = ap.add_subparsers(dest="tool", required=True)
+
+    p = sub.add_parser("amr2cube")
+    p.add_argument("outdir")
+    p.add_argument("npyfile")
+    p.add_argument("--var", default="density")
+    p.add_argument("--lmax", type=int, default=None)
+
+    p = sub.add_parser("amr2cell")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+
+    p = sub.add_parser("part2cube")
+    p.add_argument("outdir")
+    p.add_argument("npyfile")
+    p.add_argument("--n", type=int, default=64)
+
+    p = sub.add_parser("part2list")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+
+    p = sub.add_parser("histo")
+    p.add_argument("outdir")
+    p.add_argument("npyfile")
+    p.add_argument("--x", default="density")
+    p.add_argument("--y", default="temperature")
+    p.add_argument("--nbins", type=int, default=64)
+
+    p = sub.add_parser("amr2prof")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+    p.add_argument("--center", type=float, nargs="+",
+                   default=[0.5, 0.5, 0.5])
+    p.add_argument("--nbins", type=int, default=32)
+
+    p = sub.add_parser("part2prof")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+    p.add_argument("--center", type=float, nargs="+",
+                   default=[0.5, 0.5, 0.5])
+    p.add_argument("--nbins", type=int, default=32)
+
+    p = sub.add_parser("header")
+    p.add_argument("outdir")
+
+    args = ap.parse_args(argv)
+    if args.tool == "amr2cube":
+        cube = amr2cube(args.outdir, var=args.var, lmax=args.lmax)
+        np.save(args.npyfile, cube)
+        print(f"amr2cube: {cube.shape} -> {args.npyfile} "
+              f"(min {cube.min():.4e} max {cube.max():.4e})")
+    elif args.tool == "amr2cell":
+        n = amr2cell(args.outdir, args.txtfile)
+        print(f"amr2cell: {n} leaves -> {args.txtfile}")
+    elif args.tool == "part2cube":
+        cube = part2cube(args.outdir, n=args.n)
+        np.save(args.npyfile, cube)
+        print(f"part2cube: {cube.shape} -> {args.npyfile}")
+    elif args.tool == "part2list":
+        n = part2list(args.outdir, args.txtfile)
+        print(f"part2list: {n} particles -> {args.txtfile}")
+    elif args.tool == "histo":
+        H, xe, ye = histo(args.outdir, var_x=args.x, var_y=args.y,
+                          nbins=args.nbins)
+        np.savez(args.npyfile, H=H, x_edges=xe, y_edges=ye)
+        print(f"histo: {H.shape} {args.x}-{args.y} -> {args.npyfile}")
+    elif args.tool == "amr2prof":
+        r, msh, prof = amr2prof(args.outdir, args.center,
+                                nbins=args.nbins)
+        cols = [r, msh] + [prof[k] for k in sorted(prof)]
+        np.savetxt(args.txtfile, np.stack(cols, axis=1),
+                   header="r m_shell " + " ".join(sorted(prof)))
+        print(f"amr2prof: {args.nbins} bins -> {args.txtfile}")
+    elif args.tool == "part2prof":
+        r, msh, prof = part2prof(args.outdir, args.center,
+                                 nbins=args.nbins)
+        cols = [r, msh] + [prof[k] for k in sorted(prof)]
+        np.savetxt(args.txtfile, np.stack(cols, axis=1),
+                   header="r m_shell " + " ".join(sorted(prof)))
+        print(f"part2prof: {args.nbins} bins -> {args.txtfile}")
+    elif args.tool == "header":
+        for k, v in header(args.outdir).items():
+            print(f"{k:12s} {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,3 +130,45 @@ def test_tracers_follow_gas_on_hierarchy():
     assert np.isfinite(sim.tracer_x).all()
     assert (sim.tracer_x >= 0).all() and (sim.tracer_x <= 1).all()
     assert r1.mean() > r0 + 1e-4          # net outward advection
+
+
+def test_stellar_objects_from_sinks_and_sn():
+    """&STELLAR_PARAMS: sink growth spawns IMF-sampled stellar objects
+    every stellar_msink_th of accreted mass; with sn_direct they
+    explode immediately, injecting sn_e_ref thermal energy
+    (pm/stellar_particle.f90, pm/sink_sn_feedback.f90)."""
+    g = _blob_groups(lmin=4, lmax=5, d_in=100.0, p_in=1.0, tend=0.03,
+                     refine_params={"err_grad_d": 0.2},
+                     sink_params={"create_sinks": True, "n_sink": 10.0,
+                                  "accretion_scheme": "threshold",
+                                  "c_acc": 0.2},
+                     stellar_params={"stellar_msink_th": 0.002,
+                                     "imf_index": -2.35,
+                                     "imf_low": 8.0, "imf_high": 120.0,
+                                     "lt_t0": 0.01,
+                                     "sn_e_ref": 0.02,
+                                     "sn_direct": True})
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    assert sim.stellar is not None
+    e0 = sim.totals()[4]
+    sim.evolve(0.03, nstepmax=10)
+    assert sim.sinks.n > 0 and sim.sinks.m.sum() > 0.02
+    # sink growth crossed several 0.002 quanta -> objects spawned and
+    # (sn_direct) exploded, dumping energy into the gas
+    e1 = sim.totals()[4]
+    assert e1 > e0 + 0.015         # at least one 0.02 injection
+    # direct-explosion mode leaves no live objects behind
+    assert sim.stellar.n == 0
+
+
+def test_stellar_imf_and_lifetime():
+    from ramses_tpu.pm.stellar import (StellarSpec, lifetime,
+                                       sample_powerlaw)
+    rng = np.random.default_rng(0)
+    m = sample_powerlaw(rng, 8.0, 120.0, -2.35, 20000)
+    assert 8.0 <= m.min() and m.max() <= 120.0
+    # Salpeter: low-mass dominated
+    assert np.median(m) < 20.0
+    spec = StellarSpec(lt_t0=1.0, lt_m0=148.16, lt_a=0.238, lt_b=2.0)
+    tl = lifetime(np.array([8.0, 40.0, 120.0]), spec)
+    assert tl[0] > tl[1] > tl[2]          # massive stars die first

@@ -1,0 +1,144 @@
+"""Post-processing toolbox (``utils/f90`` equivalents,
+``ramses_tpu.utils.post``)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.utils import post
+
+
+@pytest.fixture(scope="module")
+def snap_dir(tmp_path_factory):
+    """One AMR snapshot with refinement + particles."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.pm.particles import ParticleSet
+
+    rng = np.random.default_rng(3)
+    x = np.concatenate([
+        np.mod(rng.normal([0.5, 0.5, 0.5], 0.05, (200, 3)), 1.0),
+        rng.uniform(0, 1, (56, 3))])
+    p = ParticleSet.make(jnp.asarray(x),
+                         jnp.asarray(rng.normal(0, 0.1, (256, 3))),
+                         jnp.asarray(np.full(256, 1.0 / 256)))
+    g = {
+        "run_params": {"hydro": True, "poisson": True, "pic": True},
+        "amr_params": {"levelmin": 4, "levelmax": 5, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "z_center": [0.5, 0.5],
+                        "length_x": [10.0, 0.25], "length_y": [10.0, 0.25],
+                        "length_z": [10.0, 0.25],
+                        "exp_region": [10.0, 2.0],
+                        "d_region": [1.0, 10.0],
+                        "p_region": [0.1, 5.0]},
+        "hydro_params": {"gamma": 5.0 / 3.0, "courant_factor": 0.5},
+        "refine_params": {"err_grad_d": 0.2},
+        "output_params": {"tend": 0.02},
+    }
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64,
+                 particles=p)
+    sim.evolve(0.01, nstepmax=3)
+    base = tmp_path_factory.mktemp("snaps")
+    return sim.dump(1, str(base)), sim
+
+
+def test_amr2cube_mass_consistency(snap_dir):
+    outdir, sim = snap_dir
+    cube = post.amr2cube(outdir, var="density")
+    n = cube.shape[0]
+    assert n == 1 << 5                         # levelmax cube
+    m_cube = cube.sum() / n ** 3               # boxlen=1
+    m_sim = sim.totals()[0]
+    assert np.isclose(m_cube, m_sim, rtol=1e-10)
+    # the blob is denser than the background
+    assert cube[n // 2, n // 2, n // 2] > cube[1, 1, 1]
+
+
+def test_amr2cell_table(snap_dir, tmp_path):
+    outdir, sim = snap_dir
+    path = tmp_path / "cells.txt"
+    nleaf = post.amr2cell(outdir, str(path))
+    assert nleaf == sim.ncell_leaf()
+    rows = np.loadtxt(path)
+    assert rows.shape[0] == nleaf
+    # x y z within the box; density positive
+    assert (rows[:, :3] >= 0).all() and (rows[:, :3] <= 1).all()
+    assert (rows[:, 5] > 0).all()
+
+
+def test_part2cube_and_list(snap_dir, tmp_path):
+    outdir, _sim = snap_dir
+    cube = post.part2cube(outdir, n=16)
+    assert np.isclose(cube.sum() / 16 ** 3, 1.0, rtol=1e-10)  # M=1
+    n = post.part2list(outdir, str(tmp_path / "p.txt"))
+    assert n == 256
+    rows = np.loadtxt(tmp_path / "p.txt")
+    assert rows.shape == (256, 8)
+
+
+def test_histo_phase_diagram(snap_dir):
+    outdir, sim = snap_dir
+    H, xe, ye = post.histo(outdir, "density", "temperature", nbins=32)
+    assert H.shape == (32, 32)
+    assert np.isclose(H.sum(), sim.totals()[0], rtol=1e-10)
+
+
+def test_profiles(snap_dir, tmp_path):
+    outdir, _sim = snap_dir
+    r, msh, prof = post.amr2prof(outdir, [0.5, 0.5, 0.5], nbins=16)
+    assert len(r) == 16
+    # central density above the outer bins (the blob)
+    assert prof["density"][0] > prof["density"][-1]
+    r2, msh2, prof2 = post.part2prof(outdir, [0.5, 0.5, 0.5], nbins=16)
+    # particle mass concentrated centrally
+    assert msh2[:4].sum() > msh2[-4:].sum()
+
+
+def test_header_and_cli(snap_dir, tmp_path, capsys):
+    outdir, sim = snap_dir
+    h = post.header(outdir)
+    assert h["ndim"] == 3 and h["npart"] == 256
+    assert h["nlevelmax"] == 5
+    # CLI smoke: every subcommand through main()
+    assert post.main(["amr2cube", outdir, str(tmp_path / "c.npy")]) == 0
+    assert post.main(["histo", outdir, str(tmp_path / "h.npz")]) == 0
+    assert post.main(["amr2prof", outdir, str(tmp_path / "pr.txt")]) == 0
+    assert post.main(["part2prof", outdir,
+                      str(tmp_path / "pp.txt")]) == 0
+    assert post.main(["header", outdir]) == 0
+
+
+def test_async_dumper_roundtrip(snap_dir, tmp_path):
+    """Background-thread snapshot writing (the pario offload,
+    SURVEY.md §2.10): async dump == sync dump, errors surface on
+    wait()."""
+    from ramses_tpu.io.async_writer import AsyncDumper
+    import filecmp
+    import os
+
+    _outdir, sim = snap_dir
+    d_sync = sim.dump(3, str(tmp_path / "sync"))
+    dumper = AsyncDumper()
+    d_async = sim.dump(3, str(tmp_path / "async"), dumper=dumper)
+    dumper.wait()
+    files = sorted(os.listdir(d_sync))
+    assert sorted(os.listdir(d_async)) == files
+    for f in files:
+        if f.endswith(".txt"):          # headers carry no timestamps
+            continue
+        assert filecmp.cmp(os.path.join(d_sync, f),
+                           os.path.join(d_async, f), shallow=False), f
+
+    # a bad path errors on wait, not in the compute thread
+    from ramses_tpu.io import snapshot as snapmod
+    snap = snapmod.snapshot_from_amr(sim, 4)
+    blocker = tmp_path / "blockfile"
+    blocker.write_text("x")
+    dumper.submit(snap, 4, str(blocker / "sub"))   # dir under a FILE
+    with pytest.raises(RuntimeError):
+        dumper.wait()
+    dumper.close()
