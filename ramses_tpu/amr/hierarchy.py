@@ -379,6 +379,9 @@ class AmrSim:
                         if (self.stellar_spec.enabled
                             and self.sinks is not None) else None)
         self.tracer_x = None          # optional [ntr, ndim] host array
+        # &MOVIE_PARAMS on-the-fly frames (amr/movie.f90)
+        from ramses_tpu.io.movie import MovieWriter
+        self.movie, self.movie_imov = MovieWriter.from_params(params)
         self._sf_rng = np.random.default_rng(1234)
         self._next_star_id = 1
         if (getattr(self.cfg, "physics", "hydro") != "hydro"
@@ -1030,6 +1033,9 @@ class AmrSim:
         if self.tracer_x is not None:
             with self.timers.section("tracers"):
                 ap.tracer_drift_amr(self, dt)
+        if self.movie is not None and self.nstep % self.movie_imov == 0:
+            with self.timers.section("movie"):
+                self.movie.emit_amr(self)
         from ramses_tpu import patch
         user_source = patch.hook("source")
         if user_source is not None:
@@ -1097,7 +1103,7 @@ class AmrSim:
             chunk = min(to_regrid, nstepmax - self.nstep, 64)
             if not self.gravity and not self.pic and not verbose \
                     and self.cosmo is None and self.sinks is None \
-                    and self.tracer_x is None \
+                    and self.tracer_x is None and self.movie is None \
                     and _patch.hook("source") is None and chunk > 1:
                 if self.step_chunk(chunk, tend) == 0:
                     break

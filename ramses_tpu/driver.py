@@ -213,6 +213,11 @@ class Simulation:
                     jnp.zeros((0, params.ndim)),
                     jnp.zeros((0, params.ndim)), jnp.zeros((0,)),
                     nmax=npmax)
+        # &MOVIE_PARAMS on-the-fly frames (amr/movie.f90)
+        from ramses_tpu.io.movie import MovieWriter
+        self.movie, self.movie_imov = MovieWriter.from_params(params)
+        if self.movie is not None:
+            self._movie_next = 0
         self.output_times = list(params.output.tout[:params.output.noutput])
         self.on_output: Optional[Callable] = None
         # perf accounting (mus/pt of adaptive_loop.f90:204-212)
@@ -249,6 +254,10 @@ class Simulation:
                 if guard is not None and not guard.check():
                     return st
                 n = min(chunk, nstepmax - st.nstep)
+                if self.movie is not None:
+                    # fused chunks may not run past the movie cadence
+                    # (frames sample at chunk boundaries)
+                    n = min(n, self.movie_imov)
                 t_before = st.t
                 if self.rt is not None and self.params.run.static:
                     # frozen gas: pure RT evolution to the output time
@@ -285,6 +294,10 @@ class Simulation:
                 self._source_passes(st.t - t_before)
                 if self.rt is not None and st.t > t_before:
                     st.u = self.rt.advance(st.u, st.t - t_before)
+                if self.movie is not None \
+                        and st.nstep >= self._movie_next:
+                    self.movie.emit(self)
+                    self._movie_next = st.nstep + self.movie_imov
                 if verbose:
                     mus_pt = (1e6 * self.wall_s / max(self.cell_updates, 1))
                     print(f"step {st.nstep:6d}  t={st.t:.6e} "

@@ -149,6 +149,56 @@ class MovieWriter:
         self.iframe += 1
         return paths
 
+    @classmethod
+    def from_params(cls, params, outdir=None):
+        """(writer, imov interval) from &MOVIE_PARAMS, or (None, 0)
+        when ``movie=.false.``.  ``proj_axis`` is the reference's
+        one-char-per-camera string; ``{x,y,z}centre_frame`` /
+        ``delta{x,y,z}_frame`` give per-camera zoom windows in code
+        units (converted to box fractions here); ``movie_vars_txt``
+        the emitted fields; ``imov`` the coarse-step cadence."""
+        raw = params.raw.get("movie_params", {}) if params.raw else {}
+
+        def g(k, dflt):
+            v = raw.get(k, dflt)
+            return v[0] if isinstance(v, list) and not isinstance(
+                dflt, list) else v
+
+        if not raw or not bool(g("movie", False)):
+            return None, 0
+        boxlen = float(params.amr.boxlen)
+        # per-axis extents: non-cubic base grids (nx/ny/nz coarse
+        # cells at boxlen/2^lmin per cell each) span base_d * boxlen
+        base = [params.amr.nx, params.amr.ny, params.amr.nz]
+        extent = [boxlen * max(int(b), 1) for b in base]
+        axes = str(g("proj_axis", "z")).strip("'\" ")
+        kind = str(g("shader", "mean")).strip("'\" ")
+        fields = g("movie_vars_txt", ["density"])
+        if isinstance(fields, str):
+            fields = [fields]
+        fields = [str(f).strip("'\" ") for f in fields]
+
+        def per_cam(key, dflt, i):
+            v = raw.get(key, dflt)
+            if isinstance(v, list):
+                return float(v[i]) if i < len(v) else float(dflt)
+            return float(v)
+
+        cams = []
+        for i, ch in enumerate(axes):
+            center = tuple(
+                per_cam(f"{c}centre_frame", extent[d] / 2, i) / extent[d]
+                for d, c in enumerate("xyz"))
+            delta = tuple(
+                per_cam(f"delta{c}_frame", extent[d], i) / extent[d]
+                for d, c in enumerate("xyz"))
+            cams.append(Camera(axis="xyz".index(ch), kind=kind,
+                               center=center, delta=delta))
+        out = outdir or os.path.join(
+            str(params.output.output_dir), "movie")
+        return (cls(out, fields=fields, cameras=cams),
+                max(1, int(g("imov", 1))))
+
     def emit(self, sim) -> list:
         """Write one frame set from a uniform Simulation-like object
         (needs only ``.state.u``/``.state.t`` — or ``.u``/``.t`` —
@@ -159,23 +209,21 @@ class MovieWriter:
 
     def emit_amr(self, sim) -> list:
         """Write one frame set from a live :class:`AmrSim`: leaves are
-        block-filled onto the finest-level dense grid, then each camera
-        projects its window (``amr/movie.f90`` leaf walk)."""
-        nd = sim.cfg.ndim
+        block-filled onto the finest-level dense grid (vectorized for
+        the dominant finest-level leaves), then each camera projects
+        its window (``amr/movie.f90`` leaf walk)."""
+        from ramses_tpu.utils.gridfill import leaves_to_dense
+
         lmax_used = max(sim.levels())
-        n = 1 << lmax_used
-        dense = np.zeros((sim.cfg.nvar,) + (n,) * nd)
+        pos, lvls, vals = [], [], []
         for l in sim.levels():
             xc, uvals = sim.leaf_sample(l)
-            if not len(xc):
-                continue
-            span = 1 << (lmax_used - l)
-            dxl = sim.boxlen / (1 << l)
-            i0 = np.clip(((xc - 0.5 * dxl) / sim.boxlen * n)
-                         .round().astype(int), 0, n - span)
-            for k in range(len(xc)):
-                sl = tuple(slice(i0[k, d], i0[k, d] + span)
-                           for d in range(nd))
-                dense[(slice(None),) + sl] = \
-                    uvals[k].reshape((-1,) + (1,) * nd)
+            if len(xc):
+                pos.append(xc)
+                lvls.append(np.full(len(xc), l))
+                vals.append(np.asarray(uvals, dtype=np.float64))
+        dense = leaves_to_dense(np.concatenate(pos),
+                                np.concatenate(lvls),
+                                np.concatenate(vals), lmax_used,
+                                float(sim.boxlen))
         return self._emit_dense(dense, sim.cfg, float(sim.t))

@@ -25,16 +25,32 @@ def shell_radii(cosmo, aexp1: float, aexp2: float) -> Tuple[float, float]:
     return abs(tau0 - tau2), abs(tau0 - tau1)
 
 
+def rotation_matrix(thetay: float = 0.0, thetaz: float = 0.0) -> np.ndarray:
+    """Observer orientation (``light_cone.f90`` compute_rotation_matrix
+    ``:580-640``: a y-rotation by ``thetay`` then a z-rotation by
+    ``thetaz`` pointing the cone axis)."""
+    cy, sy = np.cos(thetay), np.sin(thetay)
+    cz, sz = np.cos(thetaz), np.sin(thetaz)
+    ry = np.array([[cy, 0.0, sy], [0.0, 1.0, 0.0], [-sy, 0.0, cy]])
+    rz = np.array([[cz, -sz, 0.0], [sz, cz, 0.0], [0.0, 0.0, 1.0]])
+    return rz @ ry
+
+
 def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
                    r2: float, boxlen: float = 1.0,
                    opening: Optional[float] = None,
-                   axis: Sequence[float] = (0, 0, 1.0)):
+                   axis: Sequence[float] = (0, 0, 1.0),
+                   rotation: Optional[np.ndarray] = None):
     """Select particles in the shell r1 <= |x_rep − obs| < r2 over all
     periodic replicas intersecting the shell.
 
     Returns (positions [m, ndim] in observer coordinates, radii [m],
     source indices [m]) — a particle can appear in several replicas
-    (``light_cone.f90`` replica loops).
+    (``light_cone.f90`` replica loops).  ``rotation``: optional
+    [ndim, ndim] observer orientation (see :func:`rotation_matrix`)
+    applied to the emitted coordinates — the narrow-cone frame of
+    ``perform_my_selection_narrow``; the opening-angle cut then acts
+    along ``axis`` IN THE ROTATED FRAME.
     """
     x = np.asarray(x)
     ndim = x.shape[1]
@@ -56,6 +72,8 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
     cos_open = np.cos(opening) if opening is not None else None
     for s in shifts:
         pos = x + s[None, :] - obs[None, :]
+        if rotation is not None:
+            pos = pos @ np.asarray(rotation).T[:ndim, :ndim]
         r = np.sqrt((pos ** 2).sum(1))
         m = (r >= r1) & (r < r2)
         if cos_open is not None:

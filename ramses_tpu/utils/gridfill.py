@@ -1,0 +1,46 @@
+"""Leaf-cell → dense-grid block fill (shared host helper).
+
+Used by the movie engine's live-AMR frames and available to analysis
+tools: leaves at the target level scatter with ONE vectorized
+fancy-index assignment (they are the vast majority on a deep
+hierarchy); only the few coarser leaves loop to paint their 2^Δl
+blocks.  (``utils/post.amr2cube`` keeps its own weighted accumulation
+because it also volume-averages leaves FINER than the target level.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def leaves_to_dense(pos: np.ndarray, levels: np.ndarray,
+                    vals: np.ndarray, lmax: int,
+                    boxlen: float) -> np.ndarray:
+    """Dense [k, (2^lmax)^nd] grid from leaf centres/levels/values.
+
+    ``pos`` [n, nd] cell centres in [0, boxlen); ``levels`` [n] the
+    leaf's level (<= lmax); ``vals`` [n, k] per-leaf values,
+    block-constant over each leaf's footprint.
+    """
+    n = 1 << lmax
+    nd = pos.shape[1]
+    k = vals.shape[1]
+    out = np.zeros((k,) + (n,) * nd)
+    levels = np.asarray(levels)
+    for l in np.unique(levels):
+        sel = levels == l
+        span = 1 << (lmax - int(l))
+        dxl = boxlen / (1 << int(l))
+        i0 = np.clip(((pos[sel] - 0.5 * dxl) / boxlen * n)
+                     .round().astype(int), 0, n - span)
+        v = vals[sel]
+        if span == 1:
+            idx = tuple(i0[:, d] for d in range(nd))
+            out[(slice(None),) + idx] = v.T
+        else:
+            for j in range(len(v)):
+                sl = tuple(slice(i0[j, d], i0[j, d] + span)
+                           for d in range(nd))
+                out[(slice(None),) + sl] = v[j].reshape(
+                    (-1,) + (1,) * nd)
+    return out
