@@ -74,6 +74,8 @@ def main(argv=None) -> int:
             print(f"rhd-amr t={sim.t:.5e} nstep={sim.nstep} "
                   f"lor_max={sim.max_lorentz():.3f} "
                   f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
+            sim.dump(1, params.output.output_dir,
+                     namelist_path=args.namelist)
         else:
             from ramses_tpu.rhd.driver import RhdSimulation
             sim = RhdSimulation(params, dtype=dtype)

@@ -253,6 +253,45 @@ def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int,
     return u, t, dtc, ndone
 
 
+def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
+                         to_cons, place_level):
+    """Shared restart scaffold (the ``nrestart`` path) used by the
+    hydro, MHD, and SRHD AMR sims: rebuild the octree from the file
+    oct coords, construct the sim on it, place each level's restored
+    rows (re-mapped defensively through the rebuilt tree's key order),
+    then restrict.  ``to_cons(q_rows)`` converts file output columns
+    to the solver's stored rows; ``place_level(sim, l, rows, og,
+    order)`` writes them into the sim state.  Returns (sim, parts)."""
+    from ramses_tpu.io.restart import restore_tree_state
+    tree_og, rows_lv, meta, parts = restore_tree_state(
+        outdir, None, params.amr.levelmin, to_cons=to_cons)
+    tree = Octree(params.ndim, params.amr.levelmin, params.amr.levelmax)
+    for l, og in tree_og.items():
+        tree.set_level(l, og)
+    sim = cls(params, dtype=dtype, init_tree=tree)
+    for l, rows in rows_lv.items():
+        og = tree_og[l]
+        pos = tree.lookup(l, og)
+        place_level(sim, l, rows, og, np.argsort(pos))
+    sim._restrict_all()
+    sim._dt_cache = None
+    sim.t = float(meta["t"])
+    sim.nstep = int(meta["nstep"])
+    return sim, parts
+
+
+def _place_u_rows(sim, l: int, rows: np.ndarray, og: np.ndarray,
+                  order: np.ndarray):
+    """Default row placement: cell-state array only (hydro/SRHD)."""
+    nvar = sim.cfg.nvar
+    ttd = 2 ** sim.cfg.ndim
+    m = sim.maps[l]
+    out = np.array(sim.u[l])
+    out[:m.noct * ttd] = rows.reshape(
+        len(og), ttd, nvar)[order].reshape(-1, nvar)
+    sim.u[l] = jnp.asarray(out, dtype=sim.dtype)
+
+
 class AmrSim:
     """Adaptive simulation: host octree + per-level device states.
 
@@ -379,9 +418,19 @@ class AmrSim:
                         if (self.stellar_spec.enabled
                             and self.sinks is not None) else None)
         self.tracer_x = None          # optional [ntr, ndim] host array
-        # &MOVIE_PARAMS on-the-fly frames (amr/movie.f90)
-        from ramses_tpu.io.movie import MovieWriter
-        self.movie, self.movie_imov = MovieWriter.from_params(params)
+        # &MOVIE_PARAMS on-the-fly frames (amr/movie.f90); the frame
+        # field extraction uses Newtonian hydro relations, so non-hydro
+        # state layouts (MHD cell-B, SRHD (D,S,τ)) refuse loudly rather
+        # than render physically wrong maps
+        self.movie, self.movie_imov = None, 0
+        if (getattr(self.cfg, "physics", "hydro") == "hydro"
+                and self._pm_physics):
+            from ramses_tpu.io.movie import MovieWriter
+            self.movie, self.movie_imov = MovieWriter.from_params(params)
+        elif (params.raw or {}).get("movie_params", {}).get("movie"):
+            import warnings
+            warnings.warn("&MOVIE_PARAMS is only wired for the hydro "
+                          "solver family; no frames will be written")
         self._sf_rng = np.random.default_rng(1234)
         self._next_star_id = 1
         if (getattr(self.cfg, "physics", "hydro") != "hydro"
@@ -1175,27 +1224,10 @@ class AmrSim:
     def from_snapshot(cls, params: Params, outdir: str,
                       dtype=jnp.float32) -> "AmrSim":
         """Resume from a snapshot directory (``nrestart`` path)."""
-        from ramses_tpu.io.restart import restore_tree_state
-        cfg = HydroStatic.from_params(params)
-        tree_og, u_lv, meta, _parts = restore_tree_state(
-            outdir, cfg, params.amr.levelmin)
-        tree = Octree(params.ndim, params.amr.levelmin, params.amr.levelmax)
-        for l, og in tree_og.items():
-            tree.set_level(l, og)
-        sim = cls(params, dtype=dtype, init_tree=tree)
-        for l, u in u_lv.items():
-            # restored rows follow file order == our sorted-key order, but
-            # re-map defensively through the rebuilt tree's key order
-            og = tree_og[l]
-            pos = tree.lookup(l, og)
-            m = sim.maps[l]
-            ttd = 2 ** cfg.ndim
-            out = np.array(sim.u[l])
-            cells = u.reshape(len(og), ttd, cfg.nvar)
-            out[:m.noct * ttd] = cells[np.argsort(pos)].reshape(-1, cfg.nvar)
-            sim.u[l] = jnp.asarray(out, dtype=dtype)
-        sim._restrict_all()
-        sim._dt_cache = None
-        sim.t = float(meta["t"])
-        sim.nstep = int(meta["nstep"])
+        from ramses_tpu.io.snapshot import prim_out_to_cons
+        cfg = cls._make_cfg(params)
+        sim, _parts = restore_amr_scaffold(
+            cls, params, outdir, dtype,
+            to_cons=lambda q: prim_out_to_cons(q, cfg),
+            place_level=_place_u_rows)
         return sim

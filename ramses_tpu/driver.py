@@ -265,6 +265,9 @@ class Simulation:
                     st.u = self.rt.advance(st.u, tout - st.t)
                     st.t = tout
                     st.nstep += 1
+                    if self.movie is not None:
+                        self.movie.emit(self)
+                        self._movie_next = st.nstep + self.movie_imov
                     continue
                 t0 = time.perf_counter()
                 if (self.pspec.enabled or self.gspec.enabled

@@ -953,24 +953,14 @@ class MhdAmrSim(AmrSim):
         """Resume from an MHD snapshot (``mhd/init_hydro.f90`` restart
         read: the face fields come back verbatim, the cell-centred B is
         their mean)."""
-        from ramses_tpu.amr.tree import Octree
-        from ramses_tpu.io.restart import restore_tree_state
+        from ramses_tpu.amr.hierarchy import restore_amr_scaffold
         from ramses_tpu.io.snapshot import mhd_out_to_state
         mcfg = MhdStatic.from_params(params)
-        tree_og, q_lv, meta, _parts = restore_tree_state(
-            outdir, None, params.amr.levelmin, to_cons=lambda q: q)
-        tree = Octree(params.ndim, params.amr.levelmin,
-                      params.amr.levelmax)
-        for l, og in tree_og.items():
-            tree.set_level(l, og)
-        sim = cls(params, dtype=dtype, init_tree=tree)
         ttd = 2 ** params.ndim
-        for l, q in q_lv.items():
-            og = tree_og[l]
-            pos = tree.lookup(l, og)
+
+        def place(sim, l, q, og, order):
             m = sim.maps[l]
             u_rows, bf_rows = mhd_out_to_state(q, mcfg)
-            order = np.argsort(pos)
             u_out = np.array(sim.u[l])
             bf_out = np.array(sim.bfs[l])
             u_out[:m.noct * ttd] = u_rows.reshape(
@@ -979,8 +969,8 @@ class MhdAmrSim(AmrSim):
                 len(og), ttd, 3, 2)[order].reshape(-1, 3, 2)
             sim.u[l] = jnp.asarray(u_out, dtype=dtype)
             sim.bfs[l] = jnp.asarray(bf_out, dtype=dtype)
-        sim._restrict_all()
-        sim._dt_cache = None
-        sim.t = float(meta["t"])
-        sim.nstep = int(meta["nstep"])
+
+        sim, _parts = restore_amr_scaffold(
+            cls, params, outdir, dtype, to_cons=lambda q: q,
+            place_level=place)
         return sim

@@ -66,17 +66,45 @@ class RhdAmrSim(AmrSim):
         return self._place(jnp.asarray(out, dtype=self.dtype), "cells")
 
     # ------------------------------------------------------------------
-    # snapshot guard: the inherited writer converts with the Newtonian
-    # prim/cons relations (io/snapshot.cons_to_prim_out) which would
-    # silently corrupt (D, S, τ) state — refuse until the rhd format
-    # (the reference rhd solver's own output_hydro shadow) exists
+    # snapshot / restart: the generic writer with RELATIVISTIC
+    # primitive conversion (the rhd solver family's own output_hydro
+    # shadow writes rho, v, P — con→prim via the pressure Newton)
     # ------------------------------------------------------------------
-    def dump(self, *a, **kw):
-        raise NotImplementedError("rhd-amr snapshots: not yet supported")
+    def _rhd_var_names(self):
+        names = ["density", "velocity_x", "velocity_y", "velocity_z",
+                 "pressure"]
+        names += [f"scalar_{i:02d}" for i in range(self.cfg.npassive)]
+        return names
+
+    def dump(self, iout: int = 1, base_dir: str = ".",
+             namelist_path=None, ncpu: int = 1) -> str:
+        from ramses_tpu.io import snapshot as snapmod
+
+        def to_out(rows):
+            q = core.cons_to_prim(jnp.asarray(rows.T), self.cfg)
+            return np.asarray(q, dtype=np.float64).T
+
+        snap = snapmod.snapshot_from_amr(
+            self, iout, to_out=to_out, names=self._rhd_var_names(),
+            nvar_raw=self.cfg.nvar, gamma=self.cfg.gamma)
+        return snapmod.dump_all(snap, iout, base_dir,
+                                namelist_path=namelist_path, ncpu=ncpu)
 
     @classmethod
-    def from_snapshot(cls, *a, **kw):
-        raise NotImplementedError("rhd-amr restart: not yet supported")
+    def from_snapshot(cls, params: Params, outdir: str,
+                      dtype=jnp.float64) -> "RhdAmrSim":
+        from ramses_tpu.amr.hierarchy import (_place_u_rows,
+                                              restore_amr_scaffold)
+        cfg = RhdStatic.from_params(params)
+
+        def to_cons(q):
+            return np.asarray(core.prim_to_cons(jnp.asarray(q.T), cfg),
+                              dtype=np.float64).T
+
+        sim, _parts = restore_amr_scaffold(
+            cls, params, outdir, dtype, to_cons=to_cons,
+            place_level=_place_u_rows)
+        return sim
 
     # ------------------------------------------------------------------
     # diagnostics

@@ -68,6 +68,10 @@ class OpsGuard:
         self._iout = 900               # emergency outputs: high numbers
         self._max_rss = 0.0
         self._step_wall = self.t0
+        self._nblock = 0
+        # conservation audit cadence: totals() downloads the whole
+        # device state, so amortize it over screen blocks
+        self.cons_every = 10
         if install_signals:
             signal.signal(signal.SIGUSR1, self._on_dump)
             signal.signal(signal.SIGTERM, self._on_stop)
@@ -132,6 +136,17 @@ class OpsGuard:
                 f"t={getattr(sim, 't', 0.0):13.6e} "
                 f"dt={getattr(sim, 'dt_old', 0.0):11.4e} "
                 f"mem={self._max_rss:8.1f}M/{device_mb():8.1f}M")
+        self._nblock += 1
+        if hasattr(sim, "totals") and \
+                self._nblock % max(self.cons_every, 1) == 1:
+            # conservation audit line (the reference's mcons/econs
+            # print, ``amr/update_time.f90`` output block) —
+            # amortized: totals() syncs the full device state
+            tot = np.asarray(sim.totals())
+            ie = getattr(getattr(sim, "cfg", None), "ienergy", None)
+            line += f" mcons={tot[0]:.6e}"
+            if ie is not None and ie < len(tot):
+                line += f" econs={tot[ie]:.6e}"
         if hasattr(sim, "aexp_now") and sim.cosmo is not None:
             line += f" a={sim.aexp_now():8.5f}"
         if octs:

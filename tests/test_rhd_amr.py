@@ -127,7 +127,7 @@ def test_cli_dispatch_rhd_amr(tmp_path):
     """--solver rhd with levelmax>levelmin goes through RhdAmrSim."""
     import ramses_tpu.__main__ as main_mod
     nml = tmp_path / "rhd_amr.nml"
-    nml.write_text("""
+    nml.write_text(f"""
 &RUN_PARAMS
 hydro=.true.
 nstepmax=3
@@ -162,7 +162,36 @@ err_grad_p=0.1
 /
 &OUTPUT_PARAMS
 tend=0.05
+output_dir='{tmp_path}'
 /
 """)
     assert main_mod.main([str(nml), "--ndim", "1", "--solver", "rhd",
                           "--dtype", "float64"]) == 0
+    assert (tmp_path / "output_00001" / "info_00001.txt").exists()
+
+
+def test_rhd_amr_snapshot_roundtrip(tmp_path):
+    """Dump → restore with the RELATIVISTIC prim/cons conversions:
+    (D, S, τ) round-trips through the rho/v/P file columns, and
+    continued stepping matches the uncheckpointed run."""
+    tend = 0.2
+    p = params_from_dict(_tube_groups(5, 6, tend), ndim=1)
+    sim = RhdAmrSim(p, dtype=jnp.float64)
+    sim.evolve(0.1, nstepmax=6)
+    outdir = sim.dump(1, str(tmp_path))
+
+    p2 = params_from_dict(_tube_groups(5, 6, tend), ndim=1)
+    sim2 = RhdAmrSim.from_snapshot(p2, outdir, dtype=jnp.float64)
+    assert sim2.t == pytest.approx(sim.t, rel=1e-14)
+    for l in sim.levels():
+        nc = sim.maps[l].noct * 2
+        np.testing.assert_allclose(
+            np.asarray(sim2.u[l])[:nc], np.asarray(sim.u[l])[:nc],
+            rtol=1e-10, atol=1e-13)
+    for s in (sim, sim2):
+        s.step_coarse(s.coarse_dt())
+    for l in sim.levels():
+        nc = sim.maps[l].noct * 2
+        np.testing.assert_allclose(
+            np.asarray(sim2.u[l])[:nc], np.asarray(sim.u[l])[:nc],
+            rtol=1e-9, atol=1e-12)
