@@ -265,7 +265,8 @@ class Simulation:
                     st.u = self.rt.advance(st.u, tout - st.t)
                     st.t = tout
                     st.nstep += 1
-                    if self.movie is not None:
+                    if self.movie is not None \
+                            and st.nstep >= self._movie_next:
                         self.movie.emit(self)
                         self._movie_next = st.nstep + self.movie_imov
                     continue

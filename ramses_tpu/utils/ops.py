@@ -138,7 +138,7 @@ class OpsGuard:
                 f"mem={self._max_rss:8.1f}M/{device_mb():8.1f}M")
         self._nblock += 1
         if hasattr(sim, "totals") and \
-                self._nblock % max(self.cons_every, 1) == 1:
+                (self._nblock - 1) % max(self.cons_every, 1) == 0:
             # conservation audit line (the reference's mcons/econs
             # print, ``amr/update_time.f90`` output block) —
             # amortized: totals() syncs the full device state
