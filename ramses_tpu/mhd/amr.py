@@ -23,11 +23,13 @@ interpolation of face fields).  TPU re-design decisions:
   the batch as a trailing axis.  Interior (2:4) results are extracted;
   roll wrap-around only touches discarded stencil margins.
 
-Current gap vs the reference (documented, not hidden): coarse-fine EMF
-matching (``mhd/godunov_fine.f90:826-973``) is not yet applied, so the
-coarse solution adjacent to a refined region is first-order accurate
-there (each level's own divB stays machine-zero regardless, by the
-duplicated-face construction above).
+Coarse-fine EMF matching (``mhd/godunov_fine.f90:826-973``) replaces
+coarse corner EMFs with time-averaged fine EMFs on DENSE parent
+levels; a partial-level parent keeps its own EMFs there (first-order
+coupling; each level's own divB stays machine-zero regardless, by the
+duplicated-face construction above).  Self-gravity rides the hydro
+hierarchy's per-level Poisson solve with MHD-layout kicks
+(:func:`mhd_kick_flat`); particles remain TBD.
 """
 
 from __future__ import annotations
@@ -284,18 +286,29 @@ def mhd_level_sweep(u_flat, interp_u, bf_flat, interp_bf, stencil_src,
 
 @partial(jax.jit, static_argnames=("cfg",))
 def mhd_level_courant(u_flat, bf_flat, valid_cell, dx: float,
-                      cfg: MhdStatic):
-    """Fast-magnetosonic CFL dt over the level (mhd courant_fine)."""
+                      cfg: MhdStatic, fg=None):
+    """Fast-magnetosonic CFL dt over the level (mhd courant_fine).
+
+    ``fg`` [ncell, ndim]: enables the gravity-strength dt correction of
+    ``cmpdt`` (``hydro/godunov_utils.f90:100-110``) so self-gravity
+    kicks cannot outrun the step in near-free-fall cells."""
     u = jnp.moveaxis(u_flat, -1, 0)                    # [nvar, ncell]
     ctr = 0.5 * (bf_flat[:, :, 0] + bf_flat[:, :, 1])  # [ncell, NCOMP]
     u = u.at[IBX:IBX + NCOMP].set(ctr.T)
     q = core.ctoprim(u, cfg)
-    rate = jnp.zeros_like(q[0])
+    ws = jnp.zeros_like(q[0])
     for d in range(cfg.ndim):
-        rate = rate + (jnp.abs(q[1 + d]) + core.fast_speed(q, d, cfg)) / dx
-    rate = jnp.where(valid_cell, rate, 0.0)
-    return cfg.courant_factor / jnp.maximum(jnp.max(rate),
-                                            cfg.smallc / dx)
+        ws = ws + jnp.abs(q[1 + d]) + core.fast_speed(q, d, cfg)
+    ws = jnp.maximum(ws, cfg.smallc)
+    dtc = dx / ws
+    if fg is not None:
+        gnorm = sum(jnp.abs(fg[:, d]) for d in range(cfg.ndim))
+        ratio = jnp.maximum(gnorm * dx / ws ** 2, 1e-4)
+        cf = cfg.courant_factor
+        dtc = dtc * (jnp.sqrt(1.0 + 2.0 * cf * ratio) - 1.0) \
+            / (cf * ratio)
+    dtc = jnp.where(valid_cell, dtc, jnp.inf)
+    return cfg.courant_factor * jnp.min(dtc)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -428,7 +441,24 @@ def _dense_hi(lo_dense, d: int, periodic: bool):
     return hi
 
 
-def _mhd_advance_traced(u, bf, dev, dt, spec: FusedSpec):
+def mhd_kick_flat(u_rows, fg_rows, dteff, ndim: int, smallr: float):
+    """Gravity momentum kick at fixed internal+magnetic energy on flat
+    MHD rows (the ``synchro_hydro_fine`` step with the MHD layout:
+    momentum always 3 components at 1..3, total energy at IP)."""
+    r = jnp.maximum(u_rows[:, 0], smallr)
+    ek_old = sum(0.5 * u_rows[:, 1 + c] ** 2 for c in range(NCOMP)) / r
+    mom = [u_rows[:, 1 + c]
+           + (r * fg_rows[:, c] * dteff if c < ndim else 0.0)
+           for c in range(NCOMP)]
+    ek_new = sum(0.5 * m * m for m in mom) / r
+    e = u_rows[:, IP] - ek_old + ek_new
+    out = u_rows
+    for c in range(ndim):
+        out = out.at[:, 1 + c].set(mom[c])
+    return out.at[:, IP].set(e)
+
+
+def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
     """Recursive subcycled MHD coarse step (cf. hydro _advance_traced).
 
     Cell-state conservation at coarse-fine interfaces follows the hydro
@@ -453,6 +483,8 @@ def _mhd_advance_traced(u, bf, dev, dt, spec: FusedSpec):
     def advance(i, dtl):
         l = levels[i]
         d = dev[l]
+        if spec.gravity:
+            u[l] = mhd_kick_flat(u[l], fg[l], 0.5 * dtl, nd, cfg.smallr)
         unew[l] = u[l]
         child_emf = None
         if i + 1 < len(levels):
@@ -534,6 +566,8 @@ def _mhd_advance_traced(u, bf, dev, dt, spec: FusedSpec):
                                                     d["corr_idx"], cfg)
             bf[l] = bfn
         u[l] = unew[l]
+        if spec.gravity:
+            u[l] = mhd_kick_flat(u[l], fg[l], 0.5 * dtl, nd, cfg.smallr)
         if i + 1 < len(levels):
             u[l], bf[l] = mhd_restrict_upload(
                 u[l], bf[l], u[levels[i + 1]], bf[levels[i + 1]],
@@ -553,24 +587,26 @@ def _mhd_advance_traced(u, bf, dev, dt, spec: FusedSpec):
     return u, bf
 
 
-def _mhd_courant_traced(u, bf, dev, spec: FusedSpec):
+def _mhd_courant_traced(u, bf, dev, spec: FusedSpec, fg=None):
     dts = []
     for i, l in enumerate(spec.levels):
         dt_l = mhd_level_courant(u[l], bf[l], dev[l]["valid_cell"],
-                                 spec.boxlen / (1 << l), spec.cfg)
+                                 spec.boxlen / (1 << l), spec.cfg,
+                                 fg.get(l) if fg else None)
         dts.append(dt_l * (2.0 ** (l - spec.lmin)))
     return jnp.stack(dts)
 
 
 @partial(jax.jit, static_argnames=("spec",))
-def _mhd_fused_coarse_step(u, bf, dev, dt, spec: FusedSpec):
-    u, bf = _mhd_advance_traced(u, bf, dev, dt, spec)
-    return u, bf, jnp.min(_mhd_courant_traced(u, bf, dev, spec))
+def _mhd_fused_coarse_step(u, bf, dev, dt, spec: FusedSpec, fg=None):
+    u, bf = _mhd_advance_traced(u, bf, dev, fg, dt, spec)
+    return u, bf, jnp.min(_mhd_courant_traced(
+        u, bf, dev, spec, fg if spec.gravity else None))
 
 
 @partial(jax.jit, static_argnames=("spec",))
-def _mhd_fused_courant(u, bf, dev, spec: FusedSpec):
-    return _mhd_courant_traced(u, bf, dev, spec)
+def _mhd_fused_courant(u, bf, dev, spec: FusedSpec, fg=None):
+    return _mhd_courant_traced(u, bf, dev, spec, fg)
 
 
 @partial(jax.jit, static_argnames=("spec", "nsteps"))
@@ -582,6 +618,8 @@ def _mhd_fused_multi_step(u, bf, dev, t, tend, dt0, spec: FusedSpec,
         active = t < tend
         sdt = jnp.where(active, dt, 0.0).astype(u[spec.lmin].dtype)
         un, bfn, dtn = _mhd_fused_coarse_step(u, bf, dev, sdt, spec)
+        # (gravity runs step-at-a-time; the multi-step chunk path is
+        # hydro-only like the base class)
         u = {l: jnp.where(active, un[l], u[l]) for l in u}
         bf = {l: jnp.where(active, bfn[l], bf[l]) for l in bf}
         t = jnp.where(active, t + dt, t)
@@ -618,8 +656,8 @@ class MhdAmrSim(AmrSim):
                 "MHD ICs need divergence-free STAGGERED face fields; "
                 "using &INIT_PARAMS regions instead")
         self.mcfg = MhdStatic.from_params(params)
-        if params.run.poisson or params.run.pic:
-            raise NotImplementedError("MHD-AMR: gravity/particles TBD")
+        if params.run.pic:
+            raise NotImplementedError("MHD-AMR: particles TBD")
         spec = bmod.BoundarySpec.from_params(params)
         for lo, hi in ((f[0].kind, f[1].kind) for f in spec.faces):
             for k in (lo, hi):
@@ -863,7 +901,7 @@ class MhdAmrSim(AmrSim):
                 cfg=self.mcfg, bspec=self.bspec, lmin=self.lmin,
                 boxlen=self.boxlen, levels=lv,
                 complete=tuple(self.maps[l].complete for l in lv),
-                gravity=False,
+                gravity=self.gravity,
                 itype=int(self.params.refine.interpol_type))
         return self._spec
 
@@ -872,13 +910,18 @@ class MhdAmrSim(AmrSim):
             if self._dt_cache is not None:
                 return float(self._dt_cache)
             return float(jnp.min(_mhd_fused_courant(
-                self.u, self.bfs, self.dev, self._fused_spec())))
+                self.u, self.bfs, self.dev, self._fused_spec(),
+                self.fg if (self.gravity and self.fg) else None)))
 
     def step_coarse(self, dt: float):
+        if self.gravity:
+            with self.timers.section("poisson"):
+                self.solve_gravity()
         with self.timers.section("hydro - godunov"):
             self.u, self.bfs, self._dt_cache = _mhd_fused_coarse_step(
                 self.u, self.bfs, self.dev,
-                jnp.asarray(float(dt), self.dtype), self._fused_spec())
+                jnp.asarray(float(dt), self.dtype), self._fused_spec(),
+                self.fg if self.gravity else None)
         self.t += float(dt)
         # coarse-cadence source passes (for MHD only the patch 'source'
         # hook is live — SF/sinks/tracers are _pm_physics-gated)
@@ -887,6 +930,7 @@ class MhdAmrSim(AmrSim):
         self.nstep += 1
 
     def step_chunk(self, nsteps: int, tend: float) -> int:
+        assert not self.gravity     # chunks are solver-only (base class)
         spec = self._fused_spec()
         tdtype = jnp.result_type(float)
         if self._dt_cache is not None:
