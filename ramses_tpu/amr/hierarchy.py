@@ -891,8 +891,10 @@ class AmrSim:
         from ramses_tpu.pm import amr_pm
         x_host = np.asarray(self.p.x, dtype=np.float64)
         ncp = {l: self.maps[l].ncell_pad for l in self.levels()}
-        pm_maps = amr_pm.build_pm_maps(self.tree, x_host, self.boxlen,
-                                       self.bc_kinds, ncp)
+        from ramses_tpu.pm.coupling import deposit_scheme_from_params
+        pm_maps = amr_pm.build_pm_maps(
+            self.tree, x_host, self.boxlen, self.bc_kinds, ncp,
+            scheme=deposit_scheme_from_params(self.params))
         wdtype = self.dtype if self.p.x.dtype != jnp.float64 \
             else jnp.float64
         self._pm_dev = {

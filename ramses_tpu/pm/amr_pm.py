@@ -47,8 +47,10 @@ from ramses_tpu.amr.tree import Octree, map_coords
 class PmLevelMap:
     """Host-built CIC maps of one level for one position snapshot."""
     lvl: int
-    idx: np.ndarray       # [npart, 2^d] int32 flat cell row; ncell_pad=dump
-    w: np.ndarray         # [npart, 2^d] float64 CIC weights (0 if dropped)
+    idx: np.ndarray       # [npart, ncorner] int32 flat cell row;
+    #                       ncorner = 1|2^d|3^d (ngp/cic/tsc);
+    #                       ncell_pad = dump row
+    w: np.ndarray         # [npart, ncorner] float64 weights (0=dropped)
     assigned: np.ndarray  # [npart] bool: particle's finest covering level
 
 
@@ -67,15 +69,38 @@ def assign_levels(tree: Octree, x: np.ndarray, boxlen: float) -> np.ndarray:
     return lv
 
 
+def _stencil_1d(s: np.ndarray, scheme: str):
+    """Per-dim (base index, [(offset, weight)]) for one coordinate
+    ``s = x/dx`` (cells [i, i+1)).  ``rho_fine``'s CIC plus the NGP and
+    TSC alternatives (``pm/rho_fine.f90`` deposition kernels)."""
+    if scheme == "ngp":
+        return np.floor(s).astype(np.int64), [(0, np.ones_like(s))]
+    if scheme == "cic":
+        i0 = np.floor(s - 0.5).astype(np.int64)
+        f = (s - 0.5) - i0
+        return i0, [(0, 1.0 - f), (1, f)]
+    if scheme == "tsc":
+        ic = np.floor(s).astype(np.int64)
+        f = s - (ic + 0.5)                     # in [-0.5, 0.5)
+        return ic, [(-1, 0.5 * (0.5 - f) ** 2),
+                    (0, 0.75 - f ** 2),
+                    (1, 0.5 * (0.5 + f) ** 2)]
+    raise ValueError(f"deposit scheme {scheme!r}")
+
+
 def build_pm_maps(tree: Octree, x: np.ndarray, boxlen: float,
                   bc_kinds: List[tuple],
-                  ncell_pad: Dict[int, int]) -> Dict[int, PmLevelMap]:
-    """CIC index/weight maps for every populated level.
+                  ncell_pad: Dict[int, int],
+                  scheme: str = "cic") -> Dict[int, PmLevelMap]:
+    """Deposition index/weight maps for every populated level.
 
     ``x`` is a host float64 snapshot of positions; ``ncell_pad[l]`` the
     padded flat-cell count of the level batch (its value doubles as the
-    dump row index).
+    dump row index); ``scheme`` ∈ ngp|cic|tsc selects the kernel (1,
+    2^d, or 3^d corners per particle).
     """
+    import itertools
+
     ndim = tree.ndim
     ttd = 1 << ndim
     if any(k == 1 for pair in bc_kinds for k in pair):
@@ -84,8 +109,8 @@ def build_pm_maps(tree: Octree, x: np.ndarray, boxlen: float,
         # is implemented; reject loudly rather than silently mis-force
         raise NotImplementedError(
             "AMR particles: reflecting boundaries unsupported")
-    # open (outflow/inflow) dims: CIC corners falling outside the box
-    # are dropped — mass near the edge leaks like in the reference's
+    # open (outflow/inflow) dims: corners falling outside the box are
+    # dropped — mass near the edge leaks like in the reference's
     # isolated runs; escaped particles are deactivated by the drift
     open_dim = [bc_kinds[d] != (0, 0) for d in range(ndim)]
     levels = assign_levels(tree, x, boxlen)
@@ -94,20 +119,26 @@ def build_pm_maps(tree: Octree, x: np.ndarray, boxlen: float,
         if not tree.has(l):
             break
         dx = boxlen / (1 << l)
-        s = x / dx - 0.5                       # cell-center coordinates
-        i0 = np.floor(s).astype(np.int64)
-        frac = s - i0                          # weight of the +1 corner
+        base = []
+        offw = []
+        for d in range(ndim):
+            i0, ow = _stencil_1d(x[:, d] / dx, scheme)
+            base.append(i0)
+            offw.append(ow)
         npart = len(x)
-        idx = np.full((npart, ttd), ncell_pad[l], dtype=np.int32)
-        w = np.zeros((npart, ttd), dtype=np.float64)
-        for corner in range(ttd):
-            cc = i0.copy()
+        ncorner = len(offw[0]) ** ndim
+        idx = np.full((npart, ncorner), ncell_pad[l], dtype=np.int32)
+        w = np.zeros((npart, ncorner), dtype=np.float64)
+        nl = 1 << l
+        base_cc = np.stack(base, axis=1)
+        for corner, combo in enumerate(
+                itertools.product(*[range(len(ow)) for ow in offw])):
+            cc = base_cc.copy()
             wc = np.ones(npart, dtype=np.float64)
-            for d in range(ndim):
-                b = (corner >> d) & 1
-                cc[:, d] += b
-                wc *= frac[:, d] if b else (1.0 - frac[:, d])
-            nl = 1 << l
+            for d, k in enumerate(combo):
+                off, wd = offw[d][k]
+                cc[:, d] += off
+                wc = wc * wd
             oob = np.zeros(npart, dtype=bool)
             for d in range(ndim):
                 if open_dim[d]:
@@ -141,9 +172,10 @@ def deposit_flat(idx, w, m, active, ncell_pad: int, cell_vol):
 def gather_flat(field, idx, w, mask):
     """Inverse-CIC gather of a per-cell field at mapped positions.
 
-    ``field`` [ncell_pad, ncomp]; returns [npart, ncomp], zero rows for
-    particles with ``mask`` False (their corners may carry dump-row
-    indices from another level's map)."""
+    ``field`` [ncell_pad, ncomp]; ``idx``/``w`` [npart, ncorner];
+    returns [npart, ncomp], zero rows for particles with ``mask`` False
+    (their corners may carry dump-row indices from another level's
+    map)."""
     ext = jnp.concatenate(
         [field, jnp.zeros((1, field.shape[1]), field.dtype)])
     vals = ext[idx]                            # [npart, 2^d, ncomp]

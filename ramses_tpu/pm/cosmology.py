@@ -101,8 +101,10 @@ class Cosmology:
                    omega_k=float(raw.get("omega_k", 0.0)),
                    omega_b=float(raw.get("omega_b", 0.045)),
                    h0=float(raw.get("h0", 70.0)),
-                   aexp_ini=float(raw.get("aexp", p.init.aexp_ini
-                                          if p.init.aexp_ini < 1.0 else 1e-2)),
+                   aexp_ini=float(raw.get(
+                       "aexp", raw.get("aexp_ini", p.init.aexp_ini
+                                       if p.init.aexp_ini < 1.0
+                                       else 1e-2))),
                    boxlen_ini=float(raw.get("boxlen_ini", p.amr.boxlen)))
 
     # --- interpolators (host or device) ------------------------------

@@ -44,6 +44,17 @@ from ramses_tpu.poisson.coupling import (GravitySpec, _all_periodic,
                                          _pad_force, gravity_field, kick)
 
 
+def deposit_scheme_from_params(p) -> str:
+    """Validated &PM_PARAMS deposit scheme (shared by the uniform and
+    AMR particle paths so both read the namelist identically)."""
+    dep = str((p.raw or {}).get("pm_params", {})
+              .get("deposit", "cic")).strip("'\" ").lower()
+    if dep not in ("ngp", "cic", "tsc"):
+        raise ValueError(
+            f"&PM_PARAMS deposit={dep!r}: expected ngp|cic|tsc")
+    return dep
+
+
 @dataclass(frozen=True)
 class PMSpec:
     """Static particle-mesh configuration."""
@@ -57,6 +68,7 @@ class PMSpec:
     @classmethod
     def from_params(cls, p) -> "PMSpec":
         return cls(enabled=bool(p.run.pic), hydro=bool(p.run.hydro),
+                   deposit=deposit_scheme_from_params(p),
                    courant_factor=float(p.hydro.courant_factor),
                    boxlen=float(p.amr.boxlen), cosmo=bool(p.run.cosmo))
 
