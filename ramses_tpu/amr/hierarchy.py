@@ -1218,9 +1218,36 @@ class AmrSim:
         if dumper is not None:
             dumper.submit(snap, iout, base_dir,
                           namelist_path=namelist_path, ncpu=ncpu)
-            return os.path.join(base_dir, f"output_{iout:05d}")
-        return snapmod.dump_all(snap, iout, base_dir,
-                                namelist_path=namelist_path, ncpu=ncpu)
+            out = os.path.join(base_dir, f"output_{iout:05d}")
+        else:
+            out = snapmod.dump_all(snap, iout, base_dir,
+                                   namelist_path=namelist_path, ncpu=ncpu)
+        self._dump_csv_extras(out, iout, dumper)
+        return out
+
+    def _dump_csv_extras(self, out: str, iout: int, dumper=None):
+        """Sink/stellar CSV companions in the output directory
+        (``pm/output_sink.f90``, ``pm/output_stellar.f90`` — the
+        reference oracle reads both).  Tiny host writes, so they skip
+        the async queue; the directory is pre-created so the CSVs never
+        wait on the background writer (dump_all's own makedirs is
+        exist_ok, so this cannot race it)."""
+        import os
+
+        from ramses_tpu.io import snapshot as snapmod
+        if self.sinks is None and getattr(self, "stellar", None) is None:
+            return
+        os.makedirs(out, exist_ok=True)
+        if self.sinks is not None:
+            dmf = (self.stellar.dmf
+                   if getattr(self, "stellar", None) is not None else None)
+            snapmod.write_sink_csv(
+                os.path.join(out, f"sink_{iout:05d}.csv"), self.sinks,
+                dmf)
+        if getattr(self, "stellar", None) is not None:
+            snapmod.write_stellar_csv(
+                os.path.join(out, f"stellar_{iout:05d}.csv"),
+                self.stellar)
 
     @classmethod
     def from_snapshot(cls, params: Params, outdir: str,

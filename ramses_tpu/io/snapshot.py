@@ -481,6 +481,43 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
         tout=[params.output.tend or 0.0], particles=parts)
 
 
+def write_sink_csv(path: str, sinks, dmf: Optional[dict] = None) -> None:
+    """``sink_NNNNN.csv`` with the reference's column header
+    (``pm/output_sink.f90:16-27``); unsampled quantities (angular
+    momentum, Bondi diagnostics, SMBH mass) write 0 — the oracle
+    (``tests/visu/visu_ramses.py:424-447``) parses any float there."""
+    with open(path, "w") as f:
+        f.write(" # id,msink,x,y,z,vx,vy,vz,lx,ly,lz,tform,acc_rate,"
+                "del_mass,rho_gas,cs**2,etherm,vx_gas,vy_gas,vz_gas,"
+                "mbh,dmfsink,level \n")
+        f.write(" # 1,m,l,l,l,l t**-1,l t**-1,l t**-1,m l**2 t**-1,"
+                "m l**2 t**-1,m l**2 t**-1,t,m t**-1,m,m l**-3,"
+                "l**2 t**-2,m l**2 t**-2,l t**-1,l t**-1,l t**-1,"
+                "m,m,1\n")
+        nd = sinks.x.shape[1]
+        for k in range(sinks.n):
+            x3 = list(sinks.x[k]) + [0.0] * (3 - nd)
+            v3 = list(sinks.v[k]) + [0.0] * (3 - nd)
+            dmfk = (dmf or {}).get(int(sinks.idp[k]), 0.0)
+            vals = ([sinks.m[k]] + x3 + v3 + [0.0, 0.0, 0.0]
+                    + [sinks.tform[k], 0.0, 0.0, 0.0, 0.0, 0.0,
+                       0.0, 0.0, 0.0, 0.0, dmfk])
+            f.write(f"{int(sinks.idp[k]):10d}"
+                    + "".join(f",{v:21.10e}" for v in vals)
+                    + f",{1:10d}\n")
+
+
+def write_stellar_csv(path: str, stellar) -> None:
+    """``stellar_NNNNN.csv`` (``pm/output_stellar.f90:16-21``)."""
+    with open(path, "w") as f:
+        f.write(" # id,mstellar,tform,tlife \n")
+        f.write(" # 1,m,t,t\n")
+        for k in range(stellar.n):
+            f.write(f"{int(stellar.idp[k]):10d},{stellar.m[k]:21.10e},"
+                    f"{stellar.tform[k]:21.10e},"
+                    f"{stellar.tlife[k]:21.10e}\n")
+
+
 def particles_dict(p) -> dict:
     """Host copies of a :class:`ParticleSet`, active lanes only."""
     act = np.asarray(p.active)

@@ -87,6 +87,10 @@ class StellarSet:
     tlife: np.ndarray
     x: np.ndarray                    # [n, ndim] (the sink position at birth)
     sink_idp: np.ndarray
+    # persistent object ids (id_stellar): stable across SN removals so
+    # consumers can track objects between outputs
+    idp: np.ndarray = None
+    next_id: int = 1
     # per-sink accreted-mass accumulator toward the next quantum
     # (``dmfsink``) — fed by the sink creation/accretion passes so
     # merger mass transfers are NOT double-counted as new accretion
@@ -95,7 +99,9 @@ class StellarSet:
     @classmethod
     def empty(cls, ndim: int) -> "StellarSet":
         return cls(m=np.zeros(0), tform=np.zeros(0), tlife=np.zeros(0),
-                   x=np.zeros((0, ndim)), sink_idp=np.zeros(0, np.int64))
+                   x=np.zeros((0, ndim)),
+                   sink_idp=np.zeros(0, np.int64),
+                   idp=np.zeros(0, np.int64))
 
     @property
     def n(self) -> int:
@@ -139,6 +145,10 @@ def make_stellar_from_sinks(sinks, stellar: StellarSet,
             [stellar.x, np.repeat(sinks.x[k:k + 1], nnew, axis=0)])
         stellar.sink_idp = np.concatenate(
             [stellar.sink_idp, np.full(nnew, sid, np.int64)])
+        stellar.idp = np.concatenate(
+            [stellar.idp,
+             stellar.next_id + np.arange(nnew, dtype=np.int64)])
+        stellar.next_id += nnew
     return stellar
 
 
@@ -185,4 +195,6 @@ def sn_from_stellar(sim, stellar: StellarSet, spec: StellarSpec):
     keep = ~due
     return StellarSet(m=stellar.m[keep], tform=stellar.tform[keep],
                       tlife=stellar.tlife[keep], x=stellar.x[keep],
-                      sink_idp=stellar.sink_idp[keep], dmf=stellar.dmf)
+                      sink_idp=stellar.sink_idp[keep],
+                      idp=stellar.idp[keep], next_id=stellar.next_id,
+                      dmf=stellar.dmf)

@@ -316,23 +316,33 @@ def test_restart_particles(tmp_path):
     assert np.allclose(np.asarray(ps2.m), np.asarray(ps.m))
 
 
+ORACLE_PATH = "/root/reference/tests/visu/visu_ramses.py"
+
+
+def _load_oracle():
+    """Import the reference suite's snapshot parser verbatim."""
+    import importlib.util
+    import os
+    if not os.path.exists(ORACLE_PATH):
+        pytest.skip("reference oracle not available")
+    spec = importlib.util.spec_from_file_location("visu_ramses",
+                                                  ORACLE_PATH)
+    visu = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(visu)
+    return visu
+
+
 def test_reference_oracle_reads_our_snapshot(tmp_path, monkeypatch):
     """Execute the REFERENCE's own snapshot parser
     (``/root/reference/tests/visu/visu_ramses.py`` load_snapshot, run
     verbatim) against a dumped output directory — the byte-compat claim
     certified by the upstream oracle itself, not a re-implementation."""
-    import importlib.util
-    import os
-
     import jax.numpy as jnp
 
     from ramses_tpu.amr.hierarchy import AmrSim
     from ramses_tpu.config import params_from_dict
 
-    oracle_path = "/root/reference/tests/visu/visu_ramses.py"
-    if not os.path.exists(oracle_path):
-        pytest.skip("reference oracle not available")
-
+    visu = _load_oracle()
     g = {
         "run_params": {"hydro": True},
         "amr_params": {"levelmin": 3, "levelmax": 4, "boxlen": 1.0},
@@ -351,11 +361,6 @@ def test_reference_oracle_reads_our_snapshot(tmp_path, monkeypatch):
     sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
     sim.evolve(0.004, nstepmax=2)
     sim.dump(1, str(tmp_path))
-
-    spec = importlib.util.spec_from_file_location("visu_ramses",
-                                                  oracle_path)
-    visu = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(visu)
 
     monkeypatch.chdir(tmp_path)                # oracle reads from CWD
     data = visu.load_snapshot(1)
@@ -377,3 +382,49 @@ def test_reference_oracle_reads_our_snapshot(tmp_path, monkeypatch):
                        + 0.5 * d["density"] * vel2)
                       * d["dx"] ** 3).sum())
     assert np.isclose(e_oracle, sim.totals()[4], rtol=1e-12)
+
+
+def test_reference_oracle_reads_sink_csv(tmp_path, monkeypatch):
+    """The oracle's sink/stellar CSV readers parse our companions."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import params_from_dict
+
+    visu = _load_oracle()
+    g = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 3, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 2,
+                        "region_type": ["square", "square"],
+                        "x_center": [0.5, 0.5], "y_center": [0.5, 0.5],
+                        "z_center": [0.5, 0.5],
+                        "length_x": [10.0, 0.3], "length_y": [10.0, 0.3],
+                        "length_z": [10.0, 0.3],
+                        "exp_region": [10.0, 2.0],
+                        "d_region": [0.1, 100.0],
+                        "p_region": [0.05, 1.0]},
+        "hydro_params": {"gamma": 5.0 / 3.0},
+        "refine_params": {"err_grad_d": 0.3},
+        "sink_params": {"create_sinks": True, "n_sink": 10.0,
+                        "accretion_scheme": "threshold", "c_acc": 0.2},
+        "stellar_params": {"stellar_msink_th": 0.002, "lt_t0": 1.0,
+                           "sn_e_ref": 0.0},
+        "units_params": {"units_density": 1.66e-24,
+                         "units_time": 3.15e13,
+                         "units_length": 3.08e18},
+        "output_params": {"tend": 0.02},
+    }
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    sim.evolve(0.01, nstepmax=3)
+    assert sim.sinks.n > 0 and sim.stellar.n > 0
+    sim.dump(1, str(tmp_path))
+
+    monkeypatch.chdir(tmp_path)
+    data = visu.load_snapshot(1)
+    assert data["sinks"]["nsinks"] == sim.sinks.n
+    np.testing.assert_allclose(np.sort(data["sinks"]["msink"]),
+                               np.sort(sim.sinks.m), rtol=1e-9)
+    assert data["stellars"]["nstellars"] == sim.stellar.n
+    np.testing.assert_allclose(np.sort(data["stellars"]["mstellar"]),
+                               np.sort(sim.stellar.m), rtol=1e-9)
