@@ -474,6 +474,25 @@ class AmrSim:
         else:
             self._init_refine()
 
+        # radiative transfer on the hierarchy (rt=.true.; gray 1-group,
+        # rt/amr.py) — built after the tree/maps exist
+        self.rt_amr = None
+        if bool(params.run.rt):
+            if getattr(self.cfg, "physics", "hydro") == "hydro" \
+                    and self._pm_physics:
+                from ramses_tpu.rt.amr import RtAmrCoupled
+                from ramses_tpu.units import units as units_fn
+                un = self.units if self.units is not None else units_fn(
+                    params, cosmo=self.cosmo,
+                    aexp=(self.cosmo.aexp_ini if self.cosmo else 1.0))
+                self.rt_amr = RtAmrCoupled(self, params, un)
+                self._needs_mig_log = True  # rad/xion migrate on regrid
+            else:
+                import warnings
+                warnings.warn("rt=.true. is only wired for the hydro "
+                              "solver family on the AMR hierarchy; no "
+                              "radiative transfer will run")
+
     # ------------------------------------------------------------------
     # setup
     # ------------------------------------------------------------------
@@ -792,13 +811,15 @@ class AmrSim:
             if self._needs_mig_log:
                 self._mig_log[l] = (rows_d, rows_s, cell_rep, sgn_dev,
                                     rows_new, m.ncell_pad, new_octs,
-                                    f_cell)
+                                    f_cell, jnp.asarray(nb_rep))
             new_u[l] = self._place(_migrate_level(
                 old, new_u[l - 1], rows_d, rows_s, cell_rep,
                 jnp.asarray(nb_rep), sgn_dev, rows_new, m.ncell_pad,
                 self.cfg,
                 int(self.params.refine.interpol_type)), "cells")
         self.u = new_u
+        if getattr(self, "rt_amr", None) is not None:
+            self.rt_amr.apply_migration(self)
         # prune stale gravity state: a level whose bucketed size changed
         # (or that vanished) must not seed the next solve's warm start
         for l in list(self.phi):
@@ -1087,6 +1108,9 @@ class AmrSim:
         if self.movie is not None and self.nstep % self.movie_imov == 0:
             with self.timers.section("movie"):
                 self.movie.emit_amr(self)
+        if self.rt_amr is not None:
+            with self.timers.section("rt"):
+                self.rt_amr.advance(self, dt)
         from ramses_tpu import patch
         user_source = patch.hook("source")
         if user_source is not None:
@@ -1155,6 +1179,7 @@ class AmrSim:
             if not self.gravity and not self.pic and not verbose \
                     and self.cosmo is None and self.sinks is None \
                     and self.tracer_x is None and self.movie is None \
+                    and getattr(self, "rt_amr", None) is None \
                     and _patch.hook("source") is None and chunk > 1:
                 if self.step_chunk(chunk, tend) == 0:
                     break

@@ -839,7 +839,7 @@ class MhdAmrSim(AmrSim):
                 new_bf[l] = old_bf[l]
                 continue
             (rows_d, rows_s, cell_rep, sgn_rep, rows_new, ncell_pad,
-             new_octs, f_cell) = info
+             new_octs, f_cell, _nb_rep) = info
             old = old_bf.get(l)
             if old is None:
                 old = jnp.zeros((1, NCOMP, 2), self.dtype)

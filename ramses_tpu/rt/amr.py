@@ -1,0 +1,309 @@
+"""Radiative transfer on the AMR hierarchy (gray M1 + H chemistry).
+
+The reference subcycles ``rt_step`` inside ``amr_step`` per level
+(``amr/amr_step.f90:594-672``, ``rt/rt_godunov_fine.f90``).  Here the
+radiation state lives as per-level flat rows next to the gas state and
+advances at coarse-step cadence with RT-Courant substeps:
+
+  * COMPLETE levels run the dense GLF transport of the uniform solver
+    (:func:`ramses_tpu.rt.m1.transport_step`) on the permuted grid;
+  * PARTIAL levels gather 6^d oct stencils with minmod-interpolated
+    coarse ghosts (the same ``K._gather_uloc``/``K.interp_cells``
+    machinery as the hydro sweep) and apply the GLF update on the
+    block interior;
+  * the H photochemistry (:func:`ramses_tpu.rt.chem.chem_step`) runs
+    pointwise per level against the live gas density/temperature, and
+    photoheating writes back into the gas energy;
+  * restriction (``K.restrict_upload``) keeps covered cells at their
+    son means after every substep.
+
+Scope: the gray 1-group H-only system (the uniform driver carries the
+multigroup/He ladder); photon number at coarse-fine faces is
+first-order (no flux-correction scatter) — leaves are authoritative
+and restriction re-syncs covered cells, the standard relaxation.
+Regrid migration rides the hierarchy's logged migration maps exactly
+like the MHD face field.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.amr import kernels as K
+from ramses_tpu.rt import chem as chem_mod
+from ramses_tpu.rt import m1
+from ramses_tpu.rt.driver import RtSpec
+from ramses_tpu.units import X_frac, mH
+
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class _CfgShim:
+    """ndim/nvar-only cfg for the generic gather/interp kernels —
+    frozen so jit static-arg caching hits by VALUE (a fresh identity-
+    hashed instance per call would retrace every kernel)."""
+    ndim: int
+    nvar: int
+
+
+@partial(jax.jit, static_argnames=("nd", "c_red"))
+def _glf_block(rad, dt_cgs, dx_cgs, c_red: float, nd: int):
+    """GLF update on a gathered stencil block [1+nd, 6.., noct]
+    (spatial axes 1..nd, trailing oct batch; ghosts provided by the
+    gather, so no padding — the uniform ``transport_step`` without its
+    pad/unpad).  Returns the updated block."""
+    N = rad[0]
+    F = [rad[1 + d] for d in range(nd)]
+    U = [N] + F
+    dN = jnp.zeros_like(N)
+    dF = [jnp.zeros_like(N) for _ in range(nd)]
+    for d in range(nd):
+        ax = d                                  # field arrays: spatial
+        flux = m1._phys_flux(N, F, c_red, nd, d)
+        face = []
+        for k in range(1 + nd):
+            fl = jnp.roll(flux[k], 1, axis=ax)
+            ul = jnp.roll(U[k], 1, axis=ax)
+            face.append(0.5 * (fl + flux[k]) - 0.5 * c_red * (U[k] - ul))
+        dN = dN + (dt_cgs / dx_cgs) * (face[0]
+                                       - jnp.roll(face[0], -1, axis=ax))
+        for j in range(nd):
+            dF[j] = dF[j] + (dt_cgs / dx_cgs) * (
+                face[1 + j] - jnp.roll(face[1 + j], -1, axis=ax))
+    N_new = jnp.maximum(N + dN, m1.SMALL_NP)
+    F_new = [F[j] + dF[j] for j in range(nd)]
+    fmag = jnp.sqrt(sum(f ** 2 for f in F_new))
+    cap = c_red * N_new
+    scale = jnp.where(fmag > cap, cap / jnp.maximum(fmag, m1.SMALL_NP),
+                      1.0)
+    return jnp.stack([N_new] + [f * scale for f in F_new])
+
+
+class RtAmrCoupled:
+    """Owns the per-level radiation rows of an :class:`AmrSim`."""
+
+    def __init__(self, sim, params, un):
+        spec = RtSpec.from_params(params)
+        if spec.full3:
+            raise NotImplementedError(
+                "AMR RT is gray 1-group (multigroup/He runs in the "
+                "uniform driver)")
+        self.spec = spec
+        self.un = un
+        self.params = params
+        nd = sim.cfg.ndim
+        self.nd = nd
+        # rad rows: [ncell_pad, 1+nd] = (N [1/cm^3], F [1/cm^2/s])
+        self.rad: Dict[int, jnp.ndarray] = {}
+        self.xion: Dict[int, jnp.ndarray] = {}
+        for l in sim.levels():
+            ncp = sim.maps[l].ncell_pad
+            rad = np.full((ncp, 1 + nd), m1.SMALL_NP)
+            rad[:, 1:] = 0.0
+            self.rad[l] = jnp.asarray(rad)
+            self.xion[l] = jnp.full((ncp,), 1.2e-3)
+        # point source → NGP cell at its finest covering level
+        self.src: Dict[int, jnp.ndarray] = {}
+        r = params.rt
+        if float(r.rt_ndot) > 0.0:
+            from ramses_tpu.pm.amr_pm import assign_levels
+            from ramses_tpu.pm.amr_physics import ngp_rows
+            pos = np.asarray([[float(v) * sim.boxlen
+                               for v in r.rt_src_pos[:nd]]])
+            lsrc = int(assign_levels(sim.tree, pos, sim.boxlen)[0])
+            row = int(ngp_rows(sim.tree, pos, lsrc, sim.boxlen,
+                               sim.bc_kinds)[0])
+            vol_cgs = (sim.dx(lsrc) * un.scale_l) ** nd
+            self._src_info = (lsrc, row, float(r.rt_ndot) / vol_cgs)
+        else:
+            self._src_info = None
+
+    # ------------------------------------------------------------------
+    def _gas_nT(self, sim, l):
+        """(nH [1/cc], T [K]) rows of level ``l`` from the gas state."""
+        cfg = sim.cfg
+        u = sim.u[l]
+        rho = jnp.maximum(u[:, 0], cfg.smallr)
+        mom2 = sum(u[:, 1 + d] ** 2 for d in range(cfg.ndim))
+        eint = jnp.maximum(u[:, cfg.ndim + 1] - 0.5 * mom2 / rho, 1e-300)
+        t2 = (cfg.gamma - 1.0) * eint / rho * self.un.scale_T2
+        mu = 1.0 / (1.0 + self.xion[l])
+        nH = rho * self.un.scale_d * X_frac / mH
+        return nH, jnp.maximum(t2 * mu, 0.1)
+
+    def advance(self, sim, dt_code: float):
+        """Subcycled RT over one coarse step against the live gas;
+        writes photoheated energy back into ``sim.u``."""
+        spec = self.spec
+        nd = self.nd
+        if sim.cosmo is not None:
+            # supercomoving unit scales are aexp-dependent: refresh
+            # (cf. the cooling-scale refresh in step_coarse)
+            from ramses_tpu.units import units as units_fn
+            self.un = units_fn(self.params, cosmo=sim.cosmo,
+                               aexp=sim.aexp_now())
+        lmax_used = max(sim.levels())
+        dx_min_cgs = sim.dx(lmax_used) * self.un.scale_l
+        dt_cgs = float(dt_code) * self.un.scale_t
+        dt_c = m1.rt_courant_dt(dx_min_cgs, spec.c_red, spec.courant)
+        nsub = max(1, int(np.ceil(dt_cgs / dt_c)))
+        dt_sub = dt_cgs / nsub
+
+        nT = {l: self._gas_nT(sim, l) for l in sim.levels()}
+        T = {l: nT[l][1] for l in sim.levels()}
+        T0 = dict(T)
+
+        for _ in range(nsub):
+            # sources
+            if self._src_info is not None:
+                lsrc, row, rate = self._src_info
+                self.rad[lsrc] = self.rad[lsrc].at[row, 0].add(
+                    dt_sub * rate)
+            # transport, coarse→fine
+            for l in sim.levels():
+                m = sim.maps[l]
+                d = sim.dev[l]
+                dx_cgs = sim.dx(l) * self.un.scale_l
+                rad = self.rad[l]
+                shim = _CfgShim(nd, 1 + nd)
+                if m.complete:
+                    nb = 1 << l
+                    dense = rad[d["inv_perm"]]
+                    N = dense[:, 0].reshape((nb,) * nd)
+                    F = jnp.stack([dense[:, 1 + c].reshape((nb,) * nd)
+                                   for c in range(nd)])
+                    N, F = m1.transport_step(
+                        N, F, dt_sub, dx_cgs, spec.c_red, nd,
+                        periodic=spec.periodic)
+                    rows = jnp.concatenate(
+                        [N.reshape(-1, 1)]
+                        + [F[c].reshape(-1, 1) for c in range(nd)],
+                        axis=1)[d["perm"]]
+                    ncell = m.noct * (1 << nd)
+                    if m.ncell_pad > ncell:
+                        rad = rad.at[:ncell].set(rows)
+                    else:
+                        rad = rows
+                else:
+                    ghosts = K.interp_cells(
+                        self.rad[l - 1], d["interp_cell"],
+                        d["interp_nb"],
+                        d["interp_sgn"].astype(rad.dtype), shim,
+                        itype=1)
+                    blk = K._gather_uloc(rad, ghosts, d["stencil_src"],
+                                         None, shim)
+                    blk = _glf_block(blk, dt_sub, dx_cgs, spec.c_red,
+                                     nd)
+                    interior = (slice(None),) + tuple(
+                        slice(2, 4) for _ in range(nd))
+                    noct = blk.shape[-1]
+                    # oct-major flat rows, like level_sweep's du
+                    # extraction (amr/kernels.py): [noct*2^d, 1+nd]
+                    upd = jnp.transpose(
+                        blk[interior],
+                        (nd + 1,) + tuple(range(1, nd + 1)) + (0,)
+                    ).reshape(noct * 2 ** nd, 1 + nd)
+                    rad = rad.at[:noct * 2 ** nd].set(upd)
+                self.rad[l] = rad
+            # chemistry per level (pointwise; leaves authoritative)
+            for l in sim.levels():
+                nH, _T = nT[l]
+                N, x, Tn = chem_mod.chem_step(
+                    self.rad[l][:, 0], self.xion[l], T[l], nH,
+                    dt_sub, spec.c_red, spec.group, spec.otsa,
+                    heating=spec.heating)
+                self.rad[l] = self.rad[l].at[:, 0].set(N)
+                self.xion[l] = x
+                T[l] = Tn
+            # restriction fine→coarse
+            for l in sorted(sim.levels(), reverse=True):
+                if sim.tree.has(l + 1):
+                    d = sim.dev[l]
+                    self.rad[l] = K.restrict_upload(
+                        self.rad[l], self.rad[l + 1], d["ref_cell"],
+                        d["son_oct"], _CfgShim(nd, 1 + nd))
+                    self.xion[l] = K.restrict_upload(
+                        self.xion[l][:, None], self.xion[l + 1][:, None],
+                        d["ref_cell"], d["son_oct"],
+                        _CfgShim(nd, 1))[:, 0]
+
+        if spec.heating:
+            # write the integrated ΔT back into the gas energy
+            for l in sim.levels():
+                cfg = sim.cfg
+                u = sim.u[l]
+                rho = jnp.maximum(u[:, 0], cfg.smallr)
+                mu = 1.0 / (1.0 + self.xion[l])
+                dT2 = (T[l] - T0[l]) / mu
+                de = rho * dT2 / self.un.scale_T2 / (cfg.gamma - 1.0)
+                sim.u[l] = u.at[:, cfg.ndim + 1].add(
+                    de.astype(u.dtype))
+            sim._dt_cache = None
+
+    # ------------------------------------------------------------------
+    def apply_migration(self, sim):
+        """Carry rad/xion through a regrid using the hierarchy's logged
+        migration maps (the MHD face-field pattern)."""
+        from ramses_tpu.amr.hierarchy import _migrate_level
+
+        nd = self.nd
+        new_rad: Dict[int, jnp.ndarray] = {}
+        new_x: Dict[int, jnp.ndarray] = {}
+        for l in sim.levels():
+            ncp = sim.maps[l].ncell_pad
+            if l not in sim._mig_log:
+                if l in self.rad and self.rad[l].shape[0] == ncp:
+                    new_rad[l] = self.rad[l]
+                    new_x[l] = self.xion[l]
+                else:                          # fresh level
+                    rad = np.full((ncp, 1 + nd), m1.SMALL_NP)
+                    rad[:, 1:] = 0.0
+                    new_rad[l] = jnp.asarray(rad)
+                    new_x[l] = jnp.full((ncp,), 1.2e-3)
+                continue
+            (rows_d, rows_s, cell_rep, sgn_dev, rows_new, ncell_pad,
+             _new_octs, _f_cell, nb_rep) = sim._mig_log[l]
+            old_rad = self.rad.get(
+                l, jnp.full((1, 1 + nd), m1.SMALL_NP))
+            old_x = self.xion.get(l, jnp.full((1,), 1.2e-3))
+            new_rad[l] = _migrate_level(
+                old_rad, new_rad[l - 1] if l - 1 in new_rad
+                else self.rad[l - 1], rows_d, rows_s, cell_rep, nb_rep,
+                sgn_dev, rows_new, ncell_pad, _CfgShim(nd, 1 + nd), 1)
+            new_x[l] = _migrate_level(
+                old_x[:, None], (new_x[l - 1] if l - 1 in new_x
+                                 else self.xion[l - 1])[:, None],
+                rows_d, rows_s, cell_rep, nb_rep, sgn_dev, rows_new,
+                ncell_pad, _CfgShim(nd, 1), 1)[:, 0]
+        self.rad = new_rad
+        self.xion = new_x
+        # the source cell may have moved levels/rows
+        if self._src_info is not None:
+            from ramses_tpu.pm.amr_pm import assign_levels
+            from ramses_tpu.pm.amr_physics import ngp_rows
+            r = self.params.rt
+            pos = np.asarray([[float(v) * sim.boxlen
+                               for v in r.rt_src_pos[:nd]]])
+            lsrc = int(assign_levels(sim.tree, pos, sim.boxlen)[0])
+            row = int(ngp_rows(sim.tree, pos, lsrc, sim.boxlen,
+                               sim.bc_kinds)[0])
+            vol_cgs = (sim.dx(lsrc) * self.un.scale_l) ** nd
+            self._src_info = (lsrc, row,
+                              float(r.rt_ndot) / vol_cgs)
+
+    def ionized_volume(self, sim) -> float:
+        """Σ x dV over leaves (the Strömgren measure, code volume)."""
+        tot = 0.0
+        for l in sim.levels():
+            m = sim.maps[l]
+            x = np.asarray(self.xion[l])[:m.noct * 2 ** self.nd]
+            leaf = ~sim.tree.refined_mask(l)
+            tot += float(x[leaf].sum()) * sim.dx(l) ** self.nd
+        return tot
