@@ -65,14 +65,18 @@ def test_rt_amr_refined_front_and_heating():
     migration keeps the radiation state consistent."""
     refine = {"r_refine": [0.15] * 8, "x_refine": [0.5] * 8,
               "y_refine": [0.5] * 8, "z_refine": [0.5] * 8}
-    g = _rt_groups(4, 5, heating=True, refine=refine, tend=0.004)
+    g = _rt_groups(4, 5, heating=True, refine=refine, tend=0.001)
+    # denser gas + weaker source: the I-front stays INSIDE the refined
+    # region so its radial profile is measurable on the fine level
+    g["init_params"]["d_region"] = [10.0]
+    g["rt_params"]["rt_ndot"] = 1e44
     sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
     assert sim.tree.noct(5) > 0
     e0 = sim.totals()[4]
     v0 = sim.rt_amr.ionized_volume(sim)
-    sim.evolve(0.004, nstepmax=3)
+    sim.evolve(0.001, nstepmax=2)
     v1 = sim.rt_amr.ionized_volume(sim)
-    assert v1 > 10.0 * max(v0, 1e-6)          # front swept outward
+    assert v1 > 1.5 * v0                      # front swept outward
     assert sim.totals()[4] > e0               # photoheated
     lmax = max(sim.levels())
     x = np.asarray(sim.rt_amr.xion[lmax])[:sim.maps[lmax].noct * 8]
@@ -81,9 +85,9 @@ def test_rt_amr_refined_front_and_heating():
     # row-order canary: oct/cell-major scrambles flatten the profile
     xc = sim.tree.cell_centers(lmax, sim.boxlen)
     rr = np.sqrt(((xc - 0.5) ** 2).sum(axis=1))
-    near = x[:len(xc)][rr < 0.05].mean()
+    near = x[:len(xc)][rr < 0.04].mean()
     far = x[:len(xc)][(rr > 0.11) & (rr < 0.145)].mean()
-    assert near > 5.0 * max(far, 1e-3), (near, far)
+    assert near > 0.8 and far < 0.1, (near, far)
     # all levels hold sane radiation state after regrids
     for l in sim.levels():
         rad = np.asarray(sim.rt_amr.rad[l])
