@@ -262,13 +262,26 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     then restrict.  ``to_cons(q_rows)`` converts file output columns
     to the solver's stored rows; ``place_level(sim, l, rows, og,
     order)`` writes them into the sim state.  Returns (sim, parts)."""
-    from ramses_tpu.io.restart import restore_tree_state
+    from ramses_tpu.io.restart import restore_particles, restore_tree_state
     tree_og, rows_lv, meta, parts = restore_tree_state(
         outdir, None, params.amr.levelmin, to_cons=to_cons)
     tree = Octree(params.ndim, params.amr.levelmin, params.amr.levelmax)
     for l, og in tree_og.items():
         tree.set_level(l, og)
-    sim = cls(params, dtype=dtype, init_tree=tree)
+    ps = None
+    if parts:
+        from ramses_tpu.pm.particles import lane_headroom
+        from ramses_tpu.pm.sinks import SinkSpec
+        from ramses_tpu.pm.star_formation import SfSpec
+        # runs that keep creating particles need free lanes after the
+        # restart too (the fresh-start path's npartmax headroom) — but
+        # only for solver families whose __init__ keeps SF/sinks live
+        grows = (cls._pm_family(cls._make_cfg(params))
+                 and (SfSpec.from_params(params).enabled
+                      or SinkSpec.from_params(params).enabled))
+        ps = restore_particles(parts, params.ndim,
+                               nmax=lane_headroom(params, grows))
+    sim = cls(params, dtype=dtype, init_tree=tree, particles=ps)
     for l, rows in rows_lv.items():
         og = tree_og[l]
         pos = tree.lookup(l, og)
@@ -277,6 +290,28 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     sim._dt_cache = None
     sim.t = float(meta["t"])
     sim.nstep = int(meta["nstep"])
+    # the pending closing half-kick of the pre-dump step needs the old
+    # coarse dt (KDK: the first post-restart kick is 0.5*(dtold + dt)),
+    # and the stored dtnew makes the restart take the SAME next step a
+    # continuous run would (its cached CFL dt included the gravity
+    # term the fresh sim's empty force field cannot reproduce)
+    lm = params.amr.levelmin
+    dtold = np.atleast_1d(np.asarray(meta.get("dtold", 0.0)))
+    if len(dtold) >= lm:
+        sim.dt_old = float(dtold[lm - 1])
+    dtnew = np.atleast_1d(np.asarray(meta.get("dtnew", 0.0)))
+    if len(dtnew) >= lm and dtnew[lm - 1] > 0.0:
+        sim._dt_cache = float(dtnew[lm - 1])
+    if ps is not None:
+        # new star ids must not collide with restored particles'
+        sim._next_star_id = int(np.asarray(ps.idp).max()) + 1
+    if sim.gravity:
+        # prime the force field and the deposited-density maximum so
+        # the first post-restart coarse_dt carries the same free-fall
+        # cap (and the PCG the same warm start) a continuous run would
+        if sim.pic:
+            sim._build_pm()
+        sim.solve_gravity()
     return sim, parts
 
 
@@ -317,6 +352,14 @@ class AmrSim:
         hook; ``RhdAmrSim`` swaps in :class:`rhd.core.RhdStatic`)."""
         return HydroStatic.from_params(params)
 
+    @classmethod
+    def _pm_family(cls, cfg) -> bool:
+        """True when SF/sinks/tracers/cooling/movie are live for this
+        solver family: the Newtonian hydro state layout only (MHD
+        carries cell-B, SRHD stores (D,S,tau))."""
+        return (getattr(cfg, "physics", "hydro") == "hydro"
+                and cls._pm_physics)
+
     def __init__(self, params: Params, dtype=jnp.float32,
                  init_tree: Optional[Octree] = None,
                  particles=None, init_dense_u=None):
@@ -354,8 +397,7 @@ class AmrSim:
         self.cool_tables = None
         self._cool_aexp = 1.0
         if getattr(params.cooling, "cooling", False) \
-                and getattr(self.cfg, "physics", "hydro") == "hydro" \
-                and self._pm_physics:
+                and self._pm_family(self.cfg):
             from ramses_tpu.hydro.cooling import CoolingSpec, build_tables
             from ramses_tpu.units import units as units_fn
             cosmo0 = None
@@ -423,8 +465,7 @@ class AmrSim:
         # state layouts (MHD cell-B, SRHD (D,S,τ)) refuse loudly rather
         # than render physically wrong maps
         self.movie, self.movie_imov = None, 0
-        if (getattr(self.cfg, "physics", "hydro") == "hydro"
-                and self._pm_physics):
+        if self._pm_family(self.cfg):
             from ramses_tpu.io.movie import MovieWriter
             self.movie, self.movie_imov = MovieWriter.from_params(params)
         elif (params.raw or {}).get("movie_params", {}).get("movie"):
@@ -433,8 +474,7 @@ class AmrSim:
                           "solver family; no frames will be written")
         self._sf_rng = np.random.default_rng(1234)
         self._next_star_id = 1
-        if (getattr(self.cfg, "physics", "hydro") != "hydro"
-                or not self._pm_physics):
+        if not self._pm_family(self.cfg):
             self.sf_spec = SfSpec(enabled=False)
             self.sinks = None
         self.units = None
@@ -448,10 +488,10 @@ class AmrSim:
                 params, cosmo=cosmo0,
                 aexp=(cosmo0.aexp_ini if cosmo0 is not None else 1.0))
         if self.sf_spec.enabled and self.p is None:
-            npmax = params.amr.npartmax or 100000
+            from ramses_tpu.pm.particles import lane_headroom
             self.p = ParticleSet.make(
                 jnp.zeros((0, params.ndim)), jnp.zeros((0, params.ndim)),
-                jnp.zeros((0,)), nmax=npmax)
+                jnp.zeros((0,)), nmax=lane_headroom(params, True))
         if self.sf_spec.enabled:
             self.pic = True           # stars deposit/drift like DM
         self.dt_old = 0.0
@@ -478,8 +518,7 @@ class AmrSim:
         # rt/amr.py) — built after the tree/maps exist
         self.rt_amr = None
         if bool(params.run.rt):
-            if getattr(self.cfg, "physics", "hydro") == "hydro" \
-                    and self._pm_physics:
+            if self._pm_family(self.cfg):
                 from ramses_tpu.rt.amr import RtAmrCoupled
                 from ramses_tpu.units import units as units_fn
                 un = self.units if self.units is not None else units_fn(
@@ -883,25 +922,32 @@ class AmrSim:
                 dts = [float(jnp.min(_fused_courant(
                     self.u, self.dev, self._fused_spec(),
                     self.fg if (self.gravity and self.fg) else None)))]
-            if self.pic:
-                from ramses_tpu.pm import particles as pmod
-                cf = float(self.cfg.courant_factor)
-                # particle Courant: a level-l particle moves cf*dx(l) per
-                # level substep, i.e. cf*dx(lmin) per coarse step
-                # (pm/newdt_fine.f90:186-233 folded through the exact
-                # factor-2 subcycling)
-                dts.append(float(pmod.particle_dt(
-                    self.p, self.dx(self.lmin), cf)))
-                if self.gravity and self._rho_max:
-                    # free-fall cap from the previous step's deposited
-                    # density (one step lagged; pm/newdt_fine.f90:51-60)
-                    dts.append(float(pmod.freefall_dt(
-                        jnp.asarray(self._rho_max), cf,
-                        self.grav_coeff())))
-            if self.cosmo is not None:
-                # expansion cap (amr/update_time.f90 cosmo branch)
-                dts.append(0.1 / abs(self.hexp_now()))
+            dts.extend(self._aux_dts())
             return min(dts)
+
+    def _aux_dts(self) -> list:
+        """Non-solver dt caps shared by every solver family: particle
+        Courant + lagged free-fall, cosmological expansion."""
+        dts = []
+        if self.pic:
+            from ramses_tpu.pm import particles as pmod
+            cf = float(self.cfg.courant_factor)
+            # particle Courant: a level-l particle moves cf*dx(l) per
+            # level substep, i.e. cf*dx(lmin) per coarse step
+            # (pm/newdt_fine.f90:186-233 folded through the exact
+            # factor-2 subcycling)
+            dts.append(float(pmod.particle_dt(
+                self.p, self.dx(self.lmin), cf)))
+            if self.gravity and self._rho_max:
+                # free-fall cap from the previous step's deposited
+                # density (one step lagged; pm/newdt_fine.f90:51-60)
+                dts.append(float(pmod.freefall_dt(
+                    jnp.asarray(self._rho_max), cf,
+                    self.grav_coeff())))
+        if self.cosmo is not None:
+            # expansion cap (amr/update_time.f90 cosmo branch)
+            dts.append(0.1 / abs(self.hexp_now()))
+        return dts
 
     # ------------------------------------------------------------------
     # particle-mesh on the hierarchy (pm/amr_pm.py)
@@ -1026,9 +1072,34 @@ class AmrSim:
         if self.pic and rho_max is not None:
             self._rho_max = float(rho_max)   # one host sync per solve
 
-    def step_coarse(self, dt: float):
+    def _grav_pm_pre(self, dt: float):
+        """Pre-sweep gravity/PM sequence shared by the solver families:
+        rebuild particle maps, solve the per-level Poisson problem, and
+        complete the previous half-kick + this step's opening half-kick
+        with the new force at x^n (``synchro_fine``)."""
         from ramses_tpu.pm import particles as pmod
+        if self.pic:
+            with self.timers.section("particles: maps"):
+                self._build_pm()
+        if self.gravity:
+            with self.timers.section("poisson"):
+                self.solve_gravity()
+        if self.pic and self.gravity:
+            with self.timers.section("particles: kick"):
+                f_at_p = self._pm_force()
+                self.p = pmod.kick(self.p, f_at_p,
+                                   0.5 * (self.dt_old + dt))
 
+    def _pm_drift(self, dt: float):
+        """``move_fine``: drift with the coarse dt (fine levels would
+        split it into exact halves with the same frozen force)."""
+        from ramses_tpu.pm import particles as pmod
+        if self.pic:
+            with self.timers.section("particles: drift"):
+                self.p = pmod.drift(self.p, dt, self.boxlen,
+                                    periodic=self.grav_periodic)
+
+    def step_coarse(self, dt: float):
         if self.cosmo is not None and (self.cool_tables is not None
                                        or self.units is not None):
             # supercomoving unit scales are aexp-dependent
@@ -1052,30 +1123,13 @@ class AmrSim:
                         z_reion=float(c.z_reion),
                         haardt_madau=bool(c.haardt_madau))
                     self._cool_aexp = a
-        if self.pic:
-            with self.timers.section("particles: maps"):
-                self._build_pm()
-        if self.gravity:
-            with self.timers.section("poisson"):
-                self.solve_gravity()
-        if self.pic and self.gravity:
-            # synchro_fine: complete the previous half-kick with the new
-            # force at x^n, plus this step's opening half-kick
-            with self.timers.section("particles: kick"):
-                f_at_p = self._pm_force()
-                self.p = pmod.kick(self.p, f_at_p,
-                                   0.5 * (self.dt_old + float(dt)))
+        self._grav_pm_pre(float(dt))
         with self.timers.section("hydro - godunov"):
             self.u, self._dt_cache = _fused_coarse_step(
                 self.u, self.dev, self.fg if self.gravity else {},
                 jnp.asarray(float(dt), self.dtype), self._fused_spec(),
                 self._cool_bundle())
-        if self.pic:
-            # move_fine: drift with the coarse dt (fine levels would
-            # split it into exact halves with the same frozen force)
-            with self.timers.section("particles: drift"):
-                self.p = pmod.drift(self.p, float(dt), self.boxlen,
-                                    periodic=self.grav_periodic)
+        self._pm_drift(float(dt))
         self.t += float(dt)
         self._source_passes(float(dt))
         self.dt_old = float(dt)

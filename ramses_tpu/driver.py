@@ -208,11 +208,11 @@ class Simulation:
             import dataclasses as _dc
             self.pspec = _dc.replace(self.pspec, enabled=True)
             if self.state.p is None:
-                npmax = params.amr.npartmax or 100000
+                from ramses_tpu.pm.particles import lane_headroom
                 self.state.p = ParticleSet.make(
                     jnp.zeros((0, params.ndim)),
                     jnp.zeros((0, params.ndim)), jnp.zeros((0,)),
-                    nmax=npmax)
+                    nmax=lane_headroom(params, True))
         # &MOVIE_PARAMS on-the-fly frames (amr/movie.f90)
         from ramses_tpu.io.movie import MovieWriter
         self.movie, self.movie_imov = MovieWriter.from_params(params)
@@ -382,10 +382,21 @@ class Simulation:
                       dtype=jnp.float32) -> "Simulation":
         """Resume from a snapshot directory (``nrestart`` path)."""
         from ramses_tpu.io.restart import restore_particles, restore_uniform
+        from ramses_tpu.pm.particles import lane_headroom
+        from ramses_tpu.pm.sinks import SinkSpec
+        from ramses_tpu.pm.star_formation import SfSpec
         cfg = HydroStatic.from_params(params)
         dense, meta, parts = restore_uniform(outdir, params, cfg)
-        p = restore_particles(parts, params.ndim) if parts else None
+        # particle-creating runs need free lanes after the restart too
+        grows = (SfSpec.from_params(params).enabled
+                 or SinkSpec.from_params(params).enabled)
+        p = (restore_particles(parts, params.ndim,
+                               nmax=lane_headroom(params, grows))
+             if parts else None)
         sim = cls(params, dtype=dtype, particles=p)
+        if p is not None:
+            # new star ids must not collide with restored particles'
+            sim._next_star_id = int(np.asarray(p.idp).max()) + 1
         sim.state.u = jnp.asarray(dense, dtype=dtype)
         sim.state.t = float(meta["t"])
         sim.state.nstep = int(meta["nstep"])

@@ -77,16 +77,33 @@ def restore_tree_state(outdir: str, cfg, levelmin: int, to_cons=None):
 
 
 def restore_particles(parts: dict, ndim: int, nmax: Optional[int] = None):
-    """Rebuild a :class:`ParticleSet` from a read particle file."""
+    """Rebuild a :class:`ParticleSet` from a read particle file.
+
+    ``nmax`` (clamped to the stored count) sets the lane headroom for
+    runs that keep creating particles (SF/sinks).  Birth times and
+    metallicities round-trip when the file carries the star records
+    (``pm/output_part.f90`` optional ``birth_time``/``metallicity``)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
     from ramses_tpu.pm.particles import ParticleSet
     if parts is None:
         return None
     dims = "xyz"[:ndim]
     x = np.stack([parts[f"position_{d}"] for d in dims], axis=1)
     v = np.stack([parts[f"velocity_{d}"] for d in dims], axis=1)
-    return ParticleSet.make(x, v, parts["mass"],
-                            idp=parts["identity"].astype(np.int64),
-                            family=parts["family"], nmax=nmax)
+    nmax = max(nmax or 0, len(x)) or None
+    ps = ParticleSet.make(x, v, parts["mass"],
+                          idp=parts["identity"].astype(np.int64),
+                          family=parts["family"], nmax=nmax)
+    pad = ps.n - len(x)
+    for key, attr in (("birth_time", "tp"), ("metallicity", "zp")):
+        if key in parts:
+            ps = dataclasses.replace(ps, **{attr: jnp.asarray(
+                np.pad(np.asarray(parts[key], np.float64), (0, pad)),
+                getattr(ps, attr).dtype)})
+    return ps
 
 
 def restore_uniform(outdir: str, params, cfg) -> Tuple[np.ndarray, dict,

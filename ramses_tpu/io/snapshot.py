@@ -473,12 +473,24 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
     un = units_fn(params)
     parts = (particles_dict(sim.p)
              if getattr(sim, "p", None) is not None else None)
+    # per-level dtold/dtnew from the exact factor-2 subcycling
+    # (``amr/update_time.f90`` bookkeeping): restarts need the lmin
+    # dtold to complete the pending closing half-kick, and the lmin
+    # dtnew (the fused step's emitted CFL dt) to take the SAME next
+    # step a continuous run would
+    def sub(v):
+        return np.array([float(v) * 0.5 ** max(l - lmin, 0)
+                         for l in range(1, lmax + 1)])
+
+    dtc = getattr(sim, "_dt_cache", None)
     return Snapshot(
         ndim=ndim, nlevelmax=lmax, levels=levels,
         boxlen=sim.boxlen, t=float(sim.t), gamma=gamma,
         var_names=names, units=un, levelmin=lmin,
         nstep=int(sim.nstep), nstep_coarse=int(sim.nstep),
-        tout=[params.output.tend or 0.0], particles=parts)
+        tout=[params.output.tend or 0.0], particles=parts,
+        dtold=sub(getattr(sim, "dt_old", 0.0)),
+        dtnew=sub(dtc) if dtc is not None else None)
 
 
 def write_sink_csv(path: str, sinks, dmf: Optional[dict] = None) -> None:

@@ -29,7 +29,9 @@ levels; a partial-level parent keeps its own EMFs there (first-order
 coupling; each level's own divB stays machine-zero regardless, by the
 duplicated-face construction above).  Self-gravity rides the hydro
 hierarchy's per-level Poisson solve with MHD-layout kicks
-(:func:`mhd_kick_flat`); particles remain TBD.
+(:func:`mhd_kick_flat`); particles ride the shared PM layer
+(``pm/amr_pm.py`` deposits into the Poisson rhs, ``synchro_fine``/
+``move_fine`` KDK via the base class's ``_grav_pm_pre``/``_pm_drift``).
 """
 
 from __future__ import annotations
@@ -656,8 +658,6 @@ class MhdAmrSim(AmrSim):
                 "MHD ICs need divergence-free STAGGERED face fields; "
                 "using &INIT_PARAMS regions instead")
         self.mcfg = MhdStatic.from_params(params)
-        if params.run.pic:
-            raise NotImplementedError("MHD-AMR: particles TBD")
         spec = bmod.BoundarySpec.from_params(params)
         for lo, hi in ((f[0].kind, f[1].kind) for f in spec.faces):
             for k in (lo, hi):
@@ -908,20 +908,22 @@ class MhdAmrSim(AmrSim):
     def coarse_dt(self) -> float:
         with self.timers.section("courant"):
             if self._dt_cache is not None:
-                return float(self._dt_cache)
-            return float(jnp.min(_mhd_fused_courant(
-                self.u, self.bfs, self.dev, self._fused_spec(),
-                self.fg if (self.gravity and self.fg) else None)))
+                dts = [float(self._dt_cache)]
+            else:
+                dts = [float(jnp.min(_mhd_fused_courant(
+                    self.u, self.bfs, self.dev, self._fused_spec(),
+                    self.fg if (self.gravity and self.fg) else None)))]
+            dts.extend(self._aux_dts())
+            return min(dts)
 
     def step_coarse(self, dt: float):
-        if self.gravity:
-            with self.timers.section("poisson"):
-                self.solve_gravity()
+        self._grav_pm_pre(float(dt))
         with self.timers.section("hydro - godunov"):
             self.u, self.bfs, self._dt_cache = _mhd_fused_coarse_step(
                 self.u, self.bfs, self.dev,
                 jnp.asarray(float(dt), self.dtype), self._fused_spec(),
                 self.fg if self.gravity else None)
+        self._pm_drift(float(dt))
         self.t += float(dt)
         # coarse-cadence source passes (for MHD only the patch 'source'
         # hook is live — SF/sinks/tracers are _pm_physics-gated)
@@ -930,7 +932,7 @@ class MhdAmrSim(AmrSim):
         self.nstep += 1
 
     def step_chunk(self, nsteps: int, tend: float) -> int:
-        assert not self.gravity     # chunks are solver-only (base class)
+        assert not self.gravity and not self.pic  # chunks are solver-only
         spec = self._fused_spec()
         tdtype = jnp.result_type(float)
         if self._dt_cache is not None:

@@ -23,6 +23,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# default lane budget for runs that keep creating particles (the
+# reference's npartmax static ceiling, amr/amr_parameters.f90:84, when
+# the namelist leaves it unset)
+DEFAULT_HEADROOM = 100000
+
+
+def lane_headroom(params, grows: bool):
+    """Particle lane budget: ``npartmax`` when set, else the default
+    headroom for particle-creating runs (SF/sinks), else None (exact
+    fit).  The single source of truth for every construction/restore
+    site."""
+    if params.amr.npartmax:
+        return int(params.amr.npartmax)
+    return DEFAULT_HEADROOM if grows else None
+
+
 # particle families (pm/pm_commons.f90:72-96)
 FAM_GAS_TRACER = 0
 FAM_DM = 1

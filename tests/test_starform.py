@@ -173,3 +173,34 @@ def test_sink_drift():
     s.idp = np.array([1], dtype=np.int64)
     s = drift_kick(s, None, 0.1, 0.5, boxlen=1.0)
     assert np.isclose(s.x[0, 0], 0.05)  # periodic wrap
+
+
+def test_restart_star_id_counter_and_headroom(tmp_path):
+    """Restart bookkeeping for particle-creating runs: the star-id
+    counter resumes past the restored ids (no idp collisions) and the
+    restored set keeps free lanes (``npartmax`` headroom) so SF can
+    continue (``pm/init_part.f90`` restart semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import params_from_string
+
+    txt = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "poisson=.true.", "pic=.true.", "/",
+        "&AMR_PARAMS", "levelmin=4", "levelmax=4", "boxlen=1.0", "/",
+        "&HYDRO_PARAMS", "courant_factor=0.5", "/",
+        "&SF_PARAMS", "n_star=1e12", "t_star=1.0", "/",
+        "&INIT_PARAMS", "nregion=1", "region_type(1)='square'",
+        "d_region=1.0", "p_region=1.0", "/"])
+    p = params_from_string(txt, ndim=2)
+    rng = np.random.default_rng(9)
+    n = 17
+    ps = ParticleSet.make(rng.uniform(0.1, 0.9, (n, 2)),
+                          np.zeros((n, 2)), np.full(n, 1.0 / n),
+                          idp=np.arange(5, 5 + n))
+    sim = AmrSim(p, dtype=jnp.float64, particles=jax.device_put(ps))
+    out = sim.dump(1, str(tmp_path))
+    back = AmrSim.from_snapshot(p, out, dtype=jnp.float64)
+    assert back._next_star_id == 5 + n
+    assert int((~np.asarray(back.p.active)).sum()) > 0   # free lanes
