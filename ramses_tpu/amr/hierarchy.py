@@ -514,7 +514,8 @@ class AmrSim:
         else:
             self._init_refine()
 
-        # radiative transfer on the hierarchy (rt=.true.; gray 1-group,
+        # radiative transfer on the hierarchy (rt=.true.; gray or
+        # multigroup/He via &RT_PARAMS rt_ngroups/rt_y_he,
         # rt/amr.py) — built after the tree/maps exist
         self.rt_amr = None
         if bool(params.run.rt):

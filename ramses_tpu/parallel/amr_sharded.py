@@ -43,10 +43,33 @@ class ShardedAmrSim(AmrSim):
         self._row_sharding = NamedSharding(self.mesh, P("oct"))
         self._row2_sharding = NamedSharding(self.mesh, P("oct", None))
         self._rep_sharding = NamedSharding(self.mesh, P())
+        self._warned_rep = set()
         if particles is not None:
-            # particle rows replicate; deposits scatter into the sharded
-            # level batches (GSPMD inserts the reduction collectives)
-            particles = jax.device_put(particles, self._rep_sharding)
+            # particle rows shard over the mesh when the lane count
+            # divides (deposit gathers/scatters stay global-view, so
+            # GSPMD inserts the collectives either way); non-divisible
+            # sets replicate — memory stops scaling, so warn at size
+            import dataclasses as _dc
+
+            def put(a):
+                if (getattr(a, "ndim", 0) >= 1
+                        and a.shape[0] % self.ndev == 0):
+                    return jax.device_put(
+                        a, self._row2_sharding if a.ndim > 1
+                        else self._row_sharding)
+                return jax.device_put(a, self._rep_sharding)
+
+            n = particles.n
+            if n % self.ndev and n > 1_000_000:
+                import warnings
+                warnings.warn(
+                    f"particle count {n} not divisible by the "
+                    f"{self.ndev}-device mesh: arrays REPLICATE on "
+                    "every device (per-device memory stops scaling); "
+                    "pad npartmax to a mesh multiple")
+            particles = _dc.replace(
+                particles, **{f.name: put(getattr(particles, f.name))
+                              for f in _dc.fields(particles)})
         super().__init__(params, dtype=dtype, particles=particles,
                          init_tree=init_tree, init_dense_u=init_dense_u)
 
@@ -70,11 +93,18 @@ class ShardedAmrSim(AmrSim):
     def _place(self, arr, kind: str):
         if kind == "rep":
             return jax.device_put(arr, self._rep_sharding)
-        if arr.ndim == 1:
-            # cells/octs rows must be divisible; replicate otherwise
-            if arr.shape[0] % self.ndev:
-                return jax.device_put(arr, self._rep_sharding)
-            return jax.device_put(arr, self._row_sharding)
         if arr.shape[0] % self.ndev:
+            # cells/octs rows must divide the mesh to shard; the
+            # bucketed pads normally guarantee that, so a replicated
+            # fallback at scale signals a padding bug — say so once
+            if arr.shape[0] > 1_000_000 and kind not in self._warned_rep:
+                import warnings
+                self._warned_rep.add(kind)
+                warnings.warn(
+                    f"sharded-AMR: a {kind!r} array of {arr.shape[0]} "
+                    f"rows is not divisible by the {self.ndev}-device "
+                    "mesh and REPLICATES (memory/work stop scaling); "
+                    "check the _noct_pad mesh alignment")
             return jax.device_put(arr, self._rep_sharding)
-        return jax.device_put(arr, self._row2_sharding)
+        return jax.device_put(arr, self._row_sharding if arr.ndim == 1
+                              else self._row2_sharding)

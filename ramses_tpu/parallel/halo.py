@@ -1,0 +1,136 @@
+"""Explicit halo-exchange backend: shard_map + ppermute slab pipeline.
+
+The global-view path (:mod:`ramses_tpu.parallel.sharded`) leaves halo
+communication to XLA's SPMD partitioner.  This module is the EXPLICIT
+formulation of the reference's two-sided message schedule
+(``amr/virtual_boundaries.f90:373-533`` ``make_virtual_fine``): the
+state lives as per-device blocks under ``jax.shard_map``, each step
+sends the ``NGHOST``-deep boundary slabs to the ring neighbours with
+``lax.ppermute`` (ICI neighbour exchange — the collective actually
+generated for MPI_Isend/Irecv pairs on a torus), pads the remaining
+axes locally, and runs the unchanged MUSCL kernels on the interior.
+The CFL reduction is a ``lax.pmin`` over the mesh axis (P7).
+
+Why keep both: the GSPMD path is the idiomatic TPU formulation and
+lets the compiler fuse; this path pins the communication schedule —
+deterministic slab order, no partitioner heuristics — and is the
+template for hand-scheduled overlap when profiles demand it.  The two
+must agree bitwise on periodic boxes (asserted in
+``tests/test_halo.py``).
+
+Scope: fully periodic boxes, 1-D decomposition over the leading
+spatial axis — the Hilbert-order row decomposition every other sharded
+path uses (P1).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.grid.uniform import UniformGrid
+from ramses_tpu.hydro import muscl
+from ramses_tpu.hydro.timestep import compute_dt
+
+AXIS = "hx"          # mesh axis name of the slab decomposition
+
+
+def make_halo_mesh(devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def _check(grid: UniformGrid, mesh: Mesh):
+    n = mesh.shape[AXIS]
+    if any(f[0].kind != 0 or f[1].kind != 0 for f in grid.bc.faces):
+        raise NotImplementedError(
+            "halo backend: fully periodic boxes only (physical "
+            "boundary slabs stay on the GSPMD path)")
+    if grid.shape[0] % n:
+        raise ValueError(
+            f"leading axis {grid.shape[0]} not divisible by the "
+            f"{n}-device mesh")
+    if grid.shape[0] // n < muscl.NGHOST:
+        raise ValueError("shard thinner than the stencil halo")
+
+
+def _exchange(u_loc, ng: int):
+    """Ring exchange of the leading-spatial-axis boundary slabs.
+
+    ``u_loc``: [nvar, nx_loc, ...].  Returns the block extended to
+    ``nx_loc + 2*ng`` — each device's low ghost slab is its left
+    neighbour's high interior slab and vice versa (periodic ring, so
+    device 0's left neighbour is device n-1: the wrap IS the physical
+    periodic boundary)."""
+    n = jax.lax.axis_size(AXIS)
+    fwd = [(i, (i + 1) % n) for i in range(n)]    # data moves +x
+    bwd = [(i, (i - 1) % n) for i in range(n)]    # data moves -x
+    lo_ghost = jax.lax.ppermute(u_loc[:, -ng:], AXIS, fwd)
+    hi_ghost = jax.lax.ppermute(u_loc[:, :ng], AXIS, bwd)
+    return jnp.concatenate([lo_ghost, u_loc, hi_ghost], axis=1)
+
+
+def _pad_rest(u_ext, ndim: int, ng: int):
+    """Periodic-wrap padding of the non-decomposed spatial axes."""
+    pads = [(0, 0), (0, 0)] + [(ng, ng)] * (ndim - 1)
+    return jnp.pad(u_ext, pads, mode="wrap")
+
+
+def _local_step(u_loc, dt, grid: UniformGrid):
+    cfg = grid.cfg
+    ng = muscl.NGHOST
+    up = _pad_rest(_exchange(u_loc, ng), cfg.ndim, ng)
+    flux, tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
+    un = muscl.apply_fluxes(up, flux, cfg)
+    if cfg.pressure_fix or cfg.nener:
+        un = muscl.dual_energy_fix(up, un, tmp, dt,
+                                   (grid.dx,) * cfg.ndim, cfg)
+    return bmod.unpad(un, cfg.ndim, ng)
+
+
+@lru_cache(maxsize=None)
+def _build_run(grid: UniformGrid, mesh: Mesh, nsteps: int):
+    try:
+        shard_map = jax.shard_map                 # jax >= 0.8
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
+    cfg = grid.cfg
+
+    def shard_body(u_loc, t, tend):
+        def body(carry, _):
+            u_loc, t, ndone = carry
+            dt_loc = compute_dt(u_loc, None, grid.dx, cfg)
+            dt = jax.lax.pmin(dt_loc, AXIS)
+            dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+            active = t < tend
+            un = _local_step(u_loc, jnp.where(active, dt, 0.0)
+                             .astype(u_loc.dtype), grid)
+            u_loc = jnp.where(active, un, u_loc)
+            t = jnp.where(active, t + dt, t)
+            ndone = ndone + jnp.where(active, 1, 0)
+            return (u_loc, t, ndone), None
+
+        (u_loc, t, ndone), _ = jax.lax.scan(
+            body, (u_loc, t, jnp.array(0)), None, length=nsteps)
+        return u_loc, t, ndone
+
+    return jax.jit(shard_map(shard_body, mesh=mesh,
+                             in_specs=(P(None, AXIS), P(), P()),
+                             out_specs=(P(None, AXIS), P(), P())))
+
+
+def run_steps_halo(grid: UniformGrid, mesh: Mesh, u, t, tend,
+                   nsteps: int):
+    """``run_steps`` with the explicit slab pipeline: the whole window
+    is ONE shard_map program; every step does two ppermutes + one
+    pmin.  Returns (u, t, n_done) like the global-view version."""
+    _check(grid, mesh)
+    u = jax.device_put(u, NamedSharding(mesh, P(None, AXIS)))
+    return _build_run(grid, mesh, nsteps)(u, jnp.asarray(t),
+                                          jnp.asarray(tend))

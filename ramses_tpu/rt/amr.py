@@ -1,4 +1,4 @@
-"""Radiative transfer on the AMR hierarchy (gray M1 + H chemistry).
+"""Radiative transfer on the AMR hierarchy (M1 + thermochemistry).
 
 The reference subcycles ``rt_step`` inside ``amr_step`` per level
 (``amr/amr_step.f90:594-672``, ``rt/rt_godunov_fine.f90``).  Here the
@@ -11,18 +11,24 @@ advances at coarse-step cadence with RT-Courant substeps:
     coarse ghosts (the same ``K._gather_uloc``/``K.interp_cells``
     machinery as the hydro sweep) and apply the GLF update on the
     block interior;
-  * the H photochemistry (:func:`ramses_tpu.rt.chem.chem_step`) runs
-    pointwise per level against the live gas density/temperature, and
-    photoheating writes back into the gas energy;
+  * the photochemistry runs pointwise per level against the live gas
+    density/temperature — the gray H-only system
+    (:func:`ramses_tpu.rt.chem.chem_step`) or, with ``rt_ngroups>1`` /
+    ``rt_y_he>0``, the multigroup 3-ion H/He/He+ ladder with
+    blackbody-SED-averaged cross sections
+    (:func:`ramses_tpu.rt.chem.chem_step_3ion`,
+    ``rt/rt_spectra.f90`` + ``rt/rt_cooling_module.f90``); photoheating
+    writes back into the gas energy;
   * restriction (``K.restrict_upload``) keeps covered cells at their
     son means after every substep.
 
-Scope: the gray 1-group H-only system (the uniform driver carries the
-multigroup/He ladder); photon number at coarse-fine faces is
-first-order (no flux-correction scatter) — leaves are authoritative
-and restriction re-syncs covered cells, the standard relaxation.
-Regrid migration rides the hierarchy's logged migration maps exactly
-like the MHD face field.
+Row layout: ``rad[l]`` is ``[ncell_pad, ngroups*(1+nd)]`` — group-major
+(N, F_x..F_z) blocks, so every generic index kernel (gather, interp,
+restriction, regrid migration) moves ALL groups in one call.  Photon
+number at coarse-fine faces is first-order (no flux-correction
+scatter) — leaves are authoritative and restriction re-syncs covered
+cells, the standard relaxation.  Regrid migration rides the
+hierarchy's logged migration maps exactly like the MHD face field.
 """
 
 from __future__ import annotations
@@ -91,24 +97,27 @@ class RtAmrCoupled:
 
     def __init__(self, sim, params, un):
         spec = RtSpec.from_params(params)
-        if spec.full3:
-            raise NotImplementedError(
-                "AMR RT is gray 1-group (multigroup/He runs in the "
-                "uniform driver)")
         self.spec = spec
         self.un = un
         self.params = params
         nd = sim.cfg.ndim
         self.nd = nd
-        # rad rows: [ncell_pad, 1+nd] = (N [1/cm^3], F [1/cm^2/s])
+        # multigroup/He surface: ng group-major (N, F) blocks per row,
+        # He ion fractions ride a companion [ncp, 2] array
+        self.full3 = spec.full3
+        self.ng = len(spec.groups3) if self.full3 else 1
+        self.x_frac = (1.0 - spec.y_he if spec.y_he > 0 else X_frac)
+        # rad rows: [ncell_pad, ng*(1+nd)] = per group (N [1/cm^3],
+        # F [1/cm^2/s])
         self.rad: Dict[int, jnp.ndarray] = {}
         self.xion: Dict[int, jnp.ndarray] = {}
+        self.xhe: Dict[int, jnp.ndarray] = {}
         for l in sim.levels():
             ncp = sim.maps[l].ncell_pad
-            rad = np.full((ncp, 1 + nd), m1.SMALL_NP)
-            rad[:, 1:] = 0.0
-            self.rad[l] = jnp.asarray(rad)
-            self.xion[l] = jnp.full((ncp,), 1.2e-3)
+            self.rad[l] = jnp.asarray(self._fresh_rad(ncp))
+            self.xion[l] = self._fresh_x(ncp)
+            if self.full3:
+                self.xhe[l] = self._fresh_he(ncp)
         # point source → NGP cell at its finest covering level
         self.src: Dict[int, jnp.ndarray] = {}
         r = params.rt
@@ -125,7 +134,40 @@ class RtAmrCoupled:
         else:
             self._src_info = None
 
+    def _fresh_rad(self, ncp: int) -> np.ndarray:
+        """Vacuum radiation rows [ncp, ng*(1+nd)]."""
+        rad = np.zeros((ncp, self.ng * (1 + self.nd)))
+        rad[:, ::1 + self.nd] = m1.SMALL_NP          # N columns
+        return rad
+
+    @staticmethod
+    def _fresh_x(ncp: int) -> jnp.ndarray:
+        """Initial HII fraction rows (the reference's x_ini)."""
+        return jnp.full((ncp,), 1.2e-3)
+
+    @staticmethod
+    def _fresh_he(ncp: int) -> jnp.ndarray:
+        """Initial (HeII, HeIII) fraction rows."""
+        return jnp.asarray(np.tile([1e-6, 1e-8], (ncp, 1)))
+
+    def _ncol(self, g: int) -> int:
+        """Column of group ``g``'s photon density N."""
+        return g * (1 + self.nd)
+
     # ------------------------------------------------------------------
+    def _mu(self, l):
+        """Mean molecular weight rows from the current ion state
+        (``rt_cooling_module``'s getMu with mass fractions X/Y)."""
+        x = self.xion[l]
+        y = self.spec.y_he
+        if self.full3 and y > 0:
+            xh2, xh3 = self.xhe[l][:, 0], self.xhe[l][:, 1]
+            denom = (1.0 - y) * (1.0 + x) + 0.25 * y * (1.0 + xh2
+                                                        + 2.0 * xh3)
+        else:
+            denom = 1.0 + x
+        return 1.0 / jnp.maximum(denom, 1e-10)
+
     def _gas_nT(self, sim, l):
         """(nH [1/cc], T [K]) rows of level ``l`` from the gas state."""
         cfg = sim.cfg
@@ -134,9 +176,8 @@ class RtAmrCoupled:
         mom2 = sum(u[:, 1 + d] ** 2 for d in range(cfg.ndim))
         eint = jnp.maximum(u[:, cfg.ndim + 1] - 0.5 * mom2 / rho, 1e-300)
         t2 = (cfg.gamma - 1.0) * eint / rho * self.un.scale_T2
-        mu = 1.0 / (1.0 + self.xion[l])
-        nH = rho * self.un.scale_d * X_frac / mH
-        return nH, jnp.maximum(t2 * mu, 0.1)
+        nH = rho * self.un.scale_d * self.x_frac / mH
+        return nH, jnp.maximum(t2 * self._mu(l), 0.1)
 
     def advance(self, sim, dt_code: float):
         """Subcycled RT over one coarse step against the live gas;
@@ -160,32 +201,45 @@ class RtAmrCoupled:
         T = {l: nT[l][1] for l in sim.levels()}
         T0 = dict(T)
 
+        ng = self.ng
+        ncols = ng * (1 + nd)
         for _ in range(nsub):
-            # sources
+            # sources (multigroup: split by the SED's photon shares)
             if self._src_info is not None:
                 lsrc, row, rate = self._src_info
-                self.rad[lsrc] = self.rad[lsrc].at[row, 0].add(
-                    dt_sub * rate)
-            # transport, coarse→fine
+                if self.full3:
+                    for g, grp in enumerate(spec.groups3):
+                        self.rad[lsrc] = self.rad[lsrc].at[
+                            row, self._ncol(g)].add(
+                                dt_sub * rate * grp.frac)
+                else:
+                    self.rad[lsrc] = self.rad[lsrc].at[row, 0].add(
+                        dt_sub * rate)
+            # transport, coarse→fine (every group; one gather moves
+            # all group blocks, the GLF update runs per group)
             for l in sim.levels():
                 m = sim.maps[l]
                 d = sim.dev[l]
                 dx_cgs = sim.dx(l) * self.un.scale_l
                 rad = self.rad[l]
-                shim = _CfgShim(nd, 1 + nd)
+                shim = _CfgShim(nd, ncols)
                 if m.complete:
                     nb = 1 << l
                     dense = rad[d["inv_perm"]]
-                    N = dense[:, 0].reshape((nb,) * nd)
-                    F = jnp.stack([dense[:, 1 + c].reshape((nb,) * nd)
-                                   for c in range(nd)])
-                    N, F = m1.transport_step(
-                        N, F, dt_sub, dx_cgs, spec.c_red, nd,
-                        periodic=spec.periodic)
-                    rows = jnp.concatenate(
-                        [N.reshape(-1, 1)]
-                        + [F[c].reshape(-1, 1) for c in range(nd)],
-                        axis=1)[d["perm"]]
+                    cols = []
+                    for g in range(ng):
+                        c0 = self._ncol(g)
+                        N = dense[:, c0].reshape((nb,) * nd)
+                        F = jnp.stack(
+                            [dense[:, c0 + 1 + c].reshape((nb,) * nd)
+                             for c in range(nd)])
+                        N, F = m1.transport_step(
+                            N, F, dt_sub, dx_cgs, spec.c_red, nd,
+                            periodic=spec.periodic)
+                        cols.append(N.reshape(-1, 1))
+                        cols.extend(F[c].reshape(-1, 1)
+                                    for c in range(nd))
+                    rows = jnp.concatenate(cols, axis=1)[d["perm"]]
                     ncell = m.noct * (1 << nd)
                     if m.ncell_pad > ncell:
                         rad = rad.at[:ncell].set(rows)
@@ -199,27 +253,45 @@ class RtAmrCoupled:
                         itype=1)
                     blk = K._gather_uloc(rad, ghosts, d["stencil_src"],
                                          None, shim)
-                    blk = _glf_block(blk, dt_sub, dx_cgs, spec.c_red,
-                                     nd)
+                    blk = jnp.concatenate(
+                        [_glf_block(blk[self._ncol(g):self._ncol(g + 1)],
+                                    dt_sub, dx_cgs, spec.c_red, nd)
+                         for g in range(ng)], axis=0)
                     interior = (slice(None),) + tuple(
                         slice(2, 4) for _ in range(nd))
                     noct = blk.shape[-1]
                     # oct-major flat rows, like level_sweep's du
-                    # extraction (amr/kernels.py): [noct*2^d, 1+nd]
+                    # extraction (amr/kernels.py): [noct*2^d, ncols]
                     upd = jnp.transpose(
                         blk[interior],
                         (nd + 1,) + tuple(range(1, nd + 1)) + (0,)
-                    ).reshape(noct * 2 ** nd, 1 + nd)
+                    ).reshape(noct * 2 ** nd, ncols)
                     rad = rad.at[:noct * 2 ** nd].set(upd)
                 self.rad[l] = rad
             # chemistry per level (pointwise; leaves authoritative)
             for l in sim.levels():
                 nH, _T = nT[l]
-                N, x, Tn = chem_mod.chem_step(
-                    self.rad[l][:, 0], self.xion[l], T[l], nH,
-                    dt_sub, spec.c_red, spec.group, spec.otsa,
-                    heating=spec.heating)
-                self.rad[l] = self.rad[l].at[:, 0].set(N)
+                if self.full3:
+                    nHe = nH * (spec.y_he
+                                / (4.0 * max(1.0 - spec.y_he, 1e-10)))
+                    Ns = [self.rad[l][:, self._ncol(g)]
+                          for g in range(ng)]
+                    Ns, (x, xh2, xh3), Tn = chem_mod.chem_step_3ion(
+                        Ns, (self.xion[l], self.xhe[l][:, 0],
+                             self.xhe[l][:, 1]), T[l], nH, nHe,
+                        dt_sub, spec.c_red, spec.groups3, spec.otsa,
+                        heating=spec.heating)
+                    rad = self.rad[l]
+                    for g in range(ng):
+                        rad = rad.at[:, self._ncol(g)].set(Ns[g])
+                    self.rad[l] = rad
+                    self.xhe[l] = jnp.stack([xh2, xh3], axis=1)
+                else:
+                    N, x, Tn = chem_mod.chem_step(
+                        self.rad[l][:, 0], self.xion[l], T[l], nH,
+                        dt_sub, spec.c_red, spec.group, spec.otsa,
+                        heating=spec.heating)
+                    self.rad[l] = self.rad[l].at[:, 0].set(N)
                 self.xion[l] = x
                 T[l] = Tn
             # restriction fine→coarse
@@ -228,11 +300,16 @@ class RtAmrCoupled:
                     d = sim.dev[l]
                     self.rad[l] = K.restrict_upload(
                         self.rad[l], self.rad[l + 1], d["ref_cell"],
-                        d["son_oct"], _CfgShim(nd, 1 + nd))
+                        d["son_oct"], _CfgShim(nd, ncols))
                     self.xion[l] = K.restrict_upload(
                         self.xion[l][:, None], self.xion[l + 1][:, None],
                         d["ref_cell"], d["son_oct"],
                         _CfgShim(nd, 1))[:, 0]
+                    if self.full3:
+                        self.xhe[l] = K.restrict_upload(
+                            self.xhe[l], self.xhe[l + 1],
+                            d["ref_cell"], d["son_oct"],
+                            _CfgShim(nd, 2))
 
         if spec.heating:
             # write the integrated ΔT back into the gas energy
@@ -240,8 +317,7 @@ class RtAmrCoupled:
                 cfg = sim.cfg
                 u = sim.u[l]
                 rho = jnp.maximum(u[:, 0], cfg.smallr)
-                mu = 1.0 / (1.0 + self.xion[l])
-                dT2 = (T[l] - T0[l]) / mu
+                dT2 = (T[l] - T0[l]) / self._mu(l)
                 de = rho * dT2 / self.un.scale_T2 / (cfg.gamma - 1.0)
                 sim.u[l] = u.at[:, cfg.ndim + 1].add(
                     de.astype(u.dtype))
@@ -254,36 +330,48 @@ class RtAmrCoupled:
         from ramses_tpu.amr.hierarchy import _migrate_level
 
         nd = self.nd
+        ncols = self.ng * (1 + nd)
         new_rad: Dict[int, jnp.ndarray] = {}
         new_x: Dict[int, jnp.ndarray] = {}
+        new_he: Dict[int, jnp.ndarray] = {}
         for l in sim.levels():
             ncp = sim.maps[l].ncell_pad
             if l not in sim._mig_log:
                 if l in self.rad and self.rad[l].shape[0] == ncp:
                     new_rad[l] = self.rad[l]
                     new_x[l] = self.xion[l]
+                    if self.full3:
+                        new_he[l] = self.xhe[l]
                 else:                          # fresh level
-                    rad = np.full((ncp, 1 + nd), m1.SMALL_NP)
-                    rad[:, 1:] = 0.0
-                    new_rad[l] = jnp.asarray(rad)
-                    new_x[l] = jnp.full((ncp,), 1.2e-3)
+                    new_rad[l] = jnp.asarray(self._fresh_rad(ncp))
+                    new_x[l] = self._fresh_x(ncp)
+                    if self.full3:
+                        new_he[l] = self._fresh_he(ncp)
                 continue
             (rows_d, rows_s, cell_rep, sgn_dev, rows_new, ncell_pad,
              _new_octs, _f_cell, nb_rep) = sim._mig_log[l]
             old_rad = self.rad.get(
-                l, jnp.full((1, 1 + nd), m1.SMALL_NP))
-            old_x = self.xion.get(l, jnp.full((1,), 1.2e-3))
+                l, jnp.asarray(self._fresh_rad(1)))
+            old_x = self.xion.get(l, self._fresh_x(1))
             new_rad[l] = _migrate_level(
                 old_rad, new_rad[l - 1] if l - 1 in new_rad
                 else self.rad[l - 1], rows_d, rows_s, cell_rep, nb_rep,
-                sgn_dev, rows_new, ncell_pad, _CfgShim(nd, 1 + nd), 1)
+                sgn_dev, rows_new, ncell_pad, _CfgShim(nd, ncols), 1)
             new_x[l] = _migrate_level(
                 old_x[:, None], (new_x[l - 1] if l - 1 in new_x
                                  else self.xion[l - 1])[:, None],
                 rows_d, rows_s, cell_rep, nb_rep, sgn_dev, rows_new,
                 ncell_pad, _CfgShim(nd, 1), 1)[:, 0]
+            if self.full3:
+                old_he = self.xhe.get(l, self._fresh_he(1))
+                new_he[l] = _migrate_level(
+                    old_he, new_he[l - 1] if l - 1 in new_he
+                    else self.xhe[l - 1], rows_d, rows_s, cell_rep,
+                    nb_rep, sgn_dev, rows_new, ncell_pad,
+                    _CfgShim(nd, 2), 1)
         self.rad = new_rad
         self.xion = new_x
+        self.xhe = new_he
         # the source cell may have moved levels/rows
         if self._src_info is not None:
             from ramses_tpu.pm.amr_pm import assign_levels

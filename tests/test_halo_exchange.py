@@ -1,0 +1,80 @@
+"""Explicit shard_map+ppermute halo backend vs the global-view path.
+
+The reference's ``make_virtual_fine`` halo exchange (``amr/
+virtual_boundaries.f90:373-533``) has two TPU formulations here: the
+GSPMD global-view array (compiler-inserted collectives) and the
+explicit slab pipeline (``parallel/halo.py``).  Both must produce the
+SAME trajectory as the single-device stepper — the decomposition-
+invariance requirement (SURVEY.md §2.12 P2, ``tests/run_test_suite.sh``
+multi-rank aggregate trick).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from ramses_tpu.config import params_from_string
+from ramses_tpu.driver import Simulation
+from ramses_tpu.grid.uniform import run_steps
+from ramses_tpu.parallel.halo import make_halo_mesh, run_steps_halo
+
+
+def _params(lvl, ndim):
+    txt = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "/",
+        "&AMR_PARAMS", f"levelmin={lvl}", f"levelmax={lvl}",
+        "boxlen=1.0", "/",
+        "&INIT_PARAMS", "nregion=2",
+        "region_type(1)='square'", "region_type(2)='square'",
+        "x_center=0.5,0.5", "y_center=0.5,0.5", "z_center=0.5,0.5",
+        "length_x=10.0,0.12", "length_y=10.0,0.12",
+        "length_z=10.0,0.12", "exp_region=10.0,2.0",
+        "d_region=1.0,4.0", "p_region=1e-2,1.0", "/",
+        "&HYDRO_PARAMS", "riemann='hllc'", "courant_factor=0.8", "/",
+    ])
+    return params_from_string(txt, ndim=ndim)
+
+
+@pytest.mark.skipif(jax.device_count() < 8,
+                    reason="needs the 8-device mesh")
+@pytest.mark.parametrize("ndim,lvl", [(2, 5), (3, 4)])
+def test_halo_backend_matches_single_device(ndim, lvl):
+    """8-device explicit-halo trajectory == single-device trajectory,
+    bitwise (f64: the slab exchange is pure data movement and the CFL
+    pmin is an exact reduction)."""
+    sim = Simulation(_params(lvl, ndim), dtype=jnp.float64)
+    u0 = sim.state.u
+    t0 = jnp.asarray(0.0, jnp.float64)
+    tend = jnp.asarray(1e9, jnp.float64)
+    nsteps = 6
+
+    u_ref, t_ref, n_ref = run_steps(sim.grid, u0, t0, tend, nsteps)
+
+    mesh = make_halo_mesh()
+    assert mesh.shape["hx"] == 8          # conftest's virtual mesh
+    u_h, t_h, n_h = run_steps_halo(sim.grid, mesh, u0, t0, tend, nsteps)
+
+    assert int(n_h) == int(n_ref) == nsteps
+    assert float(t_h) == float(t_ref)
+    np.testing.assert_array_equal(np.asarray(u_h), np.asarray(u_ref))
+
+
+def test_halo_backend_rejects_unsupported():
+    p = _params(4, 2)
+    p.boundary.nboundary = 2
+    p.boundary.bound_type = [2, 2]
+    p.boundary.ibound_min = [-1, 1]
+    p.boundary.ibound_max = [-1, 1]
+    p.boundary.jbound_min = [0, 0]
+    p.boundary.jbound_max = [0, 0]
+    p.boundary.d_bound = [0.0, 0.0]
+    p.boundary.u_bound = [0.0, 0.0]
+    p.boundary.v_bound = [0.0, 0.0]
+    p.boundary.w_bound = [0.0, 0.0]
+    p.boundary.p_bound = [0.0, 0.0]
+    sim = Simulation(p, dtype=jnp.float64)
+    mesh = make_halo_mesh()
+    with pytest.raises(NotImplementedError):
+        run_steps_halo(sim.grid, mesh, sim.state.u, 0.0, 1.0, 2)

@@ -1,5 +1,6 @@
 """RT on the AMR hierarchy (``rt/amr.py`` — the per-level subcycled
-``rt_step`` of ``amr/amr_step.f90:594-672``, gray 1-group)."""
+``rt_step`` of ``amr/amr_step.f90:594-672``; gray 1-group and the
+multigroup 3-ion H/He/He+ ladder)."""
 
 import numpy as np
 import pytest
@@ -94,8 +95,62 @@ def test_rt_amr_refined_front_and_heating():
         assert np.isfinite(rad).all() and (rad[:, 0] >= 0).all()
 
 
-def test_rt_amr_rejects_multigroup():
-    g = _rt_groups(4, 4)
+def test_rt_amr_multigroup_he_matches_uniform():
+    """rt_ngroups=3 + helium on a levelmin==levelmax hierarchy tracks
+    the uniform driver's 3-ion ladder (same SED-averaged groups, same
+    chemistry; ``rt/rt_spectra.f90`` + ``rt_cooling_module.f90``)."""
+    from ramses_tpu.driver import Simulation
+
+    tend = 0.004
+    g = _rt_groups(4, 4, tend=tend)
     g["rt_params"]["rt_ngroups"] = 3
-    with pytest.raises(NotImplementedError):
-        AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    g["rt_params"]["rt_y_he"] = 0.25
+    g["rt_params"]["rt_t_star"] = 1e5
+    asim = AmrSim(params_from_dict({k: dict(v) for k, v in g.items()},
+                                   ndim=3), dtype=jnp.float64)
+    assert asim.rt_amr.full3 and asim.rt_amr.ng == 3
+    asim.evolve(tend, nstepmax=3)
+    v_amr = asim.rt_amr.ionized_volume(asim)
+
+    usim = Simulation(params_from_dict(
+        {k: dict(v) for k, v in g.items()}, ndim=3), dtype=jnp.float64)
+    usim.evolve()
+    x_uni = np.asarray(usim.rt.sim.x)
+    v_uni = float(x_uni.sum()) * usim.dx ** 3
+    assert v_amr > 0.05 and v_uni > 0.05
+    assert abs(v_amr - v_uni) < 0.35 * max(v_amr, v_uni), (v_amr, v_uni)
+    # the hard photons ionize helium too: He fractions moved off their
+    # initial values and stay physical
+    l = asim.lmin
+    xhe = np.asarray(asim.rt_amr.xhe[l])
+    assert np.isfinite(xhe).all()
+    assert float(xhe[:, 0].max()) > 1e-3            # HeII formed
+    assert (xhe >= 0).all() and (xhe.sum(axis=1) <= 1.0 + 1e-6).all()
+
+
+def test_rt_amr_multigroup_refined_front():
+    """The multigroup/He system on a refined hierarchy: the I-front
+    sweeps outward on the fine level and every group's radiation state
+    survives regrid migration."""
+    refine = {"r_refine": [0.15] * 8, "x_refine": [0.5] * 8,
+              "y_refine": [0.5] * 8, "z_refine": [0.5] * 8}
+    g = _rt_groups(4, 5, heating=True, refine=refine, tend=0.001)
+    g["init_params"]["d_region"] = [10.0]
+    g["rt_params"]["rt_ndot"] = 1e44
+    g["rt_params"]["rt_ngroups"] = 2
+    g["rt_params"]["rt_y_he"] = 0.25
+    g["rt_params"]["rt_t_star"] = 1e5
+    sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+    assert sim.tree.noct(5) > 0 and sim.rt_amr.ng == 2
+    v0 = sim.rt_amr.ionized_volume(sim)
+    e0 = sim.totals()[4]
+    sim.evolve(0.001, nstepmax=2)
+    assert sim.rt_amr.ionized_volume(sim) > 1.5 * v0
+    assert sim.totals()[4] > e0                    # photoheated
+    for l in sim.levels():
+        rad = np.asarray(sim.rt_amr.rad[l])
+        assert rad.shape[1] == 2 * 4               # 2 groups x (N, F)
+        assert np.isfinite(rad).all()
+        assert (rad[:, ::4] >= 0).all()            # every group's N
+        xhe = np.asarray(sim.rt_amr.xhe[l])
+        assert np.isfinite(xhe).all() and (xhe >= 0).all()
