@@ -47,6 +47,13 @@ def main(argv=None) -> int:
     dtype = getattr(jnp, args.dtype)
     params = load_params(args.namelist, ndim=args.ndim)
 
+    if params.run.debug_nan:
+        # jit-level NaN trap (SURVEY.md §5.2): every compiled program
+        # re-checks outputs and raises AT the producing op — the
+        # runtime analogue of the reference's FPE-trapping debug build
+        import jax
+        jax.config.update("jax_debug_nans", True)
+
     if args.patch:
         from ramses_tpu import patch
         patch.install(args.patch, verbose=True)
@@ -69,8 +76,10 @@ def main(argv=None) -> int:
             sim = RhdAmrSim(params, dtype=dtype)
             tend = (params.output.tout[-1] if params.output.tout
                     else params.output.tend)
-            sim.evolve(tend, nstepmax=params.run.nstepmax,
-                       verbose=args.verbose)
+            guard = make_guard(sim)
+            guard.run_guarded(lambda: sim.evolve(
+                tend, nstepmax=params.run.nstepmax,
+                verbose=args.verbose, guard=guard))
             print(f"rhd-amr t={sim.t:.5e} nstep={sim.nstep} "
                   f"lor_max={sim.max_lorentz():.3f} "
                   f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
@@ -79,15 +88,20 @@ def main(argv=None) -> int:
         else:
             from ramses_tpu.rhd.driver import RhdSimulation
             sim = RhdSimulation(params, dtype=dtype)
-            sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose)
+            guard = make_guard(sim)
+            guard.run_guarded(lambda: sim.evolve(
+                nstepmax=params.run.nstepmax, verbose=args.verbose,
+                guard=guard))
     elif solver == "mhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
             from ramses_tpu.mhd.amr import MhdAmrSim
             sim = MhdAmrSim(params, dtype=dtype)
             tend = (params.output.tout[-1] if params.output.tout
                     else params.output.tend)
-            sim.evolve(tend, nstepmax=params.run.nstepmax,
-                       verbose=args.verbose)
+            guard = make_guard(sim)
+            guard.run_guarded(lambda: sim.evolve(
+                tend, nstepmax=params.run.nstepmax,
+                verbose=args.verbose, guard=guard))
             print(f"mhd-amr t={sim.t:.5e} nstep={sim.nstep} "
                   f"max|divB|/max|B|*dx={sim.max_divb():.3e}")
             sim.dump(1, params.output.output_dir,
@@ -95,8 +109,10 @@ def main(argv=None) -> int:
         else:
             from ramses_tpu.mhd.driver import MhdSimulation
             sim = MhdSimulation(params, dtype=dtype)
-            sim.evolve(nstepmax=params.run.nstepmax, verbose=args.verbose,
-                       guard=make_guard(sim))
+            guard = make_guard(sim)
+            guard.run_guarded(lambda: sim.evolve(
+                nstepmax=params.run.nstepmax, verbose=args.verbose,
+                guard=guard))
             sim.dump(1, params.output.output_dir,
                      namelist_path=args.namelist)
     elif args.amr or params.amr.levelmax > params.amr.levelmin:
@@ -121,8 +137,10 @@ def main(argv=None) -> int:
         else:
             tend = (params.output.tout[-1] if params.output.tout
                     else params.output.tend)
-        sim.evolve(tend, nstepmax=params.run.nstepmax,
-                   verbose=args.verbose, guard=make_guard(sim))
+        guard = make_guard(sim)
+        guard.run_guarded(lambda: sim.evolve(
+            tend, nstepmax=params.run.nstepmax, verbose=args.verbose,
+            guard=guard))
         if sim.cosmo is not None:
             print(f"cosmo-amr aexp={sim.aexp_now():.4f} nstep={sim.nstep} "
                   f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
@@ -132,7 +150,9 @@ def main(argv=None) -> int:
         sim = Simulation(params, dtype=dtype)
         sim.on_output = lambda s, i: s.dump(
             i, namelist_path=args.namelist)
-        sim.evolve(verbose=args.verbose, guard=make_guard(sim))
+        guard = make_guard(sim)
+        guard.run_guarded(lambda: sim.evolve(verbose=args.verbose,
+                                             guard=guard))
     return 0
 
 

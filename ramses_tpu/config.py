@@ -44,6 +44,11 @@ class RunParams:
     # runtime plug-in overlay (ramses_tpu/patch.py) — the namelist
     # equivalent of the reference's compile-time PATCH= VPATH shadowing
     patch: str = ""
+    # NaN-trap sanitizer (SURVEY.md §5.2 — the runtime analogue of the
+    # reference's FPE-trapping debug builds): jax_debug_nans at jit
+    # level plus per-step finite checks in the ops guard, which dumps a
+    # crash snapshot and stops the run on the first non-finite state
+    debug_nan: bool = False
 
 
 @dataclass

@@ -10,9 +10,22 @@ regrid pass already does.  Stencil gathers that cross shard boundaries
 become compiler-inserted collectives (P2/P3); CFL min-reduction is a
 ``jnp.min`` → ``AllReduce`` (P7).
 
+Why no cost weights (P4): the reference decomposes SPACE once — one
+Hilbert interval per rank spanning all levels — so a rank owning more
+fine octs does 2^(l-lmin)× more substep work, and ``load_balance``
+must weight the cuts by measured cost (``amr/load_balance.f90:285``).
+Here every LEVEL is row-sharded independently: each device holds
+exactly 1/ndev of each level's octs and therefore does 1/ndev of the
+work of every substep, whatever the refinement distribution.  Static
+equal splits achieve what the reference needs dynamic cost feedback
+for; the only residual imbalance is the <ndev remainder rows per
+level, which the mesh-aligned bucket padding absorbs.
+
 This is the correctness-first global-view formulation; the shard_map +
-``ppermute`` halo pipeline with precomputed per-shard halo maps is the
-known next optimization when profiles show the gather collectives
+``ppermute`` slab pipeline exists for the uniform path
+(:mod:`ramses_tpu.parallel.halo`) as the explicit-schedule backend;
+precomputed per-shard halo maps for the AMR batches are the known
+next optimization when profiles show the gather collectives
 dominating.
 """
 

@@ -83,13 +83,16 @@ class RhdSimulation:
         self.nstep = 0
 
     def evolve(self, tend: Optional[float] = None, chunk: int = 16,
-               nstepmax: int = 10 ** 9, verbose: bool = False):
+               nstepmax: int = 10 ** 9, verbose: bool = False,
+               guard=None):
         p = self.params
         tend = tend if tend is not None else (
             p.output.tout[-1] if p.output.tout else p.output.tend)
         tdtype = (jnp.float64 if jax.config.jax_enable_x64
                   else jnp.float32)
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
+            if guard is not None and not guard.check():
+                break
             n = min(chunk, nstepmax - self.nstep)
             u, t, ndone = ru.run_steps(
                 self.grid, self.u, jnp.asarray(self.t, tdtype),

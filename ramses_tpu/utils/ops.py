@@ -57,11 +57,19 @@ class OpsGuard:
     def __init__(self, sim, base_dir: str = ".",
                  walltime_s: Optional[float] = None,
                  stop_file: str = "stop_run",
-                 install_signals: bool = True):
+                 install_signals: bool = True,
+                 nan_check: Optional[bool] = None):
         self.sim = sim
         self.base_dir = base_dir
         self.walltime_s = walltime_s
         self.stop_file = stop_file
+        # NaN trap (&RUN_PARAMS debug_nan; SURVEY.md §5.2): cheap dt
+        # check every step, full-state audit at the conservation cadence
+        if nan_check is None:
+            nan_check = bool(getattr(
+                getattr(getattr(sim, "params", None), "run", None),
+                "debug_nan", False))
+        self.nan_check = nan_check
         self.t0 = time.perf_counter()
         self._dump_requested = False
         self._stop_requested = False
@@ -69,6 +77,7 @@ class OpsGuard:
         self._max_rss = 0.0
         self._step_wall = self.t0
         self._nblock = 0
+        self._ncheck = 0
         # conservation audit cadence: totals() downloads the whole
         # device state, so amortize it over screen blocks
         self.cons_every = 10
@@ -100,8 +109,28 @@ class OpsGuard:
             return None
 
     # -- per-step hook --------------------------------------------------
+    def _nan_trapped(self) -> bool:
+        """True when the state went non-finite: cheap dt probe every
+        step, full leaf audit (a whole-device download) amortized to
+        every ``cons_every``-th check."""
+        dt = float(getattr(self.sim, "dt_old", 0.0))
+        if not np.isfinite(dt):
+            return True
+        self._ncheck += 1
+        if self._ncheck % max(self.cons_every, 1) == 0 \
+                and hasattr(self.sim, "totals"):
+            return not np.isfinite(np.asarray(
+                self.sim.totals())).all()
+        return False
+
     def check(self) -> bool:
         self._max_rss = max(self._max_rss, rss_mb())
+        if self.nan_check and self._nan_trapped():
+            out = self._dump()
+            print("ops: NaN TRAP: non-finite state detected "
+                  f"(step {getattr(self.sim, 'nstep', '?')}); crash "
+                  f"snapshot -> {out}")
+            return False
         if self._dump_requested:
             self._dump_requested = False
             out = self._dump()
@@ -126,6 +155,20 @@ class OpsGuard:
         return True
 
     # -- screen block ---------------------------------------------------
+    def run_guarded(self, evolve):
+        """Run ``evolve()`` under the jit-level NaN trap: with
+        ``jax_debug_nans`` on, a NaN raises FloatingPointError from
+        INSIDE the compiled step — before any per-step :meth:`check` —
+        so catch it here, write the promised crash snapshot, and
+        re-raise with the producing-op traceback intact."""
+        try:
+            evolve()
+        except FloatingPointError:
+            out = self._dump()
+            print(f"ops: NaN TRAP (jit raise): crash snapshot -> {out}")
+            raise
+
+
     def screen_block(self, extra: str = "") -> str:
         """The reference's per-ncontrol control line
         (``adaptive_loop.f90:199-214`` + memory census)."""

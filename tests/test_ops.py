@@ -67,3 +67,40 @@ def test_screen_block_and_memory():
     assert "Main step=" in line and "mem=" in line and "octs=" in line
     assert rss_mb() > 10.0                 # a real python process
     assert device_mb() > 0.0               # live device arrays exist
+
+
+def test_nan_trap_dumps_and_stops(tmp_path):
+    """debug_nan=.true. (SURVEY.md §5.2 NaN-trap sanitizer): the guard
+    dumps a crash snapshot and stops the run at the first non-finite
+    state instead of marching NaNs to tend."""
+    sim = _sim()
+    guard = OpsGuard(sim, str(tmp_path), install_signals=False,
+                     nan_check=True)
+    assert guard.check()                   # healthy state passes
+    sim.dt_old = float("nan")              # poisoned step
+    assert not guard.check()
+    assert any(d.startswith("output_") for d in os.listdir(tmp_path))
+
+
+def test_nan_trap_from_namelist():
+    p = load_params(NML, ndim=3)
+    p.amr.levelmin = p.amr.levelmax = 4
+    p.run.debug_nan = True
+    sim = AmrSim(p, dtype=jnp.float64)
+    guard = OpsGuard(sim, install_signals=False)
+    assert guard.nan_check                 # picked up from &RUN_PARAMS
+
+
+def test_nan_trap_jit_raise_path(tmp_path):
+    """jax_debug_nans raises FloatingPointError from INSIDE the step;
+    run_guarded must still write the crash snapshot, then re-raise."""
+    sim = _sim()
+    guard = OpsGuard(sim, str(tmp_path), install_signals=False,
+                     nan_check=True)
+
+    def boom():
+        raise FloatingPointError("nan in jitted step")
+
+    with pytest.raises(FloatingPointError):
+        guard.run_guarded(boom)
+    assert any(d.startswith("output_") for d in os.listdir(tmp_path))
