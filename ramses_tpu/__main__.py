@@ -92,6 +92,8 @@ def main(argv=None) -> int:
             guard.run_guarded(lambda: sim.evolve(
                 nstepmax=params.run.nstepmax, verbose=args.verbose,
                 guard=guard))
+            sim.dump(1, params.output.output_dir,
+                     namelist_path=args.namelist)
     elif solver == "mhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
             from ramses_tpu.mhd.amr import MhdAmrSim

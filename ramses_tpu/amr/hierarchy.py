@@ -1145,7 +1145,11 @@ class AmrSim:
         if self.sf_spec.enabled:
             with self.timers.section("star formation"):
                 ap.star_formation_amr(self, dt)
-                ap.thermal_feedback_amr(self)
+                # f_w > 0 selects the mass-loaded kinetic wind scheme
+                if self.sf_spec.f_w > 0:
+                    ap.kinetic_feedback_amr(self)
+                else:
+                    ap.thermal_feedback_amr(self)
         if self.sinks is not None:
             with self.timers.section("sinks"):
                 ap.sink_passes_amr(self, dt)

@@ -99,6 +99,12 @@ class Simulation:
         self.pspec = PMSpec.from_params(params)
         self.cosmo = (Cosmology.from_params(params) if params.run.cosmo
                       else None)
+        # SF/sink specs early: the particle-lane budget below needs to
+        # know whether the run keeps creating particles
+        from ramses_tpu.pm.sinks import SinkSet, SinkSpec
+        from ramses_tpu.pm.star_formation import SfSpec
+        self.sf_spec = SfSpec.from_params(params)
+        self.sink_spec = SinkSpec.from_params(params)
         # cosmological IC files (grafic/gadget): particles + baryons
         # (init_part.f90 / init_flow_fine.f90 'file' branches)
         u0 = None
@@ -111,10 +117,16 @@ class Simulation:
             u0 = condinit(shape, self.dx, params, self.cfg)
         self.state = SimState(u=jnp.asarray(u0, dtype=dtype))
         if self.pspec.enabled:
+            from ramses_tpu.pm.particles import lane_headroom
+            # pic without IC particles: an empty set whose lane budget
+            # must leave room for SF/sink creation (a 1-lane set would
+            # silently drop every new star)
+            grows = self.sf_spec.enabled or self.sink_spec.enabled
             self.state.p = particles if particles is not None else \
                 ParticleSet.make(jnp.zeros((0, params.ndim)),
                                  jnp.zeros((0, params.ndim)),
-                                 jnp.zeros((0,)), nmax=1)
+                                 jnp.zeros((0,)),
+                                 nmax=lane_headroom(params, grows) or 1)
         self.gspec = GravitySpec.from_params(params)
         box_periodic = all(f.kind == bmod.PERIODIC
                            for pair in self.bc.faces for f in pair)
@@ -178,14 +190,10 @@ class Simulation:
                 warnings.warn("cooling is wired into the pure-hydro path "
                               "only for now; gravity/PM runs ignore it")
         # star formation / feedback / sinks (coarse-step cadence passes)
-        from ramses_tpu.pm.sinks import SinkSet, SinkSpec
-        from ramses_tpu.pm.star_formation import SfSpec
         from ramses_tpu.units import units as units_fn
         self.units = units_fn(params, cosmo=self.cosmo,
                               aexp=(self.cosmo.aexp_ini if self.cosmo
                                     else 1.0))
-        self.sf_spec = SfSpec.from_params(params)
-        self.sink_spec = SinkSpec.from_params(params)
         self.sinks = (SinkSet.empty(params.ndim)
                       if self.sink_spec.enabled else None)
         self._sf_rng = np.random.default_rng(1234)
@@ -329,14 +337,19 @@ class Simulation:
             st.u = apply_forcing(st.u, acc, dt_chunk,
                                  self.turb_spec.turb_min_rho)
         if self.sf_spec.enabled:
-            from ramses_tpu.pm.star_formation import (star_formation,
+            from ramses_tpu.pm.star_formation import (kinetic_feedback,
+                                                      star_formation,
                                                       thermal_feedback)
             u_np = np.asarray(st.u, dtype=np.float64)
             u_np, p2, self._next_star_id = star_formation(
                 u_np, st.p, self._sf_rng, self.sf_spec, self.units,
                 self.dx, st.t, dt_chunk, self._next_star_id)
-            u_np, p2 = thermal_feedback(u_np, p2, self.sf_spec,
-                                        self.units, self.dx, st.t)
+            # f_w > 0 selects the mass-loaded kinetic wind scheme
+            # (feedback.f90's f_w branch); otherwise thermal dumps
+            fb = (kinetic_feedback if self.sf_spec.f_w > 0
+                  else thermal_feedback)
+            u_np, p2 = fb(u_np, p2, self.sf_spec, self.units, self.dx,
+                          st.t)
             st.u = jnp.asarray(u_np, st.u.dtype)
             st.p = p2
         if self.sinks is not None:
@@ -351,7 +364,9 @@ class Simulation:
                 dt_chunk, self.cfg.gamma)
             self.sinks = merge_sinks(self.sinks, self.sink_spec, self.dx)
             self.sinks = drift_kick(self.sinks, st.f, self.dx, dt_chunk,
-                                    self.params.amr.boxlen)
+                                    self.params.amr.boxlen,
+                                    spec=self.sink_spec,
+                                    units=self.units)
             st.u = jnp.asarray(u_np, st.u.dtype)
         from ramses_tpu import patch
         user_source = patch.hook("source")

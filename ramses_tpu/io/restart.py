@@ -106,16 +106,21 @@ def restore_particles(parts: dict, ndim: int, nmax: Optional[int] = None):
     return ps
 
 
-def restore_uniform(outdir: str, params, cfg) -> Tuple[np.ndarray, dict,
-                                                       Optional[dict]]:
-    """Dense [nvar, *sp] conservative state for a single-level run."""
+def restore_uniform(outdir: str, params, cfg,
+                    to_cons=None) -> Tuple[np.ndarray, dict,
+                                           Optional[dict]]:
+    """Dense [nvar, *sp] conservative state for a single-level run.
+
+    ``to_cons`` overrides the hydro output→conservative conversion for
+    other solver families (the SRHD pressure-Newton inverse)."""
     base = [params.amr.nx, params.amr.ny, params.amr.nz][:cfg.ndim]
     if any(b != 1 for b in base):
         raise NotImplementedError(
             "snapshot restore requires nx=ny=nz=1 (single coarse cell); "
             f"got {base}")
     lmin = params.amr.levelmin
-    tree_og, u_lv, meta, parts = restore_tree_state(outdir, cfg, lmin)
+    tree_og, u_lv, meta, parts = restore_tree_state(outdir, cfg, lmin,
+                                                    to_cons=to_cons)
     if lmin not in u_lv:
         raise ValueError(f"snapshot has no level {lmin} data")
     from ramses_tpu.amr.tree import cell_offsets

@@ -124,15 +124,12 @@ def thermal_feedback_amr(sim):
     spec: SfSpec = sim.sf_spec
     if spec.eta_sn <= 0:
         return
+    from ramses_tpu.pm.star_formation import sn_due_mask
+
     units: Units = sim.units
     nd = sim.cfg.ndim
     p = sim.p
-    age_code = sim.t - np.asarray(p.tp)
-    t_sne_code = spec.t_sne * 1e6 * yr2sec / units.scale_t
-    due = (np.asarray(p.active)
-           & (np.asarray(p.family) == FAM_STAR)
-           & (np.asarray(p.flags) & FLAG_SN_DONE == 0)
-           & (age_code > t_sne_code))
+    due = sn_due_mask(p, spec, units, sim.t)
     if not due.any():
         return
     x = np.asarray(p.x, dtype=np.float64)[due]
@@ -159,6 +156,97 @@ def thermal_feedback_amr(sim):
             np.add.at(u[:, 1 + d], r, me * vs[:, d] / vol)
         ek = 0.5 * me * (vs ** 2).sum(axis=1)
         np.add.at(u[:, 1 + nd], r, (ek + me * esn_code) / vol)
+        sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+
+    m_arr = np.array(p.m)
+    m_arr[due] = m_arr[due] - mej
+    flg = np.array(p.flags)
+    flg[due] |= FLAG_SN_DONE
+    sim.p = dreplace(p, m=jnp.asarray(m_arr), flags=jnp.asarray(flg))
+
+
+def kinetic_feedback_amr(sim):
+    """Delayed KINETIC SN winds on the hierarchy (the ``f_w``
+    mass-loaded momentum scheme of ``pm/feedback.f90``; see
+    :func:`ramses_tpu.pm.star_formation.kinetic_feedback` for the
+    bubble/energy split): the 3^ndim bubble lives on each star's
+    finest covering level; bubble cells the level doesn't cover fall
+    back to the host cell (their share arrives thermalized there by
+    the radial cancellation)."""
+    from ramses_tpu.pm.amr_pm import assign_levels
+    from ramses_tpu.pm.star_formation import sn_due_mask, wind_shell
+
+    spec: SfSpec = sim.sf_spec
+    if spec.eta_sn <= 0:
+        return
+    units: Units = sim.units
+    nd = sim.cfg.ndim
+    p = sim.p
+    due = sn_due_mask(p, spec, units, sim.t)
+    if not due.any():
+        return
+    x = np.asarray(p.x, dtype=np.float64)[due]
+    mej = spec.eta_sn * np.asarray(p.m)[due]
+    vstar = np.asarray(p.v)[due]
+    esn_code = (1e51 / (10.0 * M_SUN)) / units.scale_v ** 2
+    offs, rhat = wind_shell(nd)
+    nc = len(offs)
+    lv = assign_levels(sim.tree, x, sim.boxlen)
+    for l in sim.levels():
+        sel = lv == l
+        if not sel.any():
+            continue
+        dxl = sim.dx(l)
+        vol = dxl ** nd
+        rows0 = ngp_rows(sim.tree, x[sel], l, sim.boxlen, sim.bc_kinds)
+        ok = rows0 >= 0
+        if not ok.any():
+            continue
+        u = np.array(sim.u[l], dtype=np.float64)
+        r0 = rows0[ok]
+        me = mej[sel][ok]
+        vs = vstar[sel][ok]
+        xs = x[sel][ok]
+        # sweep from the host cell (capped at 25% of its gas); SNe
+        # sharing a host cell debit it ONCE for their combined draw
+        # (fancy-index *= is last-write-wins): group per unique cell
+        uniq, inv = np.unique(r0, return_inverse=True)
+        mcell_u = u[uniq, 0] * vol
+        tot_req = np.bincount(inv, weights=spec.f_w * me)
+        tot_allow = np.minimum(tot_req, 0.25 * mcell_u)
+        msw = spec.f_w * me * (tot_allow
+                               / np.maximum(tot_req, 1e-300))[inv]
+        mcell = mcell_u[inv]
+        vcell = u[uniq][inv][:, 1:1 + nd] \
+            / np.maximum(u[uniq][inv][:, :1], 1e-300)
+        e_removed = (msw / np.maximum(mcell, 1e-300)
+                     * u[uniq, 1 + nd][inv] * vol)
+        u[uniq] *= (1.0 - tot_allow
+                    / np.maximum(mcell_u, 1e-300))[:, None]
+        mload = me + msw
+        vw = np.sqrt(2.0 * esn_code * me / np.maximum(mload, 1e-300))
+        vbulk = (me[:, None] * vs + msw[:, None] * vcell) \
+            / np.maximum(mload[:, None], 1e-300)
+        e_inj = np.zeros(len(me))
+        for k in range(nc):
+            xt = xs + offs[k] * dxl
+            rt = ngp_rows(sim.tree, xt, l, sim.boxlen, sim.bc_kinds)
+            r = np.where(rt >= 0, rt, r0)
+            central = np.logical_or(bool((offs[k] == 0).all()), rt < 0)
+            mshare = mload / nc
+            vk = np.where(central[:, None], vbulk,
+                          vbulk + vw[:, None] * rhat[k])
+            np.add.at(u[:, 0], r, mshare / vol)
+            for d in range(nd):
+                np.add.at(u[:, 1 + d], r, mshare * vk[:, d] / vol)
+            ek = 0.5 * mshare * (vk ** 2).sum(axis=1)
+            np.add.at(u[:, 1 + nd], r, ek / vol)
+            e_inj += ek
+        # exact budget: the remainder (incl. the off-level fallback
+        # shares' suppressed kicks) lands as heat in the host cell
+        e_target = (e_removed + me * esn_code
+                    + 0.5 * me * (vs ** 2).sum(axis=1))
+        np.add.at(u[:, 1 + nd], r0, (e_target - e_inj) / vol)
         sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
 
     m_arr = np.array(p.m)
@@ -290,12 +378,17 @@ def sink_passes_amr(sim, dt: float):
             p_acc = vgas * dm[:, None]
             frac_u = 1.0 - (tot_allowed / vol) / rho_u
             u[uniq] *= frac_u[:, None]
+            m_gain = dm
+            if spec.agn:
+                from ramses_tpu.pm.sinks import agn_energy
+                e_agn, m_gain = agn_energy(dm, spec, units)
+                np.add.at(u[:, 1 + nd], rows, e_agn / vol)
             sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
             stellar = getattr(sim, "stellar", None)
             if stellar is not None:
                 for sid, dmi in zip(sinks.idp[sel], dm):
                     stellar.add_accreted(sid, float(dmi))
-            newm = sinks.m[sel] + dm
+            newm = sinks.m[sel] + m_gain
             sinks.v[sel] = (sinks.v[sel] * sinks.m[sel, None] + p_acc) \
                 / np.maximum(newm, 1e-300)[:, None]
             sinks.m[sel] = newm
@@ -317,6 +410,11 @@ def sink_passes_amr(sim, dt: float):
                 fg = np.asarray(sim.fg[l], dtype=np.float64)
                 acc[sel[ok]] = fg[rows[ok]]
             sinks.v = sinks.v + acc * dt
+        if spec.direct_force:
+            from ramses_tpu.pm.sinks import direct_force_kick
+            sinks = direct_force_kick(
+                sinks, units, sim.dx(max(sim.levels())), dt,
+                sim.boxlen if sim.grav_periodic else None)
         x = sinks.x + sinks.v * dt
         if sim.grav_periodic:
             sinks.x = np.mod(x, sim.boxlen)

@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from ramses_tpu.units import Units, factG_in_cgs
+from ramses_tpu.units import C_CGS, Units, factG_in_cgs
 
 
 @dataclass(frozen=True)
@@ -28,6 +28,15 @@ class SinkSpec:
     r_acc_cells: float = 2.0       # accretion radius in cells
     merging_cells: float = 2.0     # merge radius in cells
     nsinkmax: int = 1000
+    # AGN thermal feedback (``pm/sink_particle.f90`` agn branch /
+    # Teyssier+11): E = eps_c * eps_r * dM c^2 dumped into the host
+    # cell; the radiated eps_r share never reaches the sink mass
+    agn: bool = False
+    eps_r: float = 0.1             # radiative efficiency
+    eps_c: float = 0.15            # coupling efficiency
+    # direct sink-sink N^2 gravity during the drift (the reference's
+    # ``direct_force_sink`` smbh option)
+    direct_force: bool = False
 
     @classmethod
     def from_params(cls, p) -> "SinkSpec":
@@ -43,7 +52,11 @@ class SinkSpec:
                    c_acc=float(g("c_acc", 0.75)),
                    r_acc_cells=float(g("r_acc_cells", 2.0)),
                    merging_cells=float(g("merging_cells", 2.0)),
-                   nsinkmax=int(g("nsinkmax", 1000)))
+                   nsinkmax=int(g("nsinkmax", 1000)),
+                   agn=bool(g("agn", False)),
+                   eps_r=float(g("eps_r", 0.1)),
+                   eps_c=float(g("eps_c", 0.15)),
+                   direct_force=bool(g("direct_force", False)))
 
 
 @dataclass
@@ -166,11 +179,57 @@ def accrete(u, sinks: SinkSet, spec: SinkSpec, units: Units, dx: float,
     p_acc = mom_g * (dm_rho / np.maximum(rho, 1e-300))[:, None] * vol
     for iv in range(u.shape[0]):
         np.multiply.at(u[iv], cells, frac)
-    newm = sinks.m + dm
+    m_gain = dm
+    if spec.agn:
+        # AGN thermal dump: eps_r of the accreted rest mass radiates,
+        # eps_c of that couples to the host cell's gas energy
+        e_agn, m_gain = agn_energy(dm, spec, units)
+        np.add.at(u[1 + ndim], cells, e_agn / vol)
+    newm = sinks.m + m_gain
     sinks.v = (sinks.v * sinks.m[:, None] + p_acc) \
         / np.maximum(newm, 1e-300)[:, None]
     sinks.m = newm
     return u, sinks
+
+
+def agn_energy(dm: np.ndarray, spec: SinkSpec, units: Units):
+    """(coupled AGN energy [code], sink mass gain) for accreted gas
+    ``dm`` — the Teyssier+11 thermal quasar mode: L = eps_r dM c²,
+    a fraction eps_c heats the host cell, the radiated share never
+    reaches the sink (``pm/sink_particle.f90`` AGN branch)."""
+    c_code = C_CGS / units.scale_v
+    e_agn = spec.eps_c * spec.eps_r * dm * c_code ** 2
+    return e_agn, (1.0 - spec.eps_r) * dm
+
+
+def sink_sink_accel(sinks: SinkSet, g_code: float, soft: float,
+                    boxlen: Optional[float] = None) -> np.ndarray:
+    """Direct N² sink-sink gravitational acceleration with Plummer
+    softening (``direct_force_sink``; N is tiny, so the all-pairs
+    host loop is free).  ``boxlen`` applies the minimum-image
+    convention — positions are stored wrapped, so a pair straddling a
+    periodic face must attract ACROSS it."""
+    x = sinks.x
+    dxij = x[None, :, :] - x[:, None, :]          # [i, j, ndim]
+    if boxlen is not None:
+        dxij = dxij - boxlen * np.round(dxij / boxlen)
+    r2 = (dxij ** 2).sum(-1) + soft ** 2
+    np.fill_diagonal(r2, 1.0)
+    w = g_code * sinks.m[None, :] / r2 ** 1.5
+    np.fill_diagonal(w, 0.0)
+    return (w[:, :, None] * dxij).sum(axis=1)
+
+
+def direct_force_kick(sinks: SinkSet, units: Units, dx: float,
+                      dt: float, boxlen: Optional[float]) -> SinkSet:
+    """Apply the sink-sink N² kick (shared by the uniform and AMR
+    drift paths; softening = dx/2 at the force resolution)."""
+    if sinks.n < 2:
+        return sinks
+    g_code = factG_in_cgs * units.scale_d * units.scale_t ** 2
+    sinks.v = sinks.v + sink_sink_accel(sinks, g_code, 0.5 * dx,
+                                        boxlen=boxlen) * dt
+    return sinks
 
 
 def merge_sinks(sinks: SinkSet, spec: SinkSpec, dx: float) -> SinkSet:
@@ -201,8 +260,10 @@ def merge_sinks(sinks: SinkSet, spec: SinkSpec, dx: float) -> SinkSet:
 
 
 def drift_kick(sinks: SinkSet, f_field, dx: float, dt: float,
-               boxlen: float) -> SinkSet:
-    """Leapfrog sink motion in the gas gravity field (NGP gather)."""
+               boxlen: float, spec: Optional[SinkSpec] = None,
+               units: Optional[Units] = None) -> SinkSet:
+    """Leapfrog sink motion in the gas gravity field (NGP gather),
+    plus the optional direct sink-sink N² force."""
     if sinks.n == 0:
         return sinks
     if f_field is not None:
@@ -213,5 +274,7 @@ def drift_kick(sinks: SinkSet, f_field, dx: float, dt: float,
                               shape[d] - 1) for d in range(ndim))
         acc = np.stack([f[d][cells] for d in range(ndim)], axis=1)
         sinks.v = sinks.v + acc * dt
+    if spec is not None and spec.direct_force and units is not None:
+        sinks = direct_force_kick(sinks, units, dx, dt, boxlen)
     sinks.x = np.mod(sinks.x + sinks.v * dt, boxlen)
     return sinks

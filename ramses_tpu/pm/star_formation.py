@@ -39,6 +39,7 @@ class SfSpec:
     eta_sn: float = 0.0          # ejecta mass fraction
     yield_metal: float = 0.1
     t_sne: float = 10.0          # delay [Myr]
+    f_w: float = 0.0             # wind mass loading; >0 => kinetic mode
 
     @classmethod
     def from_params(cls, p) -> "SfSpec":
@@ -59,7 +60,8 @@ class SfSpec:
             g_star=float(g(raw_sf, "g_star", 1.0)),
             eta_sn=float(g(raw_fb, "eta_sn", 0.0)),
             yield_metal=float(g(raw_fb, "yield", 0.1)),
-            t_sne=float(g(raw_fb, "t_sne", 10.0)))
+            t_sne=float(g(raw_fb, "t_sne", 10.0)),
+            f_w=float(g(raw_fb, "f_w", 0.0)))
 
 
 def mstar_quantum(spec: SfSpec, units: Units, dx_min: float,
@@ -194,12 +196,7 @@ def thermal_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,
     u = np.array(u)
     ndim = u.ndim - 1
     vol = dx ** ndim
-    age_code = t - np.asarray(p.tp)
-    t_sne_code = spec.t_sne * 1e6 * yr2sec / units.scale_t
-    due = (np.asarray(p.active)
-           & (np.asarray(p.family) == FAM_STAR)
-           & (np.asarray(p.flags) & FLAG_SN_DONE == 0)
-           & (age_code > t_sne_code))
+    due = sn_due_mask(p, spec, units, t)
     if not due.any():
         return u, p
 
@@ -224,3 +221,107 @@ def thermal_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,
     flg[due] |= FLAG_SN_DONE
     p2 = dreplace(p, m=jnp.asarray(m_arr), flags=jnp.asarray(flg))
     return u, p2
+
+
+def sn_due_mask(p: ParticleSet, spec: SfSpec, units: Units, t: float):
+    """Active stars past the SN delay whose event hasn't fired."""
+    age_code = t - np.asarray(p.tp)
+    t_sne_code = spec.t_sne * 1e6 * yr2sec / units.scale_t
+    return (np.asarray(p.active)
+            & (np.asarray(p.family) == FAM_STAR)
+            & (np.asarray(p.flags) & FLAG_SN_DONE == 0)
+            & (age_code > t_sne_code))
+
+
+def wind_shell(ndim: int):
+    """(offsets [nc, ndim], rhat [nc, ndim]) of the 3^ndim SN bubble —
+    the one-cell ``rbubble`` of the kinetic scheme; the central cell's
+    unit vector is zero (its share of the wind energy goes thermal)."""
+    offs = (np.indices((3,) * ndim).reshape(ndim, -1).T - 1)
+    rr = np.sqrt((offs ** 2).sum(axis=1))
+    rhat = np.where(rr[:, None] > 0, offs / np.maximum(rr[:, None], 1.0),
+                    0.0)
+    return offs, rhat
+
+
+def kinetic_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,
+                     dx: float, t: float):
+    """Delayed KINETIC SN winds, the mass-loaded momentum scheme
+    (Dubois & Teyssier; ``pm/feedback.f90`` f_w path): each event
+    sweeps ``f_w`` x the ejecta mass from the host cell and launches
+    ``(1+f_w)·m_ej`` through the 3^ndim bubble with the wind speed
+    ``v_w = sqrt(2 E_SN / m_load)`` radially outward; the central
+    share of the wind energy is deposited thermally."""
+    if spec.eta_sn <= 0:
+        return u, p
+    u = np.array(u)
+    ndim = u.ndim - 1
+    vol = dx ** ndim
+    due = sn_due_mask(p, spec, units, t)
+    if not due.any():
+        return u, p
+
+    esn_code = (1e51 / (10.0 * M_SUN)) / units.scale_v ** 2
+    xdue = np.asarray(p.x)[due]
+    mej = spec.eta_sn * np.asarray(p.m)[due]
+    vstar = np.asarray(p.v)[due]
+    cells = np.stack([np.clip((xdue[:, d] / dx).astype(np.int64), 0,
+                              u.shape[1 + d] - 1)
+                      for d in range(ndim)], axis=1)      # [nsn, ndim]
+
+    # sweep up f_w*mej from the host cell, capped at 25% of its gas
+    # (the reference caps the swept fraction so rho stays positive).
+    # SNe sharing a host cell must debit it ONCE for their combined
+    # draw (fancy-index *= is last-write-wins): group per unique cell,
+    # cap the TOTAL, hand each SN its proportional share.
+    host = tuple(cells.T)
+    lin = np.ravel_multi_index(host, u.shape[1:])
+    uniq, inv = np.unique(lin, return_inverse=True)
+    flat = u.reshape(u.shape[0], -1)
+    mcell_u = flat[0][uniq] * vol
+    tot_req = np.bincount(inv, weights=spec.f_w * mej)
+    tot_allow = np.minimum(tot_req, 0.25 * mcell_u)
+    msw = spec.f_w * mej * (tot_allow
+                            / np.maximum(tot_req, 1e-300))[inv]
+    mcell = mcell_u[inv]
+    vcell = np.stack([flat[1 + d][uniq][inv]
+                      / np.maximum(flat[0][uniq][inv], 1e-300)
+                      for d in range(ndim)], axis=1)
+    e_removed = (msw / np.maximum(mcell, 1e-300)
+                 * flat[1 + ndim][uniq][inv] * vol)
+    frac_u = 1.0 - tot_allow / np.maximum(mcell_u, 1e-300)
+    flat[:, uniq] *= frac_u
+
+    # launch the loaded shell: the bulk velocity carries the combined
+    # momentum of ejecta + swept gas (momentum conservation exact by
+    # construction), the radial wind kick carries the SN energy
+    mload = mej + msw
+    vw = np.sqrt(2.0 * esn_code * mej / np.maximum(mload, 1e-300))
+    offs, rhat = wind_shell(ndim)
+    nc = len(offs)
+    vbulk = (mej[:, None] * vstar + msw[:, None] * vcell) \
+        / np.maximum(mload[:, None], 1e-300)
+    e_inj = np.zeros(len(mej))
+    for k in range(nc):
+        tgt = tuple(((cells[:, d] + offs[k, d]) % u.shape[1 + d])
+                    for d in range(ndim))
+        mshare = mload / nc
+        vk = vbulk + vw[:, None] * rhat[k]
+        np.add.at(u[0], tgt, mshare / vol)
+        for d in range(ndim):
+            np.add.at(u[1 + d], tgt, mshare * vk[:, d] / vol)
+        ek = 0.5 * mshare * (vk ** 2).sum(axis=1)
+        np.add.at(u[1 + ndim], tgt, ek / vol)
+        e_inj += ek
+    # exact energy budget: removed host energy + SN energy + ejecta
+    # bulk KE, minus what the shell kicks already carry, lands as heat
+    # in the host cell (the shock-heated mixing term)
+    e_target = (e_removed + mej * esn_code
+                + 0.5 * mej * (vstar ** 2).sum(axis=1))
+    np.add.at(u[1 + ndim], host, (e_target - e_inj) / vol)
+
+    m_arr = np.array(p.m)
+    m_arr[due] = m_arr[due] - mej
+    flg = np.array(p.flags)
+    flg[due] |= FLAG_SN_DONE
+    return u, dreplace(p, m=jnp.asarray(m_arr), flags=jnp.asarray(flg))

@@ -110,3 +110,51 @@ class RhdSimulation:
 
     def prims(self):
         return np.asarray(core.cons_to_prim(self.u, self.cfg))
+
+    # ------------------------------------------------------------------
+    # snapshot / restart (the rhd solver family's output_hydro shadow:
+    # rho, v/c, P columns, con→prim via the pressure Newton)
+    # ------------------------------------------------------------------
+    def var_names(self):
+        names = ["density", "velocity_x", "velocity_y", "velocity_z",
+                 "pressure"]
+        return names + [f"scalar_{i:02d}"
+                        for i in range(self.cfg.npassive)]
+
+    def dump(self, iout: int = 1, base_dir: str = ".",
+             namelist_path: Optional[str] = None) -> str:
+        from ramses_tpu.io import snapshot as snapmod
+        from ramses_tpu.units import units as units_fn
+        cfg, params = self.cfg, self.params
+        lmin, ndim = params.amr.levelmin, cfg.ndim
+        q = np.asarray(core.cons_to_prim(self.u, cfg), np.float64)
+        levels = snapmod.uniform_levels_from_dense(
+            np.moveaxis(q, 0, -1), lmin, ndim)
+        snap = snapmod.Snapshot(
+            ndim=ndim, nlevelmax=max(params.amr.levelmax, lmin),
+            levels=levels, boxlen=float(params.amr.boxlen),
+            t=float(self.t), gamma=cfg.gamma,
+            var_names=self.var_names(), units=units_fn(params),
+            levelmin=lmin, nstep=int(self.nstep),
+            nstep_coarse=int(self.nstep),
+            tout=[params.output.tend or 0.0])
+        return snapmod.dump_all(snap, iout, base_dir,
+                                namelist_path=namelist_path)
+
+    @classmethod
+    def from_snapshot(cls, params: Params, outdir: str,
+                      dtype=jnp.float64) -> "RhdSimulation":
+        from ramses_tpu.io.restart import restore_uniform
+        cfg = RhdStatic.from_params(params)
+
+        def to_cons(q):
+            return np.asarray(core.prim_to_cons(jnp.asarray(q.T), cfg),
+                              dtype=np.float64).T
+
+        dense, meta, _parts = restore_uniform(outdir, params, cfg,
+                                              to_cons=to_cons)
+        sim = cls(params, dtype=dtype)
+        sim.u = jnp.asarray(dense, dtype=dtype)
+        sim.t = float(meta["t"])
+        sim.nstep = int(meta["nstep"])
+        return sim

@@ -20,7 +20,7 @@ from ramses_tpu.rt import chem as chem_mod
 from ramses_tpu.rt import m1
 from ramses_tpu.rt.chem import GroupSpec
 
-C_CGS = 2.99792458e10
+from ramses_tpu.units import C_CGS
 
 
 @dataclass(frozen=True)

@@ -16,6 +16,7 @@ pi = twopi / 2.0
 kB = 1.3806490e-16        # Boltzmann [erg/K]
 mH = 1.6605390e-24        # atomic mass unit [g]
 factG_in_cgs = 6.6740800e-08  # G [cm^3 g^-1 s^-2]
+C_CGS = 2.99792458e10         # speed of light [cm/s]
 rhoc = 1.8800000e-29      # critical density [g/cc]
 Mpc2cm = 3.0856776e+24
 X_frac = 0.76             # hydrogen mass fraction (cooling_module X)
