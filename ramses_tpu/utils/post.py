@@ -19,6 +19,15 @@ directories:
   part2prof  — radial profiles of particle mass/velocity
                (``part2prof.f90``)
   header     — print the snapshot header (``header.f90``)
+  amr2cut    — 2D slice at a coordinate (``amr2cut.f90``)
+  amr2cylprof / part2cylprof — cylindrical profiles incl. v_phi
+               (``amr2cylprof.f90``, ``part2cylprof.f90``, the
+               rotation curve of ``vrot.f90``)
+  part2birth — star table with birth times (``part2birth.f90``,
+               ``getstarlist.f90``)
+  part2sfr   — star-formation history (``part2sfr.f90``)
+  partcenter — shrinking-sphere particle centre (``partcenter.f90``)
+  sod        — 1D axis profile for shock-tube runs (``sod.f90``)
 
 Everything reads through :mod:`ramses_tpu.io.reader` and writes plain
 ascii / .npy — small host-side numpy passes, like the originals.
@@ -199,6 +208,232 @@ def part2prof(outdir: str, center, nbins: int = 32,
                         nbins, rmax)
 
 
+def amr2cut(outdir: str, var: str = "density", axis: int = 2,
+            coord: float = 0.5, lmax: Optional[int] = None) -> np.ndarray:
+    """2D slice of ``var`` through ``coord`` (box units) normal to
+    ``axis`` at level ``lmax`` (``amr2cut.f90``): leaves whose span
+    covers the cut plane block-fill their footprint."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+    if ndim < 3:
+        raise ValueError("amr2cut needs a 3D snapshot")
+    boxlen = snap["amr"][0].header["boxlen"]
+    levels = cells["level"].astype(int)
+    if lmax is None:
+        lmax = int(levels.max())
+    n = 1 << lmax
+    dxf = boxlen / n
+    # half-open containment with an epsilon nudge: a cut on a cell
+    # face (the default coord=0.5 always is) must pick ONE layer
+    xcut = coord * boxlen * (1.0 + 1e-9) + 1e-300
+    axes2d = [d for d in range(3) if d != axis]
+    acc = np.zeros((n, n))
+    wacc = np.zeros((n, n))
+    vals = cells[var]
+    pos = np.stack([cells["xyz"[d]] for d in range(3)], axis=1)
+    hit = ((pos[:, axis] - 0.5 * cells["dx"] <= xcut)
+           & (xcut < pos[:, axis] + 0.5 * cells["dx"]))
+    for l in np.unique(levels[hit]):
+        sel = hit & (levels == l)
+        v = vals[sel]
+        p2 = pos[sel][:, axes2d]
+        if l >= lmax:
+            # in-plane area weight: pixels mixing two fine levels
+            # average by covered area (cf. amr2cube's volume weight)
+            w = (2.0 ** (lmax - l)) ** 2
+            idx = tuple(np.clip((p2[:, k] / dxf).astype(int), 0, n - 1)
+                        for k in range(2))
+            np.add.at(acc, idx, v * w)
+            np.add.at(wacc, idx, w)
+        else:
+            span = 1 << (lmax - l)
+            i0 = np.clip(((p2 - 0.5 * cells["dx"][sel][:, None])
+                          / dxf).round().astype(int), 0, n - span)
+            for k in range(len(v)):
+                sl = (slice(i0[k, 0], i0[k, 0] + span),
+                      slice(i0[k, 1], i0[k, 1] + span))
+                acc[sl] += v[k]
+                wacc[sl] += 1.0
+    return acc / np.maximum(wacc, 1e-300)
+
+
+def _cyl_coords(rel, axis: int):
+    """(R, z, perp axes) cylindrical decomposition about ``axis``.
+    2D snapshots: only the out-of-plane axis (axis >= ndim, i.e.
+    ``--dir z``) is a valid rotation axis; z = 0 there."""
+    nd = rel.shape[1]
+    perp = [d for d in range(nd) if d != axis][:2]
+    if len(perp) < 2:
+        raise ValueError(
+            f"rotation axis {axis} leaves {len(perp)} in-plane axes in "
+            f"a {nd}D snapshot; a cylindrical profile needs 2 "
+            "(2D runs: use the out-of-plane --dir z)")
+    R = np.sqrt(sum(rel[:, d] ** 2 for d in perp))
+    z = rel[:, axis] if axis < nd else np.zeros(len(rel))
+    return R, z, perp
+
+
+def _vphi(rel, vel, perp, R):
+    """Tangential velocity (r x v)_axis / R on the ``perp`` plane."""
+    return ((rel[:, perp[0]] * vel[:, perp[1]]
+             - rel[:, perp[1]] * vel[:, perp[0]])
+            / np.maximum(R, 1e-300))
+
+
+def amr2cylprof(outdir: str, center, axis: int = 2, nbins: int = 32,
+                rmax: Optional[float] = None,
+                zmax: Optional[float] = None):
+    """Cylindrical gas profiles about ``center`` (``amr2cylprof.f90``):
+    mass-weighted density/pressure/v_phi vs cylindrical radius inside
+    |z| < zmax.  Returns (R, m_ring, profiles)."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+    boxlen = snap["amr"][0].header["boxlen"]
+    rmax = rmax if rmax is not None else 0.5 * boxlen
+    zmax = zmax if zmax is not None else 0.5 * boxlen
+    pos = np.stack([cells["xyz"[d]] for d in range(ndim)], axis=1)
+    rel = pos - np.asarray(center)[:ndim]
+    rel = rel - boxlen * np.round(rel / boxlen)
+    R, z, perp = _cyl_coords(rel, axis)
+    sel = np.abs(z) < zmax if ndim == 3 else np.ones(len(R), bool)
+    vel = np.stack([cells[f"velocity_{'xyz'[d]}"] for d in range(ndim)],
+                   axis=1)
+    vphi = _vphi(rel, vel, perp, R)
+    mass = cells["density"] * cells["dx"] ** ndim
+    vals = {"density": cells["density"],
+            "pressure": cells["pressure"], "vphi": vphi}
+    return _radial_bins(R[sel], mass[sel],
+                        {k: v[sel] for k, v in vals.items()},
+                        nbins, rmax)
+
+
+def part2cylprof(outdir: str, center, axis: int = 2, nbins: int = 32,
+                 rmax: Optional[float] = None):
+    """Cylindrical particle profiles: surface density + rotation curve
+    (``part2cylprof.f90``/``vrot.f90``)."""
+    from ramses_tpu.utils.halos import load_particles
+    x, v, m, _i, boxlen, _t = load_particles(outdir)
+    nd = x.shape[1]
+    rmax = rmax if rmax is not None else 0.5 * boxlen
+    rel = x - np.asarray(center)[:nd]
+    rel = rel - boxlen * np.round(rel / boxlen)
+    R, _z, perp = _cyl_coords(rel, axis)
+    vphi = _vphi(rel, v, perp, R)
+    return _radial_bins(R, m, {"vphi": vphi,
+                               "v": np.sqrt((v ** 2).sum(axis=1))},
+                        nbins, rmax)
+
+
+def part2birth(outdir: str, path: str) -> int:
+    """Star-particle table with birth times/metallicities
+    (``part2birth.f90`` / ``getstarlist.f90``)."""
+    snap = rdr.load_snapshot(outdir)
+    if "part" not in snap:
+        raise ValueError(f"{outdir}: no particle files")
+    parts = {}
+    first = snap["part"][0]
+    for k, v in first.items():
+        if isinstance(v, np.ndarray):
+            parts[k] = np.concatenate([p[k] for p in snap["part"]])
+    from ramses_tpu.pm.particles import FAM_STAR
+    fam = parts.get("family")
+    if fam is not None:
+        star = fam == FAM_STAR
+    elif "birth_time" in parts:
+        # older outputs without family codes: stars are the particles
+        # with a birth record (part2birth.f90's tp /= 0 test)
+        star = parts["birth_time"] != 0.0
+    else:
+        star = np.ones(len(parts["mass"]), bool)
+    nd = snap["info"]["ndim"]
+    cols = [parts["identity"][star]]
+    hdr = ["id"]
+    for d in range(nd):
+        cols.append(parts[f"position_{'xyz'[d]}"][star])
+        hdr.append("xyz"[d])
+    cols.append(parts["mass"][star])
+    hdr.append("m")
+    for k, name in (("birth_time", "t_birth"), ("metallicity", "Z")):
+        if k in parts:
+            cols.append(parts[k][star])
+            hdr.append(name)
+    np.savetxt(path, np.stack(cols, axis=1), header=" ".join(hdr))
+    return int(star.sum())
+
+
+def part2sfr(outdir: str, nbins: int = 32):
+    """Star-formation history: SFR per birth-time bin [code mass /
+    code time] (``part2sfr.f90``).  Returns (t_mid, sfr)."""
+    snap = rdr.load_snapshot(outdir)
+    if "part" not in snap:
+        raise ValueError(f"{outdir}: no particle files")
+    tp, m, fam = [], [], []
+    for p in snap["part"]:
+        if "birth_time" not in p:
+            continue
+        tp.append(p["birth_time"])
+        m.append(p["mass"])
+        fam.append(p.get("family", np.full(len(p["mass"]), 2)))
+    if not tp:
+        raise ValueError(f"{outdir}: no star birth records")
+    from ramses_tpu.pm.particles import FAM_STAR
+    tp = np.concatenate(tp)
+    m = np.concatenate(m)
+    star = (np.concatenate(fam) == FAM_STAR) & (tp > 0)
+    if not star.any():
+        raise ValueError(f"{outdir}: no star birth records")
+    edges = np.linspace(0.0, max(float(tp[star].max()), 1e-300),
+                        nbins + 1)
+    msum, _ = np.histogram(tp[star], bins=edges, weights=m[star])
+    dt = np.diff(edges)
+    return 0.5 * (edges[:-1] + edges[1:]), msum / np.maximum(dt, 1e-300)
+
+
+def partcenter(outdir: str, niter: int = 16) -> np.ndarray:
+    """Shrinking-sphere centre of the particle distribution
+    (``partcenter.f90``)."""
+    from ramses_tpu.utils.halos import load_particles
+    x, _v, m, _i, boxlen, _t = load_particles(outdir)
+    nd = x.shape[1]
+    c = (x * m[:, None]).sum(0) / m.sum()
+    r = 0.5 * boxlen
+    for _ in range(niter):
+        rel = x - c
+        rel = rel - boxlen * np.round(rel / boxlen)
+        sel = (rel ** 2).sum(1) < r * r
+        if sel.sum() < 8:
+            break
+        c = c + (rel[sel] * m[sel, None]).sum(0) / m[sel].sum()
+        c = np.mod(c, boxlen)
+        r *= 0.75
+    return c
+
+
+def sod(outdir: str, axis: int = 0):
+    """1D profile along ``axis`` through the box centre — the
+    shock-tube comparison columns (``sod.f90``).  Returns
+    (x, rho, v_axis, P)."""
+    snap, cells = _cells(outdir)
+    ndim = snap["info"]["ndim"]
+    if axis >= ndim:
+        raise ValueError(f"sod axis {axis} >= snapshot ndim {ndim}")
+    boxlen = snap["amr"][0].header["boxlen"]
+    pos = np.stack([cells["xyz"[d]] for d in range(ndim)], axis=1)
+    sel = np.ones(len(pos), bool)
+    # half-open cell containment: the mid-plane often lies exactly on
+    # a cell face, which must pick ONE neighbour, not both
+    xs = 0.5 * boxlen * (1.0 + 1e-9)
+    for d in range(ndim):
+        if d != axis:
+            sel &= ((pos[:, d] - 0.5 * cells["dx"] <= xs)
+                    & (xs < pos[:, d] + 0.5 * cells["dx"]))
+    order = np.argsort(pos[sel, axis])
+    x = pos[sel, axis][order]
+    return (x, cells["density"][sel][order],
+            cells[f"velocity_{'xyz'[axis]}"][sel][order],
+            cells["pressure"][sel][order])
+
+
 def header(outdir: str) -> dict:
     """Snapshot header summary (``header.f90``)."""
     snap = rdr.load_snapshot(outdir)
@@ -262,6 +497,40 @@ def main(argv=None) -> int:
     p = sub.add_parser("header")
     p.add_argument("outdir")
 
+    p = sub.add_parser("amr2cut")
+    p.add_argument("outdir")
+    p.add_argument("npyfile")
+    p.add_argument("--var", default="density")
+    p.add_argument("--dir", default="z", choices=["x", "y", "z"])
+    p.add_argument("--coord", type=float, default=0.5)
+    p.add_argument("--lmax", type=int, default=None)
+
+    for name in ("amr2cylprof", "part2cylprof"):
+        p = sub.add_parser(name)
+        p.add_argument("outdir")
+        p.add_argument("txtfile")
+        p.add_argument("--center", type=float, nargs="+",
+                       default=[0.5, 0.5, 0.5])
+        p.add_argument("--dir", default="z", choices=["x", "y", "z"])
+        p.add_argument("--nbins", type=int, default=32)
+
+    p = sub.add_parser("part2birth")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+
+    p = sub.add_parser("part2sfr")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+    p.add_argument("--nbins", type=int, default=32)
+
+    p = sub.add_parser("partcenter")
+    p.add_argument("outdir")
+
+    p = sub.add_parser("sod")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+    p.add_argument("--dir", default="x", choices=["x", "y", "z"])
+
     args = ap.parse_args(argv)
     if args.tool == "amr2cube":
         cube = amr2cube(args.outdir, var=args.var, lmax=args.lmax)
@@ -300,6 +569,37 @@ def main(argv=None) -> int:
     elif args.tool == "header":
         for k, v in header(args.outdir).items():
             print(f"{k:12s} {v}")
+    elif args.tool == "amr2cut":
+        m = amr2cut(args.outdir, var=args.var,
+                    axis="xyz".index(args.dir), coord=args.coord,
+                    lmax=args.lmax)
+        np.save(args.npyfile, m)
+        print(f"amr2cut: {m.shape} slice -> {args.npyfile} "
+              f"(min {m.min():.4e} max {m.max():.4e})")
+    elif args.tool in ("amr2cylprof", "part2cylprof"):
+        fn = amr2cylprof if args.tool == "amr2cylprof" else part2cylprof
+        r, msh, prof = fn(args.outdir, args.center,
+                          axis="xyz".index(args.dir), nbins=args.nbins)
+        cols = [r, msh] + [prof[k] for k in sorted(prof)]
+        np.savetxt(args.txtfile, np.stack(cols, axis=1),
+                   header="R m_ring " + " ".join(sorted(prof)))
+        print(f"{args.tool}: {args.nbins} bins -> {args.txtfile}")
+    elif args.tool == "part2birth":
+        n = part2birth(args.outdir, args.txtfile)
+        print(f"part2birth: {n} stars -> {args.txtfile}")
+    elif args.tool == "part2sfr":
+        t, sfr = part2sfr(args.outdir, nbins=args.nbins)
+        np.savetxt(args.txtfile, np.stack([t, sfr], axis=1),
+                   header="t sfr")
+        print(f"part2sfr: {args.nbins} bins -> {args.txtfile}")
+    elif args.tool == "partcenter":
+        c = partcenter(args.outdir)
+        print(" ".join(f"{v:.8f}" for v in c))
+    elif args.tool == "sod":
+        x, rho, v, press = sod(args.outdir, axis="xyz".index(args.dir))
+        np.savetxt(args.txtfile, np.stack([x, rho, v, press], axis=1),
+                   header="x rho v P")
+        print(f"sod: {len(x)} cells -> {args.txtfile}")
     return 0
 
 

@@ -142,3 +142,71 @@ def test_async_dumper_roundtrip(snap_dir, tmp_path):
     with pytest.raises(RuntimeError):
         dumper.wait()
     dumper.close()
+
+
+def test_cut_cylprof_center_sod(snap_dir, tmp_path):
+    """The second batch of analysis programs: slice, cylindrical
+    profiles, shrinking-sphere centre, 1D sod extraction."""
+    from ramses_tpu.utils.post import (amr2cut, amr2cylprof, main,
+                                       part2cylprof, partcenter, sod)
+
+    out, sim = snap_dir
+    # slice through the blob: dense centre, finite values
+    m = amr2cut(out, var="density", axis=2, coord=0.5)
+    assert m.ndim == 2 and np.isfinite(m).all() and m.max() > m.mean()
+    c = m.shape[0] // 2
+    assert m[c, c] > np.median(m)
+    # cylindrical gas profile: density falls outward from the blob
+    R, mring, prof = amr2cylprof(out, [0.5, 0.5, 0.5], axis=2, nbins=8,
+                                 rmax=0.4, zmax=0.1)
+    assert prof["density"][0] > prof["density"][-1]
+    # particle rotation-curve bins exist and are finite
+    Rp, mp, pprof = part2cylprof(out, [0.5, 0.5, 0.5], axis=2, nbins=8)
+    assert np.isfinite(pprof["vphi"]).all()
+    # the particle cloud is centred near the box centre
+    cm = partcenter(out)
+    assert np.all(np.abs(cm - 0.5) < 0.1)
+    # sod line: monotone x, full row count, positive density
+    x, rho, v, press = sod(out, axis=0)
+    assert np.all(np.diff(x) > 0) and (rho > 0).all()
+    # CLI smoke for the new subcommands
+    assert main(["amr2cut", out, str(tmp_path / "cut.npy")]) == 0
+    assert main(["amr2cylprof", out, str(tmp_path / "cyl.txt")]) == 0
+    assert main(["partcenter", out]) == 0
+    assert main(["sod", out, str(tmp_path / "sod.txt")]) == 0
+
+
+def test_birth_and_sfr(tmp_path):
+    """part2birth/part2sfr read the star records of an SF snapshot."""
+    import jax
+
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
+    from ramses_tpu.utils.post import main, part2birth, part2sfr
+
+    rng = np.random.default_rng(4)
+    n = 32
+    ps = ParticleSet.make(rng.uniform(0.1, 0.9, (n, 2)),
+                          np.zeros((n, 2)), np.full(n, 1.0 / n))
+    import dataclasses
+    ps = dataclasses.replace(
+        ps, family=jnp.full((n,), FAM_STAR, jnp.int8),
+        tp=jnp.asarray(rng.uniform(0.01, 0.5, n)))
+    g = {
+        "run_params": {"hydro": True, "poisson": True, "pic": True},
+        "amr_params": {"levelmin": 4, "levelmax": 4, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "d_region": [1.0], "p_region": [1.0]},
+        "hydro_params": {"gamma": 1.4},
+        "output_params": {"tend": 1.0},
+    }
+    sim = AmrSim(params_from_dict(g, ndim=2), dtype=jnp.float64,
+                 particles=jax.device_put(ps))
+    out = sim.dump(1, str(tmp_path))
+    nstars = part2birth(out, str(tmp_path / "birth.txt"))
+    assert nstars == n
+    t, sfr = part2sfr(out, nbins=8)
+    # total formed mass is recovered: sum sfr*dt == 1
+    dt = t[1] - t[0]
+    assert np.isclose((sfr * dt).sum(), 1.0, rtol=1e-6)
+    assert main(["part2sfr", out, str(tmp_path / "sfr.txt")]) == 0
