@@ -651,7 +651,15 @@ class AmrSim:
                     g_sgn=self._place(jnp.asarray(g.g_sgn), "rep"),
                     g_octnb=self._place(jnp.asarray(g.oct_nb), "octs"),
                     g_valid=self._place(jnp.asarray(g.valid_cell),
-                                        "cells"))
+                                        "cells"),
+                    # masked-multigrid ladder: the depth-0 parent map
+                    # is oct-row-sized (shards with the octs); deeper
+                    # lattices are genuinely small and replicate
+                    g_mg=tuple((self._place(jnp.asarray(nb_j), "rep"),
+                                self._place(jnp.asarray(par_j),
+                                            "octs" if j == 0 else "rep"))
+                               for j, (nb_j, par_j, _n)
+                               in enumerate(g.mg)))
 
     # ------------------------------------------------------------------
     # cosmology helpers (host interpolation of the Friedmann tables)
@@ -1064,7 +1072,7 @@ class AmrSim:
                     rhs, ghosts, d["g_nb"], d["g_octnb"],
                     jnp.asarray(dx, rhs.dtype), d["g_valid"], nd,
                     tol=float(self.params.poisson.epsilon), iters=200,
-                    phi0=self.phi.get(l))
+                    phi0=self.phi.get(l), mg=d.get("g_mg", ()))
                 self.poisson_iters[l] = nit
             self.phi[l] = phi
             self.fg[l] = gs.grad_phi(phi, ghosts, d["g_nb"],

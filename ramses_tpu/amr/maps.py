@@ -385,6 +385,66 @@ class GravityMaps:
     # rows index concat(octs [noct_pad], zero [1]) — the coarse half of
     # the two-level preconditioner (multigrid_fine's coarse MG levels)
     oct_nb: Optional[np.ndarray] = None   # [noct_pad, ndim, 2] int32
+    # deeper coarsened lattices of the SAME masked domain — the full
+    # masked-multigrid ladder (multigrid_fine's levels below ifinelevel)
+    # as tuple of (nb [n_j, ndim, 2], par_prev [n_{j-1}|noct_pad], n_j)
+    mg: tuple = ()
+
+
+def build_mg_lattices(og: np.ndarray, lvl: int, bc_kinds: List[tuple],
+                      noct: int, noct_pad: int,
+                      min_n: int = 32) -> tuple:
+    """Coarsened lattices of a partial level's oct set for the masked
+    multigrid V-cycle (``poisson/multigrid_fine_fine.f90`` level
+    ladder): depth ``j`` holds the unique ``og >> j`` coords with
+    face-neighbour maps (sentinel ``n_j`` = outside the mask, Dirichlet
+    zero for the error equation) and the parent map from depth ``j-1``
+    (depth 0 = the oct lattice itself, padded rows -> sentinel).
+    Coarsening stops at ``min_n`` cells or a one-cell-wide box."""
+    ndim = og.shape[1]
+    out = []
+    prev_coords = og[:noct]
+    prev_pad = noct_pad
+    j = 1
+    while True:
+        side = 1 << max(lvl - 1 - j, 0)
+        if len(prev_coords) <= min_n or side < 2:
+            break
+        coords = prev_coords >> 1
+        keys = kmod.encode(coords, ndim)
+        ukeys, inv = np.unique(keys, return_inverse=True)
+        n = len(ukeys)
+        if n == len(prev_coords):      # no coarsening progress: stop
+            break
+        ucoords = kmod.decode(ukeys, ndim)
+        # bucket-padded shapes: jit signatures of the Poisson solve
+        # stay stable across regrids (sentinel = n_pad, the zeros row)
+        n_pad = bucket(n, 64)
+        par = np.full(prev_pad, n_pad, dtype=np.int32)   # pads drop
+        par[:len(inv)] = inv
+        nb = np.full((n_pad, ndim, 2), n_pad, dtype=np.int32)
+        for d in range(ndim):
+            lo_k, hi_k = bc_kinds[d]
+            for s_i, s in ((0, -1), (1, +1)):
+                q = ucoords.copy()
+                q[:, d] += s
+                if lo_k == 0 and hi_k == 0:
+                    q[:, d] = np.mod(q[:, d], side)
+                    inside = np.ones(n, dtype=bool)
+                else:
+                    inside = (q[:, d] >= 0) & (q[:, d] < side)
+                    q[:, d] = np.clip(q[:, d], 0, side - 1)
+                qk = kmod.encode(q, ndim)
+                pos = np.searchsorted(ukeys, qk)
+                pos = np.clip(pos, 0, n - 1)
+                hit = (ukeys[pos] == qk) & inside
+                nb[:n, d, s_i] = np.where(hit, pos, n_pad).astype(
+                    np.int32)
+        out.append((nb, par, n))
+        prev_coords = ucoords
+        prev_pad = n_pad
+        j += 1
+    return tuple(out)
 
 
 def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
@@ -499,4 +559,5 @@ def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
         lvl=lvl, ncell=ncell, ncell_pad=ncell_pad, ng=ng, ng_pad=ng_pad,
         nb=nb.astype(np.int32),
         g_cell=_padg(g_cell, ng_pad), g_nb=_padg(g_nb, ng_pad),
-        g_sgn=_padg(g_sgn, ng_pad), valid_cell=valid, oct_nb=oct_nb)
+        g_sgn=_padg(g_sgn, ng_pad), valid_cell=valid, oct_nb=oct_nb,
+        mg=build_mg_lattices(lev.og, lvl, bc_kinds, noct, noct_pad))

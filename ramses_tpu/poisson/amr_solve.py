@@ -71,22 +71,42 @@ def cg_level(rhs, ghosts, nb, dx, valid, ndim: int, iters: int = 200,
     return jnp.where(valid, x, 0.0)
 
 
+def _lat_apply(e, lat_nb, dxj, ndim: int):
+    """−Δe on a masked lattice (sentinel row = outside the mask =
+    Dirichlet 0 for the error equation)."""
+    ext = jnp.concatenate([e, jnp.zeros((1,), e.dtype)])
+    s = jnp.zeros_like(e)
+    for d in range(ndim):
+        s = s + ext[lat_nb[:, d, 0]] + ext[lat_nb[:, d, 1]]
+    return -(s - 2.0 * ndim * e) / (dxj * dxj)
+
+
+def _lat_jacobi(e, r, lat_nb, dxj, ndim: int, nu: int):
+    """``nu`` damped-Jacobi sweeps of −Δe = r on a masked lattice."""
+    diag = 2.0 * ndim / (dxj * dxj)
+    for _ in range(nu):
+        e = e + 0.6 * (r - _lat_apply(e, lat_nb, dxj, ndim)) / diag
+    return e
+
+
 @partial(jax.jit, static_argnames=("ndim", "iters", "nu"))
 def pcg_level(rhs, ghosts, nb, oct_nb, dx, valid, ndim: int,
               tol: float = 1e-4, iters: int = 200, nu: int = 4,
-              phi0=None):
+              phi0=None, mg=()):
     """Preconditioned CG with residual-targeted termination.
 
     The reference solves each AMR level with masked multigrid to
     ``epsilon`` (``poisson/multigrid_fine_commons.f90:25-305``) or CG
     above ``cg_levelmin``.  Here: CG on the masked level system,
-    preconditioned by an additive two-level operator —
-    ``M^-1 r = w_f * D^-1 r  +  P (Jacobi_nu on the oct lattice) P^T r``
-    with P = piecewise-constant prolongation over each oct's 2^ndim
-    cells.  Both terms are symmetric positive definite polynomials of
-    symmetric operators, so CG theory holds.  Iterations freeze once
-    ``|r| <= tol * |r0|`` (the &POISSON_PARAMS epsilon); the live
-    iteration count is returned for the multigrid-iters metric.
+    preconditioned by the masked-multigrid ladder —
+    ``M^-1 r = w_f * D^-1 r + P V(P^T r)`` where V is a symmetric
+    V-cycle (damped-Jacobi smoothing, piecewise-constant transfer)
+    over the coarsened oct lattices of the SAME masked domain
+    (``mg``; :func:`ramses_tpu.amr.maps.build_mg_lattices`) — the
+    ``multigrid_fine_fine`` level ladder as a preconditioner, which
+    keeps the epsilon-targeted CG outer loop and its live iteration
+    count (the multigrid-iters metric).  Every ingredient is a
+    symmetric positive operator, so CG theory holds.
 
     Returns (phi, niter).
     """
@@ -99,21 +119,29 @@ def pcg_level(rhs, ghosts, nb, oct_nb, dx, valid, ndim: int,
     def A(x):
         return -laplacian(x, zero_g, nb, dx, valid, ndim)
 
-    dxc = 2.0 * dx
-    diag_c = 2.0 * ndim / (dxc * dxc)
+    def vcycle(j, rj):
+        """Symmetric V-cycle on lattice depth j (0 = oct lattice)."""
+        dxj = dx * (2.0 ** (j + 1))
+        lat_nb = oct_nb if j == 0 else mg[j - 1][0]
+        ej = _lat_jacobi(jnp.zeros_like(rj), rj, lat_nb, dxj, ndim, nu)
+        if j < len(mg):
+            par = mg[j][1]               # depth j -> j+1 parent index
+            n_next = mg[j][0].shape[0]
+            resid = rj - _lat_apply(ej, lat_nb, dxj, ndim)
+            r_next = jnp.zeros((n_next,), rj.dtype).at[par].add(
+                resid[:par.shape[0]], mode="drop") / ttd
+            e_next = vcycle(j + 1, r_next)
+            ext = jnp.concatenate([e_next, jnp.zeros((1,),
+                                                     e_next.dtype)])
+            ej = ej + ext[par[:rj.shape[0]]]
+            ej = _lat_jacobi(ej, rj, lat_nb, dxj, ndim, nu)
+        return ej
 
     def Minv(r):
-        # coarse half: restrict (adjoint of repeat), nu Jacobi sweeps on
-        # the oct-lattice operator, prolong back
-        rc = r.reshape(-1, ttd).sum(axis=1)              # [noct_pad]
-        ec = jnp.zeros_like(rc)
-        for _ in range(nu):
-            ext = jnp.concatenate([ec, jnp.zeros((1,), ec.dtype)])
-            s = jnp.zeros_like(ec)
-            for d in range(ndim):
-                s = s + ext[oct_nb[:, d, 0]] + ext[oct_nb[:, d, 1]]
-            lap_c = (s - 2.0 * ndim * ec) / (dxc * dxc)
-            ec = ec + 0.6 * (rc / ttd - (-lap_c)) / diag_c
+        # restrict cells -> oct lattice (adjoint of repeat), V-cycle
+        # down the masked ladder, prolong back
+        rc = r.reshape(-1, ttd).sum(axis=1) / ttd        # [noct_pad]
+        ec = vcycle(0, rc)
         e = jnp.repeat(ec, ttd)
         # fine half: damped diagonal
         diag_f = 2.0 * ndim / (dx * dx)
