@@ -269,19 +269,37 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     for l, og in tree_og.items():
         tree.set_level(l, og)
     ps = None
+    tracer_x = None
     if parts:
-        from ramses_tpu.pm.particles import lane_headroom
+        from ramses_tpu.pm.particles import (FAM_GAS_TRACER,
+                                             lane_headroom)
         from ramses_tpu.pm.sinks import SinkSpec
         from ramses_tpu.pm.star_formation import SfSpec
+        # gas tracers ride the part files as massless family-0 rows:
+        # split them back out (they are host positions, not lanes)
+        fam = parts.get("family")
+        if fam is not None and (fam == FAM_GAS_TRACER).any():
+            sel = fam == FAM_GAS_TRACER
+            dims = "xyz"[:params.ndim]
+            tracer_x = np.stack(
+                [parts[f"position_{d}"][sel] for d in dims], axis=1)
+            npart = len(fam)
+            parts = {k: (v[~sel] if isinstance(v, np.ndarray)
+                         and len(v) == npart else v)
+                     for k, v in parts.items()}
         # runs that keep creating particles need free lanes after the
         # restart too (the fresh-start path's npartmax headroom) — but
         # only for solver families whose __init__ keeps SF/sinks live
         grows = (cls._pm_family(cls._make_cfg(params))
                  and (SfSpec.from_params(params).enabled
                       or SinkSpec.from_params(params).enabled))
-        ps = restore_particles(parts, params.ndim,
-                               nmax=lane_headroom(params, grows))
+        if len(parts.get("mass", ())):
+            ps = restore_particles(parts, params.ndim,
+                                   nmax=lane_headroom(params, grows))
     sim = cls(params, dtype=dtype, init_tree=tree, particles=ps)
+    if tracer_x is not None:
+        # restored trajectories replace the fresh per-cell seeding
+        sim.tracer_x = tracer_x
     for l, rows in rows_lv.items():
         og = tree_og[l]
         pos = tree.lookup(l, og)
@@ -513,6 +531,29 @@ class AmrSim:
             self._alloc_from_ics()
         else:
             self._init_refine()
+
+        # &RUN_PARAMS tracer: seed velocity tracers on the leaf cells
+        # (``pm/tracer_utils.f90`` initial seeding): Poisson-sampled
+        # per cell at mean ``tracer_per_cell`` (fractional thinning and
+        # oversampling both work) and jittered inside the cell so
+        # coincident tracers don't ride identical trajectories
+        if bool(getattr(params.run, "tracer", False)):
+            if not self._pm_family(self.cfg):
+                import warnings
+                warnings.warn("tracer=.true. is only wired for the "
+                              "hydro solver family; no tracers seeded")
+            else:
+                rng = np.random.default_rng(20480)
+                tpc = float(params.run.tracer_per_cell)
+                xs = []
+                for l in self.levels():
+                    c = self.tree.cell_centers(l, self.boxlen)
+                    c = c[~self.tree.refined_mask(l)]
+                    rep = np.repeat(c, rng.poisson(tpc, len(c)), axis=0)
+                    xs.append(rep + rng.uniform(-0.5, 0.5, rep.shape)
+                              * self.dx(l))
+                self.tracer_x = (np.concatenate(xs)
+                                 if xs and sum(map(len, xs)) else None)
 
         # radiative transfer on the hierarchy (rt=.true.; gray or
         # multigroup/He via &RT_PARAMS rt_ngroups/rt_y_he,

@@ -41,6 +41,10 @@ class RunParams:
     nsubcycle: List[int] = field(default_factory=lambda: [2] * MAXLEVEL)
     ordering: str = "hilbert"
     cost_weighting: bool = True
+    # Monte-Carlo gas tracers (&RUN_PARAMS tracer/MC_tracer,
+    # pm/tracer_utils.f90): seed tracer_per_cell tracers per leaf cell
+    tracer: bool = False
+    tracer_per_cell: float = 1.0
     # runtime plug-in overlay (ramses_tpu/patch.py) — the namelist
     # equivalent of the reference's compile-time PATCH= VPATH shadowing
     patch: str = ""

@@ -473,6 +473,16 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
     un = units_fn(params)
     parts = (particles_dict(sim.p)
              if getattr(sim, "p", None) is not None else None)
+    trc = getattr(sim, "tracer_x", None)
+    if trc is not None and len(trc):
+        # gas tracers ride the particle files as massless
+        # FAM_GAS_TRACER entries (``pm/output_part.f90`` writes them
+        # in the same records) — ids beyond the real particles'
+        id0 = (int(parts["idp"].max()) if parts is not None
+               and len(parts["idp"]) else 0)
+        tb = _tracer_dict(np.asarray(trc, np.float64), id0 + 1)
+        parts = (tb if parts is None else
+                 {k: np.concatenate([parts[k], tb[k]]) for k in parts})
     # per-level dtold/dtnew from the exact factor-2 subcycling
     # (``amr/update_time.f90`` bookkeeping): restarts need the lmin
     # dtold to complete the pending closing half-kick, and the lmin
@@ -528,6 +538,20 @@ def write_stellar_csv(path: str, stellar) -> None:
             f.write(f"{int(stellar.idp[k]):10d},{stellar.m[k]:21.10e},"
                     f"{stellar.tform[k]:21.10e},"
                     f"{stellar.tlife[k]:21.10e}\n")
+
+
+def _tracer_dict(x: np.ndarray, id0: int) -> dict:
+    """Massless FAM_GAS_TRACER rows in the :func:`particles_dict`
+    layout for the tracer positions ``x``."""
+    from ramses_tpu.pm.particles import FAM_GAS_TRACER
+    n = len(x)
+    z = np.zeros(n)
+    return dict(
+        x=np.asarray(x, np.float64), v=np.zeros_like(x), m=z.copy(),
+        idp=(id0 + np.arange(n)).astype(np.int32),
+        level=np.full(n, 1, dtype=np.int32),
+        family=np.full(n, FAM_GAS_TRACER, dtype=np.int8),
+        tag=np.zeros(n, dtype=np.int8), tp=z.copy(), zp=z.copy())
 
 
 def particles_dict(p) -> dict:
