@@ -296,10 +296,17 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
         if len(parts.get("mass", ())):
             ps = restore_particles(parts, params.ndim,
                                    nmax=lane_headroom(params, grows))
-    sim = cls(params, dtype=dtype, init_tree=tree, particles=ps)
+    # restarts never re-seed tracers: the restored population is the
+    # truth, INCLUDING the empty one (e.g. every tracer escaped an
+    # open box) — resurrecting a fresh population would fabricate
+    # trajectories
+    sim = cls(params, dtype=dtype, init_tree=tree, particles=ps,
+              seed_tracers=False)
     if tracer_x is not None:
-        # restored trajectories replace the fresh per-cell seeding
         sim.tracer_x = tracer_x
+    elif bool(getattr(params.run, "tracer", False)) \
+            and cls._pm_family(cls._make_cfg(params)):
+        sim.tracer_x = np.zeros((0, params.ndim))
     for l, rows in rows_lv.items():
         og = tree_og[l]
         pos = tree.lookup(l, og)
@@ -380,7 +387,8 @@ class AmrSim:
 
     def __init__(self, params: Params, dtype=jnp.float32,
                  init_tree: Optional[Octree] = None,
-                 particles=None, init_dense_u=None):
+                 particles=None, init_dense_u=None,
+                 seed_tracers: bool = True):
         from ramses_tpu import patch
         patch.maybe_install_from_params(params)
         self.params = params
@@ -537,7 +545,7 @@ class AmrSim:
         # per cell at mean ``tracer_per_cell`` (fractional thinning and
         # oversampling both work) and jittered inside the cell so
         # coincident tracers don't ride identical trajectories
-        if bool(getattr(params.run, "tracer", False)):
+        if bool(getattr(params.run, "tracer", False)) and seed_tracers:
             if not self._pm_family(self.cfg):
                 import warnings
                 warnings.warn("tracer=.true. is only wired for the "

@@ -49,7 +49,7 @@ class ShardedAmrSim(AmrSim):
     def __init__(self, params: Params,
                  devices: Optional[Sequence[jax.Device]] = None,
                  dtype=jnp.float32, particles=None, init_tree=None,
-                 init_dense_u=None):
+                 init_dense_u=None, seed_tracers: bool = True):
         devices = list(devices if devices is not None else jax.devices())
         self.ndev = len(devices)
         self.mesh = Mesh(np.array(devices), ("oct",))
@@ -84,7 +84,8 @@ class ShardedAmrSim(AmrSim):
                 particles, **{f.name: put(getattr(particles, f.name))
                               for f in _dc.fields(particles)})
         super().__init__(params, dtype=dtype, particles=particles,
-                         init_tree=init_tree, init_dense_u=init_dense_u)
+                         init_tree=init_tree, init_dense_u=init_dense_u,
+                         seed_tracers=seed_tracers)
 
     def dump(self, iout: int = 1, base_dir: str = ".",
              namelist_path=None, ncpu: Optional[int] = None) -> str:

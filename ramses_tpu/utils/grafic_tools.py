@@ -1,0 +1,122 @@
+"""Grafic IC manipulation CLI — the reference's IC-surgery programs.
+
+Covers ``utils/f90/degrade_grafic.f90`` (halve the resolution),
+``extract_grafic.f90`` (cut a sub-cube), ``center_grafic.f90``
+(periodic-shift a chosen point to the box centre) and
+``split_grafic.f90``'s role of re-windowing, over every IC field
+present in a level directory (``ic_velc*``, ``ic_deltab``,
+``ic_velb*``).  All are tiny host numpy passes through
+:mod:`ramses_tpu.io.grafic`.
+
+Usage::
+
+    python -m ramses_tpu.utils.grafic_tools degrade  IN_DIR OUT_DIR
+    python -m ramses_tpu.utils.grafic_tools extract  IN_DIR OUT_DIR \
+        --origin 0 0 0 --shape 64 64 64
+    python -m ramses_tpu.utils.grafic_tools center   IN_DIR OUT_DIR \
+        --point 0.25 0.5 0.75
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+import numpy as np
+
+from ramses_tpu.io import grafic as gr
+
+
+def _each_field(indir: str):
+    for name in gr.FIELDS_DM + gr.FIELDS_BARYON:
+        p = os.path.join(indir, name)
+        if os.path.exists(p):
+            yield name, *gr.read_grafic(p)
+
+
+def degrade(indir: str, outdir: str) -> int:
+    """Halve the resolution by 2^3 block averaging
+    (``degrade_grafic.f90``)."""
+    os.makedirs(outdir, exist_ok=True)
+    nf = 0
+    for name, hdr, arr in _each_field(indir):
+        if any(s % 2 for s in arr.shape):
+            raise ValueError(f"{name}: odd dimensions {arr.shape} "
+                             "cannot degrade by 2")
+        small = arr.reshape(arr.shape[0] // 2, 2, arr.shape[1] // 2, 2,
+                            arr.shape[2] // 2, 2).mean(axis=(1, 3, 5))
+        h2 = dataclasses.replace(hdr, np1=small.shape[0],
+                                 np2=small.shape[1], np3=small.shape[2],
+                                 dx=2.0 * hdr.dx)
+        gr.write_grafic(os.path.join(outdir, name), h2,
+                        small.astype(np.float32))
+        nf += 1
+    return nf
+
+
+def extract(indir: str, outdir: str, origin, shape) -> int:
+    """Cut a sub-cube starting at cell ``origin`` with ``shape`` cells
+    (``extract_grafic.f90``); the offsets land in the header's x*o so
+    a zoom run knows where the patch sits."""
+    os.makedirs(outdir, exist_ok=True)
+    o = np.asarray(origin, dtype=int)
+    s = np.asarray(shape, dtype=int)
+    nf = 0
+    for name, hdr, arr in _each_field(indir):
+        if ((o < 0).any() or (o + s > arr.shape).any()):
+            raise ValueError(f"{name}: window {o}+{s} outside "
+                             f"{arr.shape}")
+        sub = arr[o[0]:o[0] + s[0], o[1]:o[1] + s[1], o[2]:o[2] + s[2]]
+        h2 = dataclasses.replace(
+            hdr, np1=int(s[0]), np2=int(s[1]), np3=int(s[2]),
+            x1o=hdr.x1o + float(o[0]) * hdr.dx,
+            x2o=hdr.x2o + float(o[1]) * hdr.dx,
+            x3o=hdr.x3o + float(o[2]) * hdr.dx)
+        gr.write_grafic(os.path.join(outdir, name), h2, sub)
+        nf += 1
+    return nf
+
+
+def center(indir: str, outdir: str, point) -> int:
+    """Periodic roll so box-fraction ``point`` lands at the centre
+    (``center_grafic.f90``)."""
+    os.makedirs(outdir, exist_ok=True)
+    nf = 0
+    for name, hdr, arr in _each_field(indir):
+        shift = [int(round((0.5 - p) * n)) % n
+                 for p, n in zip(point, arr.shape)]
+        gr.write_grafic(os.path.join(outdir, name), hdr,
+                        np.roll(arr, shift, axis=(0, 1, 2)))
+        nf += 1
+    return nf
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ramses_tpu.utils.grafic_tools")
+    sub = ap.add_subparsers(dest="tool", required=True)
+    for name in ("degrade", "extract", "center"):
+        p = sub.add_parser(name)
+        p.add_argument("indir")
+        p.add_argument("outdir")
+        if name == "extract":
+            p.add_argument("--origin", type=int, nargs=3,
+                           default=[0, 0, 0])
+            p.add_argument("--shape", type=int, nargs=3, required=True)
+        if name == "center":
+            p.add_argument("--point", type=float, nargs=3,
+                           default=[0.5, 0.5, 0.5])
+    args = ap.parse_args(argv)
+    if args.tool == "degrade":
+        n = degrade(args.indir, args.outdir)
+    elif args.tool == "extract":
+        n = extract(args.indir, args.outdir, args.origin, args.shape)
+    else:
+        n = center(args.indir, args.outdir, args.point)
+    print(f"{args.tool}: {n} fields -> {args.outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

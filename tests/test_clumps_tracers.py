@@ -158,3 +158,21 @@ def test_tracer_fractional_sampling():
     nleaf = sim.ncell_leaf()
     ntr = 0 if sim.tracer_x is None else len(sim.tracer_x)
     assert ntr < 0.3 * nleaf            # far below one per cell
+
+
+def test_tracer_empty_population_not_resurrected(tmp_path):
+    """A restart of a tracer run whose population is EMPTY must stay
+    empty — re-seeding would fabricate trajectories."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import load_params
+
+    p = load_params("namelists/tracer_sedov.nml", ndim=2)
+    sim = AmrSim(p, dtype=jnp.float64)
+    sim.tracer_x = np.zeros((0, 2))        # everyone escaped
+    sim.step_coarse(sim.coarse_dt())
+    out = sim.dump(1, str(tmp_path))
+    back = AmrSim.from_snapshot(p, out, dtype=jnp.float64)
+    assert back.tracer_x is not None and len(back.tracer_x) == 0
+    back.step_coarse(back.coarse_dt())     # and it still steps
