@@ -305,7 +305,7 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     if tracer_x is not None:
         sim.tracer_x = tracer_x
     elif bool(getattr(params.run, "tracer", False)) \
-            and cls._pm_family(cls._make_cfg(params)):
+            and cls._tracer_physics:
         sim.tracer_x = np.zeros((0, params.ndim))
     for l, rows in rows_lv.items():
         og = tree_og[l]
@@ -370,6 +370,10 @@ class AmrSim:
     # solver families whose state layout differs from the hydro
     # [rho, mom, E, ...] convention opt out of the shared SF/sink passes
     _pm_physics = True
+    # velocity tracers only need momentum/density at the hydro column
+    # positions — true for hydro AND MHD layouts; SRHD's (D, S) are
+    # not coordinate velocities, so RhdAmrSim opts out
+    _tracer_physics = True
 
     @staticmethod
     def _make_cfg(params: Params):
@@ -379,7 +383,7 @@ class AmrSim:
 
     @classmethod
     def _pm_family(cls, cfg) -> bool:
-        """True when SF/sinks/tracers/cooling/movie are live for this
+        """True when SF/sinks/cooling/movie are live for this
         solver family: the Newtonian hydro state layout only (MHD
         carries cell-B, SRHD stores (D,S,tau))."""
         return (getattr(cfg, "physics", "hydro") == "hydro"
@@ -546,10 +550,11 @@ class AmrSim:
         # oversampling both work) and jittered inside the cell so
         # coincident tracers don't ride identical trajectories
         if bool(getattr(params.run, "tracer", False)) and seed_tracers:
-            if not self._pm_family(self.cfg):
+            if not self._tracer_physics:
                 import warnings
-                warnings.warn("tracer=.true. is only wired for the "
-                              "hydro solver family; no tracers seeded")
+                warnings.warn("tracer=.true. needs coordinate "
+                              "velocities (hydro/MHD layouts); no "
+                              "tracers seeded for this solver family")
             else:
                 rng = np.random.default_rng(20480)
                 tpc = float(params.run.tracer_per_cell)

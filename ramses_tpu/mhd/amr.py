@@ -925,8 +925,9 @@ class MhdAmrSim(AmrSim):
                 self.fg if self.gravity else None)
         self._pm_drift(float(dt))
         self.t += float(dt)
-        # coarse-cadence source passes (for MHD only the patch 'source'
-        # hook is live — SF/sinks/tracers are _pm_physics-gated)
+        # coarse-cadence source passes (for MHD the patch 'source'
+        # hook and gas tracers are live — SF/sinks stay
+        # _pm_physics-gated)
         self._source_passes(float(dt))
         self.dt_old = float(dt)
         self.nstep += 1

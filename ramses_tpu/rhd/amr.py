@@ -29,6 +29,8 @@ from ramses_tpu.rhd.driver import rhd_region_prims
 class RhdAmrSim(AmrSim):
     """Adaptive SRHD run: region ICs, Lorentz/gradient refinement."""
 
+    _tracer_physics = False    # (D, S) are not coordinate velocities
+
     @staticmethod
     def _make_cfg(params: Params):
         return RhdStatic.from_params(params)

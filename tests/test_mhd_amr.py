@@ -391,3 +391,20 @@ def test_mhd_amr_particle_restart(tmp_path):
                                np.asarray(sim2.p.x)[o2], atol=1e-7)
     np.testing.assert_allclose(np.asarray(sim.p.v)[o1],
                                np.asarray(sim2.p.v)[o2], atol=1e-5)
+
+
+def test_mhd_amr_tracers():
+    """tracer=.true. on the MHD hierarchy: the velocity-tracer layer
+    reads the shared [rho, mom...] columns, so tracers advect with the
+    MHD gas (``pm/move_tracer.f90`` under SOLVER=mhd)."""
+    p = _tube_params(5, 6)
+    p.boundary.nboundary = 0            # periodic: population conserved
+    p.run.tracer = True
+    p.run.tracer_per_cell = 0.5
+    p.refine.err_grad_d = 0.05
+    sim = MhdAmrSim(p, dtype=jnp.float64)
+    assert sim.tracer_x is not None and len(sim.tracer_x) > 0
+    x0 = sim.tracer_x.copy()
+    sim.evolve(0.08)
+    moved = np.abs(np.asarray(sim.tracer_x) - x0)
+    assert moved.max() > 1e-4 and np.isfinite(sim.tracer_x).all()
