@@ -20,8 +20,9 @@ from ramses_tpu.io import fortran as frt
 
 
 def project(field, axis: int, kind: str = "mean", weights=None):
-    """2D map from a dense 3D (or 2D) field: mean|sum|max|slice along
-    ``axis``; mass-weighted mean when ``weights`` given."""
+    """2D map from a dense 3D (or 2D) field: mean|sum|max|min|slice
+    along ``axis`` (the reference movie shaders); mass-weighted mean
+    when ``weights`` given."""
     field = jnp.asarray(field)
     if field.ndim == 2:
         return field
@@ -33,6 +34,8 @@ def project(field, axis: int, kind: str = "mean", weights=None):
         return jnp.sum(field, axis=axis)
     if kind == "max":
         return jnp.max(field, axis=axis)
+    if kind == "min":
+        return jnp.min(field, axis=axis)
     if weights is not None:
         w = jnp.asarray(weights)
         return (jnp.sum(field * w, axis=axis)
