@@ -21,19 +21,29 @@ baseline/run_baseline.py; C++ proxy kernels of the reference's hot loops
 — no Fortran compiler exists in this image to build the reference
 itself).  Nothing here is hard-coded.
 
+Fail-soft design: the parent process never imports jax.  Each sub-bench
+runs in its own subprocess with a hard timeout; a backend hang, Mosaic
+crash, or OOM in one sub produces a structured ``{"error": ...}`` entry
+for that sub and the rest still run.  Backend-init failures and timeouts
+are retried once (tunnel hiccups are transient).  The parent ALWAYS
+prints the JSON line and exits 0.
+
 Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_MG_N, BENCH_BF16,
-BENCH_ONLY=uniform|amr|mg.
+BENCH_ONLY=uniform|amr|mg|amr_poisson, BENCH_SUB_TIMEOUT (seconds).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+MARKER = "##BENCH_SUB##"
 
 
 def _load_baseline():
@@ -227,50 +237,115 @@ def bench_mg(dtype, jnp):
     }
 
 
-def main():
+SUBS = ("uniform", "amr", "mg", "amr_poisson")
+SUB_TIMEOUTS = {"uniform": 1500, "amr": 3000, "mg": 1200, "amr_poisson": 2400}
+
+
+def run_sub_inproc(name):
+    """Child-process entry: run ONE sub-bench, print its dict after MARKER."""
     import jax
     import jax.numpy as jnp
 
     from ramses_tpu.config import load_params
 
     dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16") else jnp.float32
+    nml = os.path.join(HERE, "namelists", "sedov3d.nml")
+    if name == "uniform":
+        d = bench_uniform(load_params(nml, ndim=3), dtype, jnp)
+    elif name == "amr":
+        d = bench_amr(load_params(nml, ndim=3), dtype, jnp)
+    elif name == "mg":
+        d = bench_mg(dtype, jnp)
+    elif name == "amr_poisson":
+        d = bench_amr_poisson(load_params(nml, ndim=3), dtype, jnp)
+    else:
+        raise SystemExit(f"unknown sub-bench {name!r}")
+    d["_device"] = str(jax.devices()[0].platform)
+    d["_dtype"] = str(dtype.__name__)
+    print(MARKER + json.dumps(d), flush=True)
+
+
+def _backend_ish(msg):
+    return any(s in msg for s in (
+        "UNAVAILABLE", "Unable to initialize backend", "DEADLINE",
+        "timed out", "TimeoutExpired", "backend setup",
+        "Socket closed", "Connection reset"))
+
+
+def run_sub(name):
+    """Parent side: launch the sub-bench subprocess; retry once on
+    backend-init failures/timeouts; return the sub dict (or error)."""
+    timeout = float(os.environ.get("BENCH_SUB_TIMEOUT",
+                                   SUB_TIMEOUTS.get(name, 1800)))
+    last = None
+    for attempt in (1, 2):
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--sub", name],
+                capture_output=True, text=True, timeout=timeout, cwd=HERE)
+            for line in reversed(r.stdout.splitlines()):
+                if line.startswith(MARKER):
+                    return json.loads(line[len(MARKER):])
+            tail = (r.stderr or r.stdout or "")[-2000:]
+            last = {"error": f"sub-bench exited rc={r.returncode} "
+                             f"without result", "tail": tail,
+                    "attempt": attempt}
+            if not _backend_ish(tail):
+                return last
+        except subprocess.TimeoutExpired:
+            last = {"error": f"sub-bench timed out after {timeout:.0f}s",
+                    "attempt": attempt}
+        except Exception:
+            last = {"error": traceback.format_exc()[-2000:],
+                    "attempt": attempt}
+        if attempt == 1:
+            time.sleep(10.0)
+    return last
+
+
+def main():
     only = os.environ.get("BENCH_ONLY", "")
-    if only not in ("", "uniform", "amr", "mg", "amr_poisson"):
+    if only not in ("",) + SUBS:
         raise SystemExit(
             f"BENCH_ONLY={only!r}: expected uniform|amr|mg|amr_poisson")
-    nml = os.path.join(HERE, "namelists", "sedov3d.nml")
+    wanted = SUBS if only == "" else (only,)
 
     sub = {}
-    if only in ("", "uniform"):
-        sub["uniform"] = bench_uniform(load_params(nml, ndim=3), dtype, jnp)
-    if only in ("", "amr"):
-        sub["amr"] = bench_amr(load_params(nml, ndim=3), dtype, jnp)
-    if only in ("", "mg"):
-        sub["mg"] = bench_mg(dtype, jnp)
-    if only in ("", "amr_poisson"):
-        sub["amr_poisson"] = bench_amr_poisson(load_params(nml, ndim=3),
-                                               dtype, jnp)
+    device = dtype_name = None
+    for name in wanted:
+        sub[name] = run_sub(name)
+        device = device or sub[name].pop("_device", None)
+        dtype_name = dtype_name or sub[name].pop("_dtype", None)
+        sub[name].pop("_device", None)
+        sub[name].pop("_dtype", None)
 
     published = _load_baseline()
     base_hydro = (published.get("hydro", {})
                   .get("cell_updates_per_sec_64rank"))
     base_mg = (published.get("multigrid", {})
                .get("vcycles_per_sec_128_64rank"))
-    if "mg" in sub and base_mg:
+    if base_mg and "vcycles_per_sec" in sub.get("mg", {}):
         sub["mg"]["vs_baseline_64rank"] = (
             sub["mg"]["vcycles_per_sec"] / base_mg)
-    if "uniform" in sub and base_hydro:
+    if base_hydro and "cell_updates_per_sec" in sub.get("uniform", {}):
         sub["uniform"]["vs_baseline_64rank"] = (
             sub["uniform"]["cell_updates_per_sec"] / base_hydro)
+    if base_hydro and "steady_state" in sub.get("amr", {}):
+        sub["amr"]["steady_state"]["vs_baseline_64rank"] = (
+            sub["amr"]["steady_state"]["cell_updates_per_sec"] / base_hydro)
 
-    head = (sub.get("amr") or sub.get("uniform") or sub.get("mg")
-            or sub["amr_poisson"])
+    def ok(name):
+        d = sub.get(name)
+        return d if d and "error" not in d else None
+
+    head = (ok("amr") or ok("uniform") or ok("mg") or ok("amr_poisson")
+            or {"config": "all sub-benches failed"})
     hydro_head = "cell_updates_per_sec" in head
     value = head.get("cell_updates_per_sec",
                      head.get("vcycles_per_sec",
                               head.get("pcg_iters_per_sec")))
     vs = (value / base_hydro if base_hydro and hydro_head else
-          (value / base_mg if base_mg and not hydro_head
+          (value / base_mg if base_mg and value is not None
            and "vcycles_per_sec" in head else None))
     out = {
         "metric": (f"cell-updates/sec/chip {head['config']}" if hydro_head
@@ -283,8 +358,8 @@ def main():
                        else "pcg-iters/s")),
         "vs_baseline": vs,
         "detail": {
-            "device": str(jax.devices()[0].platform),
-            "dtype": str(dtype.__name__),
+            "device": device,
+            "dtype": dtype_name,
             "baseline": {"hydro_64rank_cell_updates_per_sec": base_hydro,
                          "mg_64rank_vcycles_per_sec": base_mg,
                          "method": published.get("method", "unpublished")},
@@ -295,4 +370,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--sub":
+        run_sub_inproc(sys.argv[2])
+    else:
+        main()

@@ -270,6 +270,7 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
         tree.set_level(l, og)
     ps = None
     tracer_x = None
+    tracer_id = None
     if parts:
         from ramses_tpu.pm.particles import (FAM_GAS_TRACER,
                                              lane_headroom)
@@ -283,6 +284,8 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
             dims = "xyz"[:params.ndim]
             tracer_x = np.stack(
                 [parts[f"position_{d}"][sel] for d in dims], axis=1)
+            tracer_id = (parts["identity"][sel].astype(np.int64)
+                         if "identity" in parts else None)
             npart = len(fam)
             parts = {k: (v[~sel] if isinstance(v, np.ndarray)
                          and len(v) == npart else v)
@@ -304,9 +307,11 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
               seed_tracers=False)
     if tracer_x is not None:
         sim.tracer_x = tracer_x
+        sim.tracer_id = tracer_id
     elif bool(getattr(params.run, "tracer", False)) \
             and cls._tracer_physics:
         sim.tracer_x = np.zeros((0, params.ndim))
+        sim.tracer_id = np.zeros(0, dtype=np.int64)
     for l, rows in rows_lv.items():
         og = tree_og[l]
         pos = tree.lookup(l, og)
@@ -490,6 +495,7 @@ class AmrSim:
                         if (self.stellar_spec.enabled
                             and self.sinks is not None) else None)
         self.tracer_x = None          # optional [ntr, ndim] host array
+        self.tracer_id = None         # stable per-tracer ids [ntr]
         # &MOVIE_PARAMS on-the-fly frames (amr/movie.f90); the frame
         # field extraction uses Newtonian hydro relations, so non-hydro
         # state layouts (MHD cell-B, SRHD (D,S,τ)) refuse loudly rather
@@ -550,6 +556,7 @@ class AmrSim:
         # oversampling both work) and jittered inside the cell so
         # coincident tracers don't ride identical trajectories
         if bool(getattr(params.run, "tracer", False)) and seed_tracers:
+            from ramses_tpu.pm.particles import TRACER_ID0
             if not self._tracer_physics:
                 import warnings
                 warnings.warn("tracer=.true. needs coordinate "
@@ -567,6 +574,14 @@ class AmrSim:
                               * self.dx(l))
                 self.tracer_x = (np.concatenate(xs)
                                  if xs and sum(map(len, xs)) else None)
+                # ids are assigned ONCE at seeding and ride through
+                # dump/restore — cross-snapshot trajectory tracking by
+                # id must survive star formation changing the live
+                # particle population.  Base 2^30 keeps them clear of
+                # the incremental star/DM id space.
+                if self.tracer_x is not None:
+                    self.tracer_id = (TRACER_ID0 + np.arange(
+                        len(self.tracer_x), dtype=np.int64))
 
         # radiative transfer on the hierarchy (rt=.true.; gray or
         # multigroup/He via &RT_PARAMS rt_ngroups/rt_y_he,

@@ -159,7 +159,9 @@ class Simulation:
                        if self.cosmo is not None else None)
             self.state.f = gravity_field(self.gspec, rho0, self.dx, fourpi0)
         elif self.pspec.enabled or self.cosmo is not None:
-            self.state.f = jnp.zeros((params.ndim,) + shape, jnp.float64)
+            fdt = (jnp.float64 if jax.config.jax_enable_x64
+                   else jnp.float32)
+            self.state.f = jnp.zeros((params.ndim,) + shape, fdt)
         if self.cosmo is not None:
             self.state.t = self.cosmo.tau_ini
             # aexp-ladder outputs: convert aout -> conformal time
@@ -346,10 +348,13 @@ class Simulation:
                 self.dx, st.t, dt_chunk, self._next_star_id)
             # f_w > 0 selects the mass-loaded kinetic wind scheme
             # (feedback.f90's f_w branch); otherwise thermal dumps
-            fb = (kinetic_feedback if self.sf_spec.f_w > 0
-                  else thermal_feedback)
-            u_np, p2 = fb(u_np, p2, self.sf_spec, self.units, self.dx,
-                          st.t)
+            if self.sf_spec.f_w > 0:
+                u_np, p2 = kinetic_feedback(u_np, p2, self.sf_spec,
+                                            self.units, self.dx, st.t,
+                                            bc=self.bc)
+            else:
+                u_np, p2 = thermal_feedback(u_np, p2, self.sf_spec,
+                                            self.units, self.dx, st.t)
             st.u = jnp.asarray(u_np, st.u.dtype)
             st.p = p2
         if self.sinks is not None:

@@ -477,10 +477,17 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
     if trc is not None and len(trc):
         # gas tracers ride the particle files as massless
         # FAM_GAS_TRACER entries (``pm/output_part.f90`` writes them
-        # in the same records) — ids beyond the real particles'
-        id0 = (int(parts["idp"].max()) if parts is not None
-               and len(parts["idp"]) else 0)
-        tb = _tracer_dict(np.asarray(trc, np.float64), id0 + 1)
+        # in the same records).  Ids are the sim's stable per-tracer
+        # ids (assigned once at seeding) so cross-snapshot trajectory
+        # tracking by id survives particle-population changes; the
+        # max-idp fallback only covers legacy sims without them.
+        ids = getattr(sim, "tracer_id", None)
+        if ids is None:
+            id0 = (int(parts["idp"].max()) if parts is not None
+                   and len(parts["idp"]) else 0)
+            ids = id0 + 1 + np.arange(len(trc))
+        tb = _tracer_dict(np.asarray(trc, np.float64),
+                          np.asarray(ids))
         parts = (tb if parts is None else
                  {k: np.concatenate([parts[k], tb[k]]) for k in parts})
     # per-level dtold/dtnew from the exact factor-2 subcycling
@@ -540,15 +547,15 @@ def write_stellar_csv(path: str, stellar) -> None:
                     f"{stellar.tlife[k]:21.10e}\n")
 
 
-def _tracer_dict(x: np.ndarray, id0: int) -> dict:
+def _tracer_dict(x: np.ndarray, ids: np.ndarray) -> dict:
     """Massless FAM_GAS_TRACER rows in the :func:`particles_dict`
-    layout for the tracer positions ``x``."""
+    layout for the tracer positions ``x`` with per-tracer ids."""
     from ramses_tpu.pm.particles import FAM_GAS_TRACER
     n = len(x)
     z = np.zeros(n)
     return dict(
         x=np.asarray(x, np.float64), v=np.zeros_like(x), m=z.copy(),
-        idp=(id0 + np.arange(n)).astype(np.int32),
+        idp=np.asarray(ids).astype(np.int32),
         level=np.full(n, 1, dtype=np.int32),
         family=np.full(n, FAM_GAS_TRACER, dtype=np.int8),
         tag=np.zeros(n, dtype=np.int8), tp=z.copy(), zp=z.copy())

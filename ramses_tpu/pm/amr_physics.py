@@ -228,11 +228,18 @@ def kinetic_feedback_amr(sim):
         vbulk = (me[:, None] * vs + msw[:, None] * vcell) \
             / np.maximum(mload[:, None], 1e-300)
         e_inj = np.zeros(len(me))
+        # Bubble targets that are refined at this level are covered by a
+        # finer oct: the next restriction sweep overwrites covered cells
+        # with son means, silently erasing any deposit.  Treat them like
+        # off-level targets (host-cell fallback) so the budget holds
+        # across refinement boundaries.
+        ref_mask = np.asarray(sim.tree.refined_mask(l))
         for k in range(nc):
             xt = xs + offs[k] * dxl
             rt = ngp_rows(sim.tree, xt, l, sim.boxlen, sim.bc_kinds)
-            r = np.where(rt >= 0, rt, r0)
-            central = np.logical_or(bool((offs[k] == 0).all()), rt < 0)
+            bad = (rt < 0) | ref_mask[np.maximum(rt, 0)]
+            r = np.where(~bad, rt, r0)
+            central = np.logical_or(bool((offs[k] == 0).all()), bad)
             mshare = mload / nc
             vk = np.where(central[:, None], vbulk,
                           vbulk + vw[:, None] * rhat[k])
@@ -459,3 +466,5 @@ def tracer_drift_amr(sim, dt: float):
         # open box: tracers leave the domain and are dropped
         keep = ((x >= 0.0) & (x < sim.boxlen)).all(axis=1)
         sim.tracer_x = x[keep]
+        if getattr(sim, "tracer_id", None) is not None:
+            sim.tracer_id = sim.tracer_id[keep]

@@ -92,7 +92,8 @@ def total_density(spec: PMSpec, u, p: Optional[pmod.ParticleSet],
                   shape, dx: float):
     """``rho_fine``: gas density + particle deposition."""
     rho = u[0] if (spec.hydro and u is not None) else \
-        jnp.zeros(shape, jnp.float64)
+        jnp.zeros(shape, jnp.float64 if jax.config.jax_enable_x64
+                  else jnp.float32)
     if spec.enabled and p is not None:
         rho = rho + deposit(spec, p, shape, dx)
     return rho

@@ -41,6 +41,9 @@ def lane_headroom(params, grows: bool):
 
 # particle families (pm/pm_commons.f90:72-96)
 FAM_GAS_TRACER = 0
+# base of the gas-tracer id space: assigned once at seeding, stable
+# across dumps, clear of the incremental star/DM id space
+TRACER_ID0 = 1 << 30
 FAM_DM = 1
 FAM_STAR = 2
 FAM_CLOUD = 3
@@ -72,7 +75,14 @@ class ParticleSet:
 
     @classmethod
     def make(cls, x, v, m, idp=None, family=None, nmax: Optional[int] = None,
-             dtype=jnp.float64) -> "ParticleSet":
+             dtype=None) -> "ParticleSet":
+        # default width follows the active x64 setting: requesting f64
+        # with x64 off would silently truncate AND emit a UserWarning
+        # per array (polluting every driver artifact)
+        if dtype is None:
+            dtype = (jnp.float64 if jax.config.jax_enable_x64
+                     else jnp.float32)
+        idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
         x = jnp.asarray(x, dtype)
         v = jnp.asarray(v, dtype)
         m = jnp.asarray(m, dtype)
@@ -84,8 +94,8 @@ class ParticleSet:
             v = jnp.pad(v, ((0, pad), (0, 0)))
             m = jnp.pad(m, ((0, pad),))
         active = jnp.arange(nmax) < n
-        idp = (jnp.pad(jnp.asarray(idp, jnp.int64), (0, pad))
-               if idp is not None else jnp.arange(1, nmax + 1, dtype=jnp.int64))
+        idp = (jnp.pad(jnp.asarray(idp, idt), (0, pad))
+               if idp is not None else jnp.arange(1, nmax + 1, dtype=idt))
         family = (jnp.pad(jnp.asarray(family, jnp.int8), (0, pad))
                   if family is not None
                   else jnp.full((nmax,), FAM_DM, jnp.int8))

@@ -245,7 +245,7 @@ def wind_shell(ndim: int):
 
 
 def kinetic_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,
-                     dx: float, t: float):
+                     dx: float, t: float, bc=None):
     """Delayed KINETIC SN winds, the mass-loaded momentum scheme
     (Dubois & Teyssier; ``pm/feedback.f90`` f_w path): each event
     sweeps ``f_w`` x the ejecta mass from the host cell and launches
@@ -302,11 +302,30 @@ def kinetic_feedback(u, p: ParticleSet, spec: SfSpec, units: Units,
     vbulk = (mej[:, None] * vstar + msw[:, None] * vcell) \
         / np.maximum(mload[:, None], 1e-300)
     e_inj = np.zeros(len(mej))
+    # bubble cells crossing a NON-periodic wall fold into the host cell
+    # with the radial kick suppressed (their wind share goes thermal via
+    # the budget line) — wrapping there would inject on the far side of
+    # the box.  Faces are per-side: the periodic side of a mixed axis
+    # still wraps (``BoundarySpec.from_params`` sets sides independently).
+    def wall(d, side):
+        return bc is not None and bc.faces[d][side].kind != 0
+
     for k in range(nc):
-        tgt = tuple(((cells[:, d] + offs[k, d]) % u.shape[1 + d])
+        raw = cells + offs[k]
+        oob = np.zeros(len(mej), dtype=bool)
+        for d in range(ndim):
+            n = u.shape[1 + d]
+            if wall(d, 0):
+                oob |= raw[:, d] < 0
+            if wall(d, 1):
+                oob |= raw[:, d] >= n
+        tgt = tuple(np.where(oob, cells[:, d],
+                             raw[:, d] % u.shape[1 + d])
                     for d in range(ndim))
+        central = np.logical_or(bool((offs[k] == 0).all()), oob)
         mshare = mload / nc
-        vk = vbulk + vw[:, None] * rhat[k]
+        vk = np.where(central[:, None], vbulk,
+                      vbulk + vw[:, None] * rhat[k])
         np.add.at(u[0], tgt, mshare / vol)
         for d in range(ndim):
             np.add.at(u[1 + d], tgt, mshare * vk[:, d] / vol)

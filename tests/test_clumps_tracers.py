@@ -176,3 +176,37 @@ def test_tracer_empty_population_not_resurrected(tmp_path):
     back = AmrSim.from_snapshot(p, out, dtype=jnp.float64)
     assert back.tracer_x is not None and len(back.tracer_x) == 0
     back.step_coarse(back.coarse_dt())     # and it still steps
+
+
+def test_tracer_ids_stable_across_dumps(tmp_path):
+    """Tracer ids are assigned ONCE at seeding (base TRACER_ID0, clear
+    of the star/DM id space) and ride identically through successive
+    dumps and a restart — cross-snapshot trajectory tracking by id must
+    survive the live particle population changing."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import load_params
+    from ramses_tpu.pm.particles import TRACER_ID0
+
+    p = load_params("namelists/tracer_sedov.nml", ndim=2)
+    sim = AmrSim(p, dtype=jnp.float64)
+    assert sim.tracer_id is not None and len(sim.tracer_id) == len(sim.tracer_x)
+    ids0 = np.array(sim.tracer_id)
+    assert ids0.min() >= TRACER_ID0
+    assert len(np.unique(ids0)) == len(ids0)
+    x0 = {i: x.copy() for i, x in zip(ids0, np.asarray(sim.tracer_x))}
+    sim.dump(1, str(tmp_path))
+    sim.evolve(1e9, nstepmax=2)
+    out2 = sim.dump(2, str(tmp_path))
+    back = AmrSim.from_snapshot(p, out2, dtype=jnp.float64)
+    assert back.tracer_id is not None
+    ids1 = np.array(back.tracer_id)
+    # the SAME id set, not a fresh numbering from max(live idp)+1
+    assert np.array_equal(np.sort(ids1), np.sort(ids0))
+    # and each id still names the same trajectory (position advected,
+    # but the id->row association is preserved through dump/restore)
+    x1 = {i: x for i, x in zip(ids1, np.asarray(back.tracer_x))}
+    xs = np.asarray(sim.tracer_x)
+    for i, xb in zip(np.array(sim.tracer_id), xs):
+        assert np.allclose(x1[i], xb)
