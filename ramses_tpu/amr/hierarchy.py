@@ -80,6 +80,9 @@ class FusedSpec(NamedTuple):
     # static cooling config; None disables the in-step cooling source
     # (``cooling_fine`` after ``godunov_fine``, amr/amr_step.f90:448-474)
     cool: Optional[object] = None
+    # per-level explicit comm schedule (SweepCommSpec or None); empty
+    # tuple = global-view GSPMD everywhere (the default)
+    comm: tuple = ()
 
 
 def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
@@ -115,6 +118,14 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
             du = K.dense_sweep(u[l], d["inv_perm"], d["perm"],
                                d["ok_dense"], dtl, dx(l),
                                (1 << l,) * cfg.ndim, spec.bspec, cfg)
+            corr = None
+        elif spec.comm and spec.comm[i] is not None:
+            # explicit per-shard schedule (shard_map + ppermute halos,
+            # deterministic owner-fold) — parallel/amr_comm.py
+            from ramses_tpu.parallel import amr_comm
+            du, unew[l - 1] = amr_comm.sweep_correct_explicit(
+                u[l], u[l - 1], unew[l - 1], d, dtl, dx(l), cfg,
+                spec.comm[i])
             corr = None
         else:
             interp = K.interp_cells(u[l - 1], d["interp_cell"],
@@ -974,13 +985,16 @@ class AmrSim:
     def _fused_spec(self) -> FusedSpec:
         if self._spec is None:
             lv = tuple(self.levels())
+            cspecs = getattr(self, "_comm_specs", {})
             self._spec = FusedSpec(
                 cfg=self.cfg, bspec=self.bspec, lmin=self.lmin,
                 boxlen=self.boxlen, levels=lv,
                 complete=tuple(self.maps[l].complete for l in lv),
                 gravity=self.gravity,
                 itype=int(self.params.refine.interpol_type),
-                cool=self.cool_spec)
+                cool=self.cool_spec,
+                comm=(tuple(cspecs.get(l) for l in lv) if cspecs
+                      else ()))
         return self._spec
 
     def _cool_bundle(self):

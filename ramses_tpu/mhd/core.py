@@ -51,13 +51,19 @@ class MhdStatic:
     def from_params(cls, p: Params) -> "MhdStatic":
         h = p.hydro
         riemann = str(h.riemann)
-        # the reference's roe/upwind 1D solvers are not implemented;
-        # substitute hlld (less diffusive than their hll fallback)
-        if riemann in ("roe", "upwind", "hydro"):
-            import warnings
-            warnings.warn(f"mhd riemann='{riemann}' not implemented; "
-                          "using hlld")
-            riemann = "hlld"
+        if riemann not in ("llf", "hll", "hlld", "roe", "upwind"):
+            # refuse-or-implement: no silent physics substitution
+            raise NotImplementedError(
+                f"mhd riemann={riemann!r}: implemented solvers are "
+                "llf|hll|hlld|roe|upwind "
+                "(reference bank: hydro/read_hydro_params.f90:184-204)")
+        r2d = str(h.riemann2d)
+        if r2d not in ("llf", "roe", "upwind", "hll", "hlla", "hlld",
+                       "average"):
+            raise NotImplementedError(
+                f"mhd riemann2d={r2d!r}: implemented corner solvers are "
+                "llf|roe|upwind|hll|hlla|hlld|average "
+                "(reference bank: hydro/read_hydro_params.f90:207-221)")
         return cls(ndim=p.ndim, npassive=p.npassive, gamma=float(h.gamma),
                    smallr=float(h.smallr), smallc=float(h.smallc),
                    slope_type=int(h.slope_type),

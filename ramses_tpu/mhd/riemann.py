@@ -68,6 +68,12 @@ def solve(ql, qr, bn, cfg: MhdStatic):
         f = hll(ql, qr, bn, cfg)
     elif cfg.riemann == "hlld":
         f = hlld(ql, qr, bn, cfg)
+    elif cfg.riemann == "roe":
+        from ramses_tpu.mhd import roe as roemod
+        f = roemod.roe(ql, qr, bn, cfg)
+    elif cfg.riemann == "upwind":
+        from ramses_tpu.mhd import roe as roemod
+        f = roemod.upwind(ql, qr, bn, cfg)
     else:
         raise NotImplementedError(f"mhd riemann={cfg.riemann}")
     if cfg.npassive:

@@ -207,6 +207,7 @@ def ct_core(up, bfp, dt, dx: Sequence[float], cfg: MhdStatic,
     # even where duplicated faces disagree across a coarse-fine seam.
     bfn = [base_faces[c] for c in range(NCOMP)]
     e_edges = {}
+    use2d = cfg.riemann2d != "average" and nd >= 2
     for d1 in range(nd):
         for d2 in range(d1 + 1, nd):
             # axes on the scalar (no component dim) EMF arrays
@@ -214,20 +215,61 @@ def ct_core(up, bfp, dt, dx: Sequence[float], cfg: MhdStatic,
             ax2 = ax_(d2, bfp[d1])
             # face EMFs: E_e on d1-faces and d2-faces
             sig = 1.0 if (d1, d2) in ((0, 1), (1, 2), (2, 0)) else -1.0
-            # F_d1(B_d2) = -sig*E_e ; F_d2(B_d1) = +sig*E_e
-            e_f1 = -sig * fluxes[d1][IBX + d2]           # at (lo d1, ctr d2)
-            e_f2 = sig * fluxes[d2][IBX + d1]            # at (ctr d1, lo d2)
-            # cell-centered reference EMF from half-step state
-            v1, v2 = q_half[1 + d1], q_half[1 + d2]
-            b1, b2 = q_half[IBX + d1], q_half[IBX + d2]
-            e_c = sig * (v2 * b1 - v1 * b2)              # E_e = -(v×B)_e
-            # Gardiner & Stone (2005) arithmetic corner average
-            e_edge = (0.5 * (e_f1 + jnp.roll(e_f1, 1, axis=ax2)
-                             + e_f2 + jnp.roll(e_f2, 1, axis=ax1))
-                      - 0.25 * (e_c + jnp.roll(e_c, 1, axis=ax1)
-                                + jnp.roll(e_c, 1, axis=ax2)
-                                + jnp.roll(jnp.roll(e_c, 1, axis=ax1),
-                                           1, axis=ax2)))
+            if use2d:
+                # 2D corner Riemann upwinding (cmp_mag_flx,
+                # mhd/umuscl.f90:1453): half-dt-evolved corner states
+                # of the four cells around each edge.  Reconstruction
+                # happens in PRIMITIVE space around the half-evolved
+                # cell state (the reference's trace does the same) — a
+                # conservative round-trip would divide momentum by the
+                # floored density when the diagonal slope sum overshoots
+                # at a strong contact, exploding the corner velocities.
+                from ramses_tpu.mhd import riemann2d as r2d
+                pfloor = cfg.smallr * cfg.smallc ** 2
+                qcorner = {}
+                for s1 in (-1.0, 1.0):
+                    for s2 in (-1.0, 1.0):
+                        qc = q_half + 0.5 * (s1 * dq[d1] + s2 * dq[d2])
+                        qc = qc.at[0].set(jnp.maximum(qc[0], cfg.smallr))
+                        qc = qc.at[IP].set(jnp.maximum(qc[IP], pfloor))
+                        qcorner[(s1, s2)] = qc
+                dorth = 3 - d1 - d2
+
+                def comp(qc, *rolls):
+                    for ax in rolls:
+                        qc = jnp.roll(qc, 1, axis=ax)
+                    return (qc[0], qc[IP], qc[1 + d1], qc[1 + d2],
+                            qc[1 + dorth], qc[IBX + dorth])
+
+                qax1, qax2 = ax_(d1, q), ax_(d2, q)
+                states = {
+                    ("R", "T"): comp(qcorner[(-1.0, -1.0)]),
+                    ("L", "T"): comp(qcorner[(1.0, -1.0)], qax1),
+                    ("R", "B"): comp(qcorner[(-1.0, 1.0)], qax2),
+                    ("L", "B"): comp(qcorner[(1.0, 1.0)], qax1, qax2),
+                }
+                A_T = bf_half[d1]
+                A_B = jnp.roll(bf_half[d1], 1, axis=ax2)
+                B_R = bf_half[d2]
+                B_L = jnp.roll(bf_half[d2], 1, axis=ax1)
+                eps = r2d.corner_emf(states, A_T, A_B, B_R, B_L, cfg)
+                e_edge = -sig * eps
+            else:
+                # F_d1(B_d2) = -sig*E_e ; F_d2(B_d1) = +sig*E_e
+                e_f1 = -sig * fluxes[d1][IBX + d2]       # (lo d1, ctr d2)
+                e_f2 = sig * fluxes[d2][IBX + d1]        # (ctr d1, lo d2)
+                # cell-centered reference EMF from half-step state
+                v1, v2 = q_half[1 + d1], q_half[1 + d2]
+                b1, b2 = q_half[IBX + d1], q_half[IBX + d2]
+                e_c = sig * (v2 * b1 - v1 * b2)          # E_e = -(v×B)_e
+                # Gardiner & Stone (2005) arithmetic corner average
+                e_edge = (0.5 * (e_f1 + jnp.roll(e_f1, 1, axis=ax2)
+                                 + e_f2 + jnp.roll(e_f2, 1, axis=ax1))
+                          - 0.25 * (e_c + jnp.roll(e_c, 1, axis=ax1)
+                                    + jnp.roll(e_c, 1, axis=ax2)
+                                    + jnp.roll(jnp.roll(e_c, 1,
+                                                        axis=ax1),
+                                               1, axis=ax2)))
             if emf_override is not None and (d1, d2) in emf_override:
                 # coarse-fine EMF matching (godunov_fine.f90:826-973):
                 # edges covered by a refined oct take the time-averaged

@@ -49,9 +49,11 @@ class ShardedAmrSim(AmrSim):
     def __init__(self, params: Params,
                  devices: Optional[Sequence[jax.Device]] = None,
                  dtype=jnp.float32, particles=None, init_tree=None,
-                 init_dense_u=None, seed_tracers: bool = True):
+                 init_dense_u=None, seed_tracers: bool = True,
+                 explicit_comm: bool = False):
         devices = list(devices if devices is not None else jax.devices())
         self.ndev = len(devices)
+        self._explicit_comm = explicit_comm and len(devices) > 1
         self.mesh = Mesh(np.array(devices), ("oct",))
         self._row_sharding = NamedSharding(self.mesh, P("oct"))
         self._row2_sharding = NamedSharding(self.mesh, P("oct", None))
@@ -103,6 +105,37 @@ class ShardedAmrSim(AmrSim):
             b += self.ndev - (b % self.ndev)
             self._pad_hist[lvl] = b
         return b
+
+    def _rebuild_maps(self, old_tree=None, old_maps=None, old_dev=None):
+        """Base maps + the explicit per-shard comm schedules (the
+        ``build_comm`` analogue, parallel/amr_comm.py) for partial
+        levels when ``explicit_comm=True``."""
+        super()._rebuild_maps(old_tree, old_maps, old_dev)
+        if not self._explicit_comm:
+            return
+        from ramses_tpu.parallel import amr_comm
+        specs = getattr(self, "_comm_specs", {})
+        self._comm_specs = {}
+        for l, m in self.maps.items():
+            if m.complete or l <= self.lmin or l - 1 not in self.maps:
+                continue
+            if "comm" in self.dev[l] and l in specs:
+                self._comm_specs[l] = specs[l]     # reused with the maps
+                continue
+            built = amr_comm.build_sweep_comm(
+                m, self.maps[l - 1], self.ndev, self.mesh,
+                int(self.params.refine.interpol_type))
+            if built is None:
+                continue
+            spec, arrays = built
+            self._comm_specs[l] = spec
+            sh = NamedSharding(self.mesh, P("oct"))
+            self.dev[l]["comm"] = {
+                k: jax.device_put(
+                    jnp.asarray(v, self.dtype if v.dtype == np.float64
+                                else None), sh)
+                for k, v in arrays.items()}
+        self._spec = None                          # comm is part of the key
 
     def _place(self, arr, kind: str):
         if kind == "rep":

@@ -233,6 +233,7 @@ def test_mhd_amr_self_gravity_collapse():
     p.init.A_region = [0.1, 0.1]           # uniform Bx threads the box
     p.init.B_region = [0.0, 0.0]
     p.init.C_region = [0.0, 0.0]
+    # runs tube_mhd.nml's riemann='roe' + the default llf corner solver
     sim = MhdAmrSim(p, dtype=jnp.float64)
     assert sim.gravity
     m0 = sim.totals()[0]
