@@ -16,3 +16,7 @@ Architecture (see README.md):
 __version__ = "0.1.0"
 
 from ramses_tpu.config import Params, load_params  # noqa: F401
+from ramses_tpu.platform import enable_compile_cache as _ecc
+
+_ecc()
+del _ecc

@@ -1325,7 +1325,11 @@ class AmrSim:
             # cap: bounds compiled-scan length AND the post-tend no-op
             # tail (masked steps still execute inside the scan)
             from ramses_tpu import patch as _patch
-            chunk = min(to_regrid, nstepmax - self.nstep, 64)
+            lim = min(to_regrid, nstepmax - self.nstep, 64)
+            # canonical power-of-two scan lengths: every (regrid-interval,
+            # nstepmax) combination decomposes into the same handful of
+            # compiled programs instead of compiling one per remainder
+            chunk = 1 << (max(lim, 1).bit_length() - 1)
             if not self.gravity and not self.pic and not verbose \
                     and self.cosmo is None and self.sinks is None \
                     and self.tracer_x is None and self.movie is None \

@@ -104,7 +104,7 @@ def _gather_uloc(u_flat, interp_vals, stencil_src, vsgn, cfg: HydroStatic):
     return ul.reshape((cfg.nvar,) + (6,) * cfg.ndim + (noct,))
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "dx"))
 def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
                 dt, dx: float, cfg: HydroStatic):
     """Full godfine1 for one level.
@@ -119,6 +119,16 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     noct = uloc.shape[-1]
     # [noct, 6^d] → [6..., noct]
     okl = ok_ref.T.reshape((6,) * ndim + (noct,))
+
+    from ramses_tpu.hydro import pallas_oct
+    if pallas_oct.available(cfg, noct, u_flat.dtype, gloc is not None):
+        # fused TPU oct-batch kernel (same physics, VMEM-resident)
+        du_k, corr_k = pallas_oct.oct_sweep(
+            uloc, okl.astype(uloc.dtype), dt, cfg, dx)
+        du_flat = jnp.transpose(
+            du_k, (ndim + 1,) + tuple(range(1, ndim + 1)) + (0,)
+        ).reshape(noct * 2 ** ndim, nvar)
+        return du_flat, jnp.transpose(corr_k, (3, 1, 2, 0))
 
     flux, tmp = _unsplit_fn(cfg)(uloc, gloc, dt, (dx,) * ndim, bcfg)
     # flux[d]: [nvar, 6..., noct], defined at the LOW face of each cell.

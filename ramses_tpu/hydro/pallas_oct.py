@@ -1,0 +1,193 @@
+"""Fused MUSCL-Hancock TPU kernel for AMR oct-stencil batches (Pallas).
+
+The partial-level sweep (``godfine1`` on an incomplete level,
+``hydro/godunov_fine.f90:486-910``) runs on gathered ``[nvar, 6,6,6,
+noct]`` stencil blocks (:func:`ramses_tpu.amr.kernels.level_sweep`).
+The XLA formulation materializes ~60 block-sized intermediates in HBM;
+at a few thousand octs that traffic — not the flops — is the whole cost,
+and on the Sedov benchmark the fine-level sweeps end up costing as much
+as the complete base level's fused kernel.  This kernel keeps every
+intermediate in VMEM: HBM sees one read of the stencil block (+ mask)
+and one write of (du, coarse-correction fluxes).
+
+Layout: the oct axis is minor (lane dimension, 128-multiple — the
+bucket padding guarantees this beyond tiny levels); the three 6-cell
+stencil axes lead.  Neighbour access is ``jnp.roll`` along the leading
+axes, wrap-around junk confined to stencil cells the 2³ interior never
+consumes — exactly the XLA path's contract.
+
+Scope (gated by :func:`available`, falls back to the XLA path
+otherwise): ndim=3 hydro, nener=npassive=0, no pressure_fix,
+scheme=muscl, slope_type∈{1,2,8}, riemann∈{llf, hllc}, f32, no
+per-cell gravity block, single device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ramses_tpu.hydro.core import HydroStatic
+from ramses_tpu.hydro.pallas_muscl import (DISABLED, _hllc_flux, _llf_flux,
+                                           _slopes)
+
+
+# Test hook: force the kernel branch on any backend, run it in Pallas
+# interpreter mode — lets CI drive level_sweep's REAL pallas branch (not
+# a replica) on the CPU test backend.  Module attribute so tests can
+# monkeypatch; also settable via env for whole-suite sweeps.
+FORCE_INTERPRET = bool(__import__("os").environ
+                       .get("RAMSES_PALLAS_OCT_INTERPRET"))
+
+
+def available(cfg: HydroStatic, noct_pad: int, dtype, has_grav: bool) -> bool:
+    """Availability gate for the oct-batch kernel (see module docstring;
+    the single-device restriction mirrors ``pallas_muscl.kernel_available``
+    — sharded levels must keep the XLA solver so GSPMD can partition)."""
+    if DISABLED or has_grav:
+        return False
+    if not FORCE_INTERPRET and (jax.default_backend() != "tpu"
+                                or jax.device_count() != 1):
+        return False
+    if getattr(cfg, "physics", "hydro") != "hydro":
+        return False
+    if cfg.ndim != 3 or cfg.nener != 0 or cfg.npassive != 0:
+        return False
+    if cfg.pressure_fix or cfg.scheme != "muscl":
+        return False
+    if cfg.slope_type not in (1, 2, 8):
+        return False
+    if cfg.riemann not in ("llf", "hllc"):
+        return False
+    if dtype not in (jnp.float32, jnp.dtype("float32")):
+        return False
+    return noct_pad % 128 == 0
+
+
+def _tile(noct_pad: int) -> int:
+    """Lane-tile size: ~45 live [6,6,6,NT] f32 arrays must fit VMEM."""
+    for nt in (512, 256, 128):
+        if noct_pad % nt == 0:
+            return nt
+    raise AssertionError("gated by available()")
+
+
+def _make_kernel(cfg: HydroStatic, dx: float):
+    """Kernel body; refs: u [5,6,6,6,NT], ok [6,6,6,NT] (state-dtype
+    0/1 refined mask), dt [1,1] SMEM → du [5,2,2,2,NT] (interior
+    update), corr [5,3,2,NT] (dt/dx-scaled boundary-face flux sums)."""
+    st = cfg.slope_type
+    theta = float(getattr(cfg, "slope_theta", 1.5))
+    solver = _llf_flux if cfg.riemann == "llf" else _hllc_flux
+    core = (slice(2, 4), slice(2, 4), slice(2, 4))
+
+    def kernel(u_ref, ok_ref, dt_ref, du_ref, corr_ref):
+        dt = dt_ref[0, 0]
+        # ---- ctoprim ----
+        r = jnp.maximum(u_ref[0], cfg.smallr)
+        ir = 1.0 / r
+        v = [u_ref[1] * ir, u_ref[2] * ir, u_ref[3] * ir]
+        ek = 0.5 * (v[0] * v[0] + v[1] * v[1] + v[2] * v[2])
+        eint = jnp.maximum(u_ref[4] * ir - ek, cfg.smalle)
+        p = (cfg.gamma - 1.0) * r * eint
+        q = (r, v[0], v[1], v[2], p)
+        # ---- uslope ----
+        dq = []
+        for d in range(3):
+            qm1 = tuple(jnp.roll(c, 1, axis=d) for c in q)
+            qp1 = tuple(jnp.roll(c, -1, axis=d) for c in q)
+            dq.append(tuple(_slopes(a, b, c, st, theta)
+                            for a, b, c in zip(qm1, q, qp1)))
+        # ---- trace3d source terms ----
+        divv = dq[0][1] + dq[1][2] + dq[2][3]
+        adv = lambda comp: (v[0] * dq[0][comp] + v[1] * dq[1][comp]
+                            + v[2] * dq[2][comp])
+        sr0 = -adv(0) - divv * r
+        sp0 = -adv(4) - divv * cfg.gamma * p
+        sv0 = [-adv(1 + j) - dq[j][4] * ir for j in range(3)]
+        dtdx2 = 0.5 * dt / dx
+        okf = ok_ref[:]
+        scale = dt / dx
+
+        du = [None] * 5
+        for d in range(3):
+            def face_state(sgn):
+                rho = r + sgn * 0.5 * dq[d][0] + sr0 * dtdx2
+                rho = jnp.where(rho < cfg.smallr, r, rho)
+                vs = [v[j] + sgn * 0.5 * dq[d][1 + j] + sv0[j] * dtdx2
+                      for j in range(3)]
+                pp = p + sgn * 0.5 * dq[d][4] + sp0 * dtdx2
+                return (rho, vs[0], vs[1], vs[2], pp)
+            qm = face_state(+1.0)
+            qp = face_state(-1.0)
+            ql5 = tuple(jnp.roll(c, 1, axis=d) for c in qm)
+            qr5 = qp
+            ql5 = (jnp.maximum(ql5[0], cfg.smallr), ql5[1], ql5[2], ql5[3],
+                   jnp.maximum(ql5[4], ql5[0] * cfg.smallp))
+            qr5 = (jnp.maximum(qr5[0], cfg.smallr), qr5[1], qr5[2], qr5[3],
+                   jnp.maximum(qr5[4], qr5[0] * cfg.smallp))
+            flux = solver(ql5, qr5, d, cfg)
+            # refined-face zeroing (godunov_fine.f90:718-747): a face is
+            # dropped when either adjacent cell is refined
+            keepf = (1.0 - okf) * (1.0 - jnp.roll(okf, 1, axis=d))
+            flux = tuple(f * keepf for f in flux)
+            # coarse-correction sums: low face idx 2 / high face idx 4,
+            # summed over the 2x2 transverse interior, ×dt/dx
+            lo_ix = tuple(2 if dd == d else slice(2, 4) for dd in range(3))
+            hi_ix = tuple(4 if dd == d else slice(2, 4) for dd in range(3))
+            for c in range(5):
+                corr_ref[c, d, 0] = flux[c][lo_ix].sum(axis=(0, 1)) * scale
+                corr_ref[c, d, 1] = flux[c][hi_ix].sum(axis=(0, 1)) * scale
+                contrib = (flux[c] - jnp.roll(flux[c], -1, axis=d)) * scale
+                du[c] = contrib if du[c] is None else du[c] + contrib
+        for c in range(5):
+            du_ref[c] = du[c][core]
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("cfg", "dx", "interpret"))
+def oct_sweep(uloc, ok, dt, cfg: HydroStatic, dx: float,
+              interpret: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused partial-level sweep on a gathered stencil batch.
+
+    uloc: [5, 6, 6, 6, N] (N = padded oct count, 128-multiple);
+    ok: [6, 6, 6, N] refined-cell mask in the state dtype (0/1).
+    Returns (du [5, 2, 2, 2, N], corr [5, 3, 2, N]) with corr already
+    ×dt/dx — the :func:`~ramses_tpu.amr.kernels.level_sweep` convention.
+    """
+    n = uloc.shape[-1]
+    nt = _tile(n)
+    dt2 = jnp.asarray(dt, uloc.dtype).reshape(1, 1)
+    kern = _make_kernel(cfg, dx)
+    interpret = interpret or FORCE_INTERPRET
+    return pl.pallas_call(
+        kern,
+        grid=(n // nt,),
+        in_specs=[
+            pl.BlockSpec((5, 6, 6, 6, nt), lambda i: (0, 0, 0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 6, 6, nt), lambda i: (0, 0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((5, 2, 2, 2, nt), lambda i: (0, 0, 0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((5, 3, 2, nt), lambda i: (0, 0, 0, i),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((5, 2, 2, 2, n), uloc.dtype),
+            jax.ShapeDtypeStruct((5, 3, 2, n), uloc.dtype),
+        ),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(uloc, ok, dt2)

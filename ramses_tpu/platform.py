@@ -19,6 +19,45 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
+def enable_compile_cache():
+    """Point JAX's persistent compilation cache at a durable directory.
+
+    The AMR growth phase recompiles its fused programs whenever a level
+    crosses a padding bucket; each TPU compile costs seconds to tens of
+    seconds while the device work itself is milliseconds (the reference
+    pays zero — Fortran compiles once at build time).  The persistent
+    cache makes every recompile after the first sighting of a shape a
+    disk hit instead.  Called from ``ramses_tpu/__init__``; disable with
+    ``RAMSES_NO_XLA_CACHE=1``, relocate with ``RAMSES_XLA_CACHE_DIR``.
+    Best-effort: a read-only filesystem must not break the solver.
+    """
+    if os.environ.get("RAMSES_NO_XLA_CACHE"):
+        return
+    # CPU-forced runs (tests, the driver's dryrun, verify checks) skip
+    # the cache: XLA:CPU executables are AOT machine code whose
+    # feature-set check warns on every load (and can in principle
+    # SIGILL), polluting driver artifacts.  TPU is where recompiles
+    # cost tens of seconds, and TPU runs never force JAX_PLATFORMS.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower().startswith("cpu"):
+        return
+    path = os.environ.get(
+        "RAMSES_XLA_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "ramses_tpu_xla"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # JAX-level executable cache only: the XLA:CPU AOT cache keys on
+        # exact host machine features and warns (worse: may SIGILL) when
+        # they drift between processes; the TPU win comes from the
+        # executable cache alone
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except Exception:
+        pass
+
+
 def force_cpu_mesh(n_devices: int):
     """Force the CPU backend with ``n_devices`` virtual devices.
 
@@ -39,6 +78,12 @@ def force_cpu_mesh(n_devices: int):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # authoritative cache kill for CPU-forced processes: package import
+    # may have enabled the persistent cache before this call (the
+    # JAX_PLATFORMS guard in enable_compile_cache only covers runs that
+    # exported the variable before importing ramses_tpu), and XLA:CPU
+    # cache entries are AOT machine code (load warnings / SIGILL risk)
+    jax.config.update("jax_compilation_cache_dir", "")
     devices = jax.devices()
     if devices[0].platform != "cpu":
         raise RuntimeError(

@@ -128,3 +128,56 @@ def test_fused_courant_matches_compute_dt():
     # (~1e-3 relative); cell_dt evaluates it per-cell in the array dtype
     # while the kernel folds it into one scalar — allow that spread
     assert got == pytest.approx(want, rel=3e-3)
+
+
+# ---------------------------------------------------------------------------
+# oct-batch kernel (pallas_oct): partial-level AMR sweeps
+# ---------------------------------------------------------------------------
+
+def _row_state(cfg, n, seed=0):
+    """[n, nvar] physically-valid random conservative rows."""
+    rng = np.random.default_rng(seed)
+    r = 1.0 + 0.3 * rng.random(n)
+    v = 0.2 * rng.standard_normal((3, n))
+    p_ = 0.5 + 0.2 * rng.random(n)
+    e = p_ / (cfg.gamma - 1.0) + 0.5 * r * (v ** 2).sum(axis=0)
+    return jnp.asarray(np.stack([r, r * v[0], r * v[1], r * v[2], e],
+                                axis=1), jnp.float32)
+
+
+@pytest.mark.parametrize("riemann", ["llf", "hllc"])
+def test_oct_sweep_matches_level_sweep(riemann, monkeypatch):
+    """Drive kernels.level_sweep itself twice — pallas branch forced on
+    (interpreter mode) vs forced off (XLA) — so the REAL production
+    dispatch is what is pinned, not a replica of it."""
+    from ramses_tpu.amr import kernels as K
+    from ramses_tpu.hydro import pallas_oct
+
+    cfg = _cfg(riemann)
+    noct, ni_pad = 128, 256
+    ncell_pad = noct * 8
+    rng = np.random.default_rng(5)
+    u_flat = _row_state(cfg, ncell_pad, seed=21)
+    interp = _row_state(cfg, ni_pad, seed=22)
+    nrows = ncell_pad + ni_pad + 1          # + trash row
+    sten = jnp.asarray(rng.integers(0, nrows, (noct, 216)), jnp.int32)
+    ok = jnp.asarray(rng.random((noct, 216)) < 0.15)
+    dt = jnp.asarray(2e-4, jnp.float32)
+    dx = 1.0 / 64
+
+    def run():
+        jax.clear_caches()                  # force a fresh branch choice
+        du, corr = K.level_sweep(u_flat, interp, sten, None, ok, None,
+                                 dt, dx, cfg)
+        return np.asarray(du), np.asarray(corr)
+
+    monkeypatch.setattr(pallas_oct, "FORCE_INTERPRET", True)
+    assert pallas_oct.available(cfg, noct, jnp.float32, False)
+    du_k, corr_k = run()
+    monkeypatch.setattr(pallas_oct, "FORCE_INTERPRET", False)
+    monkeypatch.setattr(pallas_oct, "DISABLED", True)
+    assert not pallas_oct.available(cfg, noct, jnp.float32, False)
+    du_x, corr_x = run()
+    jax.clear_caches()                      # do not leak into other tests
+    np.testing.assert_allclose(du_k, du_x, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(corr_k, corr_x, rtol=2e-5, atol=2e-6)
