@@ -83,6 +83,9 @@ class FusedSpec(NamedTuple):
     # per-level explicit comm schedule (SweepCommSpec or None); empty
     # tuple = global-view GSPMD everywhere (the default)
     comm: tuple = ()
+    # capture per-cell face mass fluxes for the MC gas tracers
+    # (godunov_fine.f90:685-715); hydro single-device path only
+    want_flux: bool = False
 
 
 def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
@@ -99,6 +102,10 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
     u = dict(u)
     unew = dict(u)
     levels = spec.levels
+    # MC-tracer flux capture: per-level [ncell, ndim, 2] signed face
+    # mass fluxes, accumulated over every substep of the coarse step
+    phi = ({l: jnp.zeros((u[l].shape[0], cfg.ndim, 2), u[l].dtype)
+            for l in levels} if spec.want_flux else None)
 
     def dx(l):
         return spec.boxlen / (1 << l)
@@ -115,9 +122,13 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
             advance(i + 1, 0.5 * dtl)
             advance(i + 1, 0.5 * dtl)
         if spec.complete[i]:
-            du = K.dense_sweep(u[l], d["inv_perm"], d["perm"],
-                               d["ok_dense"], dtl, dx(l),
-                               (1 << l,) * cfg.ndim, spec.bspec, cfg)
+            out = K.dense_sweep(u[l], d["inv_perm"], d["perm"],
+                                d["ok_dense"], dtl, dx(l),
+                                (1 << l,) * cfg.ndim, spec.bspec, cfg,
+                                ret_flux=spec.want_flux)
+            du = out[0] if spec.want_flux else out
+            if spec.want_flux:
+                phi[l] = phi[l] + out[1]
             corr = None
         elif spec.comm and spec.comm[i] is not None:
             # explicit per-shard schedule (shard_map + ppermute halos,
@@ -131,13 +142,19 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
             interp = K.interp_cells(u[l - 1], d["interp_cell"],
                                     d["interp_nb"], d["interp_sgn"], cfg,
                                     itype=spec.itype)
-            du, corr = K.level_sweep(
+            out = K.level_sweep(
                 u[l], interp, d["stencil_src"], d["vsgn"], d["ok_ref"],
-                None, dtl, dx(l), cfg)
+                None, dtl, dx(l), cfg, ret_flux=spec.want_flux)
+            du, corr = out[0], out[1]
+            if spec.want_flux:
+                phi[l] = phi[l] + out[2]
         unew[l] = unew[l] + du
         if corr is not None and l > spec.lmin:
             unew[l - 1] = K.scatter_corrections(unew[l - 1], corr,
                                                 d["corr_idx"], cfg)
+            if spec.want_flux:
+                phi[l - 1] = K.scatter_corr_flux(phi[l - 1], corr,
+                                                 d["corr_idx"], cfg)
         u[l] = unew[l]
         if spec.gravity:
             u[l] = kick_flat(u[l], fg[l], 0.5 * dtl, cfg.ndim, cfg.smallr)
@@ -157,7 +174,7 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
                                      d["son_oct"], cfg)
 
     advance(0, dt)
-    return u
+    return (u, phi) if spec.want_flux else (u, None)
 
 
 def _courant_traced(u, dev, spec: FusedSpec, fg=None):
@@ -182,10 +199,14 @@ def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
     Returning dt(u^{n+1}) from the same program is the reference's
     ``dtnew`` bookkeeping (``amr/update_time.f90``): the next coarse
     step starts without a host round-trip to evaluate CFL.
+
+    With ``spec.want_flux`` the result carries a third element: the
+    per-level MC-tracer flux capture dict.
     """
-    u = _advance_traced(u, dev, fg, dt, spec, cool_tables)
-    return u, jnp.min(_courant_traced(u, dev, spec,
-                                      fg if spec.gravity else None))
+    u, phi = _advance_traced(u, dev, fg, dt, spec, cool_tables)
+    dtn = jnp.min(_courant_traced(u, dev, spec,
+                                  fg if spec.gravity else None))
+    return (u, dtn, phi) if spec.want_flux else (u, dtn)
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -319,10 +340,12 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     if tracer_x is not None:
         sim.tracer_x = tracer_x
         sim.tracer_id = tracer_id
+        sim._spec = None               # enable the MC flux capture
     elif bool(getattr(params.run, "tracer", False)) \
             and cls._tracer_physics:
         sim.tracer_x = np.zeros((0, params.ndim))
         sim.tracer_id = np.zeros(0, dtype=np.int64)
+        sim._spec = None
     for l, rows in rows_lv.items():
         og = tree_og[l]
         pos = tree.lookup(l, og)
@@ -418,6 +441,14 @@ class AmrSim:
         spec = bmod.BoundarySpec.from_params(params)
         self.bspec = spec
         self.bc_kinds = [(f[0].kind, f[1].kind) for f in spec.faces]
+        base = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
+        if any(b != 1 for b in base):
+            # the octree keys/maps/Hilbert ordering all assume one coarse
+            # root cube; the uniform solver supports non-cubic boxes
+            raise NotImplementedError(
+                "the AMR hierarchy requires nx=ny=nz=1; non-cubic coarse "
+                f"grids (got {base}) run on the uniform solver "
+                "(levelmin=levelmax)")
         self.lmin = params.amr.levelmin
         self.lmax = params.amr.levelmax
         self.t = 0.0
@@ -520,6 +551,8 @@ class AmrSim:
             warnings.warn("&MOVIE_PARAMS is only wired for the hydro "
                           "solver family; no frames will be written")
         self._sf_rng = np.random.default_rng(1234)
+        self._tracer_rng = np.random.default_rng(20481)
+        self._tracer_phi = None        # MC flux capture of the last step
         self._next_star_id = 1
         if not self._pm_family(self.cfg):
             self.sf_spec = SfSpec(enabled=False)
@@ -576,15 +609,29 @@ class AmrSim:
             else:
                 rng = np.random.default_rng(20480)
                 tpc = float(params.run.tracer_per_cell)
-                xs = []
+                # mass-proportional seeding (``tracer_utils.f90`` init:
+                # tracers sample the GAS MASS distribution, not the
+                # leaf-cell count — a refined region must not be
+                # over-weighted 2^d-fold per level)
+                cen, mass, dxs = [], [], []
                 for l in self.levels():
-                    c = self.tree.cell_centers(l, self.boxlen)
-                    c = c[~self.tree.refined_mask(l)]
-                    rep = np.repeat(c, rng.poisson(tpc, len(c)), axis=0)
-                    xs.append(rep + rng.uniform(-0.5, 0.5, rep.shape)
-                              * self.dx(l))
-                self.tracer_x = (np.concatenate(xs)
-                                 if xs and sum(map(len, xs)) else None)
+                    m = self.maps[l]
+                    leaf = ~self.tree.refined_mask(l)
+                    c = self.tree.cell_centers(l, self.boxlen)[leaf]
+                    rho = np.asarray(
+                        self.u[l])[:m.noct * 2 ** self.cfg.ndim, 0][leaf]
+                    cen.append(c)
+                    mass.append(rho * self.dx(l) ** self.cfg.ndim)
+                    dxs.append(np.full(len(c), self.dx(l)))
+                cen = np.concatenate(cen)
+                mass = np.concatenate(mass)
+                dxs = np.concatenate(dxs)
+                lam = tpc * len(cen) * mass / max(mass.sum(), 1e-300)
+                nper = rng.poisson(lam)
+                rep = np.repeat(cen, nper, axis=0)
+                jit = rng.uniform(-0.5, 0.5, rep.shape) \
+                    * np.repeat(dxs, nper)[:, None]
+                self.tracer_x = rep + jit if len(rep) else None
                 # ids are assigned ONCE at seeding and ride through
                 # dump/restore — cross-snapshot trajectory tracking by
                 # id must survive star formation changing the live
@@ -593,6 +640,7 @@ class AmrSim:
                 if self.tracer_x is not None:
                     self.tracer_id = (TRACER_ID0 + np.arange(
                         len(self.tracer_x), dtype=np.int64))
+                    self._spec = None    # enable the MC flux capture
 
         # radiative transfer on the hierarchy (rt=.true.; gray or
         # multigroup/He via &RT_PARAMS rt_ngroups/rt_y_he,
@@ -994,7 +1042,11 @@ class AmrSim:
                 itype=int(self.params.refine.interpol_type),
                 cool=self.cool_spec,
                 comm=(tuple(cspecs.get(l) for l in lv) if cspecs
-                      else ()))
+                      else ()),
+                want_flux=(self.tracer_x is not None
+                           and getattr(self.cfg, "physics",
+                                       "hydro") == "hydro"
+                           and not cspecs))
         return self._spec
 
     def _cool_bundle(self):
@@ -1216,11 +1268,20 @@ class AmrSim:
                         haardt_madau=bool(c.haardt_madau))
                     self._cool_aexp = a
         self._grav_pm_pre(float(dt))
+        spec = self._fused_spec()
+        if spec.want_flux:
+            # density BEFORE the step: the tracer jump probability
+            # denominator (move_tracer.f90 uses the pre-step cell mass)
+            self._tracer_rho0 = {l: self.u[l][:, 0] for l in self.levels()}
         with self.timers.section("hydro - godunov"):
-            self.u, self._dt_cache = _fused_coarse_step(
+            out = _fused_coarse_step(
                 self.u, self.dev, self.fg if self.gravity else {},
-                jnp.asarray(float(dt), self.dtype), self._fused_spec(),
+                jnp.asarray(float(dt), self.dtype), spec,
                 self._cool_bundle())
+            if spec.want_flux:
+                self.u, self._dt_cache, self._tracer_phi = out
+            else:
+                self.u, self._dt_cache = out
         self._pm_drift(float(dt))
         self.t += float(dt)
         self._source_passes(float(dt))
@@ -1254,7 +1315,14 @@ class AmrSim:
                     self, self.stellar, self.stellar_spec)
         if self.tracer_x is not None:
             with self.timers.section("tracers"):
-                ap.tracer_drift_amr(self, dt)
+                if getattr(self, "_tracer_phi", None) is not None:
+                    # MC flux-probability jumps (pm/move_tracer.f90) —
+                    # the fused step captured this step's face fluxes
+                    ap.mc_tracer_amr(self)
+                else:
+                    # no flux capture on this path (MHD hierarchy,
+                    # explicit-comm sharding): velocity tracers
+                    ap.tracer_drift_amr(self, dt)
         if self.movie is not None and self.nstep % self.movie_imov == 0:
             with self.timers.section("movie"):
                 self.movie.emit_amr(self)

@@ -104,14 +104,26 @@ def _gather_uloc(u_flat, interp_vals, stencil_src, vsgn, cfg: HydroStatic):
     return ul.reshape((cfg.nvar,) + (6,) * cfg.ndim + (noct,))
 
 
-@partial(jax.jit, static_argnames=("cfg", "dx"))
+def _flat_cells(blk, ndim: int):
+    """[2..., noct] per-cell block → flat [noct*2^d] row order."""
+    noct = blk.shape[-1]
+    return jnp.transpose(
+        blk, (ndim,) + tuple(range(ndim))).reshape(noct * 2 ** ndim)
+
+
+@partial(jax.jit, static_argnames=("cfg", "dx", "ret_flux"))
 def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
-                dt, dx: float, cfg: HydroStatic):
+                dt, dx: float, cfg: HydroStatic, ret_flux: bool = False):
     """Full godfine1 for one level.
 
     Returns (du_flat [ncell, nvar], corr [noct, ndim, 2, nvar]) where
     corr[:, d, side] is the summed boundary flux (already ×dt/dx) to be
     scattered ∓/2^ndim into unrefined coarse neighbours.
+
+    ``ret_flux``: additionally return the per-cell signed mass flux
+    ``phi [ncell, ndim, 2]`` at each cell's (low, high) face — the MC
+    gas-tracer capture of ``godunov_fine.f90:685-715`` (fluxes already
+    ×dt/dx, refined faces zeroed).  Forces the XLA path.
     """
     ndim, nvar = cfg.ndim, cfg.nvar
     bcfg = dreplace(cfg, trailing_batch=True)
@@ -121,7 +133,8 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     okl = ok_ref.T.reshape((6,) * ndim + (noct,))
 
     from ramses_tpu.hydro import pallas_oct
-    if pallas_oct.available(cfg, noct, u_flat.dtype, gloc is not None):
+    if not ret_flux and pallas_oct.available(cfg, noct, u_flat.dtype,
+                                             gloc is not None):
         # fused TPU oct-batch kernel (same physics, VMEM-resident)
         du_k, corr_k = pallas_oct.oct_sweep(
             uloc, okl.astype(uloc.dtype), dt, cfg, dx)
@@ -177,12 +190,26 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
         corr.append(jnp.stack([lo, hi], axis=-1))      # [nvar, noct, 2]
     corr = jnp.stack(corr, axis=-2)                    # [nvar, noct, ndim, 2]
     corr = jnp.moveaxis(corr, 0, -1)                   # [noct, ndim, 2, nvar]
-    return du_flat, corr
+    if not ret_flux:
+        return du_flat, corr
+    # per-cell (low, high) face mass flux: cell at stencil position i
+    # along d has its low face flux at index i, high face at i+1
+    phis = []
+    for d in range(ndim):
+        f0 = fluxes[d][0]                              # [6..., noct] mass
+        lo_ix = tuple(slice(2, 4) for _ in range(ndim))
+        hi_ix = tuple(slice(3, 5) if dd == d else slice(2, 4)
+                      for dd in range(ndim))
+        phis.append(jnp.stack([_flat_cells(f0[lo_ix], ndim),
+                               _flat_cells(f0[hi_ix], ndim)], axis=-1))
+    phi = jnp.stack(phis, axis=-2)                     # [ncell, ndim, 2]
+    return du_flat, corr, phi
 
 
-@partial(jax.jit, static_argnames=("cfg", "shape", "bc", "dx"))
+@partial(jax.jit, static_argnames=("cfg", "shape", "bc", "dx", "ret_flux"))
 def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
-                shape: Tuple[int, ...], bc, cfg: HydroStatic):
+                shape: Tuple[int, ...], bc, cfg: HydroStatic,
+                ret_flux: bool = False):
     """Sweep for a COMPLETE level (covers the whole box) as a dense grid.
 
     The 6^d stencil gather duplicates each cell ~3^d times and its
@@ -190,6 +217,10 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
     neither ghost interpolation nor coarse corrections, so it runs the
     roll-based uniform kernel instead (``grid/uniform.py`` path) with
     refined-face flux zeroing.  Returns du over the flat level rows.
+
+    ``ret_flux``: additionally return ``phi [ncell, ndim, 2]`` — the
+    per-cell (low, high) face mass flux ×dt/dx in flat row order (MC
+    gas-tracer capture).  Forces the XLA path.
     """
     from ramses_tpu.grid import boundary as bmod
     from ramses_tpu.hydro import pallas_muscl as pk
@@ -200,7 +231,8 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
         ncell *= s
     ud = u_flat[inv_perm]                              # dense row order
     ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)  # [nvar, *shape]
-    if pk.kernel_available(cfg, shape, bc.faces, ud.dtype):
+    if not ret_flux and pk.kernel_available(cfg, shape, bc.faces,
+                                            ud.dtype):
         # fused TPU kernel path (same physics, VMEM-resident pipeline);
         # refined-face flux zeroing rides in as the mask input
         ok = ok_dense.reshape(shape) if ok_dense is not None else None
@@ -236,7 +268,22 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
     du_rows = jnp.moveaxis(du_dense, 0, -1).reshape(ncell, nvar)[perm]
     if u_flat.shape[0] > ncell:
         du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
-    return du_rows
+    if not ret_flux:
+        return du_rows
+    g = muscl.NGHOST
+    phis = []
+    for d in range(nd):
+        f0 = flux[d][0]                                # [*padded] mass
+        lo_ix = tuple(slice(g, g + shape[dd]) for dd in range(nd))
+        hi_ix = tuple(slice(g + 1, g + 1 + shape[dd]) if dd == d
+                      else slice(g, g + shape[dd]) for dd in range(nd))
+        phis.append(jnp.stack([f0[lo_ix].reshape(ncell),
+                               f0[hi_ix].reshape(ncell)], axis=-1))
+    phi = jnp.stack(phis, axis=-2)[perm]               # [ncell, ndim, 2]
+    if u_flat.shape[0] > ncell:
+        phi = jnp.zeros((u_flat.shape[0], nd, 2),
+                        phi.dtype).at[:ncell].set(phi)
+    return du_rows, phi
 
 
 @partial(jax.jit, static_argnames=("cfg", "shape", "bc", "err_grad",
@@ -277,6 +324,30 @@ def scatter_corrections(unew_coarse, corr, corr_idx, cfg: HydroStatic):
                     corr_idx.shape[0] * ndim)
     vals = corr.reshape(-1, cfg.nvar) * (w * sign * valid)[:, None]
     return unew_coarse.at[safe].add(vals.astype(unew_coarse.dtype))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def scatter_corr_flux(phi_coarse, corr, corr_idx, cfg: HydroStatic):
+    """Fold the fine level's boundary mass fluxes into the coarse
+    neighbours' face slots of the MC-tracer capture ``phi``.
+
+    A fine oct's faces coincide with its parent cell's faces, so the
+    low-side corr value IS the mass flux through the unrefined coarse
+    neighbour's HIGH face (and vice versa), scaled 1/2^ndim into coarse
+    Δρ units exactly like :func:`scatter_corrections`.  The coarse
+    sweep zeroed those faces (refined-adjacent), so this is the only
+    writer."""
+    ndim = cfg.ndim
+    w = 1.0 / (2 ** ndim)
+    for d in range(ndim):
+        for side, slot in ((0, 1), (1, 0)):
+            idx = corr_idx[:, d, side]
+            valid = idx >= 0
+            safe = jnp.where(valid, idx, 0)
+            vals = corr[:, d, side, 0] * w * valid
+            phi_coarse = phi_coarse.at[safe, d, slot].add(
+                vals.astype(phi_coarse.dtype))
+    return phi_coarse
 
 
 @partial(jax.jit, static_argnames=("cfg",))

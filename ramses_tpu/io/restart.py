@@ -114,10 +114,6 @@ def restore_uniform(outdir: str, params, cfg,
     ``to_cons`` overrides the hydro output→conservative conversion for
     other solver families (the SRHD pressure-Newton inverse)."""
     base = [params.amr.nx, params.amr.ny, params.amr.nz][:cfg.ndim]
-    if any(b != 1 for b in base):
-        raise NotImplementedError(
-            "snapshot restore requires nx=ny=nz=1 (single coarse cell); "
-            f"got {base}")
     lmin = params.amr.levelmin
     tree_og, u_lv, meta, parts = restore_tree_state(outdir, cfg, lmin,
                                                     to_cons=to_cons)
@@ -129,7 +125,8 @@ def restore_uniform(outdir: str, params, cfg,
     n = 1 << lmin
     offs = cell_offsets(ndim)
     cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
-    dense = np.zeros((cfg.nvar,) + (n,) * ndim)
+    dense = np.zeros((cfg.nvar,)
+                     + tuple(base[d] * n for d in range(ndim)))
     u = u_lv[lmin]                          # [ncell, nvar]
     idx = tuple(cc[:, d] for d in range(ndim))
     for iv in range(cfg.nvar):

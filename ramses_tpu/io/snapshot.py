@@ -238,6 +238,11 @@ class Snapshot:
     particles: Optional[dict] = None     # arrays: x,v,m,idp,level,family,tag
     mstar_tot: float = 0.0
     mstar_lost: float = 0.0
+    # coarse grid dimensions (&AMR_PARAMS nx, ny, nz — the reference's
+    # icoarse/jcoarse/kcoarse extents, amr/init_amr.f90:37-60); cells
+    # stay cubic with side boxlen/2^l, the domain extends to
+    # (nx, ny, nz)·boxlen
+    base: Tuple[int, ...] = (1, 1, 1)
 
     def grid_id_base(self) -> Dict[int, int]:
         base, tot = {}, 0
@@ -267,12 +272,16 @@ def _dense_to_level(dense: np.ndarray) -> np.ndarray:
     return sl
 
 
-def _full_level_og(lvl: int, ndim: int) -> np.ndarray:
-    """All oct coords of a complete level, Morton-key sorted order."""
+def _full_level_og(lvl: int, ndim: int, base=None) -> np.ndarray:
+    """All oct coords of a complete level, Morton-key sorted order.
+    ``base``: coarse-grid dims (nx, ny, nz); level-l oct extents are
+    ``base[d] * 2^(l-1)``."""
     from ramses_tpu.amr import keys as kmod
     n = 1 << (lvl - 1)
-    ax = np.arange(n, dtype=np.int64)
-    grids = np.meshgrid(*([ax] * ndim), indexing="ij")
+    if base is None:
+        base = (1,) * ndim
+    axes = [np.arange(base[d] * n, dtype=np.int64) for d in range(ndim)]
+    grids = np.meshgrid(*axes, indexing="ij")
     og = np.stack([g.ravel() for g in grids], axis=1)
     ks = kmod.encode(og, ndim)
     return og[np.argsort(ks, kind="stable")]
@@ -291,13 +300,17 @@ def _gather_cells_dense(dense: np.ndarray, og: np.ndarray,
 
 
 def uniform_levels_from_dense(dense: np.ndarray, lmin: int,
-                              ndim: int) -> Dict[int, SnapLevel]:
+                              ndim: int, base=None) -> Dict[int, SnapLevel]:
     """Scaffolded level set 1..lmin from a dense [*sp, nvar_out] array of
     already-converted output variables (scaffold values by plain mean —
-    adequate for the never-leaf coarse levels)."""
+    adequate for the never-leaf coarse levels).  ``base``: coarse-grid
+    dims for non-cubic boxes (nx, ny, nz)."""
     from ramses_tpu.amr import keys as kmod
     from ramses_tpu.amr.tree import cell_offsets
 
+    if base is None:
+        base = (1,) * ndim
+    ncoarse = int(np.prod(base))
     perm = ref_cell_perm(ndim)
     offs = cell_offsets(ndim)
     denses = {lmin: dense}
@@ -306,14 +319,14 @@ def uniform_levels_from_dense(dense: np.ndarray, lmin: int,
     id_base, tot = {}, 0
     for l in range(1, lmin + 1):
         id_base[l] = tot
-        tot += (1 << (l - 1)) ** ndim
+        tot += ncoarse * (1 << (l - 1)) ** ndim
     levels: Dict[int, SnapLevel] = {}
     for l in range(1, lmin + 1):
-        og = _full_level_og(l, ndim)
+        og = _full_level_og(l, ndim, base)
         hyd = _gather_cells_dense(denses[l], og, perm)
         if l < lmin:
             cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
-            og1 = _full_level_og(l + 1, ndim)
+            og1 = _full_level_og(l + 1, ndim, base)
             ks1 = kmod.encode(og1, ndim)
             pos = np.searchsorted(ks1, kmod.encode(cc, ndim))
             son = (id_base[l + 1] + pos + 1).astype(np.int32)
@@ -338,17 +351,13 @@ def snapshot_from_uniform(sim, iout: int = 1) -> Snapshot:
     lmin = params.amr.levelmin
     ndim = cfg.ndim
     perm = ref_cell_perm(ndim)
-    base = [params.amr.nx, params.amr.ny, params.amr.nz][:ndim]
-    if any(b != 1 for b in base):
-        raise NotImplementedError(
-            "snapshot output requires nx=ny=nz=1 (single coarse cell); "
-            f"got {base}")
+    base = tuple([params.amr.nx, params.amr.ny, params.amr.nz][:ndim])
 
     u = np.asarray(sim.state.u, dtype=np.float64)   # [nvar, *sp]
     dense = np.moveaxis(u, 0, -1)                   # [*sp, nvar]
     dense_prim = cons_to_prim_out(
         dense.reshape(-1, cfg.nvar), cfg).reshape(dense.shape)
-    levels = uniform_levels_from_dense(dense_prim, lmin, ndim)
+    levels = uniform_levels_from_dense(dense_prim, lmin, ndim, base)
 
     if getattr(sim.state, "f", None) is not None:
         f = np.asarray(sim.state.f, dtype=np.float64)    # [ndim, *sp]
@@ -373,6 +382,7 @@ def snapshot_from_uniform(sim, iout: int = 1) -> Snapshot:
         levelmin=lmin, nstep=int(sim.state.nstep),
         nstep_coarse=int(sim.state.nstep),
         tout=[params.output.tend or 0.0],
+        base=base + (1,) * (3 - ndim),
     )
     if cosmo is not None:
         snap.aexp = aexp
@@ -599,7 +609,8 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
     nlevelmax = snap.nlevelmax
     twotondim = 1 << ndim
     twondim = 2 * ndim
-    ncoarse = 1
+    base = tuple(snap.base[:ndim]) + (1,) * (3 - ndim)
+    ncoarse = int(np.prod(base))
     ngrid = snap.ngrid_total
     ngridmax = max(ngrid, 1)
     id_base = snap.grid_id_base()
@@ -628,7 +639,7 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
     with open(path, "wb") as f:
         frt.write_ints(f, ncpu)
         frt.write_ints(f, ndim)
-        frt.write_ints(f, 1, 1, 1)                       # nx, ny, nz
+        frt.write_ints(f, *base)                         # nx, ny, nz
         frt.write_ints(f, nlevelmax)
         frt.write_ints(f, ngridmax)
         frt.write_ints(f, 0)                             # nboundary
@@ -659,8 +670,21 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
         bk_max = float(2 ** min(ndim * nlevelmax, 62))
         bound_key = np.linspace(0.0, bk_max, ndomain + 1)
         frt.write_record(f, bound_key)
-        # coarse level
-        frt.write_record(f, np.asarray([1], dtype=np.int32))   # son
+        # coarse level: each coarse cell's son = the covering level-1
+        # oct's grid id (x-fastest cell order, init_amr.f90 ind layout)
+        if 1 in snap.levels and snap.levels[1].noct:
+            axes = [np.arange(base[d], dtype=np.int64)
+                    for d in range(ndim)]
+            gr = np.meshgrid(*axes, indexing="ij")
+            cc = np.stack([g.ravel() for g in gr], axis=1)
+            order = np.zeros(len(cc), dtype=np.int64)    # x-fastest
+            for d in range(ndim - 1, -1, -1):
+                order = order * base[d] + cc[:, d]
+            son_c = np.zeros(ncoarse, dtype=np.int32)
+            son_c[order] = _lookup_ids(snap.levels[1].og, cc, 0)
+        else:
+            son_c = np.zeros(ncoarse, dtype=np.int32)
+        frt.write_record(f, son_c)                        # son
         frt.write_record(f, np.zeros(ncoarse, dtype=np.int32))  # flag1
         frt.write_record(f, np.full(ncoarse, icpu, dtype=np.int32))
         # fine levels
@@ -681,7 +705,11 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
                 frt.write_record(f, (lv.og[:, d] + 0.5) * scale)
             # father cell index
             if l == 1:
-                father = np.ones(n, dtype=np.int32)
+                # the coarse cell this oct fills (x-fastest, 1-based)
+                acc = np.zeros(n, dtype=np.int64)
+                for d in range(ndim - 1, -1, -1):
+                    acc = acc * base[d] + lv.og[:, d]
+                father = (acc + 1).astype(np.int32)
             elif partial_links:
                 father = np.zeros(n, dtype=np.int32)
             else:
@@ -699,14 +727,20 @@ def write_amr_file(path: str, snap: Snapshot, iout: int,
             for idir in range(twondim):
                 d, sgn = idir // 2, (-1 if idir % 2 == 0 else 1)
                 if l == 1:
-                    frt.write_record(f, np.ones(n, dtype=np.int32))
+                    # neighbour COARSE cell index (periodic wrap)
+                    cc = lv.og.copy()
+                    cc[:, d] = np.mod(cc[:, d] + sgn, base[d])
+                    acc = np.zeros(n, dtype=np.int64)
+                    for dd in range(ndim - 1, -1, -1):
+                        acc = acc * base[dd] + cc[:, dd]
+                    frt.write_record(f, (acc + 1).astype(np.int32))
                     continue
                 if partial_links:
                     frt.write_record(f, np.zeros(n, dtype=np.int32))
                     continue
                 cc = lv.og.copy()
                 cc[:, d] += sgn
-                ncell = 1 << (l - 1)
+                ncell = base[d] << (l - 1)
                 cc[:, d] = np.mod(cc[:, d], ncell)       # periodic wrap
                 pog = cc // 2
                 coff = cc - 2 * pog
@@ -943,6 +977,12 @@ def dump_all(snap: Snapshot, iout: int, base_dir: str = ".",
     ``ncpu > 1`` writes one file set per domain (multi-domain
     checkpoint); the restore path re-concatenates any domain count onto
     any device count."""
+    if ncpu > 1 and any(b != 1 for b in snap.base):
+        # the domain split orders octs by Hilbert keys over a 2^l cube;
+        # non-cubic coarse grids need the reference's multi-root walk
+        raise NotImplementedError(
+            "multi-domain output with nx,ny,nz != 1 is unsupported "
+            f"(base={snap.base}, ncpu={ncpu})")
     outdir = os.path.join(base_dir, f"output_{iout:05d}")
     os.makedirs(outdir, exist_ok=True)
     suffix = f"{iout:05d}"

@@ -44,8 +44,10 @@ def enable_compile_cache():
         "RAMSES_XLA_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "ramses_tpu_xla"))
     try:
-        os.makedirs(path, exist_ok=True)
         import jax
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            return                 # respect the host app's own cache
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -17,10 +17,11 @@ Level semantics:
   * feedback/accretion target the particle's FINEST covering level; the
     containing cell there is a leaf by construction (a refined cell
     would imply a finer covering oct);
-  * tracers advect with the CIC-gathered gas velocity at their finest
-    covering level (the velocity-tracer scheme of ``move_tracer.f90``;
-    the flux-probability MC scheme stays uniform-grid, see
-    ``pm/tracers.py``).
+  * gas tracers use the flux-probability MC scheme on the hierarchy
+    (:func:`mc_tracer_amr`, ``pm/move_tracer.f90``) wherever the fused
+    step captures face mass fluxes (hydro family); the MHD hierarchy
+    and explicit-comm sharded runs fall back to CIC velocity tracers
+    (:func:`tracer_drift_amr`).
 """
 
 from __future__ import annotations
@@ -433,6 +434,96 @@ def sink_passes_amr(sim, dt: float):
                             tform=sinks.tform[keep], idp=sinks.idp[keep],
                             next_id=sinks.next_id)
     sim.sinks = sinks
+
+
+def mc_tracer_amr(sim):
+    """Flux-probability Monte-Carlo tracer jumps on the hierarchy
+    (``pm/move_tracer.f90``, Cadiou+ scheme): a tracer in leaf cell i
+    jumps across face f with probability (outgoing mass through f) /
+    (cell gas mass before the step), so the tracer distribution follows
+    the gas mass distribution exactly in expectation — including across
+    refinement boundaries, where the coarse face slots carry the
+    flux-correction values (``K.scatter_corr_flux``).
+
+    The fused step captured the coarse step's TOTAL face fluxes per
+    level; a level-l cell saw 2^(l-lmin) substeps, so the total
+    outgoing probability can exceed 1.  The move therefore runs
+    ``R = 2^(lmax-lmin)`` global rounds in which a level-l tracer
+    participates at its OWN substep cadence — 2^(l-lmin) moves with
+    flux/2^(l-lmin) each, like the reference's per-substep moves (per
+    move probability ≤ the CFL number).  Total host work is
+    Σ_l 2^(l-lmin)·ntracer(l), linear in the tracer count.
+    """
+    x = sim.tracer_x
+    phi_dev = sim._tracer_phi
+    sim._tracer_phi = None
+    if x is None or len(x) == 0 or phi_dev is None:
+        return
+    nd = sim.cfg.ndim
+    levels = sim.levels()
+    phi = {l: np.asarray(phi_dev[l], dtype=np.float64) for l in phi_dev}
+    rho0 = {l: np.asarray(sim._tracer_rho0[l], dtype=np.float64)
+            for l in phi}
+    rng = sim._tracer_rng
+    x = np.asarray(x, dtype=np.float64).copy()
+    periodic = all(k == 0 for pair in sim.bc_kinds for k in pair)
+    rounds = 1 << (max(levels) - sim.lmin)
+    lev = np.full(len(x), -2, dtype=np.int64)
+    row = np.full(len(x), -1, dtype=np.int64)
+    stale = np.ones(len(x), dtype=bool)        # needs (re)location
+    for r in range(rounds):
+        # level-l tracers move in rounds r ≡ 0 (mod R/2^(l-lmin))
+        active = [l for l in levels
+                  if r % (rounds >> (l - sim.lmin)) == 0]
+        if stale.any():
+            ii0 = np.nonzero(stale)[0]
+            xs = x[ii0]
+            inbox = ((xs >= 0.0) & (xs < sim.boxlen)).all(axis=1)
+            lev[ii0] = -1
+            row[ii0] = -1
+            for l in levels:
+                rr = ngp_rows(sim.tree, xs[inbox], l, sim.boxlen,
+                              sim.bc_kinds)
+                upd = rr >= 0
+                ii = ii0[np.nonzero(inbox)[0][upd]]
+                lev[ii] = l        # ascending: finest covering wins
+                row[ii] = rr[upd]
+            stale[:] = False
+        for l in active:
+            sel = lev == l
+            if not sel.any():
+                continue
+            nsub = 1 << (l - sim.lmin)
+            rows = row[sel]
+            mcell = np.maximum(rho0[l][rows], 1e-300)
+            ph = phi[l][rows]                      # [n, ndim, 2] signed
+            p = np.empty((int(sel.sum()), 2 * nd))
+            for d in range(nd):
+                p[:, 2 * d] = np.maximum(-ph[:, d, 0], 0.0)   # leave -d
+                p[:, 2 * d + 1] = np.maximum(ph[:, d, 1], 0.0)  # leave +d
+            p /= (mcell[:, None] * nsub)
+            np.clip(p, 0.0, 1.0, out=p)
+            c = np.cumsum(p, axis=1)
+            uu = rng.random(int(sel.sum()))
+            k = (uu[:, None] < c).argmax(axis=1)
+            hit = uu < c[:, -1]                    # else: stay
+            dxl = sim.dx(l)
+            step = np.zeros((int(sel.sum()), nd))
+            step[np.arange(len(k)), k // 2] = np.where(k % 2 == 1,
+                                                       dxl, -dxl)
+            step[~hit] = 0.0
+            x[sel] += step
+            moved = np.zeros(len(x), dtype=bool)
+            moved[np.nonzero(sel)[0][hit]] = True
+            stale |= moved
+        if periodic:
+            x = np.mod(x, sim.boxlen)
+    if not periodic:
+        keep = ((x >= 0.0) & (x < sim.boxlen)).all(axis=1)
+        x = x[keep]
+        if getattr(sim, "tracer_id", None) is not None:
+            sim.tracer_id = sim.tracer_id[keep]
+    sim.tracer_x = x
 
 
 def tracer_drift_amr(sim, dt: float):

@@ -428,3 +428,43 @@ def test_reference_oracle_reads_sink_csv(tmp_path, monkeypatch):
     assert data["stellars"]["nstellars"] == sim.stellar.n
     np.testing.assert_allclose(np.sort(data["stellars"]["mstellar"]),
                                np.sort(sim.stellar.m), rtol=1e-9)
+
+
+def test_noncubic_box_roundtrip(tmp_path):
+    """A 2x1x1 coarse grid round-trips snapshot -> restart (VERDICT r3
+    item 8: arbitrary coarse dims, ref amr/init_amr.f90:37-60)."""
+    from ramses_tpu.driver import Simulation
+
+    p = load_params("namelists/sedov3d.nml", ndim=3)
+    p.amr.levelmin = p.amr.levelmax = 4
+    p.amr.nx = 2
+    p.run.nstepmax = 3
+    sim = Simulation(p)
+    assert sim.grid.shape == (32, 16, 16)
+    sim.evolve()
+    out = sim.dump(iout=1, base_dir=str(tmp_path))
+    # header carries the coarse dims; level-1 oct grid is 2x1x1
+    from ramses_tpu.io import reader as rdr
+    snap = rdr.load_snapshot(out)
+    h = snap["amr"][0].header
+    assert (h["nx"], h["ny"], h["nz"]) == (2, 1, 1)
+    xg1 = snap["amr"][0].levels[1]["xg"]
+    assert len(xg1) == 2 and xg1[:, 0].max() > 1.0   # two roots along x
+    back = Simulation.from_snapshot(p, out)
+    np.testing.assert_allclose(np.asarray(back.state.u),
+                               np.asarray(sim.state.u),
+                               rtol=1e-6, atol=1e-9)
+    assert back.state.t == pytest.approx(sim.state.t)
+    # evolving the restart works (boundary wrap across the long axis)
+    back.params.run.nstepmax = back.state.nstep + 2
+    back.evolve()
+    assert np.isfinite(np.asarray(back.state.u)).all()
+
+
+def test_noncubic_box_amr_refuses():
+    p = load_params("namelists/sedov3d.nml", ndim=3)
+    p.amr.levelmin, p.amr.levelmax = 4, 5
+    p.amr.ny = 3
+    from ramses_tpu.amr.hierarchy import AmrSim
+    with pytest.raises(NotImplementedError, match="nx=ny=nz"):
+        AmrSim(p)
