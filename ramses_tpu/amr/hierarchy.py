@@ -1044,6 +1044,7 @@ class AmrSim:
                 comm=(tuple(cspecs.get(l) for l in lv) if cspecs
                       else ()),
                 want_flux=(self.tracer_x is not None
+                           and len(self.tracer_x) > 0
                            and getattr(self.cfg, "physics",
                                        "hydro") == "hydro"
                            and not cspecs))

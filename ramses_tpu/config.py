@@ -211,6 +211,15 @@ class RtParams:
     rt_egy_bounds: List[float] = field(default_factory=list)
     rt_src_pos: List[float] = field(default_factory=lambda: [0.5, 0.5, 0.5])
     rt_ndot: float = 0.0              # source photons/s (0: no source)
+    # stellar SED tables (rt/rt_spectra.f90): directory holding
+    # metallicity_bins.dat / age_bins.dat / all_seds.dat; empty →
+    # RAMSES_SED_DIR env, else the blackbody SED above
+    sed_dir: str = ""
+    sedprops_update: int = 5          # group-prop refresh cadence (steps)
+    rt_esc_frac: float = 1.0          # stellar photon escape fraction
+    # homogeneous UV background inside the RT chemistry
+    # (rt_UV_hom; amplitude from &COOLING_PARAMS J21/a_spec/z_reion)
+    rt_uv_hom: bool = False
 
 
 @dataclass

@@ -174,6 +174,19 @@ def _sigma_HeII(nu):
     return np.where(x >= 1.0, 1.58e-18 * x ** -3.0, 0.0)
 
 
+def uv_amplitude(aexp: float, J21: float, z_reion: float = 8.5,
+                 haardt_madau: bool = False) -> float:
+    """Effective J21 amplitude at this epoch: zero before reionization,
+    then flat, or the HM-style (1+z)^0.73·exp decline toward z=0
+    (shared by the equilibrium-cooling tables and the RT chemistry's
+    homogeneous UV background, ``rt_UV_hom``)."""
+    z = 1.0 / max(aexp, 1e-10) - 1.0
+    if z >= z_reion:
+        return 0.0
+    return J21 * ((1 + z) ** 0.73 * np.exp(-((1 + z) / 9.0) ** 2)
+                  if haardt_madau else 1.0)
+
+
 def uv_rates(J21: float, alpha: float):
     """(photoionization [1/s], photoheating [erg/s]) per species for the
     power-law background; numerical quadrature over the spectrum."""
@@ -264,13 +277,7 @@ def build_tables(aexp: float = 1.0, J21: float = 0.0,
     nH = 10.0 ** log_nH[:, None]                     # [N, 1]
     T2 = 10.0 ** log_T2[None, :]                     # [1, T]
 
-    # UV amplitude at this redshift: flat until reionization, smoothly
-    # ramped on; HM-style (1+z)^0.73 exp decline toward z=0
-    if z >= z_reion:
-        J_eff = 0.0
-    else:
-        J_eff = J21 * ((1 + z) ** 0.73 * np.exp(-((1 + z) / 9.0) ** 2)
-                       if haardt_madau else 1.0)
+    J_eff = uv_amplitude(aexp, J21, z_reion, haardt_madau)
     gamma_uv, heat_uv = uv_rates(J_eff, a_spec) if J_eff > 0 else ({}, {})
 
     # solve T = T2 * mu self-consistently (mu depends on ionization)

@@ -114,6 +114,14 @@ def restore_uniform(outdir: str, params, cfg,
     ``to_cons`` overrides the hydro output→conservative conversion for
     other solver families (the SRHD pressure-Newton inverse)."""
     base = [params.amr.nx, params.amr.ny, params.amr.nz][:cfg.ndim]
+    if any(b != 1 for b in base) \
+            and getattr(cfg, "physics", "hydro") != "hydro":
+        # non-cubic support is end-to-end for the hydro family only;
+        # the SRHD/MHD drivers build cubic grids (their constructors
+        # refuse too — this keeps the restore path equally loud)
+        raise NotImplementedError(
+            "snapshot restore with nx,ny,nz != 1 is hydro-only "
+            f"(got {base})")
     lmin = params.amr.levelmin
     tree_og, u_lv, meta, parts = restore_tree_state(outdir, cfg, lmin,
                                                     to_cons=to_cons)

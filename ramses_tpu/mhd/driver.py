@@ -90,6 +90,12 @@ class MhdSimulation:
     def __init__(self, params: Params, dtype=jnp.float64):
         self.params = params
         self.cfg = MhdStatic.from_params(params)
+        base = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
+        if any(b != 1 for b in base):
+            # this solver family builds cubic grids; only the hydro
+            # uniform driver supports non-cubic coarse boxes
+            raise NotImplementedError(
+                f"MHD requires nx=ny=nz=1 (got {base})")
         lmin = params.amr.levelmin
         n = 2 ** lmin
         shape = tuple([n] * params.ndim)

@@ -85,7 +85,7 @@ def force_cpu_mesh(n_devices: int):
     # JAX_PLATFORMS guard in enable_compile_cache only covers runs that
     # exported the variable before importing ramses_tpu), and XLA:CPU
     # cache entries are AOT machine code (load warnings / SIGILL risk)
-    jax.config.update("jax_compilation_cache_dir", "")
+    jax.config.update("jax_compilation_cache_dir", None)
     devices = jax.devices()
     if devices[0].platform != "cpu":
         raise RuntimeError(

@@ -65,6 +65,12 @@ class RhdSimulation:
     def __init__(self, params: Params, dtype=jnp.float64):
         self.params = params
         self.cfg = RhdStatic.from_params(params)
+        base = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
+        if any(b != 1 for b in base):
+            # this solver family builds cubic grids; only the hydro
+            # uniform driver supports non-cubic coarse boxes
+            raise NotImplementedError(
+                f"SRHD requires nx=ny=nz=1 (got {base})")
         n = 2 ** params.amr.levelmin
         shape = tuple([n] * params.ndim)
         self.dx = params.amr.boxlen / n

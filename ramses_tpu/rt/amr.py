@@ -133,6 +133,77 @@ class RtAmrCoupled:
             self._src_info = (lsrc, row, float(r.rt_ndot) / vol_cgs)
         else:
             self._src_info = None
+        # stellar SED tables (rt/rt_spectra.f90): star particles become
+        # photon sources with age/metallicity-dependent rates, and the
+        # population refreshes the chemistry's group cross-sections
+        import os as _os
+        self.sed = None
+        if r.sed_dir or _os.environ.get("RAMSES_SED_DIR"):
+            from ramses_tpu.rt.sed import SedTables, read_sed_dir
+            g3 = spec.groups3
+            bounds = [g.e_lo for g in g3] + [g3[-1].e_hi]
+            self.sed = SedTables(read_sed_dir(r.sed_dir), bounds)
+        self._esc = float(getattr(r, "rt_esc_frac", 1.0))
+        self._sed_update = max(1, int(getattr(r, "sedprops_update", 5)))
+        self._sed_count = 0
+        self._star_src = {}
+        # homogeneous UV background (rt_UV_hom): amplitude follows the
+        # cooling module's J21/a_spec/z_reion epoch dependence
+        self.uv_on = bool(getattr(r, "rt_uv_hom", False))
+        self._uv = None
+
+    def _refresh_stellar_sources(self, sim):
+        """Rebuild per-level stellar injection lists from the SED tables
+        and, at the ``sedprops_update`` cadence, refresh the chemistry's
+        group properties to the population's photon-rate-weighted
+        average (``rt_spectra.f90`` star_RT_feedback +
+        update_SED_group_props roles)."""
+        self._star_src = {}
+        if self.sed is None or sim.p is None:
+            return
+        from ramses_tpu.pm.amr_pm import assign_levels
+        from ramses_tpu.pm.amr_physics import ngp_rows
+        from ramses_tpu.pm.particles import FAM_STAR
+        from ramses_tpu.pm.star_formation import M_SUN
+        p = sim.p
+        sel = np.asarray((p.family == FAM_STAR) & p.active)
+        if not sel.any():
+            return
+        un = self.un
+        GYR = 3.15576e16
+        age_gyr = np.maximum(
+            (sim.t - np.asarray(p.tp)[sel]) * un.scale_t / GYR, 0.0)
+        zmet = np.asarray(p.zp)[sel]
+        m_sun = np.asarray(p.m)[sel] * un.scale_d * un.scale_l ** 3 \
+            / M_SUN
+        rates = self.sed.star_rates(age_gyr, zmet, m_sun) * self._esc
+        pos = np.asarray(p.x)[sel]
+        levs = assign_levels(sim.tree, pos, sim.boxlen)
+        for l in sim.levels():
+            at_l = levs == l
+            if not at_l.any():
+                continue
+            rows = ngp_rows(sim.tree, pos[at_l], l, sim.boxlen,
+                            sim.bc_kinds)
+            ok = rows >= 0
+            if not ok.any():
+                continue
+            vol = (sim.dx(l) * un.scale_l) ** self.nd
+            self._star_src[l] = (jnp.asarray(rows[ok]),
+                                 jnp.asarray(rates[at_l][ok] / vol))
+        if self._sed_count % self._sed_update == 0:
+            import dataclasses
+            g3 = self.sed.population_groups(age_gyr, zmet, m_sun)
+            if self.full3:
+                self.spec = dataclasses.replace(self.spec, groups3=g3)
+            else:
+                # gray chemistry consumes spec.group, not groups3
+                from ramses_tpu.rt.chem import GroupSpec
+                self.spec = dataclasses.replace(
+                    self.spec, groups3=g3,
+                    group=GroupSpec(sigma=g3[0].sigmaN[0],
+                                    e_photon=g3[0].e_photon))
+        self._sed_count += 1
 
     def _fresh_rad(self, ncp: int) -> np.ndarray:
         """Vacuum radiation rows [ncp, ng*(1+nd)]."""
@@ -196,6 +267,22 @@ class RtAmrCoupled:
         dt_c = m1.rt_courant_dt(dx_min_cgs, spec.c_red, spec.courant)
         nsub = max(1, int(np.ceil(dt_cgs / dt_c)))
         dt_sub = dt_cgs / nsub
+        self._refresh_stellar_sources(sim)
+        spec = self.spec              # groups3 may have been refreshed
+        if self.uv_on:
+            from ramses_tpu.hydro.cooling import uv_amplitude, uv_rates
+            c = self.params.cooling
+            aexp = sim.aexp_now() if sim.cosmo is not None else 1.0
+            J = uv_amplitude(aexp, float(c.J21), float(c.z_reion),
+                             bool(c.haardt_madau))
+            if J > 0.0:
+                g, h = uv_rates(J, float(c.a_spec))
+                self._uv = ((g.get("HI", 0.0), g.get("HeI", 0.0),
+                             g.get("HeII", 0.0)),
+                            (h.get("HI", 0.0), h.get("HeI", 0.0),
+                             h.get("HeII", 0.0)))
+            else:
+                self._uv = None
 
         nT = {l: self._gas_nT(sim, l) for l in sim.levels()}
         T = {l: nT[l][1] for l in sim.levels()}
@@ -215,6 +302,16 @@ class RtAmrCoupled:
                 else:
                     self.rad[lsrc] = self.rad[lsrc].at[row, 0].add(
                         dt_sub * rate)
+            # stellar sources (SED tables: per-star per-group rates)
+            for l, (rows, dens) in self._star_src.items():
+                rad = self.rad[l]
+                if self.full3:
+                    for g in range(ng):
+                        rad = rad.at[rows, self._ncol(g)].add(
+                            dt_sub * dens[:, g])
+                else:
+                    rad = rad.at[rows, 0].add(dt_sub * dens.sum(axis=1))
+                self.rad[l] = rad
             # transport, coarse→fine (every group; one gather moves
             # all group blocks, the GLF update runs per group)
             for l in sim.levels():
@@ -280,7 +377,7 @@ class RtAmrCoupled:
                         Ns, (self.xion[l], self.xhe[l][:, 0],
                              self.xhe[l][:, 1]), T[l], nH, nHe,
                         dt_sub, spec.c_red, spec.groups3, spec.otsa,
-                        heating=spec.heating)
+                        heating=spec.heating, uv=self._uv)
                     rad = self.rad[l]
                     for g in range(ng):
                         rad = rad.at[:, self._ncol(g)].set(Ns[g])
@@ -290,7 +387,7 @@ class RtAmrCoupled:
                     N, x, Tn = chem_mod.chem_step(
                         self.rad[l][:, 0], self.xion[l], T[l], nH,
                         dt_sub, spec.c_red, spec.group, spec.otsa,
-                        heating=spec.heating)
+                        heating=spec.heating, uv=self._uv)
                     self.rad[l] = self.rad[l].at[:, 0].set(N)
                 self.xion[l] = x
                 T[l] = Tn

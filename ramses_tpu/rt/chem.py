@@ -91,13 +91,15 @@ E_ION = tuple(e * EV for e in ION_EV)
 
 def chem_step_3ion(Ns, xs, T, nH, nHe, dt, c_red, groups,
                    otsa: bool = True, niter: int = 5,
-                   heating: bool = True):
+                   heating: bool = True, uv=None):
     """Multigroup, 3-ion (HII, HeII, HeIII) implicit chemistry substep —
     the ``rt_cooling_module.f90`` system with helium.
 
     ``Ns``: list of per-group photon densities; ``xs`` = (xHII, xHeII,
     xHeIII) fractional abundances (of H and He respectively); ``groups``:
-    :class:`ramses_tpu.rt.spectra.Group3` tuple.  Returns (Ns', xs', T').
+    :class:`ramses_tpu.rt.spectra.Group3` tuple.  ``uv``: optional
+    homogeneous UV background (``rt_UV_hom``) as (gamma[3] 1/s,
+    heat[3] erg/s) per HI/HeI/HeII atom.  Returns (Ns', xs', T').
     """
     xH0, xHe20, xHe30 = [jnp.clip(x, 1e-10, 1.0 - 1e-10) for x in xs]
     xH, xHe2, xHe3 = xH0, xHe20, xHe30
@@ -124,6 +126,9 @@ def chem_step_3ion(Ns, xs, T, nH, nHe, dt, c_red, groups,
             N_new.append(Np)
             for sp in range(3):
                 Gam[sp] = Gam[sp] + c_red * g.sigmaN[sp] * Np
+        if uv is not None:
+            for sp in range(3):
+                Gam[sp] = Gam[sp] + uv[0][sp]
         # H: (Γ + β ne)(1-x) = α ne x — implicit from the FIXED initial
         # state, rates refined at the current guess (see chem_step)
         creH = Gam[0] + beta_ci(T) * ne
@@ -160,6 +165,9 @@ def chem_step_3ion(Ns, xs, T, nH, nHe, dt, c_red, groups,
                 heat = heat + absorbed * frac[sp] * jnp.maximum(
                     g.e_photon - E_ION[sp], 0.0)
     if heating:
+        if uv is not None:
+            heat = heat + (uv[1][0] * nHI + uv[1][1] * nHeI
+                           + uv[1][2] * nHeII)
         cool = (cool_rec_B(T) * ne * nH * xH
                 + 1.55e-26 * T ** 0.3647 * ne * nHeII)   # He+ rec (Cen92)
         ntot = nH * (1.0 + xH) + nHe * (1.0 + xHe2 + 2.0 * xHe3)
@@ -169,7 +177,8 @@ def chem_step_3ion(Ns, xs, T, nH, nHe, dt, c_red, groups,
 
 
 def chem_step(N, xHII, T, nH, dt, c_red, group: GroupSpec,
-              otsa: bool = True, niter: int = 5, heating: bool = True):
+              otsa: bool = True, niter: int = 5, heating: bool = True,
+              uv=None):
     """One implicitly-coupled chemistry substep.  Returns (N', x', T').
 
     Sequential implicit sweep (the reference's cell-wise iteration,
@@ -188,6 +197,8 @@ def chem_step(N, xHII, T, nH, dt, c_red, group: GroupSpec,
         # implicit absorption at fixed nHI
         N_new = N / (1.0 + dt * c_red * group.sigma * nHI)
         gamma = c_red * group.sigma * N_new         # photoionizations/s/atom
+        if uv is not None:
+            gamma = gamma + uv[0][0]
         ne = nH * x
         cre = gamma + beta_ci(T) * ne
         dst = alpha * ne
@@ -202,6 +213,8 @@ def chem_step(N, xHII, T, nH, dt, c_red, group: GroupSpec,
     if heating:
         ne = nH * x
         heat = absorbed / dt * (group.e_photon - E_ION_HI)
+        if uv is not None:
+            heat = heat + uv[1][0] * nHI
         cool = cool_rec_B(T) * ne * nH * x
         ntot = nH * (1.0 + x)                        # H + electrons
         dT = dt * (heat - cool) / (1.5 * kB * jnp.maximum(ntot, 1e-30))

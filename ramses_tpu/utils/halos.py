@@ -55,7 +55,8 @@ def load_particles(outdir: str):
 def catalogue_output(outdir: str, nx: int = 64,
                      threshold_over_mean: float = 5.0,
                      relevance: float = 1.5, G: float = 1.0,
-                     npart_min: int = 10, unbind: bool = True):
+                     npart_min: int = 10, unbind: bool = True,
+                     saddle_pot: bool = False, nmassbins: int = 0):
     """Full chain on one output: deposit → watershed → unbind.
     Returns (halos, t)."""
     x, v, m, ids, boxlen, t = load_particles(outdir)
@@ -69,7 +70,9 @@ def catalogue_output(outdir: str, nx: int = 64,
     labels, _ = find_clumps(rho, thr, relevance=relevance, dx=dx)
     pl = particle_labels(x, labels, dx, boxlen)
     return build_catalogue(x, v, m, ids, pl, boxlen, G=G,
-                           unbind=unbind, npart_min=npart_min), t
+                           unbind=unbind, npart_min=npart_min,
+                           saddle_pot=saddle_pot,
+                           nmassbins=nmassbins), t
 
 
 def main(argv=None) -> int:
@@ -81,17 +84,31 @@ def main(argv=None) -> int:
     ap.add_argument("--relevance", type=float, default=1.5)
     ap.add_argument("--npart-min", type=int, default=10)
     ap.add_argument("--no-unbind", action="store_true")
+    ap.add_argument("--saddle-pot", action="store_true",
+                    help="reference binding energies to the clump "
+                         "boundary potential (unbinding.f90 saddle_pot)")
+    ap.add_argument("--nmassbins", type=int, default=0,
+                    help="binned mass-profile potential with N radial "
+                         "bins (0 = exact per-particle monopole)")
+    ap.add_argument("--nmost-bound", type=int, default=0,
+                    help="merger-tree tracers per halo (0 = all bound; "
+                         "merger_tree.f90 nmost_bound)")
+    ap.add_argument("--max-gap", type=int, default=2,
+                    help="snapshots a vanished progenitor stays "
+                         "linkable across (merger_tree.f90 jumpers)")
     ap.add_argument("--tree", default=None,
                     help="merger-tree table path (needs >=2 outputs)")
     args = ap.parse_args(argv)
 
-    tree = MergerTree()
+    tree = MergerTree(max_gap=args.max_gap,
+                      nmost_bound=args.nmost_bound)
     for outdir in args.outdirs:
         halos, t = catalogue_output(
             outdir, nx=args.nx,
             threshold_over_mean=args.threshold_over_mean,
             relevance=args.relevance, npart_min=args.npart_min,
-            unbind=not args.no_unbind)
+            unbind=not args.no_unbind, saddle_pot=args.saddle_pot,
+            nmassbins=args.nmassbins)
         table = os.path.join(outdir, "halos.txt")
         write_halo_table(halos, table)
         print(f"{outdir}: {len(halos)} halos -> {table}"

@@ -18,3 +18,19 @@ force_cpu_mesh(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables after each test module.
+
+    A full-suite run accumulates thousands of XLA:CPU executables in
+    one process; past ~130 tests the NEXT compile can segfault inside
+    ``backend_compile_and_load`` (reproduced twice at the same test).
+    Per-module cache clearing bounds the in-process compiler state;
+    each module recompiles its own programs anyway.
+    """
+    yield
+    jax.clear_caches()

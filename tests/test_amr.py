@@ -116,6 +116,7 @@ def _full_tree(ndim, lmin, lmax):
     return t
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("ndim", [1, 2])
 def test_fully_refined_matches_uniform(ndim):
     """Two-level hierarchy, everything refined: leaf level must evolve

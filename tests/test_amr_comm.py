@@ -64,6 +64,7 @@ def test_explicit_comm_builds_schedules():
         assert len(spec.fine_offsets) <= sim.ndev - 1
 
 
+@pytest.mark.smoke
 def test_explicit_comm_matches_gspmd():
     """Same tree, same dt sequence: the explicit ppermute schedule and
     the compiler-inserted collectives integrate the same physics."""

@@ -17,6 +17,9 @@ from ramses_tpu.hydro.eos import barotropic_eos_temperature
 from ramses_tpu.units import X_frac, kB
 
 
+
+pytestmark = pytest.mark.smoke
+
 @pytest.fixture(scope="module")
 def tables():
     return cm.build_tables(aexp=1.0, J21=0.0)

@@ -147,8 +147,10 @@ def test_halo_cli_on_snapshots(tmp_path):
                                     / "halos.txt"))
     assert rows.shape[0] >= 1 and rows[0, 1] >= 200   # blob captured
     tl = np.atleast_2d(np.loadtxt(tree))
-    assert tl.shape[0] >= 1 and tl[0, 3] >= 200       # shared tracers
-    assert tl[0, 4] == 1                              # main progenitor
+    # columns: snap desc prog_snap prog shared frac main
+    assert tl.shape[0] >= 1 and tl[0, 4] >= 200       # shared tracers
+    assert tl[0, 6] == 1                              # main progenitor
+    assert tl[0, 5] > 0.5                             # progenitor frac
 
 
 def test_halo_table_roundtrip(tmp_path):
@@ -162,3 +164,108 @@ def test_halo_table_roundtrip(tmp_path):
     rows = np.atleast_2d(rows)
     assert rows.shape[0] == len(halos)
     np.testing.assert_allclose(rows[0, 2], halos[0].mass, rtol=1e-5)
+
+
+@pytest.mark.smoke
+def test_unbinding_option_set():
+    """Reference unbinding options: the binned mass-profile potential
+    tracks the exact one, and saddle_pot strips borderline members."""
+    from ramses_tpu.pm.halo import unbind_clump
+    rng = np.random.default_rng(9)
+    n = 400
+    x = 0.5 + rng.normal(0, 0.01, (n, 3))
+    m = np.ones(n)
+    # virial-ish speeds, plus a shell of marginal members
+    v = rng.normal(0, 0.5, (n, 3))
+    c = np.full(3, 0.5)
+    b_exact = unbind_clump(x, v, m, c, 1.0, G=1.0)
+    b_binned = unbind_clump(x, v, m, c, 1.0, G=1.0, nmassbins=25)
+    # the binned potential is an approximation: memberships agree on
+    # the overwhelming majority
+    assert (b_exact == b_binned).mean() > 0.95
+    b_saddle = unbind_clump(x, v, m, c, 1.0, G=1.0, saddle_pot=True)
+    # referencing energies to the boundary potential is strictly
+    # harsher than referencing to infinity
+    assert b_saddle.sum() < b_exact.sum()
+    assert not np.any(b_saddle & ~b_exact)
+
+
+@pytest.mark.smoke
+def test_merger_history_three_snapshots():
+    """PHEW + unbinding + tree reproduce a hand-checkable history:
+    halos A and B merge (A the main progenitor), halo D drops out of
+    one catalogue and re-links across the gap (merger_tree.f90
+    jumpers)."""
+    from ramses_tpu.pm.clumps import find_clumps
+    from ramses_tpu.pm.halo import (MergerTree, build_catalogue,
+                                    particle_labels)
+
+    rng = np.random.default_rng(4)
+    n = 64
+    dx = 1.0 / n
+
+    def blob(center, npart, id0, sigma=0.01):
+        x = np.mod(rng.normal(center, sigma, (npart, 3)), 1.0)
+        return x, id0 + np.arange(npart)
+
+    def catalogue(blobs):
+        xs = np.concatenate([b[0] for b in blobs])
+        ids = np.concatenate([b[1] for b in blobs])
+        rho, _ = np.histogramdd(xs, bins=(n,) * 3,
+                                range=[(0.0, 1.0)] * 3)
+        labels, _ = find_clumps(rho, threshold=3.0, dx=dx)
+        pl = particle_labels(xs, np.asarray(labels), dx, 1.0)
+        return build_catalogue(xs, np.zeros_like(xs), np.ones(len(xs)),
+                               ids, pl, 1.0, npart_min=20)
+
+    A1 = blob([0.3, 0.5, 0.5], 500, 0)
+    B1 = blob([0.7, 0.5, 0.5], 250, 1000)
+    D1 = blob([0.5, 0.15, 0.5], 80, 2000)
+    h1 = catalogue([A1, B1, D1])
+    assert len(h1) == 3
+    A, B, D = h1[0], h1[1], h1[2]          # heaviest first
+    # watershed labels only above-threshold cells: the blob cores
+    assert 350 <= A.npart <= 500 and 150 <= B.npart <= 250
+    assert 40 <= D.npart <= 80
+
+    # snapshot 2: A and B merged at the centre; D dispersed (gone)
+    AB2 = (np.mod(rng.normal([0.5, 0.5, 0.5], 0.012, (750, 3)), 1.0),
+           np.concatenate([A1[1], B1[1]]))
+    Dgone = (np.mod(rng.normal([0.85, 0.85, 0.85], 0.15, (80, 3)), 1.0),
+             D1[1])
+    h2 = catalogue([AB2, Dgone])
+    assert len(h2) == 1                    # D fell below threshold
+    M2 = h2[0]
+
+    # snapshot 3: the merged halo persists; D reassembles
+    AB3 = (np.mod(rng.normal([0.52, 0.5, 0.5], 0.012, (750, 3)), 1.0),
+           AB2[1])
+    D3 = blob([0.5, 0.15, 0.5], 80, 2000)
+    h3 = catalogue([AB3, D3])
+    assert len(h3) == 2
+    M3, Dre = h3[0], h3[1]
+
+    tree = MergerTree(max_gap=2)
+    tree.add_snapshot(0.0, h1)
+    tree.add_snapshot(1.0, h2)
+    tree.add_snapshot(2.0, h3)
+
+    # snapshot 2: the merged halo's main progenitor is A (heavier),
+    # B is a non-main progenitor; both contributed ~all their tracers
+    links2 = tree.progenitors(1, M2.index)
+    byprog = {l.prog: l for l in links2}
+    assert byprog[A.index].main and not byprog[B.index].main
+    assert byprog[A.index].frac > 0.8 and byprog[B.index].frac > 0.8
+
+    # snapshot 3: reborn D links ACROSS THE GAP to snapshot-0 D
+    linksD = tree.progenitors(2, Dre.index)
+    assert len(linksD) >= 1
+    gap = [l for l in linksD if l.main][0]
+    assert gap.snap_prog == 0 and gap.prog == D.index
+    assert gap.frac > 0.5
+
+    # the main branch of the final big halo walks back through the
+    # merger to A
+    assert tree.main_branch(2, M3.index) == [(2, M3.index),
+                                             (1, M2.index),
+                                             (0, A.index)]

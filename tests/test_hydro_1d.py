@@ -52,6 +52,9 @@ riemann='{riemann}'
 """
 
 
+
+pytestmark = pytest.mark.smoke
+
 def run_sod(riemann: str, lmin: int = 7, slope: int = 2):
     p = params_from_string(SOD.format(lmin=lmin, slope=slope,
                                       riemann=riemann), ndim=1)

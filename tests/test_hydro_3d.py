@@ -45,6 +45,9 @@ riemann='hllc'
 """
 
 
+
+pytestmark = pytest.mark.smoke
+
 def run_sedov(ndim, lmin=5, tout=0.05, nstep=1000):
     p = params_from_string(SEDOV.format(lmin=lmin, tout=tout, nstep=nstep),
                            ndim=ndim)

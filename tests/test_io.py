@@ -332,6 +332,7 @@ def _load_oracle():
     return visu
 
 
+@pytest.mark.smoke
 def test_reference_oracle_reads_our_snapshot(tmp_path, monkeypatch):
     """Execute the REFERENCE's own snapshot parser
     (``/root/reference/tests/visu/visu_ramses.py`` load_snapshot, run
@@ -430,6 +431,7 @@ def test_reference_oracle_reads_sink_csv(tmp_path, monkeypatch):
                                np.sort(sim.stellar.m), rtol=1e-9)
 
 
+@pytest.mark.smoke
 def test_noncubic_box_roundtrip(tmp_path):
     """A 2x1x1 coarse grid round-trips snapshot -> restart (VERDICT r3
     item 8: arbitrary coarse dims, ref amr/init_amr.f90:37-60)."""

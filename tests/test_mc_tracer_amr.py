@@ -40,6 +40,7 @@ def _uniform_flow_params(vx=0.5):
     return p
 
 
+@pytest.mark.smoke
 def test_mc_capture_matches_mass_update(monkeypatch):
     """Σ_d (φ_lo - φ_hi) == Δρ on every leaf cell of every level."""
     p = _uniform_flow_params()

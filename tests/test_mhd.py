@@ -76,6 +76,7 @@ def test_constant_state_preserved(riemann):
     assert float(sim.max_divb()) < 1e-12
 
 
+@pytest.mark.smoke
 def test_divb_zero_3d_random_field():
     sim = _uniform_sim(ndim=3, lmin=3)
     rng = np.random.default_rng(0)

@@ -50,6 +50,10 @@ riemann='hllc'
 """
 
 
+import pytest
+
+pytestmark = pytest.mark.smoke
+
 def test_parse_groups():
     g = parse_nml(SOD)
     assert g["run_params"]["hydro"] is True

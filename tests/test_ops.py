@@ -16,6 +16,9 @@ from ramses_tpu.utils.ops import OpsGuard, device_mb, rss_mb
 NML = "namelists/sedov3d.nml"
 
 
+
+pytestmark = pytest.mark.smoke
+
 def _sim(lmin=4, lmax=5):
     p = load_params(NML, ndim=3)
     p.amr.levelmin, p.amr.levelmax = lmin, lmax

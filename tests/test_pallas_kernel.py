@@ -45,6 +45,7 @@ def _xla_step(u, dt, cfg, bc, dx):
     return bmod.unpad(un, 3, muscl.NGHOST)
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("riemann", ["llf", "hllc"])
 def test_fused_step_matches_xla(riemann):
     cfg = _cfg(riemann)
@@ -145,6 +146,7 @@ def _row_state(cfg, n, seed=0):
                                 axis=1), jnp.float32)
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("riemann", ["llf", "hllc"])
 def test_oct_sweep_matches_level_sweep(riemann, monkeypatch):
     """Drive kernels.level_sweep itself twice — pallas branch forced on

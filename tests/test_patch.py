@@ -10,6 +10,9 @@ from ramses_tpu import patch
 from ramses_tpu.config import params_from_dict
 
 
+
+pytestmark = pytest.mark.smoke
+
 @pytest.fixture(autouse=True)
 def _clean_patch():
     patch.clear()

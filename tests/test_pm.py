@@ -11,6 +11,9 @@ from ramses_tpu.pm.coupling import PMSpec, pm_hydro_step, run_steps_pm
 from ramses_tpu.poisson.coupling import GravitySpec
 
 
+
+pytestmark = pytest.mark.smoke
+
 def _pset(x, v=None, m=None, **kw):
     x = np.atleast_2d(np.asarray(x, np.float64))
     n = x.shape[0]

@@ -19,6 +19,9 @@ from ramses_tpu.pm.coupling import PMSpec, pm_hydro_step
 from ramses_tpu.hydro.core import HydroStatic
 
 
+
+pytestmark = pytest.mark.smoke
+
 def _random_rhs(shape, seed=0):
     rng = np.random.default_rng(seed)
     r = rng.standard_normal(shape)

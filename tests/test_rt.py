@@ -65,6 +65,7 @@ def test_absorption_ionization_balance():
     assert np.isclose(absorbed, ionized, rtol=0.05)
 
 
+@pytest.mark.smoke
 def test_stromgren_sphere_3d():
     """Ionized volume approaches the analytic Stromgren value."""
     nH0 = 1e-3           # cm^-3

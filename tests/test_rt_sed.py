@@ -1,0 +1,142 @@
+"""Stellar SED tables + homogeneous UV background (rt/rt_spectra.f90,
+rt_UV_hom) — VERDICT r3 item 7."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.rt import sed as sedmod
+from ramses_tpu.rt.sed import (SedLibrary, SedTables, blackbody_library,
+                               read_sed_dir, write_sed_dir)
+
+
+
+pytestmark = pytest.mark.smoke
+
+def _lib():
+    # young stars hot (1e5 K), old stars cool (1.2e4 K)
+    t_of_age = lambda a: 1e5 / (1.0 + 80.0 * a)
+    return blackbody_library(t_of_age,
+                             ages_gyr=np.array([0.0, 0.01, 0.1, 1.0, 10.0]),
+                             zs=np.array([0.001, 0.02]))
+
+
+def test_sed_dir_roundtrip(tmp_path):
+    lib = _lib()
+    d = str(tmp_path / "seds")
+    write_sed_dir(d, lib)
+    back = read_sed_dir(d)
+    np.testing.assert_allclose(back.lam_A, lib.lam_A)
+    np.testing.assert_allclose(back.ages_gyr, lib.ages_gyr, rtol=1e-6)
+    np.testing.assert_allclose(back.zs, lib.zs, rtol=1e-6)
+    np.testing.assert_allclose(back.seds, lib.seds)
+
+
+def test_cross_sections_change_with_age():
+    """The chemistry's group cross-sections must depend on source age
+    (the whole point of SED tables vs a fixed blackbody)."""
+    tab = SedTables(_lib(), (13.6, 1e3))
+    young = tab.population_groups([0.0], [0.02], [1.0])[0]
+    old = tab.population_groups([1.0], [0.02], [1.0])[0]
+    # cooler old SED: ionizing photons pile up just above threshold,
+    # where sigma_HI is largest
+    assert old.sigmaN[0] > 1.2 * young.sigmaN[0]
+    assert old.e_photon < young.e_photon
+    # and the ionizing luminosity collapses with age
+    r_young = tab.star_rates([0.0], [0.02], [1.0])[0, 0]
+    r_old = tab.star_rates([1.0], [0.02], [1.0])[0, 0]
+    assert r_old < 0.1 * r_young
+
+
+def test_population_weighting():
+    tab = SedTables(_lib(), (13.6, 24.59, 1e3))
+    g_y = tab.population_groups([0.0], [0.02], [1.0])
+    g_o = tab.population_groups([1.0], [0.02], [1.0])
+    g_mix = tab.population_groups([0.0, 1.0], [0.02, 0.02], [1.0, 1.0])
+    assert abs(sum(g.frac for g in g_mix) - 1.0) < 1e-12
+    for g in range(2):
+        lo = min(g_y[g].sigmaN[0], g_o[g].sigmaN[0])
+        hi = max(g_y[g].sigmaN[0], g_o[g].sigmaN[0])
+        assert lo <= g_mix[g].sigmaN[0] <= hi
+
+
+def test_stellar_injection_amr(tmp_path):
+    """A star particle with SED tables becomes a photon source and the
+    population refresh rewires the chemistry's group properties."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import Params
+    from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
+
+    d = str(tmp_path / "seds")
+    write_sed_dir(d, _lib())
+    p = Params(ndim=2)
+    p.run.rt = True
+    p.run.pic = False
+    p.amr.levelmin, p.amr.levelmax = 4, 4
+    p.init.nregion = 1
+    p.init.region_type = ["square"]
+    p.init.x_center, p.init.y_center = [0.5], [0.5]
+    p.init.length_x, p.init.length_y = [10.0], [10.0]
+    p.init.exp_region = [10.0]
+    p.init.d_region, p.init.p_region = [1.0], [1e-4]
+    p.init.u_region, p.init.v_region = [0.0], [0.0]
+    p.rt.rt_ngroups = 3
+    p.rt.rt_y_he = 0.25
+    p.rt.sed_dir = d
+    p.rt.sedprops_update = 1
+    import dataclasses
+    ps = ParticleSet.make(
+        jnp.asarray([[0.5, 0.5]]), jnp.zeros((1, 2)),
+        jnp.asarray([1e-3]), family=np.array([FAM_STAR]))
+    ps = dataclasses.replace(ps, tp=jnp.asarray([-0.01]),
+                             zp=jnp.asarray([0.02]))
+    sim = AmrSim(p, particles=ps)
+    assert sim.rt_amr is not None and sim.rt_amr.sed is not None
+    n0 = {l: np.asarray(sim.rt_amr.rad[l][:, 0]).sum()
+          for l in sim.levels()}
+    # drive the RT advance directly with a dt under one reduced-light
+    # crossing time (code units have scale 1 here, so any hydro-scale
+    # dt would imply tens of thousands of RT substeps)
+    sim.rt_amr.advance(sim, 1e-10)
+    # photons were injected somewhere
+    grew = any(np.asarray(sim.rt_amr.rad[l][:, 0]).sum() > n0[l] * 1.001
+               for l in sim.levels())
+    assert grew
+    # group props refreshed to the (single-star) population values
+    tab = sim.rt_amr.sed
+    want = tab.population_groups(
+        [max(sim.t - (-0.01), 0.0) * sim.rt_amr.un.scale_t / 3.15576e16],
+        [0.02], [np.asarray(ps.m)[0] * sim.rt_amr.un.scale_d
+                 * sim.rt_amr.un.scale_l ** 2 / 1.989e33])
+    got = sim.rt_amr.spec.groups3
+    assert got[0].sigmaN[0] == pytest.approx(want[0].sigmaN[0], rel=0.3)
+
+
+def test_uv_background_shifts_equilibrium():
+    """rt_UV_hom: the homogeneous UV photoionization raises the
+    equilibrium ionized fraction of optically thin gas."""
+    from ramses_tpu.hydro.cooling import uv_rates
+    from ramses_tpu.rt import chem
+
+    g, h = uv_rates(1.0, 1.0)
+    uv = ((g["HI"], g["HeI"], g["HeII"]),
+          (h["HI"], h["HeI"], h["HeII"]))
+    nH = jnp.full((8,), 1e-4)
+    T = jnp.full((8,), 1e4)
+    N = jnp.full((8,), 1e-12)          # no local radiation
+    x = jnp.full((8,), 1e-3)
+    spec = chem.GroupSpec()
+    for _ in range(200):
+        N1, x_uv, T1 = chem.chem_step(N, x, T, nH, 3e11, 3e8, spec,
+                                      uv=uv)
+        x = x_uv
+    x0 = jnp.full((8,), 1e-3)
+    for _ in range(200):
+        _, x0, _ = chem.chem_step(N, x0, T, nH, 3e11, 3e8, spec)
+    assert float(x[0]) > 10 * float(x0[0])
+    # analytic check: x/(1-x)^... Gamma = alpha_B ne x at equilibrium
+    gam = g["HI"]
+    ne = nH[0] * x[0]
+    bal = gam * (1 - x[0]) / (float(chem.alpha_B(T[0])) * ne * x[0])
+    assert 0.5 < float(bal) < 2.0

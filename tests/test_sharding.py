@@ -50,6 +50,7 @@ def test_mesh_shape():
     assert mesh.devices.size == len(jax.devices())
 
 
+@pytest.mark.smoke
 def test_sharded_amr_matches_single_device():
     """Decomposition invariance for the AMR path: identical aggregates
     from the 8-device sharded run and the single-device run."""

@@ -9,6 +9,9 @@ from ramses_tpu.config import params_from_dict
 from ramses_tpu.turb.forcing import (TurbForcing, TurbSpec, apply_forcing)
 
 
+
+pytestmark = pytest.mark.smoke
+
 def _div_curl(acc, ndim):
     """Spectral divergence and curl magnitude of a real field."""
     div = sum(np.gradient(np.asarray(acc[d]), axis=d) for d in range(ndim))
