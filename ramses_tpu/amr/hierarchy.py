@@ -242,7 +242,8 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
         if spec.complete[i]:
             fl = K.dense_refine_flags(u[l], d["inv_perm"], d["perm"], eg,
                                       fls, (1 << l,) * cfg.ndim,
-                                      spec.bspec, cfg)
+                                      spec.bspec, cfg,
+                                      dx=spec.boxlen / (1 << l))
         else:
             if l == spec.lmin:
                 interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),

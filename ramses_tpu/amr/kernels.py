@@ -242,7 +242,7 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
         if u_flat.shape[0] > ncell:
             du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
         return du_rows
-    up = bmod.pad(ud, bc, cfg, muscl.NGHOST)
+    up = bmod.pad(ud, bc, cfg, muscl.NGHOST, dx=dx)
     flux, tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
     if ok_dense is not None:
         okp = ok_dense.reshape(shape)
@@ -287,11 +287,12 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
 
 
 @partial(jax.jit, static_argnames=("cfg", "shape", "bc", "err_grad",
-                                   "floors"))
+                                   "floors", "dx"))
 def dense_refine_flags(u_flat, inv_perm, perm,
                        err_grad: Tuple[float, float, float],
                        floors: Tuple[float, float, float],
-                       shape: Tuple[int, ...], bc, cfg: HydroStatic):
+                       shape: Tuple[int, ...], bc, cfg: HydroStatic,
+                       dx: float = None):
     """Gradient refinement criteria for a complete level on the dense
     grid (same semantics as :func:`refine_flags`)."""
     from ramses_tpu.grid import boundary as bmod
@@ -302,7 +303,7 @@ def dense_refine_flags(u_flat, inv_perm, perm,
         ncell *= s
     ud = u_flat[inv_perm]
     ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)
-    up = bmod.pad(ud, bc, cfg, 1)
+    up = bmod.pad(ud, bc, cfg, 1, dx=dx)
     ok = _flags_fn(cfg)(up, err_grad, floors, spatial0=0, cfg=cfg)
     ok = ok[tuple(slice(1, -1) for _ in range(nd))]    # interior
     flags_flat = ok.reshape(-1)[perm]                  # flat cell order

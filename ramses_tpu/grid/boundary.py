@@ -79,8 +79,30 @@ def _inflow_state(bc: FaceBC, cfg: HydroStatic, dtype):
     return jnp.asarray(np.array(u, dtype=np.float64), dtype=dtype)
 
 
-def pad(u, spec: BoundarySpec, cfg: HydroStatic, ng: int = 2):
-    """Pad an active [nvar, *spatial] grid with ``ng`` ghost cells/side."""
+def _prims_to_cons_block(vals, cfg: HydroStatic, shape, dtype):
+    """Ghost block [nvar, *shape] from primitive values that may be
+    scalars or per-cell arrays (position-dependent ``boundana``)."""
+    r = jnp.maximum(jnp.broadcast_to(jnp.asarray(vals[0], dtype), shape),
+                    cfg.smallr)
+    vels = [jnp.broadcast_to(jnp.asarray(v, dtype), shape)
+            for v in vals[1:1 + cfg.ndim]]
+    p = jnp.broadcast_to(jnp.asarray(vals[1 + cfg.ndim], dtype), shape)
+    rows = [r] + [r * v for v in vels]
+    rows.append(p / (cfg.gamma - 1.0)
+                + 0.5 * r * sum(v * v for v in vels))
+    rows += [jnp.zeros(shape, dtype)] * (cfg.nener + cfg.npassive)
+    return jnp.stack(rows)
+
+
+def pad(u, spec: BoundarySpec, cfg: HydroStatic, ng: int = 2,
+        dx: float = None):
+    """Pad an active [nvar, *spatial] grid with ``ng`` ghost cells/side.
+
+    ``dx``: cell size — enables POSITION-DEPENDENT inflow profiles:
+    a ``boundana(d, side, cfg, x=...)`` patch hook receives the ghost
+    block's cell-centre coordinate arrays (``hydro/boundana.f90:45``
+    computes per-cell boundary states the same way) and may return
+    per-cell primitive arrays instead of constants."""
     from ramses_tpu import patch
     boundana = patch.hook("boundana")
     for d in range(cfg.ndim):
@@ -111,16 +133,46 @@ def pad(u, spec: BoundarySpec, cfg: HydroStatic, ng: int = 2):
                 reps[ax] = ng
                 return jnp.tile(edge, reps)
             # INFLOW
+            tshape = list(u.shape)
+            tshape[ax] = ng
             if boundana is not None:
+                import inspect
+                takes_x = "x" in inspect.signature(boundana).parameters
+                if takes_x and dx is not None:
+                    # ghost-cell centre coordinates per spatial dim
+                    # (spatial axes only — drop the leading nvar axis)
+                    sshape = tuple(tshape[u.ndim - cfg.ndim:])
+                    coords = []
+                    for dd in range(cfg.ndim):
+                        ncells = sshape[dd]
+                        if dd == d:
+                            i0 = -ng if side == 0 else n
+                            idxs = jnp.arange(i0, i0 + ng)
+                        else:
+                            # dims < d were already padded by this
+                            # loop: index 0 sits at -(ng-0.5)*dx
+                            off = ng if dd < d else 0
+                            idxs = jnp.arange(ncells) - off
+                        shape1 = [1] * cfg.ndim
+                        shape1[dd] = -1
+                        coords.append(
+                            jnp.broadcast_to(
+                                ((idxs + 0.5) * dx).astype(u.dtype)
+                                .reshape(shape1), sshape))
+                    vals = boundana(d, side, cfg, x=tuple(coords))
+                    return _prims_to_cons_block(
+                        vals, cfg, sshape, u.dtype)
+                if takes_x and dx is None:
+                    raise ValueError(
+                        "position-aware boundana hook needs pad(..., "
+                        "dx=...); this caller provides no geometry")
                 vals = tuple(float(v) for v in boundana(d, side, cfg))
                 bc = FaceBC(INFLOW, vals)
             state = _inflow_state(bc, cfg, u.dtype)
             shape = [1] * u.ndim
             shape[0] = cfg.nvar
             g = state.reshape(shape)
-            tshape = list(u.shape)
-            tshape[ax] = ng
-            return jnp.broadcast_to(g, tshape)
+            return jnp.broadcast_to(g.astype(u.dtype), tshape)
 
         u = jnp.concatenate([ghost(lo_bc, 0), u, ghost(hi_bc, 1)], axis=ax)
     return u

@@ -62,7 +62,7 @@ def step(grid: UniformGrid, u, dt):
         from ramses_tpu.hydro import pallas_muscl as pk
         up, _ = pk.pad_xy(u, grid.bc, cfg)
         return pk.fused_step_padded(up, dt, cfg, grid.dx, grid.shape)
-    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
+    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST, dx=grid.dx)
     flux, tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
     un = muscl.apply_fluxes(up, flux, cfg)
     if cfg.pressure_fix or cfg.nener:
@@ -78,7 +78,7 @@ def step_with_flux(grid: UniformGrid, u, dt):
     Monte-Carlo tracers sample (``hydro/godunov_fine.f90:685-715``)."""
     cfg = grid.cfg
     dt = jnp.asarray(dt, u.dtype)
-    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
+    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST, dx=grid.dx)
     flux, tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
     un = muscl.apply_fluxes(up, flux, cfg)
     if cfg.pressure_fix or cfg.nener:

@@ -19,28 +19,61 @@ import numpy as np
 from ramses_tpu.io import fortran as frt
 
 
-def project(field, axis: int, kind: str = "mean", weights=None):
+def project(field, axis: int, kind: str = "mean", weights=None,
+            vmin=None, vmax=None):
     """2D map from a dense 3D (or 2D) field: mean|sum|max|min|slice
     along ``axis`` (the reference movie shaders); mass-weighted mean
-    when ``weights`` given."""
+    when ``weights`` given.  ``vmin``/``vmax``: cells whose value
+    falls outside the range are excluded from the projection
+    (``varmin_frame``/``varmax_frame``, ``amr/movie.f90:456``)."""
     field = jnp.asarray(field)
     if field.ndim == 2:
         return field
+    mask = None
+    if vmin is not None or vmax is not None:
+        mask = jnp.ones_like(field, dtype=bool)
+        if vmin is not None:
+            mask = mask & (field >= vmin)
+        if vmax is not None:
+            mask = mask & (field <= vmax)
     if kind == "slice":
         idx = [slice(None)] * field.ndim
         idx[axis] = field.shape[axis] // 2
-        return field[tuple(idx)]
+        f = field if mask is None else field * mask  # excluded -> 0
+        return f[tuple(idx)]
     if kind == "sum":
-        return jnp.sum(field, axis=axis)
+        f = field if mask is None else field * mask
+        return jnp.sum(f, axis=axis)
     if kind == "max":
-        return jnp.max(field, axis=axis)
+        f = field if mask is None else jnp.where(mask, field, -jnp.inf)
+        return jnp.max(f, axis=axis)
     if kind == "min":
-        return jnp.min(field, axis=axis)
-    if weights is not None:
-        w = jnp.asarray(weights)
-        return (jnp.sum(field * w, axis=axis)
-                / jnp.maximum(jnp.sum(w, axis=axis), 1e-300))
-    return jnp.mean(field, axis=axis)
+        f = field if mask is None else jnp.where(mask, field, jnp.inf)
+        return jnp.min(f, axis=axis)
+    w = (jnp.asarray(weights) if weights is not None
+         else jnp.ones_like(field))
+    if mask is not None:
+        w = w * mask
+    return (jnp.sum(field * w, axis=axis)
+            / jnp.maximum(jnp.sum(w, axis=axis), 1e-300))
+
+
+def smooth2d(m: np.ndarray, sigma_px: float) -> np.ndarray:
+    """Separable Gaussian blur of a 2D map (``smooth_frame``: the
+    reference widens each leaf's deposition footprint; blurring the
+    finished map by the same scale is the dense-grid equivalent)."""
+    if sigma_px <= 0.0:
+        return m
+    r = max(int(3.0 * sigma_px), 1)
+    x = np.arange(-r, r + 1)
+    k = np.exp(-0.5 * (x / sigma_px) ** 2)
+    k /= k.sum()
+    out = np.apply_along_axis(
+        lambda a: np.convolve(np.pad(a, r, mode="edge"), k,
+                              mode="valid"), 0, np.asarray(m))
+    return np.apply_along_axis(
+        lambda a: np.convolve(np.pad(a, r, mode="edge"), k,
+                              mode="valid"), 1, out)
 
 
 def write_frame(path: str, data, t: float = 0.0,
@@ -70,11 +103,15 @@ class Camera:
     default covers the whole grid for any box size."""
 
     def __init__(self, axis: int = 2, kind: str = "mean",
-                 center=(0.5, 0.5, 0.5), delta=(1.0, 1.0, 1.0)):
+                 center=(0.5, 0.5, 0.5), delta=(1.0, 1.0, 1.0),
+                 varmin=None, varmax=None, smooth: float = 0.0):
         self.axis = axis
         self.kind = kind
         self.center = tuple(center)
         self.delta = tuple(delta)
+        self.varmin = varmin          # per-camera value range
+        self.varmax = varmax          # (varmin/varmax_frame)
+        self.smooth = float(smooth)   # smooth_frame, in pixels
 
     def window(self, n: int, d: int):
         """[i0, i1) cell range of this camera's zoom along dim d."""
@@ -100,7 +137,23 @@ def _extract_field(u, name: str, cfg, ndim: int):
             / (2 * np.maximum(u[0], 1e-300))
         return ((cfg.gamma - 1.0) * (u[1 + ndim] - ek)
                 / np.maximum(u[0], 1e-300))
+    if name == "speed":
+        return np.sqrt(sum(u[1 + d] ** 2 for d in range(ndim))) \
+            / np.maximum(u[0], 1e-300)
+    if name in ("metallicity", "var"):
+        # first passive scalar as a mass fraction (i_mv_metallicity /
+        # i_mv_var, movie.f90:736-745); loud when the run carries none
+        ip = 2 + ndim + getattr(cfg, "nener", 0)
+        if u.shape[0] <= ip:
+            raise ValueError(
+                f"movie field {name!r} needs a passive scalar "
+                "(npassive/metals); this run has none")
+        return u[ip] / np.maximum(u[0], 1e-300)
     raise ValueError(f"unknown movie field {name!r}")
+
+
+PART_FIELDS = ("dm", "stars", "lum")   # particle-deposition shaders
+AUX_FIELDS = ("xhi", "xhii", "xheii", "xheiii")  # RT ion fractions
 
 
 class MovieWriter:
@@ -109,11 +162,13 @@ class MovieWriter:
     proj_axis string, each with its own axis/shader/zoom)."""
 
     def __init__(self, outdir: str, axis: int = 2, kind: str = "mean",
-                 fields: Sequence[str] = ("density",), cameras=None):
+                 fields: Sequence[str] = ("density",), cameras=None,
+                 extent=(1.0, 1.0, 1.0)):
         self.outdir = outdir
         self.fields = list(fields)
         self.cameras = (list(cameras) if cameras
                         else [Camera(axis=axis, kind=kind)])
+        self._extent = tuple(extent)   # per-dim box extents (user units)
         self.iframe = 0
         for i in range(len(self.cameras)):
             os.makedirs(self._camdir(i), exist_ok=True)
@@ -123,7 +178,42 @@ class MovieWriter:
             return self.outdir
         return os.path.join(self.outdir, f"movie{i + 1}")
 
-    def _emit_dense(self, u, cfg, t: float) -> list:
+    def _part_map(self, name, parts, cam, ndim, shape, axis):
+        """Particle-deposition shader: surface density of DM / stars /
+        stellar "luminosity" on the camera plane (``movie.f90:884-894``
+        i_mv_dm/stars/lum).  ``lum`` weights stars by the SED tables'
+        photon rates when the run carries them, else by mass."""
+        from ramses_tpu.pm.particles import FAM_DM, FAM_STAR
+        x, m, fam, lumw = parts
+        if name == "dm":
+            sel = fam == FAM_DM
+            w = m[sel]
+        elif name == "stars":
+            sel = fam == FAM_STAR
+            w = m[sel]
+        else:                          # lum
+            sel = fam == FAM_STAR
+            w = (lumw[sel] if lumw is not None else m[sel])
+        ax2 = [d for d in range(ndim) if d != axis][:2]
+        edges, sels = [], np.ones(int(sel.sum()), dtype=bool)
+        xs = x[sel]
+        for d in ax2:
+            nd_ = shape[d]
+            i0, i1 = cam.window(nd_, d)
+            lo, hi = i0 / nd_, i1 / nd_
+            xd = xs[:, d] / self._extent[d]
+            sels &= (xd >= lo) & (xd < hi)
+            edges.append(np.linspace(lo, hi, (i1 - i0) + 1))
+        pts = [xs[sels][:, d] / self._extent[d] for d in ax2]
+        h, _ = np.histogramdd(np.stack(pts, axis=1) if pts else
+                              np.zeros((0, 2)),
+                              bins=edges, weights=w[sels])
+        px = np.diff(edges[0])[0] * np.diff(edges[1])[0] \
+            if len(edges) == 2 else 1.0
+        return h / max(px, 1e-300)
+
+    def _emit_dense(self, u, cfg, t: float, parts=None,
+                    aux=None) -> list:
         ndim = u.ndim - 1
         n = u.shape[1]
         paths = []
@@ -136,9 +226,26 @@ class MovieWriter:
             uc = u[tuple(idx)]
             axis = cam.axis if ndim == 3 else 0
             for name in self.fields:
-                field = _extract_field(uc, name, cfg, ndim)
-                m = project(field, axis, cam.kind,
-                            weights=uc[0] if cam.kind == "mean" else None)
+                if name in PART_FIELDS:
+                    if parts is None:
+                        continue       # no particles in this run
+                    m = self._part_map(name, parts, cam, ndim,
+                                       u.shape[1:], axis)
+                elif aux is not None and name in aux:
+                    field = aux[name][tuple(idx[1:])]
+                    m = project(field, axis, cam.kind,
+                                weights=(uc[0] if cam.kind == "mean"
+                                         else None),
+                                vmin=cam.varmin, vmax=cam.varmax)
+                elif name in AUX_FIELDS:
+                    continue           # RT not active in this run
+                else:
+                    field = _extract_field(uc, name, cfg, ndim)
+                    m = project(field, axis, cam.kind,
+                                weights=(uc[0] if cam.kind == "mean"
+                                         else None),
+                                vmin=cam.varmin, vmax=cam.varmax)
+                m = smooth2d(np.asarray(m), cam.smooth)
                 path = os.path.join(
                     self._camdir(ic), f"{name}_{self.iframe:05d}.map")
                 ax2 = [d for d in range(ndim) if d != axis][:2]
@@ -195,11 +302,24 @@ class MovieWriter:
             delta = tuple(
                 per_cam(f"delta{c}_frame", extent[d], i) / extent[d]
                 for d, c in enumerate("xyz"))
+            vmin = raw.get("varmin_frame")
+            vmax = raw.get("varmax_frame")
+            smo = raw.get("smooth_frame", 0.0)
+
+            def pick(v):
+                if v is None:
+                    return None
+                if isinstance(v, list):
+                    return float(v[i]) if i < len(v) else None
+                return float(v)
+
             cams.append(Camera(axis="xyz".index(ch), kind=kind,
-                               center=center, delta=delta))
+                               center=center, delta=delta,
+                               varmin=pick(vmin), varmax=pick(vmax),
+                               smooth=pick(smo) or 0.0))
         out = outdir or os.path.join(
             str(params.output.output_dir), "movie")
-        return (cls(out, fields=fields, cameras=cams),
+        return (cls(out, fields=fields, cameras=cams, extent=extent),
                 max(1, int(g("imov", 1))))
 
     def emit(self, sim) -> list:
@@ -208,7 +328,13 @@ class MovieWriter:
         and ``.cfg``)."""
         u = np.asarray(sim.state.u if hasattr(sim, "state") else sim.u)
         t = float(sim.state.t if hasattr(sim, "state") else sim.t)
-        return self._emit_dense(u, sim.cfg, t)
+        ps = getattr(getattr(sim, "state", sim), "p", None)
+        parts = None
+        if ps is not None:
+            act = np.asarray(ps.active)
+            parts = (np.asarray(ps.x)[act], np.asarray(ps.m)[act],
+                     np.asarray(ps.family)[act], None)
+        return self._emit_dense(u, sim.cfg, t, parts=parts)
 
     def emit_amr(self, sim) -> list:
         """Write one frame set from a live :class:`AmrSim`: leaves are
@@ -218,15 +344,55 @@ class MovieWriter:
         from ramses_tpu.utils.gridfill import leaves_to_dense
 
         lmax_used = max(sim.levels())
+        rt = getattr(sim, "rt_amr", None)
+        want_aux = rt is not None and any(f in AUX_FIELDS
+                                          for f in self.fields)
         pos, lvls, vals = [], [], []
         for l in sim.levels():
             xc, uvals = sim.leaf_sample(l)
             if len(xc):
                 pos.append(xc)
                 lvls.append(np.full(len(xc), l))
-                vals.append(np.asarray(uvals, dtype=np.float64))
+                uv = np.asarray(uvals, dtype=np.float64)
+                if want_aux:
+                    m = sim.maps[l]
+                    leaf = ~sim.tree.refined_mask(l)
+                    nc = m.noct * 2 ** sim.cfg.ndim
+                    xi = np.asarray(rt.xion[l])[:nc][leaf][:, None]
+                    if rt.full3:
+                        xhe = np.asarray(rt.xhe[l])[:nc][leaf]
+                    else:
+                        xhe = np.zeros((len(xi), 2))
+                    uv = np.concatenate([uv, xi, xhe], axis=1)
+                vals.append(uv)
         dense = leaves_to_dense(np.concatenate(pos),
                                 np.concatenate(lvls),
                                 np.concatenate(vals), lmax_used,
                                 float(sim.boxlen))
-        return self._emit_dense(dense, sim.cfg, float(sim.t))
+        aux = None
+        if want_aux:
+            nvar = sim.cfg.nvar
+            xhii, xheii, xheiii = dense[nvar], dense[nvar + 1], \
+                dense[nvar + 2]
+            aux = {"xhii": xhii, "xhi": 1.0 - xhii,
+                   "xheii": xheii, "xheiii": xheiii}
+            dense = dense[:nvar]
+        parts = None
+        if sim.p is not None:
+            act = np.asarray(sim.p.active)
+            lumw = None
+            if rt is not None and getattr(rt, "sed", None) is not None:
+                from ramses_tpu.pm.particles import FAM_STAR
+                from ramses_tpu.pm.star_formation import M_SUN
+                un = rt.un
+                GYR = 3.15576e16
+                age = np.maximum((sim.t - np.asarray(sim.p.tp))
+                                 * un.scale_t / GYR, 0.0)
+                msun = np.asarray(sim.p.m) * un.scale_d \
+                    * un.scale_l ** sim.cfg.ndim / M_SUN
+                lumw = rt.sed.star_rates(age, np.asarray(sim.p.zp),
+                                         msun).sum(axis=1)[act]
+            parts = (np.asarray(sim.p.x)[act], np.asarray(sim.p.m)[act],
+                     np.asarray(sim.p.family)[act], lumw)
+        return self._emit_dense(dense, sim.cfg, float(sim.t),
+                                parts=parts, aux=aux)

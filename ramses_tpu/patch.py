@@ -23,11 +23,13 @@ known hook overrides the stock implementation:
   ``gravana(x, gravity_type, gravity_params, boxlen) -> g [ndim, ...]``
       analytic gravity field (``poisson/gravana.f90``); consulted for
       every ``gravity_type > 0``.
-  ``boundana(d, side, cfg) -> primitive values (rho, v..., P)``
+  ``boundana(d, side, cfg[, x]) -> primitive values (rho, v..., P)``
       imposed-inflow state for face (dimension, side) — replaces the
       &BOUNDARY_PARAMS d/u/v/w/p_bound constants with computed ones
-      (``hydro/boundana.f90``; position-dependent profiles are not yet
-      plumbed through the ghost padding).
+      (``hydro/boundana.f90``).  Declaring an ``x`` keyword makes the
+      hook POSITION-DEPENDENT: it receives the ghost block's
+      cell-centre coordinate arrays (one per dim) and may return
+      per-cell primitive arrays (``boundana.f90:45`` per-cell states).
   ``source(sim, dt) -> None``
       arbitrary extra physics at coarse-step cadence, mutating the
       simulation in place — the runtime analogue of patching extra

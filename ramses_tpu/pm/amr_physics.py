@@ -341,65 +341,146 @@ def sink_passes_amr(sim, dt: float):
             idp=np.concatenate([sinks.idp, new_idp]),
             next_id=sinks.next_id + len(rows))
 
-    # ---- accretion from the finest covering cell
+    # ---- accretion over the sink CLOUD (``create_cloud_from_sink``,
+    # pm/sink_particle.f90:131): equal-weight points within
+    # 0.5*ir_cloud*dx_min sample the gas state — the Bondi kernel sees
+    # the neighbourhood, not one host cell — and the draw distributes
+    # over every covered leaf cell with per-cell 90% caps shared
+    # between overlapping clouds.
     if sinks.n and spec.accretion_scheme != "none":
-        lv = assign_levels(sim.tree, sinks.x, sim.boxlen)
+        from ramses_tpu.pm.sinks import cloud_offsets
+        dxm = sim.dx(max(sim.levels()))
+        offs = cloud_offsets(nd, spec.ir_cloud, dxm)
+        ncl = len(offs)
+        ns = sinks.n
+        pts = (sinks.x[:, None, :] + offs[None]).reshape(-1, nd)
+        periodic = all(k == 0 for pair in sim.bc_kinds for k in pair)
+        if periodic:
+            pts = np.mod(pts, sim.boxlen)
+        else:
+            pts = np.clip(pts, 0.0, np.nextafter(sim.boxlen, 0))
+        lvp = assign_levels(sim.tree, pts, sim.boxlen)
+        plvl = np.full(len(pts), -1, dtype=np.int64)
+        prow = np.full(len(pts), -1, dtype=np.int64)
+        ulv = {}
+        vol_l = {l: sim.dx(l) ** nd for l in sim.levels()}
         for l in sim.levels():
-            sel = np.nonzero(lv == l)[0]
-            if len(sel) == 0:
+            selp = np.nonzero(lvp == l)[0]
+            if len(selp) == 0:
                 continue
-            rows = ngp_rows(sim.tree, sinks.x[sel], l, sim.boxlen,
-                            sim.bc_kinds)
-            ok = rows >= 0
-            sel, rows = sel[ok], rows[ok]
-            if len(sel) == 0:
+            r = ngp_rows(sim.tree, pts[selp], l, sim.boxlen,
+                         sim.bc_kinds)
+            ok = r >= 0
+            plvl[selp[ok]] = l
+            prow[selp[ok]] = r[ok]
+            ulv[l] = np.array(sim.u[l], dtype=np.float64)
+        valid = plvl >= 0
+        npts = len(pts)
+        rho_p = np.full(npts, 1e-300)
+        mom_p = np.zeros((npts, nd))
+        e_p = np.zeros(npts)
+        vol_p = np.zeros(npts)
+        for l, u in ulv.items():
+            m = plvl == l
+            rows = prow[m]
+            rho_p[m] = np.maximum(u[rows, 0], 1e-300)
+            mom_p[m] = u[rows, 1:1 + nd]
+            e_p[m] = u[rows, 1 + nd]
+            vol_p[m] = vol_l[l]
+        # per-sink cloud-averaged state (equal-weight cloud points)
+        w2 = valid.reshape(ns, ncl).astype(np.float64)
+        wsum = np.maximum(w2.sum(1), 1e-300)
+        rho2 = rho_p.reshape(ns, ncl)
+        mom2 = mom_p.reshape(ns, ncl, nd)
+        # floor-density cells can carry stray momenta whose v=mom/rho
+        # overflows f64 — suppress and zero those contributions
+        with np.errstate(over="ignore", invalid="ignore",
+                         divide="ignore"):
+            rho_bar = (rho2 * w2).sum(1) / wsum
+            mw = np.maximum((rho2 * w2).sum(1), 1e-300)
+            vgas_bar = np.nan_to_num(
+                (mom2 * w2[:, :, None]).sum(1) / mw[:, None],
+                posinf=0.0, neginf=0.0)
+            ek2 = np.nan_to_num(0.5 * (mom2 ** 2).sum(2) / rho2,
+                                posinf=0.0, neginf=0.0)
+            press2 = (gamma - 1.0) * (e_p.reshape(ns, ncl) - ek2)
+            cs2 = gamma * np.maximum((press2 * w2).sum(1) / wsum,
+                                     1e-300) \
+                / np.maximum(rho_bar, 1e-300)
+        if spec.accretion_scheme == "bondi":
+            g_code = factG_in_cgs * units.scale_d * units.scale_t ** 2
+            vrel2 = ((sinks.v - vgas_bar) ** 2).sum(1)
+            mdot = (4 * np.pi * g_code ** 2 * sinks.m ** 2 * rho_bar
+                    / np.maximum(cs2 + vrel2, 1e-300) ** 1.5)
+            # equal split over the sink's valid cloud points
+            dm_p = np.where(valid, np.repeat(mdot * dt / wsum, ncl), 0.0)
+        else:   # threshold: per-point excess, deduped per (sink, cell)
+            key_sc = (np.repeat(np.arange(ns), ncl) * (1 << 40)
+                      + plvl * (1 << 32) + prow)
+            _, first = np.unique(np.where(valid, key_sc, -1),
+                                 return_index=True)
+            once = np.zeros(npts, dtype=bool)
+            once[first] = True
+            once &= valid
+            dm_p = np.where(once, spec.c_acc
+                            * np.maximum(rho_p - d_thr, 0.0) * vol_p,
+                            0.0)
+        # group per unique CELL: cap the combined draw at 90% of gas
+        key = plvl * (1 << 48) + prow
+        uniq, inv = np.unique(np.where(valid, key, -1),
+                              return_inverse=True)
+        tot_req = np.bincount(inv, weights=dm_p, minlength=len(uniq))
+        # gas available per unique cell (first point of each group)
+        firsts = np.zeros(len(uniq), dtype=np.int64)
+        firsts[inv[::-1]] = np.arange(npts)[::-1]
+        cell_gas = rho_p[firsts] * vol_p[firsts]
+        allowed = np.minimum(tot_req, 0.9 * cell_gas)
+        scale = allowed / np.maximum(tot_req, 1e-300)
+        dm_p = dm_p * scale[inv] * valid
+        # write back per level
+        with np.errstate(over="ignore", invalid="ignore",
+                         divide="ignore"):
+            vpt = np.nan_to_num(mom_p / rho_p[:, None],
+                                posinf=0.0, neginf=0.0)
+        for l, u in ulv.items():
+            m = (plvl == l) & (dm_p > 0.0)
+            if not m.any():
                 continue
-            dx = sim.dx(l)
-            vol = dx ** nd
-            u = np.array(sim.u[l], dtype=np.float64)
-            rho = np.maximum(u[rows, 0], 1e-300)
-            mom = u[rows, 1:1 + nd]
-            vgas = mom / rho[:, None]
-            ek = 0.5 * (mom ** 2).sum(1) / rho
-            press = (gamma - 1.0) * (u[rows, 1 + nd] - ek)
-            cs2 = gamma * np.maximum(press, 1e-300) / rho
-            if spec.accretion_scheme == "bondi":
-                g_code = factG_in_cgs * units.scale_d * units.scale_t ** 2
-                vrel2 = ((sinks.v[sel] - vgas) ** 2).sum(1)
-                mdot = (4 * np.pi * g_code ** 2 * sinks.m[sel] ** 2 * rho
-                        / np.maximum(cs2 + vrel2, 1e-300) ** 1.5)
-                dm = np.minimum(mdot * dt, 0.9 * rho * vol)
-            else:   # threshold
-                dm = np.minimum(
-                    spec.c_acc * np.maximum(rho - d_thr, 0.0) * vol,
-                    0.9 * rho * vol)
-            # two sinks sharing a cell must debit the gas ONCE for their
-            # combined draw (fancy-index *= is last-write-wins): group
-            # requests per unique cell, cap the TOTAL at 90% of the
-            # cell's gas, and hand each sink its proportional share
-            uniq, inv = np.unique(rows, return_inverse=True)
-            tot_req = np.bincount(inv, weights=dm)
-            rho_u = np.maximum(u[uniq, 0], 1e-300)
-            tot_allowed = np.minimum(tot_req, 0.9 * rho_u * vol)
-            scale = tot_allowed / np.maximum(tot_req, 1e-300)
-            dm = dm * scale[inv]
-            p_acc = vgas * dm[:, None]
-            frac_u = 1.0 - (tot_allowed / vol) / rho_u
-            u[uniq] *= frac_u[:, None]
-            m_gain = dm
-            if spec.agn:
-                from ramses_tpu.pm.sinks import agn_energy
-                e_agn, m_gain = agn_energy(dm, spec, units)
-                np.add.at(u[:, 1 + nd], rows, e_agn / vol)
+            rows = prow[m]
+            # additive removal against the PRE-draw state: duplicates
+            # (two cloud points in one cell) sum to exactly the
+            # combined fraction of the old state — conservative
+            np.add.at(u, rows,
+                      -u[rows] * (dm_p[m] / (rho_p[m] * vol_l[l]))[:, None])
             sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
-            stellar = getattr(sim, "stellar", None)
-            if stellar is not None:
-                for sid, dmi in zip(sinks.idp[sel], dm):
+        dm = dm_p.reshape(ns, ncl).sum(1)
+        p_acc = (vpt * dm_p[:, None]).reshape(ns, ncl, nd).sum(1)
+        m_gain = dm
+        if spec.agn:
+            from ramses_tpu.pm.sinks import agn_energy
+            e_agn, m_gain = agn_energy(dm, spec, units)
+            # dump into the sink's own covering cell (cloud centre)
+            lv0 = assign_levels(sim.tree, sinks.x, sim.boxlen)
+            for l in ulv:
+                m = lv0 == l
+                if not m.any():
+                    continue
+                rows = ngp_rows(sim.tree, sinks.x[m], l, sim.boxlen,
+                                sim.bc_kinds)
+                ok = rows >= 0
+                u = np.array(sim.u[l], dtype=np.float64)
+                np.add.at(u[:, 1 + nd], rows[ok],
+                          e_agn[m][ok] / vol_l[l])
+                sim.u[l] = jnp.asarray(u, sim.u[l].dtype)
+        stellar = getattr(sim, "stellar", None)
+        if stellar is not None:
+            for sid, dmi in zip(sinks.idp, dm):
+                if dmi > 0.0:
                     stellar.add_accreted(sid, float(dmi))
-            newm = sinks.m[sel] + m_gain
-            sinks.v[sel] = (sinks.v[sel] * sinks.m[sel, None] + p_acc) \
-                / np.maximum(newm, 1e-300)[:, None]
-            sinks.m[sel] = newm
+        newm = sinks.m + m_gain
+        sinks.v = (sinks.v * sinks.m[:, None] + p_acc) \
+            / np.maximum(newm, 1e-300)[:, None]
+        sinks.m = newm
 
     sinks = merge_sinks(sinks, spec, sim.dx(sim.lmax))
 

@@ -126,7 +126,7 @@ def pm_hydro_step(grid: UniformGrid, gspec: GravitySpec, pspec: PMSpec,
     if pspec.hydro:
         if gspec.enabled:
             u = kick(u, f, +0.5 * dt, cfg)
-        up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST)
+        up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST, dx=grid.dx)
         mode = "wrap" if _all_periodic(grid.bc) else "edge"
         fp = _pad_force(f, cfg.ndim, mode)
         grav = [fp[d] for d in range(cfg.ndim)] if gspec.enabled else None

@@ -108,6 +108,7 @@ def unbind_clump(x: np.ndarray, v: np.ndarray, m: np.ndarray,
     if periodic:
         rel = rel - boxlen * np.round(rel / boxlen)
     r = np.sqrt((rel ** 2).sum(axis=1))
+    phi_ref = None
     for _ in range(max_iter):
         nb = bound.sum()
         if nb < 2:
@@ -120,7 +121,15 @@ def unbind_clump(x: np.ndarray, v: np.ndarray, m: np.ndarray,
                                            nmassbins, logbins)
         else:
             phi[bound] = _sphere_potential(r[bound], m[bound], G)
-        phi_ref = float(phi[bound].max()) if saddle_pot else 0.0
+        if saddle_pot:
+            # boundary reference FROZEN at the first iteration (the
+            # reference's saddle surface does not shrink with the
+            # bound set; a per-iteration max would strip the
+            # outermost member forever and never converge)
+            if phi_ref is None:
+                phi_ref = float(phi[bound].max())
+        else:
+            phi_ref = 0.0
         ekin = 0.5 * ((v - vbulk) ** 2).sum(axis=1)
         new_bound = bound & (ekin + phi < phi_ref)
         if new_bound.sum() < max(2, int(keep_frac_min * n)):

@@ -37,6 +37,10 @@ class SinkSpec:
     # direct sink-sink N^2 gravity during the drift (the reference's
     # ``direct_force_sink`` smbh option)
     direct_force: bool = False
+    # cloud sampling: accretion samples a lattice of cloud points
+    # within radius 0.5*ir_cloud*dx_min (``create_cloud_from_sink``,
+    # pm/sink_particle.f90:131); 1 = host cell only
+    ir_cloud: int = 4
 
     @classmethod
     def from_params(cls, p) -> "SinkSpec":
@@ -56,7 +60,25 @@ class SinkSpec:
                    agn=bool(g("agn", False)),
                    eps_r=float(g("eps_r", 0.1)),
                    eps_c=float(g("eps_c", 0.15)),
-                   direct_force=bool(g("direct_force", False)))
+                   direct_force=bool(g("direct_force", False)),
+                   ir_cloud=int(g("ir_cloud", 4)))
+
+
+def cloud_offsets(ndim: int, ir_cloud: int, dx: float) -> np.ndarray:
+    """Cloud-point offsets: a dx/2-spaced lattice inside radius
+    ``0.5*ir_cloud*dx`` (the reference's sink cloud particles,
+    ``create_cloud_from_sink`` — equal-weight points that let the
+    accretion kernel resolve the Bondi radius instead of sampling one
+    host cell).  Always includes the centre point."""
+    if ir_cloud <= 1:
+        return np.zeros((1, ndim))
+    half = 0.5 * dx
+    r = 0.5 * ir_cloud * dx
+    k = int(np.floor(r / half))
+    ax = np.arange(-k, k + 1) * half
+    grids = np.meshgrid(*([ax] * ndim), indexing="ij")
+    pts = np.stack([g.ravel() for g in grids], axis=1)
+    return pts[(pts ** 2).sum(axis=1) <= r * r + 1e-12]
 
 
 @dataclass

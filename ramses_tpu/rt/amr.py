@@ -174,8 +174,8 @@ class RtAmrCoupled:
         age_gyr = np.maximum(
             (sim.t - np.asarray(p.tp)[sel]) * un.scale_t / GYR, 0.0)
         zmet = np.asarray(p.zp)[sel]
-        m_sun = np.asarray(p.m)[sel] * un.scale_d * un.scale_l ** 3 \
-            / M_SUN
+        m_sun = np.asarray(p.m)[sel] * un.scale_d \
+            * un.scale_l ** self.nd / M_SUN
         rates = self.sed.star_rates(age_gyr, zmet, m_sun) * self._esc
         pos = np.asarray(p.x)[sel]
         levs = assign_levels(sim.tree, pos, sim.boxlen)

@@ -89,3 +89,42 @@ def test_explicit_comm_deterministic():
     for l in b1.levels():
         assert (np.asarray(b1.u[l]).tobytes()
                 == np.asarray(b2.u[l]).tobytes())
+
+
+@pytest.mark.smoke
+def test_explicit_comm_collective_footprint():
+    """Pin the comm footprint of the sharded-AMR coarse step: the
+    explicit ppermute schedule must not regress into all-gathers, and
+    must not be beaten by the GSPMD partitioner's own choice (VERDICT
+    r3: a regression from neighbour ppermute to all-gather would
+    otherwise be invisible until real multi-chip time)."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr import hierarchy as H
+    from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+
+    def counts(explicit):
+        p = _params()
+        sim = ShardedAmrSim(p, devices=_devices(), dtype=jnp.float64,
+                            explicit_comm=explicit)
+        assert len(sim.levels()) >= 2       # a partial level exists
+        spec = sim._fused_spec()
+        if explicit:
+            assert any(c is not None for c in spec.comm)
+        dt = jnp.asarray(1e-4, sim.dtype)
+        txt = H._fused_coarse_step.lower(
+            sim.u, sim.dev, {}, dt, spec, None).compile().as_text()
+        return {op: txt.count(f" {op}(")
+                for op in ("all-gather", "collective-permute",
+                           "all-reduce", "all-to-all")}
+
+    gspmd = counts(False)
+    expl = counts(True)
+    # the sharded program really communicates
+    assert sum(gspmd.values()) > 0
+    # the explicit schedule rides point-to-point permutes, and never
+    # MORE gathers than the partitioner's own lowering
+    assert expl["collective-permute"] > 0
+    assert expl["all-gather"] <= gspmd["all-gather"]
+    # the CFL reduction stays a reduction on both paths
+    assert expl["all-reduce"] > 0 and gspmd["all-reduce"] > 0

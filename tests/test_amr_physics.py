@@ -172,3 +172,43 @@ def test_stellar_imf_and_lifetime():
     spec = StellarSpec(lt_t0=1.0, lt_m0=148.16, lt_a=0.238, lt_b=2.0)
     tl = lifetime(np.array([8.0, 40.0, 120.0]), spec)
     assert tl[0] > tl[1] > tl[2]          # massive stars die first
+
+
+def test_sink_cloud_accretion():
+    """Cloud sampling (create_cloud_from_sink): the draw spreads over
+    the cloud's cells instead of one host cell, mass+momentum stay
+    conserved, and ir_cloud=1 reproduces host-cell-only accretion."""
+    def run(ir_cloud):
+        g = _blob_groups(lmin=4, lmax=5, d_in=100.0, p_in=1.0,
+                         tend=0.02, refine_params={"err_grad_d": 0.2},
+                         sink_params={"create_sinks": True,
+                                      "n_sink": 10.0,
+                                      "accretion_scheme": "threshold",
+                                      "c_acc": 0.1,
+                                      "ir_cloud": ir_cloud})
+        sim = AmrSim(params_from_dict(g, ndim=3), dtype=jnp.float64)
+        m0 = sim.totals()[0]
+        u_before = {l: np.asarray(sim.u[l]).copy() for l in sim.levels()}
+        sim.evolve(0.02, nstepmax=4)
+        return sim, m0, u_before
+
+    sim4, m0, _ = run(4)
+    assert sim4.sinks.n > 0 and sim4.sinks.m.sum() > 0
+    # conservation with clouds on
+    assert abs(sim4.totals()[0] + sim4.sinks.m.sum() - m0) < 1e-11
+    sim1, m0b, _ = run(1)
+    assert abs(sim1.totals()[0] + sim1.sinks.m.sum() - m0b) < 1e-11
+    # the cloud spreads each sink's draw over >1 cell: one isolated
+    # accretion pass from identical states must debit more cells
+    from ramses_tpu.pm import amr_physics as ap
+
+    def debited_cells(sim):
+        u_pre = {l: np.asarray(sim.u[l]).copy() for l in sim.levels()}
+        ap.sink_passes_amr(sim, 1e-3)
+        n = 0
+        for l in sim.levels():
+            d = np.asarray(sim.u[l])[:, 0] - u_pre[l][:, 0]
+            n += int((d < -1e-14).sum())
+        return n
+    if sim4.sinks.n and sim1.sinks.n:
+        assert debited_cells(sim4) > debited_cells(sim1)

@@ -292,3 +292,58 @@ def test_lightcone_rotation():
                                 axis=(-1.0, 0, 0))
     assert set(pi.tolist()) == set(qi.tolist())
     np.testing.assert_allclose(np.sort(pr), np.sort(qr), rtol=1e-12)
+
+
+def test_movie_shader_bank(tmp_path):
+    """Extended shader bank (amr/movie.f90 i_mv_*): speed field,
+    varmin/varmax exclusion, smoothing, and particle-deposition maps."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.io.movie import Camera, MovieWriter, read_frame
+    from ramses_tpu.pm.particles import FAM_DM, FAM_STAR
+
+    n = 16
+    u = np.zeros((5, n, n, n))
+    u[0] = 1.0
+    u[0, :, :, :8] = 5.0               # dense half (z < 0.5)
+    u[1] = 2.0                         # mom_x: v = 2 (light), 0.4 (dense)
+    u[4] = 10.0
+    # varmin=1 keeps only the fast (light) cells in the projection
+    cam = Camera(axis=2, kind="mean", varmin=1.0)
+    mw = MovieWriter(str(tmp_path / "m"), fields=("speed", "dm",
+                                                  "stars"),
+                     cameras=[cam])
+
+    class P:
+        x = np.array([[0.25, 0.25, 0.5], [0.75, 0.75, 0.5]])
+        m = np.array([3.0, 7.0])
+        family = np.array([FAM_DM, FAM_STAR], dtype=np.int8)
+        active = np.array([True, True])
+
+    class Sim:
+        class state:
+            u = jnp.asarray(np.ones((5, n, n, n)))
+            t = 0.0
+            p = P()
+        cfg = type("C", (), {"gamma": 1.4, "nvar": 5, "ndim": 3,
+                             "nener": 0})()
+
+    Sim.state.u = jnp.asarray(u)
+    paths = mw.emit(Sim())
+    frames = {p.split("/")[-1].split("_")[0]: read_frame(p)
+              for p in paths}
+    # speed: 2 in light cells, 0.4 in dense cells; varmin=1 excludes
+    # the dense half -> masked mass-weighted mean = 2.0
+    np.testing.assert_allclose(frames["speed"]["data"], 2.0, rtol=1e-6)
+    # particle surface densities integrate to the family masses
+    px = (1.0 / n) ** 2
+    assert frames["dm"]["data"].sum() * px == pytest.approx(3.0)
+    assert frames["stars"]["data"].sum() * px == pytest.approx(7.0)
+    # smoothing conserves the map integral
+    cam2 = Camera(axis=2, kind="sum", smooth=2.0)
+    mw2 = MovieWriter(str(tmp_path / "m2"), fields=("density",),
+                      cameras=[cam2])
+    paths2 = mw2.emit(Sim())
+    f2 = read_frame(paths2[0])
+    assert f2["data"].sum() == pytest.approx(
+        np.asarray(Sim.state.u)[0].sum(axis=2).sum(), rel=1e-5)

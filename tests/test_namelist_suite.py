@@ -24,6 +24,9 @@ NMLDIR = os.path.join(os.path.dirname(__file__), "..", "namelists")
 # IC files and is exercised by tests/test_cosmo_ics.py instead
 CONFIGS = {
     "sedov1d.nml": (1, []),
+    "advect1d.nml": (1, []),
+    "blast1d.nml": (1, []),
+    "detente.nml": (1, []),
     "tube1d.nml": (1, []),
     "tube_mhd.nml": (1, []),
     "orszag2d.nml": (2, []),
@@ -31,7 +34,11 @@ CONFIGS = {
     "stromgren2d.nml": (2, []),
     "smbh_bondi.nml": (2, []),
     "tracer_sedov.nml": (2, []),
+    "sedov2d.nml": (2, []),
     "sedov3d.nml": (3, []),
+    "static.nml": (3, []),
+    "iliev1.nml": (3, []),
+    "pointmass.nml": (3, []),
     "collapse_iso.nml": (3, []),
     "stromgren3.nml": (3, []),
     "turb_driving.nml": (3, []),

@@ -182,3 +182,92 @@ output_dir='{tmp_path}'
 """)
     assert main_mod.main([str(nml), "--ndim", "1", "--dtype", "float64",
                           "--patch", str(pf)]) == 0
+
+
+def test_boundana_position_dependent():
+    """A boundana hook declaring ``x`` receives ghost-cell coordinates
+    and imposes a per-cell inflow profile (hydro/boundana.f90:45)."""
+    import jax.numpy as jnp
+
+    from ramses_tpu import patch
+    from ramses_tpu.grid import boundary as bmod
+    from ramses_tpu.hydro.core import HydroStatic
+    from ramses_tpu.config import Params
+
+    p = Params(ndim=2)
+    cfg = HydroStatic.from_params(p)
+
+    def boundana(d, side, cfg, x=None):
+        # density ramp along y on the low-x face; constant elsewhere
+        rho = 1.0 + x[1] if d == 0 and side == 0 else jnp.ones_like(x[0])
+        return (rho, jnp.zeros_like(rho), jnp.zeros_like(rho),
+                jnp.full_like(rho, 2.5))
+
+    inflow = bmod.FaceBC(bmod.INFLOW, (1.0, 0.0, 0.0, 2.5))
+    per = bmod.FaceBC()
+    spec = bmod.BoundarySpec(faces=((inflow, per), (per, per)))
+    n = 8
+    dx = 1.0 / n
+    u = jnp.ones((4, n, n))
+    u = u.at[3].set(2.5 / (cfg.gamma - 1.0))
+    import types
+    mod = types.SimpleNamespace(boundana=boundana)
+    try:
+        patch.install(mod)
+        up = bmod.pad(u, spec, cfg, 2, dx=dx)
+    finally:
+        patch.clear()
+    # low-x ghosts carry the y ramp: rho(y) = 1 + (j+0.5)*dx
+    ys = (np.arange(n) + 0.5) * dx
+    np.testing.assert_allclose(np.asarray(up[0, 0, 2:-2]), 1.0 + ys,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(up[0, 1, 2:-2]), 1.0 + ys,
+                               rtol=1e-6)
+    # energy ghosts: pure thermal at P=2.5
+    np.testing.assert_allclose(np.asarray(up[3, 0, 2:-2]),
+                               2.5 / (cfg.gamma - 1.0), rtol=1e-6)
+
+
+def test_boundana_transverse_coordinates_after_padding():
+    """An inflow profile on a HIGHER dim's face sees transverse
+    coordinates consistent with the already-padded lower dims (the
+    y-face ghost block includes x ghosts at negative x)."""
+    import jax.numpy as jnp
+
+    from ramses_tpu import patch
+    from ramses_tpu.grid import boundary as bmod
+    from ramses_tpu.hydro.core import HydroStatic
+    from ramses_tpu.config import Params
+
+    p = Params(ndim=2)
+    cfg = HydroStatic.from_params(p)
+    seen = {}
+
+    def boundana(d, side, cfg, x=None):
+        seen[(d, side)] = tuple(np.asarray(c) for c in x)
+        rho = 1.0 + x[0]               # x-dependent on the y-face
+        return (rho, jnp.zeros_like(rho), jnp.zeros_like(rho),
+                jnp.full_like(rho, 2.5))
+
+    inflow = bmod.FaceBC(bmod.INFLOW, (1.0, 0.0, 0.0, 2.5))
+    per = bmod.FaceBC()
+    spec = bmod.BoundarySpec(faces=((per, per), (inflow, per)))
+    n = 8
+    dx = 1.0 / n
+    u = jnp.ones((4, n, n))
+    import types
+    try:
+        patch.install(types.SimpleNamespace(boundana=boundana))
+        up = bmod.pad(u, spec, cfg, 2, dx=dx)
+    finally:
+        patch.clear()
+    xcoords = seen[(1, 0)][0]
+    # the y-face ghost block spans the PADDED x axis: its first two x
+    # rows are the x-ghost columns at negative coordinates
+    assert xcoords.shape == (n + 4, 2)
+    np.testing.assert_allclose(xcoords[0, 0], -1.5 * dx)
+    np.testing.assert_allclose(xcoords[2, 0], 0.5 * dx)
+    # and the imposed density follows 1 + x at the INTERIOR columns
+    np.testing.assert_allclose(np.asarray(up[0, 2:-2, 0]),
+                               1.0 + (np.arange(n) + 0.5) * dx,
+                               rtol=1e-6)

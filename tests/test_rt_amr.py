@@ -154,3 +154,34 @@ def test_rt_amr_multigroup_refined_front():
         assert (rad[:, ::4] >= 0).all()            # every group's N
         xhe = np.asarray(sim.rt_amr.xhe[l])
         assert np.isfinite(xhe).all() and (xhe >= 0).all()
+
+
+def test_photon_conservation_on_refined_front():
+    """Quantify the photon budget on a 2-level hierarchy (VERDICT r3:
+    the RT coarse-fine coupling is first-order; pin its conservation
+    error).  Optically thin gas + central source: leaf-summed photons
+    must match the injected count within a few percent."""
+    g = _rt_groups(4, 5, tend=0.01,
+                   refine={"r_refine": [-1.0, -1.0, -1.0, 0.25],
+                           "x_refine": [0.0, 0.0, 0.0, 0.5],
+                           "y_refine": [0.0, 0.0, 0.0, 0.5],
+                           "z_refine": [0.0, 0.0, 0.0, 0.5]})
+    g["init_params"]["d_region"] = [1e-12]     # optically thin
+    p = params_from_dict({k: dict(v) for k, v in g.items()}, ndim=3)
+    sim = AmrSim(p, dtype=jnp.float64)
+    assert len(sim.levels()) == 2              # source sits in L5 patch
+    rt = sim.rt_amr
+    dt_code = 2e-3
+    nstep = 4
+    for _ in range(nstep):
+        rt.advance(sim, dt_code)
+    total = 0.0
+    for l in sim.levels():
+        m = sim.maps[l]
+        nc = m.noct * 2 ** sim.cfg.ndim
+        leaf = ~sim.tree.refined_mask(l)
+        vol = (sim.dx(l) * rt.un.scale_l) ** sim.cfg.ndim
+        N = np.asarray(rt.rad[l][:nc, 0])[leaf]
+        total += float(N.sum() * vol)
+    injected = float(p.rt.rt_ndot) * nstep * dt_code * rt.un.scale_t
+    assert abs(total - injected) / injected < 0.05, (total, injected)
