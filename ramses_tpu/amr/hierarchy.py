@@ -1328,6 +1328,13 @@ class AmrSim:
         if self.movie is not None and self.nstep % self.movie_imov == 0:
             with self.timers.section("movie"):
                 self.movie.emit_amr(self)
+        if bool(self.params.run.lightcone) and self.cosmo is not None \
+                and self.p is not None:
+            # output_cone every coarse step (amr_step.f90:177-178)
+            from ramses_tpu.pm import lightcone as lcmod
+            with self.timers.section("lightcone"):
+                lcmod.emit_coarse_step(
+                    self, outdir=str(self.params.output.output_dir))
         if self.rt_amr is not None:
             with self.timers.section("rt"):
                 self.rt_amr.advance(self, dt)

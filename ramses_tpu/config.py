@@ -41,6 +41,9 @@ class RunParams:
     nsubcycle: List[int] = field(default_factory=lambda: [2] * MAXLEVEL)
     ordering: str = "hilbert"
     cost_weighting: bool = True
+    # lightcone particle emission each coarse step (&RUN_PARAMS
+    # lightcone, amr/light_cone.f90; geometry in &LIGHTCONE_PARAMS)
+    lightcone: bool = False
     # Monte-Carlo gas tracers (&RUN_PARAMS tracer/MC_tracer,
     # pm/tracer_utils.f90): seed tracer_per_cell tracers per leaf cell
     tracer: bool = False
@@ -69,6 +72,16 @@ class AmrParams:
     nx: int = 1
     ny: int = 1
     nz: int = 1
+
+
+@dataclass
+class LightconeParams:
+    """&LIGHTCONE_PARAMS (amr/read_params.f90:62): narrow-cone opening
+    half-angles [degrees] and the maximum emission redshift.  Angles
+    >= 90 degrees mean full sky."""
+    thetay_cone: float = 12.5
+    thetaz_cone: float = 12.5
+    zmax_cone: float = 2.0
 
 
 @dataclass
@@ -271,6 +284,8 @@ class Params:
     cooling: CoolingParams = field(default_factory=CoolingParams)
     rt: RtParams = field(default_factory=RtParams)
     units: UnitsParams = field(default_factory=UnitsParams)
+    lightcone: LightconeParams = field(
+        default_factory=LightconeParams)
     raw: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -292,6 +307,7 @@ _GROUP_MAP = {
     "cooling_params": "cooling",
     "rt_params": "rt",
     "units_params": "units",
+    "lightcone_params": "lightcone",
 }
 
 # fields that are per-region/bound/level lists: (field, count_attr, default)

@@ -197,6 +197,13 @@ class MovieWriter:
         ax2 = [d for d in range(ndim) if d != axis][:2]
         edges, sels = [], np.ones(int(sel.sum()), dtype=bool)
         xs = x[sel]
+        if ndim == 3:
+            # the camera slab also bounds the projection DEPTH (the
+            # gas path crops along cam.axis; particles must match)
+            na = shape[axis]
+            i0, i1 = cam.window(na, axis)
+            xa = xs[:, axis] / self._extent[axis]
+            sels &= (xa >= i0 / na) & (xa < i1 / na)
         for d in ax2:
             nd_ = shape[d]
             i0, i1 = cam.window(nd_, d)
@@ -302,11 +309,8 @@ class MovieWriter:
             delta = tuple(
                 per_cam(f"delta{c}_frame", extent[d], i) / extent[d]
                 for d, c in enumerate("xyz"))
-            vmin = raw.get("varmin_frame")
-            vmax = raw.get("varmax_frame")
-            smo = raw.get("smooth_frame", 0.0)
-
-            def pick(v):
+            def pick(key):
+                v = raw.get(key)
                 if v is None:
                     return None
                 if isinstance(v, list):
@@ -315,8 +319,9 @@ class MovieWriter:
 
             cams.append(Camera(axis="xyz".index(ch), kind=kind,
                                center=center, delta=delta,
-                               varmin=pick(vmin), varmax=pick(vmax),
-                               smooth=pick(smo) or 0.0))
+                               varmin=pick("varmin_frame"),
+                               varmax=pick("varmax_frame"),
+                               smooth=pick("smooth_frame") or 0.0))
         out = outdir or os.path.join(
             str(params.output.output_dir), "movie")
         return (cls(out, fields=fields, cameras=cams, extent=extent),
@@ -378,20 +383,27 @@ class MovieWriter:
                    "xheii": xheii, "xheiii": xheiii}
             dense = dense[:nvar]
         parts = None
-        if sim.p is not None:
+        if sim.p is not None and any(f in PART_FIELDS
+                                     for f in self.fields):
             act = np.asarray(sim.p.active)
             lumw = None
-            if rt is not None and getattr(rt, "sed", None) is not None:
+            if "lum" in self.fields and rt is not None \
+                    and getattr(rt, "sed", None) is not None:
                 from ramses_tpu.pm.particles import FAM_STAR
                 from ramses_tpu.pm.star_formation import M_SUN
                 un = rt.un
                 GYR = 3.15576e16
-                age = np.maximum((sim.t - np.asarray(sim.p.tp))
+                # SED rates only for the stars; other lanes carry 0
+                fam = np.asarray(sim.p.family)
+                stars = act & (fam == FAM_STAR)
+                age = np.maximum((sim.t - np.asarray(sim.p.tp)[stars])
                                  * un.scale_t / GYR, 0.0)
-                msun = np.asarray(sim.p.m) * un.scale_d \
+                msun = np.asarray(sim.p.m)[stars] * un.scale_d \
                     * un.scale_l ** sim.cfg.ndim / M_SUN
-                lumw = rt.sed.star_rates(age, np.asarray(sim.p.zp),
-                                         msun).sum(axis=1)[act]
+                lumw_all = np.zeros(len(fam))
+                lumw_all[stars] = rt.sed.star_rates(
+                    age, np.asarray(sim.p.zp)[stars], msun).sum(axis=1)
+                lumw = lumw_all[act]
             parts = (np.asarray(sim.p.x)[act], np.asarray(sim.p.m)[act],
                      np.asarray(sim.p.family)[act], lumw)
         return self._emit_dense(dense, sim.cfg, float(sim.t),

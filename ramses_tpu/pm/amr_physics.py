@@ -354,11 +354,14 @@ def sink_passes_amr(sim, dt: float):
         ncl = len(offs)
         ns = sinks.n
         pts = (sinks.x[:, None, :] + offs[None]).reshape(-1, nd)
-        periodic = all(k == 0 for pair in sim.bc_kinds for k in pair)
-        if periodic:
-            pts = np.mod(pts, sim.boxlen)
-        else:
-            pts = np.clip(pts, 0.0, np.nextafter(sim.boxlen, 0))
+        # wrap/clip per dimension: a box periodic in x but walled in z
+        # must wrap cloud points through x and clamp them in z
+        for d in range(nd):
+            if sim.bc_kinds[d] == (0, 0):
+                pts[:, d] = np.mod(pts[:, d], sim.boxlen)
+            else:
+                pts[:, d] = np.clip(pts[:, d], 0.0,
+                                    np.nextafter(sim.boxlen, 0))
         lvp = assign_levels(sim.tree, pts, sim.boxlen)
         plvl = np.full(len(pts), -1, dtype=np.int64)
         prow = np.full(len(pts), -1, dtype=np.int64)

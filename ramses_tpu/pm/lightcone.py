@@ -40,7 +40,9 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
                    r2: float, boxlen: float = 1.0,
                    opening: Optional[float] = None,
                    axis: Sequence[float] = (0, 0, 1.0),
-                   rotation: Optional[np.ndarray] = None):
+                   rotation: Optional[np.ndarray] = None,
+                   half_angles: Optional[Tuple[float, float]] = None,
+                   v: Optional[np.ndarray] = None):
     """Select particles in the shell r1 <= |x_rep − obs| < r2 over all
     periodic replicas intersecting the shell.
 
@@ -50,7 +52,11 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
     [ndim, ndim] observer orientation (see :func:`rotation_matrix`)
     applied to the emitted coordinates — the narrow-cone frame of
     ``perform_my_selection_narrow``; the opening-angle cut then acts
-    along ``axis`` IN THE ROTATED FRAME.
+    along ``axis`` IN THE ROTATED FRAME.  ``half_angles`` =
+    (thetay, thetaz) [radians]: the reference's RECTANGULAR cut
+    (|x| ≤ z·tan(thetay), |y| ≤ z·tan(thetaz), z > 0 in the rotated
+    frame).  ``v``: optional velocities, emitted alongside positions
+    (the reference writes xp AND vp per cone particle).
     """
     x = np.asarray(x)
     ndim = x.shape[1]
@@ -70,6 +76,8 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
     ax = np.asarray(axis, dtype=np.float64)[:ndim]
     ax = ax / np.linalg.norm(ax)
     cos_open = np.cos(opening) if opening is not None else None
+    tan_yz = (tuple(np.tan(a) for a in half_angles)
+              if half_angles is not None else None)
     for s in shifts:
         pos = x + s[None, :] - obs[None, :]
         if rotation is not None:
@@ -79,6 +87,11 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
         if cos_open is not None:
             mu = (pos @ ax) / np.maximum(r, 1e-300)
             m &= mu >= cos_open
+        if tan_yz is not None and ndim == 3:
+            z = pos[:, 2]
+            m &= ((z > 0.0)
+                  & (np.abs(pos[:, 0]) <= z * tan_yz[0])
+                  & (np.abs(pos[:, 1]) <= z * tan_yz[1]))
         if m.any():
             out_x.append(pos[m])
             out_r.append(r[m])
@@ -91,6 +104,58 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
 
 
 def write_cone(path: str, pos: np.ndarray, r: np.ndarray,
-               idx: np.ndarray, aexp: float) -> None:
-    """Cone dump (``output_cone`` reduced to an npz payload)."""
-    np.savez_compressed(path, pos=pos, r=r, idx=idx, aexp=aexp)
+               idx: np.ndarray, aexp: float, vel=None,
+               a_emit=None) -> None:
+    """Cone dump (``output_cone`` reduced to an npz payload: positions,
+    radii, source indices, velocities, per-particle emission aexp)."""
+    payload = dict(pos=pos, r=r, idx=idx, aexp=aexp)
+    if vel is not None:
+        payload["vel"] = vel
+    if a_emit is not None:
+        payload["a_emit"] = a_emit
+    np.savez_compressed(path, **payload)
+
+
+def emit_coarse_step(sim, outdir: str = ".") -> Optional[str]:
+    """Per-coarse-step lightcone emission (``amr_step.f90:177-178``
+    ``output_cone``): the shell swept since the previous coarse step,
+    observer at the box centre, narrow cone per &LIGHTCONE_PARAMS
+    (full sky when the half-angles reach 90°).  Each particle carries
+    its emission expansion factor interpolated at its comoving radius.
+    Returns the written path (None when nothing was emitted)."""
+    import os
+
+    cosmo = sim.cosmo
+    lc = sim.params.lightcone
+    a_now = sim.aexp_now()
+    a_prev = getattr(sim, "_cone_aexp_prev", None)
+    sim._cone_aexp_prev = a_now
+    if a_prev is None or sim.p is None or a_now <= a_prev:
+        return None
+    if a_now < 1.0 / (1.0 + float(lc.zmax_cone)):
+        return None                    # beyond the cone's zmax
+    r2, r1 = shell_radii(cosmo, a_prev, a_now)
+    if r1 > r2:
+        r1, r2 = r2, r1
+    if r2 <= r1:
+        return None
+    act = np.asarray(sim.p.active)
+    x = np.asarray(sim.p.x)[act]
+    vpart = np.asarray(sim.p.v)[act]
+    obs = np.full(sim.cfg.ndim, 0.5 * sim.boxlen)
+    ty = np.radians(float(lc.thetay_cone))
+    tz = np.radians(float(lc.thetaz_cone))
+    half = ((ty, tz) if (ty < np.pi / 2 and tz < np.pi / 2
+                         and sim.cfg.ndim == 3) else None)
+    pos, r, idx = cone_selection(x, obs, r1, r2, boxlen=sim.boxlen,
+                                 half_angles=half)
+    if len(r) == 0:
+        return None
+    # emission epoch per particle: a(tau0 - r)
+    tau0 = float(cosmo.tau_of_aexp(1.0 - 1e-12))
+    a_emit = np.interp(tau0 - r, cosmo.tau_frw, cosmo.axp_frw)
+    os.makedirs(outdir, exist_ok=True)
+    path = os.path.join(outdir, f"cone_{sim.nstep:05d}.npz")
+    write_cone(path, pos, r, idx, a_now, vel=vpart[idx],
+               a_emit=a_emit)
+    return path

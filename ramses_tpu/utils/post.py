@@ -125,6 +125,96 @@ def part2cube(outdir: str, n: int = 64) -> np.ndarray:
     return cube / dx ** ndim
 
 
+def part2map(outdir: str, n: int = 256, axis: str = "z",
+             family: str = "all") -> np.ndarray:
+    """CIC particle surface-density map along an axis
+    (``part2map.f90``): [code mass / code area].  ``family``:
+    all|dm|stars selects the deposited population."""
+    import ramses_tpu.io.reader as rdr
+    snap = rdr.load_snapshot(outdir)
+    boxlen = snap["amr"][0].header["boxlen"]
+    ndim = snap["amr"][0].header["ndim"]
+    dims_all = "xyz"[:ndim]
+    x = np.stack([np.concatenate([pp[f"position_{d}"]
+                                  for pp in snap["part"]])
+                  for d in dims_all], axis=1)
+    m = np.concatenate([pp["mass"] for pp in snap["part"]])
+    if family != "all":
+        fam = np.concatenate([pp["family"] for pp in snap["part"]])
+        want = {"dm": 1, "stars": 2}[family]
+        sel = fam == want
+        x, m = x[sel], m[sel]
+    ax = "xyz".index(axis) if ndim == 3 else 2
+    dims = [d for d in range(ndim) if d != ax][:2]
+    dx = boxlen / n
+    s2 = x[:, dims] / dx - 0.5
+    i0 = np.floor(s2).astype(int)
+    frac = s2 - i0
+    mp = np.zeros((n, n) if len(dims) == 2 else (n,))
+    for corner in range(1 << len(dims)):
+        idx = []
+        w = m.copy()
+        for k in range(len(dims)):
+            b = (corner >> k) & 1
+            idx.append(np.mod(i0[:, k] + b, n))
+            w = w * (frac[:, k] if b else 1.0 - frac[:, k])
+        np.add.at(mp, tuple(idx), w)
+    return mp / dx ** len(dims)
+
+
+def vrot(outdir: str, center, axis: str = "z",
+         nbins: int = 32):
+    """Particle rotation curve about an axis (``vrot.f90``):
+    mass-weighted mean tangential velocity per cylindrical radius
+    bin.  Returns (r_bins, v_rot)."""
+    from ramses_tpu.utils.halos import load_particles
+    x, v, m, _i, boxlen, _t = load_particles(outdir)
+    ndim = x.shape[1]
+    ax = "xyz".index(axis) if ndim == 3 else 2
+    dims = [d for d in range(ndim) if d != ax][:2]
+    c = np.asarray(center, dtype=np.float64)[:ndim]
+    rel = x - c[None, :]
+    rel -= boxlen * np.round(rel / boxlen)
+    rr = np.sqrt((rel[:, dims] ** 2).sum(1))
+    # tangential unit vector in the plane: (-y, x)/r
+    tx, ty = -rel[:, dims[1]], rel[:, dims[0]]
+    nrm = np.maximum(rr, 1e-300)
+    vt = (v[:, dims[0]] * tx + v[:, dims[1]] * ty) / nrm
+    edges = np.linspace(0.0, rr.max() + 1e-12, nbins + 1)
+    ib = np.clip(np.searchsorted(edges, rr, side="right") - 1, 0,
+                 nbins - 1)
+    msum = np.bincount(ib, weights=m, minlength=nbins)
+    vsum = np.bincount(ib, weights=m * vt, minlength=nbins)
+    rmid = 0.5 * (edges[1:] + edges[:-1])
+    return rmid, vsum / np.maximum(msum, 1e-300)
+
+
+def getstarlist(outdir: str, path: str) -> int:
+    """Star-particle table: id x.. v.. m birth_time metallicity
+    (``getstarlist.f90``)."""
+    import ramses_tpu.io.reader as rdr
+    snap = rdr.load_snapshot(outdir)
+    parts = {}
+    for k in snap["part"][0]:
+        v = [pp[k] for pp in snap["part"]]
+        if isinstance(v[0], np.ndarray):
+            parts[k] = np.concatenate(v)
+    sel = parts["family"] == 2
+    ndim = snap["amr"][0].header["ndim"]
+    dims = "xyz"[:ndim]
+    cols = [parts["identity"][sel]]
+    cols += [parts[f"position_{d}"][sel] for d in dims]
+    cols += [parts[f"velocity_{d}"][sel] for d in dims]
+    cols.append(parts["mass"][sel])
+    cols.append(parts.get("birth_time", np.zeros(len(parts["mass"])))[sel])
+    cols.append(parts.get("metallicity",
+                          np.zeros(len(parts["mass"])))[sel])
+    hdr = ("id " + " ".join(dims) + " "
+           + " ".join("v" + d for d in dims) + " m tp zp")
+    np.savetxt(path, np.stack(cols, axis=1), header=hdr)
+    return int(sel.sum())
+
+
 def part2list(outdir: str, path: str) -> int:
     """Ascii particle table: id x.. v.. m."""
     from ramses_tpu.utils.halos import load_particles
@@ -531,6 +621,26 @@ def main(argv=None) -> int:
     p.add_argument("txtfile")
     p.add_argument("--dir", default="x", choices=["x", "y", "z"])
 
+    p = sub.add_parser("part2map")
+    p.add_argument("outdir")
+    p.add_argument("npyfile")
+    p.add_argument("--n", type=int, default=256)
+    p.add_argument("--dir", default="z", choices=["x", "y", "z"])
+    p.add_argument("--family", default="all",
+                   choices=["all", "dm", "stars"])
+
+    p = sub.add_parser("vrot")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+    p.add_argument("--center", type=float, nargs="+",
+                   default=[0.5, 0.5, 0.5])
+    p.add_argument("--dir", default="z", choices=["x", "y", "z"])
+    p.add_argument("--nbins", type=int, default=32)
+
+    p = sub.add_parser("getstarlist")
+    p.add_argument("outdir")
+    p.add_argument("txtfile")
+
     args = ap.parse_args(argv)
     if args.tool == "amr2cube":
         cube = amr2cube(args.outdir, var=args.var, lmax=args.lmax)
@@ -547,6 +657,20 @@ def main(argv=None) -> int:
     elif args.tool == "part2list":
         n = part2list(args.outdir, args.txtfile)
         print(f"part2list: {n} particles -> {args.txtfile}")
+    elif args.tool == "part2map":
+        mp = part2map(args.outdir, n=args.n, axis=args.dir,
+                      family=args.family)
+        np.save(args.npyfile, mp)
+        print(f"part2map: {mp.shape} {args.family} -> {args.npyfile}")
+    elif args.tool == "vrot":
+        r, vr = vrot(args.outdir, args.center, axis=args.dir,
+                     nbins=args.nbins)
+        np.savetxt(args.txtfile, np.stack([r, vr], axis=1),
+                   header="r v_rot")
+        print(f"vrot: {args.nbins} bins -> {args.txtfile}")
+    elif args.tool == "getstarlist":
+        n = getstarlist(args.outdir, args.txtfile)
+        print(f"getstarlist: {n} stars -> {args.txtfile}")
     elif args.tool == "histo":
         H, xe, ye = histo(args.outdir, var_x=args.x, var_y=args.y,
                           nbins=args.nbins)

