@@ -1479,7 +1479,89 @@ class AmrSim:
             out = snapmod.dump_all(snap, iout, base_dir,
                                    namelist_path=namelist_path, ncpu=ncpu)
         self._dump_csv_extras(out, iout, dumper)
+        self._clumpfind_pass(out, iout)
         return out
+
+    def _clumpfind_pass(self, out: str, iout: int):
+        """In-run PHEW chain at output time (``clumpfind=.true.``,
+        ``pm/clump_finder.f90`` called from ``amr_step``/outputs):
+        deposit the LIVE particles, watershed with saddle-relevance
+        merging, unbind, write the clump table, and grow the run's
+        merger tree across outputs (``pm/merger_tree.f90``).
+
+        Runs synchronously inside ``dump`` (cost bounded by
+        ``nx_clump^ndim`` + per-clump unbinding) — an AsyncDumper
+        offloads the FILE writing only, like the reference whose
+        clump finder also runs inline at outputs.  The tree's halo
+        catalogues persist per output (``clump_cat_NNNNN.npz``) so a
+        restart rebuilds the cross-output links (the reference
+        re-reads progenitor data from prior outputs the same way)."""
+        import glob
+        import os
+
+        if not bool(getattr(self.params.run, "clumpfind", False)):
+            return
+        if self.p is None:
+            import warnings
+            warnings.warn("clumpfind=.true. needs particles (pic or "
+                          "SF); no clump tables will be written")
+            return
+        from ramses_tpu.pm.halo import (Halo, MergerTree,
+                                        write_halo_table)
+        from ramses_tpu.utils.halos import catalogue_from_arrays
+        cf = self.params.clumpfind
+        act = np.asarray(self.p.active)
+        x = np.asarray(self.p.x)[act]
+        if len(x) == 0:
+            return
+        halos = catalogue_from_arrays(
+            x, np.asarray(self.p.v)[act], np.asarray(self.p.m)[act],
+            np.asarray(self.p.idp)[act], self.boxlen,
+            nx=int(cf.nx_clump), threshold=float(cf.density_threshold),
+            relevance=float(cf.relevance_threshold),
+            npart_min=int(cf.npart_min), unbind=bool(cf.unbind),
+            saddle_pot=bool(cf.saddle_pot),
+            nmassbins=int(cf.nmassbins))
+        if cf.mass_threshold > 0 and act.any():
+            mp = float(np.asarray(self.p.m)[act].mean())
+            halos = [h for h in halos
+                     if h.mass >= cf.mass_threshold * mp]
+        os.makedirs(out, exist_ok=True)
+        write_halo_table(halos,
+                         os.path.join(out, f"clump_{iout:05d}.txt"))
+        if not hasattr(self, "_mergertree"):
+            self._mergertree = MergerTree()
+            # restart: rebuild the tree from the catalogues persisted
+            # alongside earlier outputs (they carry the particle ids
+            # the id-based linking needs)
+            base = os.path.dirname(os.path.abspath(out))
+            for f in sorted(glob.glob(
+                    os.path.join(base, "output_*",
+                                 "clump_cat_*.npz"))):
+                # only catalogues from BEFORE this output (a restart
+                # may overwrite later outputs of the aborted run)
+                if int(f[-9:-4]) >= iout:
+                    continue
+                z = np.load(f, allow_pickle=True)
+                old = [Halo(index=int(i), mass=float(mm),
+                            npart=len(hid), pos=pp, vel=vv,
+                            ekin=0.0, epot=0.0, ids=hid)
+                       for i, mm, pp, vv, hid in zip(
+                           z["index"], z["mass"], z["pos"], z["vel"],
+                           z["ids"])]
+                self._mergertree.add_snapshot(float(z["t"]), old)
+        np.savez_compressed(
+            os.path.join(out, f"clump_cat_{iout:05d}.npz"),
+            t=float(self.t),
+            index=np.array([h.index for h in halos]),
+            mass=np.array([h.mass for h in halos]),
+            pos=np.array([h.pos for h in halos]),
+            vel=np.array([h.vel for h in halos]),
+            ids=np.array([h.ids for h in halos], dtype=object))
+        self._mergertree.add_snapshot(float(self.t), halos)
+        if len(self._mergertree.snapshots) > 1:
+            self._mergertree.write(
+                os.path.join(out, f"mergertree_{iout:05d}.txt"))
 
     def _dump_csv_extras(self, out: str, iout: int, dumper=None):
         """Sink/stellar CSV companions in the output directory

@@ -44,6 +44,9 @@ class RunParams:
     # lightcone particle emission each coarse step (&RUN_PARAMS
     # lightcone, amr/light_cone.f90; geometry in &LIGHTCONE_PARAMS)
     lightcone: bool = False
+    # in-run PHEW clump finding at every output (&RUN_PARAMS clumpfind,
+    # pm/clump_finder.f90; options in &CLUMPFIND_PARAMS)
+    clumpfind: bool = False
     # Monte-Carlo gas tracers (&RUN_PARAMS tracer/MC_tracer,
     # pm/tracer_utils.f90): seed tracer_per_cell tracers per leaf cell
     tracer: bool = False
@@ -72,6 +75,19 @@ class AmrParams:
     nx: int = 1
     ny: int = 1
     nz: int = 1
+
+
+@dataclass
+class ClumpfindParams:
+    """&CLUMPFIND_PARAMS (pm/clfind_commons.f90:12-17)."""
+    density_threshold: float = -1.0   # code units; <0 → 5x mean density
+    relevance_threshold: float = 2.0  # peak/saddle merge ratio
+    mass_threshold: float = 0.0       # min clump mass [particle masses]
+    npart_min: int = 10
+    unbind: bool = True               # &UNBINDING_PARAMS role
+    saddle_pot: bool = False
+    nmassbins: int = 0
+    nx_clump: int = 64                # deposition grid per dim
 
 
 @dataclass
@@ -286,6 +302,8 @@ class Params:
     units: UnitsParams = field(default_factory=UnitsParams)
     lightcone: LightconeParams = field(
         default_factory=LightconeParams)
+    clumpfind: ClumpfindParams = field(
+        default_factory=ClumpfindParams)
     raw: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -308,6 +326,7 @@ _GROUP_MAP = {
     "rt_params": "rt",
     "units_params": "units",
     "lightcone_params": "lightcone",
+    "clumpfind_params": "clumpfind",
 }
 
 # fields that are per-region/bound/level lists: (field, count_attr, default)

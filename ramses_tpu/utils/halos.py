@@ -52,27 +52,43 @@ def load_particles(outdir: str):
             np.concatenate(ids), float(boxlen), float(t))
 
 
-def catalogue_output(outdir: str, nx: int = 64,
-                     threshold_over_mean: float = 5.0,
-                     relevance: float = 1.5, G: float = 1.0,
-                     npart_min: int = 10, unbind: bool = True,
-                     saddle_pot: bool = False, nmassbins: int = 0):
-    """Full chain on one output: deposit → watershed → unbind.
-    Returns (halos, t)."""
-    x, v, m, ids, boxlen, t = load_particles(outdir)
+def catalogue_from_arrays(x, v, m, ids, boxlen, nx: int = 64,
+                          threshold: float = -1.0,
+                          threshold_over_mean: float = 5.0,
+                          relevance: float = 1.5, G: float = 1.0,
+                          npart_min: int = 10, unbind: bool = True,
+                          saddle_pot: bool = False, nmassbins: int = 0):
+    """PHEW chain on in-memory particle arrays: deposit → watershed →
+    unbind.  Shared by the offline CLI and the in-run
+    ``clumpfind=.true.`` pass.  ``threshold``: absolute density
+    threshold in code units (<0 → ``threshold_over_mean`` × mean)."""
     nd = x.shape[1]
     dx = boxlen / nx
     idx = tuple(np.clip((np.mod(x[:, d], boxlen) / dx).astype(int),
                         0, nx - 1) for d in range(nd))
     rho = np.zeros((nx,) * nd)
     np.add.at(rho, idx, m / dx ** nd)
-    thr = float(rho.mean()) * threshold_over_mean
+    thr = (float(threshold) if threshold > 0
+           else float(rho.mean()) * threshold_over_mean)
     labels, _ = find_clumps(rho, thr, relevance=relevance, dx=dx)
-    pl = particle_labels(x, labels, dx, boxlen)
+    pl = np.asarray(labels)[idx]        # NGP labels, one gather
     return build_catalogue(x, v, m, ids, pl, boxlen, G=G,
                            unbind=unbind, npart_min=npart_min,
-                           saddle_pot=saddle_pot,
-                           nmassbins=nmassbins), t
+                           saddle_pot=saddle_pot, nmassbins=nmassbins)
+
+
+def catalogue_output(outdir: str, nx: int = 64,
+                     threshold_over_mean: float = 5.0,
+                     relevance: float = 1.5, G: float = 1.0,
+                     npart_min: int = 10, unbind: bool = True,
+                     saddle_pot: bool = False, nmassbins: int = 0):
+    """Full chain on one output directory; returns (halos, t)."""
+    x, v, m, ids, boxlen, t = load_particles(outdir)
+    return catalogue_from_arrays(
+        x, v, m, ids, boxlen, nx=nx,
+        threshold_over_mean=threshold_over_mean, relevance=relevance,
+        G=G, npart_min=npart_min, unbind=unbind,
+        saddle_pot=saddle_pot, nmassbins=nmassbins), t
 
 
 def main(argv=None) -> int:
