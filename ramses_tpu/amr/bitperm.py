@@ -1,0 +1,82 @@
+"""Flat↔dense conversion for COMPLETE levels as a bit-permutation
+reshape/transpose — no gather.
+
+A complete level's flat row order is (sorted-Morton oct index) × (cell
+offset): the sorted Morton keys of a full oct grid are simply
+0..noct-1, so the flat cell index is a fixed *bit permutation* of the
+dense C-order ravel index::
+
+    flat bits (MSB→LSB):  [z_{l-1} y_{l-1} x_{l-1}] … [z_1 y_1 x_1] [x_0 y_0 z_0]
+    dense bits (MSB→LSB): [x_{l-1} … x_0] [y_{l-1} … y_0] [z_{l-1} … z_0]
+
+(x_k = bit k of the cell's x coordinate; the oct Morton triplets carry
+coordinate bits 1..l-1 with z most significant per triplet —
+``amr/keys.py`` ``encode`` — and the within-oct offset carries bit 0
+with x slowest — ``amr/tree.py`` ``cell_offsets``.)
+
+A gather by this permutation moves one ~nvar-float row per index: on
+TPU that lowers to millions of latency-bound small copies and was the
+dominant cost of the steady-state AMR step (BENCH_CAPTURED_r04).  A
+reshape to ``(2,)*ndim*lvl`` axes + transpose expresses the same data
+movement with static regular strides that XLA vectorizes.
+
+Only valid for cubic complete levels (2^lvl cells per dim); callers
+fall back to the index-permutation gather otherwise (non-cubic roots).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+
+
+@lru_cache(maxsize=None)
+def _bit_axes(lvl: int, ndim: int) -> tuple:
+    """Transpose permutation taking flat bit-axis order to dense
+    (coordinate-major) bit-axis order.  Axis p of the reshaped flat
+    array holds the p-th most significant flat index bit."""
+    pos = {}
+    p = 0
+    for i in range(lvl - 1, 0, -1):           # oct Morton triplets
+        for d in range(ndim - 1, -1, -1):     # z most significant
+            pos[(d, i)] = p
+            p += 1
+    for d in range(ndim):                     # within-oct: x slowest
+        pos[(d, 0)] = p
+        p += 1
+    return tuple(pos[(d, i)] for d in range(ndim)
+                 for i in range(lvl - 1, -1, -1))
+
+
+@lru_cache(maxsize=None)
+def _inv_bit_axes(lvl: int, ndim: int) -> tuple:
+    fwd = _bit_axes(lvl, ndim)
+    inv = [0] * len(fwd)
+    for i, a in enumerate(fwd):
+        inv[a] = i
+    return tuple(inv)
+
+
+def flat_to_dense(rows, lvl: int, ndim: int):
+    """[ncell(+pad), *trailing] flat-order rows → dense
+    ``(2^lvl,)*ndim + trailing`` array (pure reshape/transpose)."""
+    n = 1 << lvl
+    ncell = n ** ndim
+    trailing = rows.shape[1:]
+    nb = ndim * lvl
+    x = rows[:ncell].reshape((2,) * nb + trailing)
+    ax = _bit_axes(lvl, ndim) + tuple(range(nb, nb + len(trailing)))
+    return jnp.transpose(x, ax).reshape((n,) * ndim + trailing)
+
+
+def dense_to_flat(dense, lvl: int, ndim: int):
+    """Dense ``(2^lvl,)*ndim + trailing`` array → [ncell, *trailing]
+    flat-order rows (inverse of :func:`flat_to_dense`)."""
+    n = 1 << lvl
+    ncell = n ** ndim
+    trailing = dense.shape[ndim:]
+    nb = ndim * lvl
+    x = dense.reshape((2,) * nb + trailing)
+    ax = _inv_bit_axes(lvl, ndim) + tuple(range(nb, nb + len(trailing)))
+    return jnp.transpose(x, ax).reshape((ncell,) + trailing)

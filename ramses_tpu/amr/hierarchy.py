@@ -122,7 +122,7 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
             advance(i + 1, 0.5 * dtl)
             advance(i + 1, 0.5 * dtl)
         if spec.complete[i]:
-            out = K.dense_sweep(u[l], d["inv_perm"], d["perm"],
+            out = K.dense_sweep(u[l], d.get("inv_perm"), d.get("perm"),
                                 d["ok_dense"], dtl, dx(l),
                                 (1 << l,) * cfg.ndim, spec.bspec, cfg,
                                 ret_flux=spec.want_flux)
@@ -240,7 +240,8 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
     for i, l in enumerate(spec.levels):
         d = dev[l]
         if spec.complete[i]:
-            fl = K.dense_refine_flags(u[l], d["inv_perm"], d["perm"], eg,
+            fl = K.dense_refine_flags(u[l], d.get("inv_perm"),
+                                      d.get("perm"), eg,
                                       fls, (1 << l,) * cfg.ndim,
                                       spec.bspec, cfg,
                                       dx=spec.boxlen / (1 << l))
@@ -744,10 +745,12 @@ class AmrSim:
             self.maps[l] = m
             valid_cell = np.repeat(m.valid_oct, 2 ** self.tree.ndim)
             if m.complete:
-                # dense path: permutation + restriction only
+                # dense path: restriction (+ refined mask) only.  The
+                # flat↔dense permutation is a bit-permutation transpose
+                # on cubic levels (amr/bitperm.py) — no device index
+                # arrays needed; non-cubic roots would ship perm maps
+                # here when the hierarchy grows that support.
                 self.dev[l] = dict(
-                    perm=self._place(jnp.asarray(m.perm), "cells"),
-                    inv_perm=self._place(jnp.asarray(m.inv_perm), "cells"),
                     ok_dense=(self._place(jnp.asarray(m.ok_dense), "cells")
                               if m.ok_dense is not None else None),
                     ref_cell=self._place(jnp.asarray(m.ref_cell), "rep"),
@@ -1174,12 +1177,14 @@ class AmrSim:
                 # box is open), force by central differences
                 nb_ = 1 << l
                 ncell = m.noct * (1 << nd)
-                dense = rhs[d["inv_perm"]].reshape((nb_,) * nd)
+                shp = (nb_,) * nd
+                dense = K.rows_to_dense(rhs, d.get("inv_perm"), shp)
                 if self.grav_periodic:
                     phi_dense = fft_solve(dense, dx)
-                    fg_rows = gs.grad_dense(phi_dense,
-                                            jnp.asarray(dx, rhs.dtype),
-                                            nd)[d["perm"]]
+                    fg_rows = K.dense_to_rows(
+                        gs.grad_dense(phi_dense,
+                                      jnp.asarray(dx, rhs.dtype), nd),
+                        d.get("perm"), shp)
                 else:
                     from ramses_tpu.poisson.isolated import (
                         grad_isolated, isolated_solve)
@@ -1187,12 +1192,12 @@ class AmrSim:
                     phi_dense, gh = isolated_solve(
                         dense / coeff, dx, jnp.asarray(coeff, rhs.dtype),
                         iters=300, tol=float(self.params.poisson.epsilon))
-                    fg_rows = jnp.moveaxis(
-                        grad_isolated(phi_dense, gh, dx), 0, -1
-                    ).reshape(-1, nd)[d["perm"]]
+                    fg_rows = K.dense_to_rows(jnp.moveaxis(
+                        grad_isolated(phi_dense, gh, dx), 0, -1),
+                        d.get("perm"), shp)
                 phi = jnp.zeros((m.ncell_pad,), rhs.dtype)
                 phi = phi.at[:ncell].set(
-                    phi_dense.reshape(-1)[d["perm"]])
+                    K.dense_to_rows(phi_dense, d.get("perm"), shp))
                 if m.ncell_pad > ncell:
                     fg_rows = jnp.zeros(
                         (m.ncell_pad, nd), fg_rows.dtype

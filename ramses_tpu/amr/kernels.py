@@ -16,9 +16,47 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ramses_tpu.amr import bitperm
 from ramses_tpu.hydro import muscl
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.hydro.timestep import cell_dt
+
+
+def pow2_cube(shape) -> bool:
+    """True when every dim equals the same power of two — the complete
+    cubic-level case where flat↔dense is a bit permutation
+    (:mod:`ramses_tpu.amr.bitperm`) instead of an index gather."""
+    s0 = shape[0]
+    return (s0 & (s0 - 1)) == 0 and all(s == s0 for s in shape)
+
+
+def rows_to_dense(rows, inv_perm, shape):
+    """Flat-order rows ``[ncell(+pad), *trailing]`` → dense
+    ``[*shape, *trailing]``.  Bit-permutation transpose on cubic
+    power-of-two levels (no gather — the TPU fast path); index gather
+    through ``inv_perm`` otherwise."""
+    if pow2_cube(shape):
+        return bitperm.flat_to_dense(rows, shape[0].bit_length() - 1,
+                                     len(shape))
+    if inv_perm is None:
+        raise ValueError(f"non-cubic complete level {shape} needs an "
+                         "inv_perm index map")
+    return rows[inv_perm].reshape(shape + rows.shape[1:])
+
+
+def dense_to_rows(dense, perm, shape):
+    """Dense ``[*shape, *trailing]`` → flat-order rows (inverse of
+    :func:`rows_to_dense`)."""
+    nd = len(shape)
+    if pow2_cube(shape):
+        return bitperm.dense_to_flat(dense, shape[0].bit_length() - 1, nd)
+    if perm is None:
+        raise ValueError(f"non-cubic complete level {shape} needs a "
+                         "perm index map")
+    ncell = 1
+    for s in shape:
+        ncell *= s
+    return dense.reshape((ncell,) + dense.shape[nd:])[perm]
 
 
 def _unsplit_fn(cfg):
@@ -229,8 +267,8 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
     ncell = 1
     for s in shape:
         ncell *= s
-    ud = u_flat[inv_perm]                              # dense row order
-    ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)  # [nvar, *shape]
+    ud = rows_to_dense(u_flat, inv_perm, shape)        # [*shape, nvar]
+    ud = jnp.moveaxis(ud, -1, 0)                       # [nvar, *shape]
     if not ret_flux and pk.kernel_available(cfg, shape, bc.faces,
                                             ud.dtype):
         # fused TPU kernel path (same physics, VMEM-resident pipeline);
@@ -238,7 +276,7 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
         ok = ok_dense.reshape(shape) if ok_dense is not None else None
         up, okp = pk.pad_xy(ud, bc, cfg, ok=ok)
         un = pk.fused_step_padded(up, dt, cfg, dx, shape, ok_pad=okp)
-        du_rows = jnp.moveaxis(un - ud, 0, -1).reshape(ncell, nvar)[perm]
+        du_rows = dense_to_rows(jnp.moveaxis(un - ud, 0, -1), perm, shape)
         if u_flat.shape[0] > ncell:
             du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
         return du_rows
@@ -265,7 +303,7 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
     if tmp is not None and (cfg.pressure_fix or cfg.nener):
         un = muscl.dual_energy_fix(up, un, tmp, dt, (dx,) * nd, cfg)
     du_dense = bmod.unpad(un, nd, muscl.NGHOST) - ud   # [nvar, *shape]
-    du_rows = jnp.moveaxis(du_dense, 0, -1).reshape(ncell, nvar)[perm]
+    du_rows = dense_to_rows(jnp.moveaxis(du_dense, 0, -1), perm, shape)
     if u_flat.shape[0] > ncell:
         du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
     if not ret_flux:
@@ -277,9 +315,9 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
         lo_ix = tuple(slice(g, g + shape[dd]) for dd in range(nd))
         hi_ix = tuple(slice(g + 1, g + 1 + shape[dd]) if dd == d
                       else slice(g, g + shape[dd]) for dd in range(nd))
-        phis.append(jnp.stack([f0[lo_ix].reshape(ncell),
-                               f0[hi_ix].reshape(ncell)], axis=-1))
-    phi = jnp.stack(phis, axis=-2)[perm]               # [ncell, ndim, 2]
+        phis.append(jnp.stack([f0[lo_ix], f0[hi_ix]], axis=-1))
+    phi = dense_to_rows(jnp.stack(phis, axis=-2), perm,
+                        shape)                         # [ncell, ndim, 2]
     if u_flat.shape[0] > ncell:
         phi = jnp.zeros((u_flat.shape[0], nd, 2),
                         phi.dtype).at[:ncell].set(phi)
@@ -301,12 +339,11 @@ def dense_refine_flags(u_flat, inv_perm, perm,
     ncell = 1
     for s in shape:
         ncell *= s
-    ud = u_flat[inv_perm]
-    ud = jnp.moveaxis(ud.reshape(shape + (nvar,)), -1, 0)
+    ud = jnp.moveaxis(rows_to_dense(u_flat, inv_perm, shape), -1, 0)
     up = bmod.pad(ud, bc, cfg, 1, dx=dx)
     ok = _flags_fn(cfg)(up, err_grad, floors, spatial0=0, cfg=cfg)
     ok = ok[tuple(slice(1, -1) for _ in range(nd))]    # interior
-    flags_flat = ok.reshape(-1)[perm]                  # flat cell order
+    flags_flat = dense_to_rows(ok, perm, shape)        # flat cell order
     return flags_flat.reshape(ncell // 2 ** nd, 2 ** nd)
 
 

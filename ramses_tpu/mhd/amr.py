@@ -402,15 +402,15 @@ def _mhd_fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
         if spec.complete[i]:
             shape = (1 << l,) * nd
             ncell = shape[0] ** nd
-            ud = u[l][d["inv_perm"]]
-            ud = jnp.moveaxis(ud.reshape(shape + (cfg.nvar,)), -1, 0)
+            ud = jnp.moveaxis(
+                K.rows_to_dense(u[l], d.get("inv_perm"), shape), -1, 0)
             # ghost-pad per the physical BCs: a raw roll would wrap the
             # two domain edges together and flag phantom gradients there
             up = mu._pad(ud, nd, bc_kinds, 1)
             ok = _mhd_grad_flags(up, eg, fls, 0, cfg)
             ok = ok[tuple(slice(1, -1) for _ in range(nd))]
-            fl = ok.reshape(-1)[d["perm"]].reshape(ncell // 2 ** nd,
-                                                   2 ** nd)
+            fl = K.dense_to_rows(ok, d.get("perm"), shape).reshape(
+                ncell // 2 ** nd, 2 ** nd)
         else:
             if l == spec.lmin:
                 interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
@@ -500,11 +500,11 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
             ncell = shape[0] ** nd
             grid = mu.MhdGrid(cfg=cfg, shape=shape, dx=dx(l),
                               bc_kinds=bc_kinds)
-            ud = u[l][d["inv_perm"]]
-            ud = jnp.moveaxis(ud.reshape(shape + (cfg.nvar,)), -1, 0)
-            bl = bf[l][d["inv_perm"]]                  # [ncell, 3, 2]
-            bfd = jnp.stack([bl[:, c, 0].reshape(shape)
-                             for c in range(NCOMP)])
+            ud = jnp.moveaxis(
+                K.rows_to_dense(u[l], d.get("inv_perm"), shape), -1, 0)
+            bld = K.rows_to_dense(bf[l], d.get("inv_perm"),
+                                  shape)               # [*shape, 3, 2]
+            bfd = jnp.stack([bld[..., c, 0] for c in range(NCOMP)])
             ok_d = (d["ok_dense"].reshape(shape)
                     if d.get("ok_dense") is not None else None)
             override = None
@@ -523,8 +523,8 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
                                           vals.reshape(shape))
             un_d, bfn_d = mu.step(grid, ud, bfd, dtl, ok=ok_d,
                                   emf_override=override)
-            du_rows = jnp.moveaxis(un_d - ud, 0,
-                                   -1).reshape(ncell, cfg.nvar)[d["perm"]]
+            du_rows = K.dense_to_rows(jnp.moveaxis(un_d - ud, 0, -1),
+                                      d.get("perm"), shape)
             if u[l].shape[0] > ncell:
                 du_rows = jnp.zeros_like(u[l]).at[:ncell].set(
                     du_rows.astype(u[l].dtype))
@@ -536,10 +536,9 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
                     hi_d = _dense_hi(lo_d, c, bc_kinds[c][0] == 0)
                 else:
                     hi_d = lo_d
-                comps.append(jnp.stack(
-                    [lo_d.reshape(-1)[d["perm"]],
-                     hi_d.reshape(-1)[d["perm"]]], axis=-1))
-            b_rows = jnp.stack(comps, axis=1)
+                comps.append(jnp.stack([lo_d, hi_d], axis=-1))
+            b_rows = K.dense_to_rows(jnp.stack(comps, axis=-2),
+                                     d.get("perm"), shape)
             bf[l] = bf[l].at[:ncell].set(b_rows.astype(bf[l].dtype)) \
                 if bf[l].shape[0] > ncell else b_rows.astype(bf[l].dtype)
         else:

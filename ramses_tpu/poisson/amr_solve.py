@@ -199,8 +199,9 @@ def grad_phi(phi, ghosts, nb, dx, valid, ndim: int):
 def grad_dense(phi_dense, dx, ndim: int):
     """f = −∇φ on a dense periodic grid, 4th-order 5-point stencil
     (``force_fine``'s gradient, the same operator as
-    ``poisson/force.py:gradient_phi``); returns raveled rows
-    [ncell, ndim] (the complete-level companion of :func:`grad_phi`)."""
+    ``poisson/force.py:gradient_phi``); returns the dense grid
+    ``[*shape, ndim]`` (the complete-level companion of
+    :func:`grad_phi`)."""
     a = 2.0 / (3.0 * dx)
     b = 1.0 / (12.0 * dx)
     comps = []
@@ -208,7 +209,7 @@ def grad_dense(phi_dense, dx, ndim: int):
         d1 = jnp.roll(phi_dense, -1, axis=d) - jnp.roll(phi_dense, 1, axis=d)
         d2 = jnp.roll(phi_dense, -2, axis=d) - jnp.roll(phi_dense, 2, axis=d)
         comps.append(-(a * d1 - b * d2))
-    return jnp.stack(comps, axis=-1).reshape(-1, ndim)
+    return jnp.stack(comps, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("ndim",))

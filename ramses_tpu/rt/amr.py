@@ -322,21 +322,22 @@ class RtAmrCoupled:
                 shim = _CfgShim(nd, ncols)
                 if m.complete:
                     nb = 1 << l
-                    dense = rad[d["inv_perm"]]
+                    shp = (nb,) * nd
+                    dense = K.rows_to_dense(rad, d.get("inv_perm"), shp)
                     cols = []
                     for g in range(ng):
                         c0 = self._ncol(g)
-                        N = dense[:, c0].reshape((nb,) * nd)
-                        F = jnp.stack(
-                            [dense[:, c0 + 1 + c].reshape((nb,) * nd)
-                             for c in range(nd)])
+                        N = dense[..., c0]
+                        F = jnp.stack([dense[..., c0 + 1 + c]
+                                       for c in range(nd)])
                         N, F = m1.transport_step(
                             N, F, dt_sub, dx_cgs, spec.c_red, nd,
                             periodic=spec.periodic)
-                        cols.append(N.reshape(-1, 1))
-                        cols.extend(F[c].reshape(-1, 1)
-                                    for c in range(nd))
-                    rows = jnp.concatenate(cols, axis=1)[d["perm"]]
+                        cols.append(N[..., None])
+                        cols.extend(F[c][..., None] for c in range(nd))
+                    rows = K.dense_to_rows(
+                        jnp.concatenate(cols, axis=-1), d.get("perm"),
+                        shp)
                     ncell = m.noct * (1 << nd)
                     if m.ncell_pad > ncell:
                         rad = rad.at[:ncell].set(rows)

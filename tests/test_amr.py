@@ -228,3 +228,46 @@ def test_outflow_momentum_flux():
         _, u = sim.leaf_sample(l)
         assert np.isfinite(u).all()
         assert (u[:, 0] > 0).all()
+
+
+class TestBitperm:
+    """flat↔dense bit-permutation conversion vs the index maps
+    (amr/bitperm.py vs LevelMaps.perm/inv_perm)."""
+
+    def _check(self, ndim, lvl):
+        import numpy as np
+
+        from ramses_tpu.amr import bitperm
+        from ramses_tpu.amr import maps as mapmod
+        from ramses_tpu.amr.tree import Octree
+
+        tree = Octree.base(ndim, lvl, lvl)
+        m = mapmod.build_level_maps(tree, lvl, [(0, 0)] * ndim)
+        assert m.complete
+        n = 1 << lvl
+        ncell = n ** ndim
+        rng = np.random.default_rng(lvl * 10 + ndim)
+        rows = rng.standard_normal((ncell, 3)).astype(np.float32)
+        dense_ref = rows[m.inv_perm].reshape((n,) * ndim + (3,))
+        dense = np.asarray(bitperm.flat_to_dense(
+            jnp.asarray(rows), lvl, ndim))
+        assert np.array_equal(dense, dense_ref)
+        back = np.asarray(bitperm.dense_to_flat(
+            jnp.asarray(dense), lvl, ndim))
+        assert np.array_equal(back, rows)
+        # scalar trailing-free arrays too
+        d1 = np.asarray(bitperm.flat_to_dense(
+            jnp.asarray(rows[:, 0]), lvl, ndim))
+        assert np.array_equal(d1, rows[m.inv_perm, 0].reshape((n,) * ndim))
+
+    def test_3d(self):
+        for lvl in (1, 2, 3, 4):
+            self._check(3, lvl)
+
+    def test_2d(self):
+        for lvl in (1, 2, 3, 5):
+            self._check(2, lvl)
+
+    def test_1d(self):
+        for lvl in (1, 3, 6):
+            self._check(1, lvl)

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Component-level device-time breakdown of the steady-state AMR step.
+
+The VERDICT-r04 mandate: find the measured 678x per-cell-update overhead
+of the AMR path vs the uniform kernel WITH A MEASUREMENT, not a guess.
+This tool times each device kernel of the fused coarse step in
+isolation, at the exact live shapes of the bench configuration
+(sedov3d levelmin=7 levelmax=9 by default), plus the candidate
+conversions (index-gather vs bit-permutation transpose) side by side.
+
+Emits one JSON object; tools/write_trace_doc.py renders it into
+docs/perf-trace-r05.md.
+
+Optionally wraps 3 steady-state steps in a ``jax.profiler.trace``
+(PROFILE_TRACE_DIR env) for op-level inspection where the tensorboard
+profile plugin exists.
+
+Env: PROF_LMIN, PROF_LMAX, PROF_WARM, PROF_REPS, PROFILE_TRACE_DIR.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, reps, sync):
+    """Median-free simple wall: warm once (compile), sync, run reps,
+    sync; returns seconds per call."""
+    out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def _sync(x):
+    """Hard sync: host-fetch one element of every leaf (block_until_ready
+    alone can return early over a tunneled device)."""
+    leaves = jax.tree_util.tree_leaves(x)
+    jax.device_get([l.ravel()[:1] for l in leaves if hasattr(l, "ravel")])
+
+
+def main():
+    from ramses_tpu.amr import bitperm
+    from ramses_tpu.amr import kernels as K
+    from ramses_tpu.amr.hierarchy import (AmrSim, _fused_coarse_step,
+                                          _fused_courant)
+    from ramses_tpu.config import load_params
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lmin = int(os.environ.get("PROF_LMIN", "7"))
+    lmax = int(os.environ.get("PROF_LMAX", "9"))
+    warm = int(os.environ.get("PROF_WARM", "15"))
+    reps = int(os.environ.get("PROF_REPS", "10"))
+    params = load_params(os.path.join(here, "namelists", "sedov3d.nml"),
+                        ndim=3)
+    params.amr.levelmin, params.amr.levelmax = lmin, lmax
+    params.refine.err_grad_d = 0.1
+    params.refine.err_grad_p = 0.1
+    sim = AmrSim(params, dtype=jnp.float32)
+    sim.evolve(1e9, nstepmax=warm)          # develop the blast + compile
+    sim.regrid_interval = 0                 # freeze the tree
+    spec = sim._fused_spec()
+    dt = jnp.asarray(sim.coarse_dt(), sim.dtype)
+    res = {"device": str(jax.devices()[0].platform),
+           "octs_per_level": {str(l): sim.tree.noct(l)
+                              for l in sim.levels()},
+           "levels": list(sim.levels()), "reps": reps}
+
+    t = {}
+
+    # --- full fused coarse step (the steady-state unit of work) ------
+    t["fused_coarse_step"] = timeit(
+        lambda: _fused_coarse_step(sim.u, sim.dev, {}, dt, spec, None),
+        reps, _sync)
+
+    # --- per-component, exact live shapes ----------------------------
+    lb = sim.lmin
+    d = sim.dev[lb]
+    u0 = sim.u[lb]
+    shape = (1 << lb,) * sim.cfg.ndim
+    ncell = shape[0] ** sim.cfg.ndim
+
+    t["dense_sweep_base"] = timeit(
+        lambda: K.dense_sweep(u0, d.get("inv_perm"), d.get("perm"),
+                              d["ok_dense"], dt, sim.dx(lb), shape,
+                              sim.bspec, sim.cfg), reps, _sync)
+
+    # conversions: bit-permutation transpose vs index gather
+    f2d = jax.jit(lambda u: bitperm.flat_to_dense(u, lb, 3))
+    d2f = jax.jit(lambda ud: bitperm.dense_to_flat(ud, lb, 3))
+    ud = f2d(u0)
+    t["flat_to_dense_bitperm"] = timeit(lambda: f2d(u0), reps, _sync)
+    t["dense_to_flat_bitperm"] = timeit(lambda: d2f(ud), reps, _sync)
+    m = sim.maps[lb]
+    inv_perm = jnp.asarray(m.inv_perm)
+    perm = jnp.asarray(m.perm)
+    gat = jax.jit(lambda u, i: u[i])
+    t["flat_to_dense_gather"] = timeit(lambda: gat(u0, inv_perm), reps,
+                                       _sync)
+    rows = u0[:ncell]
+    t["dense_to_flat_gather"] = timeit(lambda: gat(rows, perm), reps,
+                                       _sync)
+
+    # pure dense kernel (what the uniform bench runs per 128^3)
+    from ramses_tpu.hydro import pallas_muscl as pk
+    if pk.kernel_available(sim.cfg, shape, sim.bspec.faces, u0.dtype):
+        ok = d["ok_dense"].reshape(shape)
+        udm = jnp.moveaxis(ud, -1, 0)
+
+        @jax.jit
+        def dense_kernel(udm):
+            up, okp = pk.pad_xy(udm, sim.bspec, sim.cfg, ok=ok)
+            return pk.fused_step_padded(up, dt, sim.cfg, sim.dx(lb),
+                                        shape, ok_pad=okp)
+        t["pallas_dense_kernel"] = timeit(lambda: dense_kernel(udm),
+                                          reps, _sync)
+
+    for l in sim.levels():
+        if sim.maps[l].complete:
+            continue
+        dl = sim.dev[l]
+        itp = K.interp_cells(sim.u[l - 1], dl["interp_cell"],
+                             dl["interp_nb"], dl["interp_sgn"], sim.cfg,
+                             itype=spec.itype)
+        t[f"interp_cells_L{l}"] = timeit(
+            lambda: K.interp_cells(sim.u[l - 1], dl["interp_cell"],
+                                   dl["interp_nb"], dl["interp_sgn"],
+                                   sim.cfg, itype=spec.itype), reps,
+            _sync)
+        t[f"level_sweep_L{l}"] = timeit(
+            lambda: K.level_sweep(sim.u[l], itp, dl["stencil_src"],
+                                  dl["vsgn"], dl["ok_ref"], None, dt,
+                                  sim.dx(l), sim.cfg), reps, _sync)
+        t[f"scatter_corr_L{l}"] = timeit(
+            lambda: K.scatter_corrections(
+                sim.u[l - 1],
+                jnp.zeros((sim.maps[l].noct_pad, 3, 2, sim.cfg.nvar),
+                          sim.dtype), dl["corr_idx"], sim.cfg),
+            reps, _sync)
+
+    t["restrict_upload_base"] = timeit(
+        lambda: K.restrict_upload(sim.u[lb], sim.u[lb + 1],
+                                  d["ref_cell"], d["son_oct"], sim.cfg),
+        reps, _sync) if sim.tree.has(lb + 1) else None
+
+    t["fused_courant"] = timeit(
+        lambda: _fused_courant(sim.u, sim.dev, spec), reps, _sync)
+
+    # steady-state chunk throughput (the bench's steady_state number)
+    nss = 8
+    n0 = sim.nstep
+    sim.evolve(1e9, nstepmax=sim.nstep + nss)   # warm the scan chunks
+    sim.drain()
+    ttd = 2 ** sim.cfg.ndim
+    upd = sum(sim.tree.noct(l) * ttd * 2 ** (l - sim.lmin)
+              for l in sim.levels())
+    t0 = time.perf_counter()
+    sim.evolve(1e9, nstepmax=sim.nstep + nss)
+    sim.drain()
+    wss = time.perf_counter() - t0
+    res["steady_state_cell_updates_per_sec"] = nss * upd / wss
+    res["steady_state_s_per_coarse_step"] = wss / nss
+    res["updates_per_coarse_step"] = upd
+
+    tdir = os.environ.get("PROFILE_TRACE_DIR")
+    if tdir:
+        with jax.profiler.trace(tdir):
+            sim.evolve(1e9, nstepmax=sim.nstep + 3)
+            sim.drain()
+        res["trace_dir"] = tdir
+
+    res["timings_s"] = {k: (round(v, 6) if v is not None else None)
+                        for k, v in t.items()}
+    print("##PROF##" + json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
