@@ -114,7 +114,8 @@ def main():
     # pure dense kernel (what the uniform bench runs per 128^3)
     from ramses_tpu.hydro import pallas_muscl as pk
     if pk.kernel_available(sim.cfg, shape, sim.bspec.faces, u0.dtype):
-        ok = d["ok_dense"].reshape(shape)
+        ok = (d["ok_dense"].reshape(shape)
+              if d.get("ok_dense") is not None else None)
         udm = jnp.moveaxis(ud, -1, 0)
 
         @jax.jit
