@@ -391,6 +391,14 @@ def main():
 
     sub = {}
     device = dtype_name = None
+    # clear any stale partial from a previous run BEFORE the first sub:
+    # a driver kill during sub 1 must not leave run N-1's numbers
+    # masquerading as run N's
+    try:
+        with open(partial_path, "w") as f:
+            json.dump({"budget_s": budget, "sub": {}}, f)
+    except OSError:
+        pass
     for name in wanted:
         sub[name] = run_sub(name, deadline,
                             weight=0.95 if len(wanted) == 1 else None)

@@ -356,6 +356,11 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     sim._dt_cache = None
     sim.t = float(meta["t"])
     sim.nstep = int(meta["nstep"])
+    if bool(params.run.lightcone) and sim.cosmo is not None:
+        # seed the shell chain from the restored epoch so the first
+        # post-restart coarse step emits its shell instead of silently
+        # dropping it (the lazily-initialized prev-aexp would skip it)
+        sim._cone_aexp_prev = sim.aexp_now()
     # the pending closing half-kick of the pre-dump step needs the old
     # coarse dt (KDK: the first post-restart kick is 0.5*(dtold + dt)),
     # and the stored dtnew makes the restart take the SAME next step a
@@ -468,6 +473,12 @@ class AmrSim:
             from ramses_tpu.pm.cosmology import Cosmology
             self.cosmo = Cosmology.from_params(params)
             self.t = float(self.cosmo.tau_ini)
+            if bool(params.run.lightcone):
+                # seed the lightcone shell chain at the run's start so
+                # the FIRST coarse step emits its shell (restarts
+                # re-seed from the restored epoch in
+                # restore_amr_scaffold)
+                self._cone_aexp_prev = self.cosmo.aexp_ini
         # dense base-grid gas ICs (grafic baryons) sampled per level
         self._init_dense = (np.asarray(init_dense_u)
                             if init_dense_u is not None else None)
@@ -1538,23 +1549,54 @@ class AmrSim:
             self._mergertree = MergerTree()
             # restart: rebuild the tree from the catalogues persisted
             # alongside earlier outputs (they carry the particle ids
-            # the id-based linking needs)
+            # the id-based linking needs).  ids ride as a flat int
+            # array + offsets — no object arrays, no allow_pickle —
+            # and the output index comes from the filename pattern,
+            # skipping anything that doesn't match.
+            import re
             base = os.path.dirname(os.path.abspath(out))
             for f in sorted(glob.glob(
                     os.path.join(base, "output_*",
                                  "clump_cat_*.npz"))):
+                mm_ = re.search(r"clump_cat_(\d+)\.npz$",
+                                os.path.basename(f))
                 # only catalogues from BEFORE this output (a restart
                 # may overwrite later outputs of the aborted run)
-                if int(f[-9:-4]) >= iout:
+                if mm_ is None or int(mm_.group(1)) >= iout:
                     continue
-                z = np.load(f, allow_pickle=True)
-                old = [Halo(index=int(i), mass=float(mm),
-                            npart=len(hid), pos=pp, vel=vv,
-                            ekin=0.0, epot=0.0, ids=hid)
-                       for i, mm, pp, vv, hid in zip(
-                           z["index"], z["mass"], z["pos"], z["vel"],
-                           z["ids"])]
-                self._mergertree.add_snapshot(float(z["t"]), old)
+                try:
+                    z = np.load(f)
+                    if "ids_off" in z.files:
+                        off = np.asarray(z["ids_off"], dtype=np.int64)
+                        flat = np.asarray(z["ids_flat"], dtype=np.int64)
+                        ids = [flat[off[k]:off[k + 1]]
+                               for k in range(len(off) - 1)]
+                    elif "ids" in z.files:
+                        # legacy r04 object-array layout: the one case
+                        # allow_pickle is still accepted for, so an
+                        # existing run's history survives the format
+                        # change
+                        z = np.load(f, allow_pickle=True)
+                        ids = [np.asarray(i, dtype=np.int64)
+                               for i in z["ids"]]
+                    else:
+                        raise KeyError("no ids_off/ids record")
+                    old = [Halo(index=int(i), mass=float(mm),
+                                npart=len(hid), pos=pp, vel=vv,
+                                ekin=0.0, epot=0.0, ids=hid)
+                           for i, mm, pp, vv, hid in zip(
+                               z["index"], z["mass"], z["pos"],
+                               z["vel"], ids)]
+                    t_snap = float(z["t"])
+                except Exception as e:      # truncated zip, missing keys
+                    import warnings
+                    warnings.warn(f"skipping malformed clump "
+                                  f"catalogue {f}: {e}")
+                    continue
+                self._mergertree.add_snapshot(t_snap, old)
+        ids_off = np.concatenate(
+            [[0], np.cumsum([len(h.ids) for h in halos])]
+        ).astype(np.int64)
         np.savez_compressed(
             os.path.join(out, f"clump_cat_{iout:05d}.npz"),
             t=float(self.t),
@@ -1562,7 +1604,9 @@ class AmrSim:
             mass=np.array([h.mass for h in halos]),
             pos=np.array([h.pos for h in halos]),
             vel=np.array([h.vel for h in halos]),
-            ids=np.array([h.ids for h in halos], dtype=object))
+            ids_off=ids_off,
+            ids_flat=(np.concatenate([h.ids for h in halos])
+                      if halos else np.zeros(0)).astype(np.int64))
         self._mergertree.add_snapshot(float(self.t), halos)
         if len(self._mergertree.snapshots) > 1:
             self._mergertree.write(

@@ -390,12 +390,21 @@ class Simulation:
     def dump(self, iout: Optional[int] = None, base_dir: Optional[str] = None,
              namelist_path: Optional[str] = None) -> str:
         """Write a reference-format ``output_NNNNN/`` snapshot."""
+        import os
+
         from ramses_tpu.io import snapshot as snapmod
         iout = iout if iout is not None else self.state.iout
         snap = snapmod.snapshot_from_uniform(self, iout)
-        return snapmod.dump_all(snap, iout,
-                                base_dir or self.params.output.output_dir,
-                                namelist_path=namelist_path)
+        out = snapmod.dump_all(snap, iout,
+                               base_dir or self.params.output.output_dir,
+                               namelist_path=namelist_path)
+        if self.turb is not None:
+            # the OU spectral state + RNG key ride in every snapshot
+            # (``turb/write_turb_fields.f90``) so a driven-turbulence
+            # restart continues the SAME forcing realization instead of
+            # silently re-seeding
+            self.turb.save(os.path.join(out, "turb_fields.npz"))
+        return out
 
     @classmethod
     def from_snapshot(cls, params: Params, outdir: str,
@@ -421,6 +430,22 @@ class Simulation:
         sim.state.t = float(meta["t"])
         sim.state.nstep = int(meta["nstep"])
         sim.state.iout = max(int(meta["iout"]), 1) + 1
+        if sim.turb is not None:
+            import os
+
+            from ramses_tpu.turb.forcing import TurbForcing
+            tpath = os.path.join(outdir, "turb_fields.npz")
+            if os.path.exists(tpath):
+                # restore the OU field + RNG key (read_turb_fields.f90):
+                # the restarted run reproduces the continuous run's
+                # forcing sequence bitwise
+                sim.turb = TurbForcing.load(tpath, sim.turb_spec)
+            else:
+                import warnings
+                warnings.warn(f"no turb_fields.npz in {outdir}: the "
+                              "forcing re-seeds from turb_seed and the "
+                              "restart will not reproduce the original "
+                              "driving sequence")
         if sim.gspec.enabled:
             rho = total_density(sim.pspec, sim.state.u, sim.state.p,
                                 sim.grid.shape, sim.dx)

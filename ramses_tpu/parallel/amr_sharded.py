@@ -21,12 +21,14 @@ equal splits achieve what the reference needs dynamic cost feedback
 for; the only residual imbalance is the <ndev remainder rows per
 level, which the mesh-aligned bucket padding absorbs.
 
-This is the correctness-first global-view formulation; the shard_map +
-``ppermute`` slab pipeline exists for the uniform path
-(:mod:`ramses_tpu.parallel.halo`) as the explicit-schedule backend;
-precomputed per-shard halo maps for the AMR batches are the known
-next optimization when profiles show the gather collectives
-dominating.
+Two comm backends coexist: the default global-view formulation (GSPMD
+inserts the collectives) and, with ``explicit_comm=True``, precomputed
+per-shard halo schedules for partial levels — ring-offset ``ppermute``
+halos plus a deterministic owner-fold, rebuilt at regrid like the
+reference's ``build_comm`` (:mod:`ramses_tpu.parallel.amr_comm`; the
+uniform path's analogue is :mod:`ramses_tpu.parallel.halo`).  Complete
+levels always take the dense global-view sweep, whose halos are
+compiler-inserted collectives on the bit-permuted dense axes.
 """
 
 from __future__ import annotations
@@ -126,7 +128,12 @@ class ShardedAmrSim(AmrSim):
                 m, self.maps[l - 1], self.ndev, self.mesh,
                 int(self.params.refine.interpol_type))
             if built is None:
-                continue
+                # build_sweep_comm bails only for a 1-device mesh, and
+                # _explicit_comm requires ndev > 1 — anything else here
+                # would be a silent GSPMD fallback, so refuse loudly
+                raise RuntimeError(
+                    f"explicit comm schedule missing for partial level "
+                    f"{l} on a {self.ndev}-device mesh")
             spec, arrays = built
             self._comm_specs[l] = spec
             sh = NamedSharding(self.mesh, P("oct"))

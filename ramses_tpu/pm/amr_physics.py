@@ -537,6 +537,17 @@ def mc_tracer_amr(sim):
     flux/2^(l-lmin) each, like the reference's per-substep moves (per
     move probability ≤ the CFL number).  Total host work is
     Σ_l 2^(l-lmin)·ntracer(l), linear in the tracer count.
+
+    Known approximations vs ``move_tracer.f90`` (documented on the
+    advisor's r04 findings): (1) every substep round divides by the
+    PRE-COARSE-STEP density rho0 rather than each substep's own
+    pre-step mass — identical to first order in the CFL number,
+    biased low in strongly compressive subcycled flows; (2) gas mass
+    removed by star formation / sink accretion between flux capture
+    and the jump pass is invisible to the probabilities, and gas
+    tracers are not converted to star tracers at SF sites (the
+    reference's tracer2othertracer); trajectories remain gas-mass
+    weighted.
     """
     x = sim.tracer_x
     phi_dev = sim._tracer_phi

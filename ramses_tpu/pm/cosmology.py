@@ -37,10 +37,13 @@ def dadt(a, om, ov, ok):
 
 def friedman(om: float, ov: float, ok: float, aexp_min: float,
              ntable: int = 1000):
-    """Look-up tables (a, hexp, tau, t) from a=aexp_min/1.2 to 1.
+    """Look-up tables (a, hexp, tau, t, chi) from a=aexp_min/1.2 to 1.
 
     Quadrature replacement of ``friedman`` (``amr/init_time.f90:756``):
-    tau(a) = -int_a^1 da'/dadtau, t(a) = -int_a^1 da'/dadt.
+    tau(a) = -int_a^1 da'/dadtau, t(a) = -int_a^1 da'/dadt, plus the
+    proper comoving distance chi(a) = int_a^1 c·da'/(a'^2 H(a')) in
+    c/H0 units (the lightcone's ``coord_distance`` integrand,
+    ``amr/light_cone.f90:795-804``; note a'/dadtau = 1/(a'^2 E)).
     """
     if abs(om + ov + ok - 1.0) > 1e-9:
         raise ValueError(f"Omegas must sum to 1: {om}+{ov}+{ok}")
@@ -48,18 +51,25 @@ def friedman(om: float, ov: float, ok: float, aexp_min: float,
     a_fine = np.exp(np.linspace(np.log(aexp_min / 1.2), 0.0, nfine))
     inv_dtau = 1.0 / dadtau(a_fine, om, ov, ok)
     inv_dt = 1.0 / dadt(a_fine, om, ov, ok)
+    inv_chi = a_fine * inv_dtau            # 1/(a^2 E(a)) in 1/H0 units
     # cumulative trapezoid from a=1 downward (negative times in the past)
     da = np.diff(a_fine)
-    tau_f = np.concatenate([[0.0], np.cumsum(0.5 * da * (inv_dtau[1:]
-                                                         + inv_dtau[:-1]))])
-    t_f = np.concatenate([[0.0], np.cumsum(0.5 * da * (inv_dt[1:]
-                                                       + inv_dt[:-1]))])
+
+    def cum(f):
+        return np.concatenate([[0.0],
+                               np.cumsum(0.5 * da * (f[1:] + f[:-1]))])
+
+    tau_f = cum(inv_dtau)
+    t_f = cum(inv_dt)
+    chi_f = cum(inv_chi)
     tau_f = tau_f - tau_f[-1]   # tau(1) = 0, negative in the past
     t_f = t_f - t_f[-1]
+    chi_f = chi_f[-1] - chi_f   # chi(1) = 0, POSITIVE in the past
     # subsample to ntable+1 entries (reference keeps 0:ntable)
     idx = np.linspace(0, nfine - 1, ntable + 1).round().astype(int)
     a_t = a_fine[idx]
-    return (a_t, dadtau(a_t, om, ov, ok) / a_t, tau_f[idx], t_f[idx])
+    return (a_t, dadtau(a_t, om, ov, ok) / a_t, tau_f[idx], t_f[idx],
+            chi_f[idx])
 
 
 @dataclass(frozen=True)
@@ -83,15 +93,18 @@ class Cosmology:
     hexp_frw: Tuple[float, ...] = ()
     tau_frw: Tuple[float, ...] = ()
     t_frw: Tuple[float, ...] = ()
+    chi_frw: Tuple[float, ...] = ()    # comoving distance to a=1, c/H0
 
     def __post_init__(self):
         if not self.axp_frw:
-            a, h, tau, t = friedman(self.omega_m, self.omega_l, self.omega_k,
-                                    self.aexp_ini, self.ntable)
+            a, h, tau, t, chi = friedman(self.omega_m, self.omega_l,
+                                         self.omega_k, self.aexp_ini,
+                                         self.ntable)
             object.__setattr__(self, "axp_frw", tuple(a))
             object.__setattr__(self, "hexp_frw", tuple(h))
             object.__setattr__(self, "tau_frw", tuple(tau))
             object.__setattr__(self, "t_frw", tuple(t))
+            object.__setattr__(self, "chi_frw", tuple(chi))
 
     @classmethod
     def from_params(cls, p) -> "Cosmology":
@@ -123,6 +136,24 @@ class Cosmology:
     def tau_of_aexp(self, aexp):
         return jnp.interp(aexp, jnp.asarray(self.axp_frw),
                           jnp.asarray(self.tau_frw))
+
+    # --- lightcone comoving distances (box-length units) --------------
+    @property
+    def _chi_to_box(self) -> float:
+        """c/H0 expressed in box lengths: coverH0/Lbox with
+        coverH0 = 299792.458/(100·h) Mpc and Lbox = boxlen_ini/h Mpc
+        (``light_cone.f90:57,791``) — h cancels."""
+        return 2997.92458 / self.boxlen_ini
+
+    def chi_of_aexp(self, aexp):
+        """Proper comoving distance from aexp to today, box units."""
+        return jnp.interp(aexp, jnp.asarray(self.axp_frw),
+                          jnp.asarray(self.chi_frw)) * self._chi_to_box
+
+    def aexp_of_chi(self, chi):
+        """Emission epoch at comoving distance ``chi`` [box units]."""
+        c = jnp.asarray(self.chi_frw[::-1]) * self._chi_to_box
+        return jnp.interp(chi, c, jnp.asarray(self.axp_frw[::-1]))
 
     @property
     def tau_ini(self) -> float:

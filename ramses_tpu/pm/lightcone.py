@@ -16,13 +16,14 @@ import numpy as np
 
 
 def shell_radii(cosmo, aexp1: float, aexp2: float) -> Tuple[float, float]:
-    """Comoving radii [code units, boxlen=1] of the lightcone shell
-    between two expansion factors (observer at aexp=1)."""
-    tau1 = float(cosmo.tau_of_aexp(aexp1))
-    tau2 = float(cosmo.tau_of_aexp(aexp2))
-    tau0 = float(cosmo.tau_of_aexp(1.0 - 1e-12))
-    # conformal lookback distance; supercomoving c=... relative scale
-    return abs(tau0 - tau2), abs(tau0 - tau1)
+    """Comoving radii [box-length units] of the lightcone shell between
+    two expansion factors (observer at aexp=1): the PROPER comoving
+    distance chi(a) = ∫ c·da'/(a'²H) from the Friedmann tables —
+    the ``coord_distance`` integral of ``amr/light_cone.f90:795-804``
+    (NOT the super-conformal Δτ, whose dτ = dt/a² lacks the c/a
+    weighting)."""
+    return (float(cosmo.chi_of_aexp(aexp2)),
+            float(cosmo.chi_of_aexp(aexp1)))
 
 
 def rotation_matrix(thetay: float = 0.0, thetaz: float = 0.0) -> np.ndarray:
@@ -34,6 +35,51 @@ def rotation_matrix(thetay: float = 0.0, thetaz: float = 0.0) -> np.ndarray:
     ry = np.array([[cy, 0.0, sy], [0.0, 1.0, 0.0], [-sy, 0.0, cy]])
     rz = np.array([[cz, -sz, 0.0], [sz, cz, 0.0], [0.0, 0.0, 1.0]])
     return rz @ ry
+
+
+def _replica_shifts(obs: np.ndarray, r1: float, r2: float,
+                    boxlen: float, ndim: int) -> np.ndarray:
+    """Periodic replica shifts whose box can intersect the shell
+    [r1, r2), built axis by axis with incremental pruning.
+
+    A proper comoving r2 can span hundreds of box lengths (deep
+    cones), so materializing the full (2·nrep+1)^ndim shift cube —
+    O(r2^ndim) memory — is not an option; pruning each axis on the
+    partial minimum distance keeps intermediates at the shell's
+    surface size O(r2^(ndim-1)) (``compute_replica``'s bounds,
+    ``amr/light_cone.f90``)."""
+    nrep = int(np.ceil(r2 / boxlen)) + 1
+    k = np.arange(-nrep, nrep + 1) * boxlen
+    los = [np.maximum(np.abs(k - obs[d]) - boxlen, 0.0) ** 2
+           for d in range(ndim)]
+    his = [(np.abs(k - obs[d]) + boxlen) ** 2 for d in range(ndim)]
+    # largest possible contribution of the axes NOT yet expanded: rows
+    # whose partial dmax2 + rem_max still misses r1 are ball interior
+    # and can be dropped mid-build — without this, the dmin2 prune
+    # alone keeps the whole O(r2^ndim) interior
+    rem_max = [sum(h.max() for h in his[d + 1:]) for d in range(ndim)]
+    shifts = np.zeros((1, 0))
+    dmin2 = np.zeros(1)
+    dmax2 = np.zeros(1)
+    for d in range(ndim):
+        # expand in k-chunks: pruning per chunk caps the transient at
+        # O(|survivors| · chunk) — the unchunked last-axis expansion
+        # would materialize the O(r2^ndim) interior before its prune
+        parts = []
+        for c0 in range(0, len(k), 16):
+            kc, loc, hic = (a[c0:c0 + 16] for a in (k, los[d], his[d]))
+            s = np.concatenate(
+                [np.repeat(shifts, len(kc), axis=0),
+                 np.tile(kc, len(shifts))[:, None]], axis=1)
+            mn = (dmin2[:, None] + loc[None, :]).ravel()
+            mx = (dmax2[:, None] + hic[None, :]).ravel()
+            # later axes only grow both bounds, so both prunes are safe
+            keep = (mn < r2 * r2) & (mx + rem_max[d] >= r1 * r1)
+            parts.append((s[keep], mn[keep], mx[keep]))
+        shifts = np.concatenate([p[0] for p in parts])
+        dmin2 = np.concatenate([p[1] for p in parts])
+        dmax2 = np.concatenate([p[2] for p in parts])
+    return shifts
 
 
 def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
@@ -61,16 +107,7 @@ def cone_selection(x: np.ndarray, obs: Sequence[float], r1: float,
     x = np.asarray(x)
     ndim = x.shape[1]
     obs = np.asarray(obs, dtype=np.float64)
-    nrep = int(np.ceil(r2 / boxlen)) + 1
-    reps = np.arange(-nrep, nrep + 1) * boxlen
-    grids = np.meshgrid(*([reps] * ndim), indexing="ij")
-    shifts = np.stack([g.ravel() for g in grids], axis=1)
-    # prune replicas whose box cannot intersect the shell
-    lo = np.maximum(np.abs(shifts - obs[None, :]) - boxlen, 0.0)
-    hi = np.abs(shifts - obs[None, :]) + boxlen
-    dmin = np.sqrt((lo ** 2).sum(1))
-    dmax = np.sqrt((hi ** 2).sum(1))
-    shifts = shifts[(dmax >= r1) & (dmin < r2)]
+    shifts = _replica_shifts(obs, r1, r2, boxlen, ndim)
 
     out_x, out_r, out_i = [], [], []
     ax = np.asarray(axis, dtype=np.float64)[:ndim]
@@ -135,6 +172,7 @@ def emit_coarse_step(sim, outdir: str = ".") -> Optional[str]:
     if a_now < 1.0 / (1.0 + float(lc.zmax_cone)):
         return None                    # beyond the cone's zmax
     r2, r1 = shell_radii(cosmo, a_prev, a_now)
+    r1, r2 = r1 * sim.boxlen, r2 * sim.boxlen   # box → code units
     if r1 > r2:
         r1, r2 = r2, r1
     if r2 <= r1:
@@ -151,9 +189,8 @@ def emit_coarse_step(sim, outdir: str = ".") -> Optional[str]:
                                  half_angles=half)
     if len(r) == 0:
         return None
-    # emission epoch per particle: a(tau0 - r)
-    tau0 = float(cosmo.tau_of_aexp(1.0 - 1e-12))
-    a_emit = np.interp(tau0 - r, cosmo.tau_frw, cosmo.axp_frw)
+    # emission epoch per particle: a at comoving distance r
+    a_emit = np.asarray(cosmo.aexp_of_chi(r / sim.boxlen))
     os.makedirs(outdir, exist_ok=True)
     path = os.path.join(outdir, f"cone_{sim.nstep:05d}.npz")
     write_cone(path, pos, r, idx, a_now, vel=vpart[idx],

@@ -39,6 +39,17 @@ class StellarSpec:
     sn_e_ref: float = 0.0            # SN energy [code]
     sn_direct: bool = False          # explode at birth (testing mode)
     Tsat: float = 1e50               # post-injection temperature cap
+    # sink RT (HII) feedback: the Vacca+96 ionizing-flux fit
+    # S(M) = stf_K·(M/stf_m0)^a / (1+(M/stf_m0)^b)^c while the object
+    # is younger than hii_t (pm/sink_feedback_parameters.f90:43-53);
+    # hii_t <= 0 disables photon emission
+    hii_t_myr: float = 0.0           # emitting lifetime [Myr]
+    stf_k: float = 9.634642584812752e48   # photons/s
+    stf_m0: float = 27.28098824280431     # Msun
+    stf_a: float = 6.840015602892084
+    stf_b: float = 4.353614230584390
+    stf_c: float = 1.142166657042991
+    fb_group: int = 0                # photon group receiving the flux
 
     @classmethod
     def from_params(cls, p) -> "StellarSpec":
@@ -59,7 +70,14 @@ class StellarSpec:
                    lt_b=float(g("lt_b", 2.0)),
                    sn_e_ref=float(g("sn_e_ref", 0.0)),
                    sn_direct=bool(g("sn_direct", False)),
-                   Tsat=float(g("tsat", 1e50)))
+                   Tsat=float(g("tsat", 1e50)),
+                   hii_t_myr=float(g("hii_t", 0.0)),
+                   stf_k=float(g("stf_k", cls.stf_k)),
+                   stf_m0=float(g("stf_m0", cls.stf_m0)),
+                   stf_a=float(g("stf_a", cls.stf_a)),
+                   stf_b=float(g("stf_b", cls.stf_b)),
+                   stf_c=float(g("stf_c", cls.stf_c)),
+                   fb_group=int(g("feedback_photon_group", 1)) - 1)
 
 
 def sample_powerlaw(rng: np.random.Generator, a: float, b: float,

@@ -147,6 +147,7 @@ class RtAmrCoupled:
         self._sed_update = max(1, int(getattr(r, "sedprops_update", 5)))
         self._sed_count = 0
         self._star_src = {}
+        self._sink_src = {}
         # homogeneous UV background (rt_UV_hom): amplitude follows the
         # cooling module's J21/a_spec/z_reion epoch dependence
         self.uv_on = bool(getattr(r, "rt_uv_hom", False))
@@ -204,6 +205,57 @@ class RtAmrCoupled:
                     group=GroupSpec(sigma=g3[0].sigmaN[0],
                                     e_photon=g3[0].e_photon))
         self._sed_count += 1
+
+    def _refresh_sink_sources(self, sim):
+        """Sink RT (HII) feedback: sink-spawned stellar objects emit
+        ionizing photons into their sink's NGP cell while younger than
+        ``hii_t`` — the Vacca+96 ionizing-flux fit
+        ``S(M) = stf_K·(M/m0)^a/(1+(M/m0)^b)^c``
+        (``pm/sink_rt_feedback.f90`` ``gather_ioni_flux`` +
+        ``sink_RT_vsweep_stellar``; the reference splits S over the
+        sink's cloud particles, whose NGP cells collapse to the sink's
+        cell at the deposit level — the single-cell limit here)."""
+        self._sink_src = {}
+        st = getattr(sim, "stellar", None)
+        sp = getattr(sim, "stellar_spec", None)
+        if (st is None or st.n == 0 or sim.sinks is None
+                or sp is None or sp.hii_t_myr <= 0.0):
+            return
+        MYR = 3.15576e13
+        age_s = (sim.t - st.tform) * self.un.scale_t
+        live = age_s < sp.hii_t_myr * MYR
+        if not live.any():
+            return
+        m = st.m[live]
+        S = sp.stf_k * (m / sp.stf_m0) ** sp.stf_a \
+            / (1.0 + (m / sp.stf_m0) ** sp.stf_b) ** sp.stf_c
+        # photons follow the sink's CURRENT position, not the birth one
+        sink_of = {int(i): k for k, i in enumerate(sim.sinks.idp)}
+        snk = np.array([sink_of.get(int(s), -1)
+                        for s in st.sink_idp[live]])
+        ok = snk >= 0
+        if not ok.any():
+            return
+        pos = np.asarray(sim.sinks.x)[snk[ok]]
+        S = S[ok]
+        from ramses_tpu.pm.amr_pm import assign_levels
+        from ramses_tpu.pm.amr_physics import ngp_rows
+        levs = assign_levels(sim.tree, pos, sim.boxlen)
+        gidx = min(max(sp.fb_group, 0), self.ng - 1)
+        for l in sim.levels():
+            at_l = levs == l
+            if not at_l.any():
+                continue
+            rows = ngp_rows(sim.tree, pos[at_l], l, sim.boxlen,
+                            sim.bc_kinds)
+            okr = rows >= 0
+            if not okr.any():
+                continue
+            vol = (sim.dx(l) * self.un.scale_l) ** self.nd
+            dens = np.zeros((int(okr.sum()), self.ng))
+            dens[:, gidx] = S[at_l][okr] / vol
+            self._sink_src[l] = (jnp.asarray(rows[okr]),
+                                 jnp.asarray(dens))
 
     def _fresh_rad(self, ncp: int) -> np.ndarray:
         """Vacuum radiation rows [ncp, ng*(1+nd)]."""
@@ -268,6 +320,7 @@ class RtAmrCoupled:
         nsub = max(1, int(np.ceil(dt_cgs / dt_c)))
         dt_sub = dt_cgs / nsub
         self._refresh_stellar_sources(sim)
+        self._refresh_sink_sources(sim)
         spec = self.spec              # groups3 may have been refreshed
         if self.uv_on:
             from ramses_tpu.hydro.cooling import uv_amplitude, uv_rates
@@ -303,15 +356,18 @@ class RtAmrCoupled:
                     self.rad[lsrc] = self.rad[lsrc].at[row, 0].add(
                         dt_sub * rate)
             # stellar sources (SED tables: per-star per-group rates)
-            for l, (rows, dens) in self._star_src.items():
-                rad = self.rad[l]
-                if self.full3:
-                    for g in range(ng):
-                        rad = rad.at[rows, self._ncol(g)].add(
-                            dt_sub * dens[:, g])
-                else:
-                    rad = rad.at[rows, 0].add(dt_sub * dens.sum(axis=1))
-                self.rad[l] = rad
+            # + sink-spawned stellar objects (Vacca fit, _sink_src)
+            for srcmap in (self._star_src, self._sink_src):
+                for l, (rows, dens) in srcmap.items():
+                    rad = self.rad[l]
+                    if self.full3:
+                        for g in range(ng):
+                            rad = rad.at[rows, self._ncol(g)].add(
+                                dt_sub * dens[:, g])
+                    else:
+                        rad = rad.at[rows, 0].add(
+                            dt_sub * dens.sum(axis=1))
+                    self.rad[l] = rad
             # transport, coarse→fine (every group; one gather moves
             # all group blocks, the GLF update runs per group)
             for l in sim.levels():

@@ -117,7 +117,7 @@ def test_selfgravity_two_particle_attraction():
 
 def test_friedman_eds_age():
     """Einstein-de Sitter: age = 2/3 H0^-1, a(tau): tau = 2 - 2/sqrt(a)."""
-    a, h, tau, t = friedman(1.0, 0.0, 0.0, 1e-3)
+    a, h, tau, t, chi = friedman(1.0, 0.0, 0.0, 1e-3)
     assert np.isclose(-t[0], 2.0 / 3.0, rtol=1e-3)
     i = np.searchsorted(a, 0.25)
     assert np.isclose(tau[i], 2.0 - 2.0 / np.sqrt(a[i]), rtol=1e-3)

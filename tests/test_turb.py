@@ -147,3 +147,43 @@ def test_driver_turb_stirring():
     assert np.all(np.isfinite(u))
     # mass conserved
     assert np.isclose(u[0].mean(), 1.0, rtol=1e-12)
+
+
+def test_driver_dump_restart_same_forcing(tmp_path):
+    """A driven-turbulence restart continues the SAME OU realization:
+    dump mid-run, restore, and the restarted sim's next forcing update
+    must match the continuous run's bitwise (VERDICT-r04 Missing #2;
+    ``turb/write_turb_fields.f90`` role)."""
+    from ramses_tpu.driver import Simulation
+    groups = {
+        "run_params": {"hydro": True},
+        "amr_params": {"levelmin": 3, "levelmax": 3, "boxlen": 1.0},
+        "init_params": {"nregion": 1, "region_type": ["square"],
+                        "x_center": [0.5], "y_center": [0.5],
+                        "length_x": [10.0], "length_y": [10.0],
+                        "exp_region": [10.0],
+                        "d_region": [1.0], "p_region": [1.0]},
+        "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                         "riemann": "hllc"},
+        "turb_params": {"turb": True, "turb_rms": 2.0, "turb_t": 0.5,
+                        "turb_seed": 3},
+        "output_params": {"noutput": 1, "tout": [0.05], "tend": 0.05},
+    }
+    p = params_from_dict(groups, ndim=2)
+    sim = Simulation(p, dtype=jnp.float64)
+    sim.evolve(chunk=2)
+    out = sim.dump(iout=1, base_dir=str(tmp_path))
+    import os
+    assert os.path.exists(os.path.join(out, "turb_fields.npz"))
+    sim2 = Simulation.from_snapshot(p, out, dtype=jnp.float64)
+    # same spectral state restored...
+    assert np.array_equal(np.asarray(sim.turb.fhat),
+                          np.asarray(sim2.turb.fhat))
+    assert np.array_equal(np.asarray(sim.turb.key),
+                          np.asarray(sim2.turb.key))
+    # ...and the NEXT update (same dt) produces bitwise-identical
+    # forcing on both
+    sim.turb.update(0.01)
+    sim2.turb.update(0.01)
+    assert np.array_equal(np.asarray(sim.turb.acceleration()),
+                          np.asarray(sim2.turb.acceleration()))
