@@ -26,14 +26,14 @@ def _neighbor_offsets(ndim: int) -> np.ndarray:
                     dtype=np.int64)
 
 
-def dilate(flag_coords: np.ndarray, lvl: int, bc_kinds, ndim: int
-           ) -> np.ndarray:
+def dilate(flag_coords: np.ndarray, lvl: int, bc_kinds, ndim: int,
+           dims=None) -> np.ndarray:
     """One smoothing pass: the 3^ndim dilation of the flagged cell set."""
     if len(flag_coords) == 0:
         return flag_coords
     offs = _neighbor_offsets(ndim)
     ex = (flag_coords[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
-    ex, _ = map_coords(ex, lvl, bc_kinds, ndim)
+    ex, _ = map_coords(ex, lvl, bc_kinds, ndim, dims=dims)
     ks = np.unique(kmod.encode(ex, ndim))
     return kmod.decode(ks, ndim)
 
@@ -86,7 +86,8 @@ def compute_new_tree(tree: Octree, crit_flags: Dict[int, np.ndarray],
             np.zeros((0, ndim), dtype=np.int64)
         ne = nexpand[l - 1] if l - 1 < len(nexpand) else 1
         for _ in range(max(int(ne), 0)):
-            coords = dilate(coords, l, bc_kinds, ndim)
+            coords = dilate(coords, l, bc_kinds, ndim,
+                            dims=tree.cell_dims(l))
         fcoords[l] = coords
 
     # top-down nesting: project fine flags into father-neighbourhood flags
@@ -96,7 +97,7 @@ def compute_new_tree(tree: Octree, crit_flags: Dict[int, np.ndarray],
         if len(x) == 0:
             continue
         ex = (x[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
-        ex, _ = map_coords(ex, l, bc_kinds, ndim)
+        ex, _ = map_coords(ex, l, bc_kinds, ndim, dims=tree.cell_dims(l))
         up = ex >> 1
         ks = np.unique(kmod.encode(up, ndim))
         prev = kmod.encode(fcoords[l - 1], ndim) if len(fcoords[l - 1]) \
@@ -105,8 +106,7 @@ def compute_new_tree(tree: Octree, crit_flags: Dict[int, np.ndarray],
         fcoords[l - 1] = kmod.decode(allk, ndim)
 
     # flags only refine existing cells: intersect with current cell sets
-    new = Octree(ndim, lmin, lmax)
-    n_base = 1 << (lmin - 1)
+    new = Octree(ndim, lmin, lmax, root=tree.root)
     new.set_level(lmin, tree.levels[lmin].og)          # base stays complete
     for l in range(lmin, lmax):
         coords = fcoords[l]

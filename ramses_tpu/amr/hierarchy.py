@@ -77,6 +77,9 @@ class FusedSpec(NamedTuple):
     complete: tuple        # per-level bool
     gravity: bool
     itype: int
+    # coarse root-cell counts per dim (nx, ny, nz); level-l dense
+    # shape is root[d]·2^l (all-ones = the single-cube default)
+    root: tuple = ()
     # static cooling config; None disables the in-step cooling source
     # (``cooling_fine`` after ``godunov_fine``, amr/amr_step.f90:448-474)
     cool: Optional[object] = None
@@ -110,6 +113,10 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
     def dx(l):
         return spec.boxlen / (1 << l)
 
+    def shape(l):
+        root = spec.root or (1,) * cfg.ndim
+        return tuple(r << l for r in root[:cfg.ndim])
+
     def advance(i, dtl):
         from ramses_tpu.poisson.amr_solve import kick_flat
 
@@ -124,7 +131,7 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
         if spec.complete[i]:
             out = K.dense_sweep(u[l], d.get("inv_perm"), d.get("perm"),
                                 d["ok_dense"], dtl, dx(l),
-                                (1 << l,) * cfg.ndim, spec.bspec, cfg,
+                                shape(l), spec.bspec, cfg,
                                 ret_flux=spec.want_flux)
             du = out[0] if spec.want_flux else out
             if spec.want_flux:
@@ -236,13 +243,15 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
     per-level ``hydro_refine`` kernels of ``flag_fine``); the host
     fetches the whole tuple with a single device round-trip."""
     cfg = spec.cfg
+    root = spec.root or (1,) * cfg.ndim
     out = []
     for i, l in enumerate(spec.levels):
         d = dev[l]
         if spec.complete[i]:
+            shp = tuple(r << l for r in root[:cfg.ndim])
             fl = K.dense_refine_flags(u[l], d.get("inv_perm"),
                                       d.get("perm"), eg,
-                                      fls, (1 << l,) * cfg.ndim,
+                                      fls, shp,
                                       spec.bspec, cfg,
                                       dx=spec.boxlen / (1 << l))
         else:
@@ -299,7 +308,9 @@ def restore_amr_scaffold(cls, params: Params, outdir: str, dtype,
     from ramses_tpu.io.restart import restore_particles, restore_tree_state
     tree_og, rows_lv, meta, parts = restore_tree_state(
         outdir, None, params.amr.levelmin, to_cons=to_cons)
-    tree = Octree(params.ndim, params.amr.levelmin, params.amr.levelmax)
+    root = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
+    tree = Octree(params.ndim, params.amr.levelmin, params.amr.levelmax,
+                  root=root)
     for l, og in tree_og.items():
         tree.set_level(l, og)
     ps = None
@@ -416,6 +427,9 @@ class AmrSim:
     # solver families whose state layout differs from the hydro
     # [rho, mom, E, ...] convention opt out of the shared SF/sink passes
     _pm_physics = True
+    # families whose kernels handle non-cubic root grids (the MHD/SRHD
+    # dense paths still assume one root cube and opt out)
+    _noncubic_ok = True
     # velocity tracers only need momentum/density at the hydro column
     # positions — true for hydro AND MHD layouts; SRHD's (D, S) are
     # not coordinate velocities, so RhdAmrSim opts out
@@ -448,14 +462,31 @@ class AmrSim:
         spec = bmod.BoundarySpec.from_params(params)
         self.bspec = spec
         self.bc_kinds = [(f[0].kind, f[1].kind) for f in spec.faces]
-        base = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
-        if any(b != 1 for b in base):
-            # the octree keys/maps/Hilbert ordering all assume one coarse
-            # root cube; the uniform solver supports non-cubic boxes
-            raise NotImplementedError(
-                "the AMR hierarchy requires nx=ny=nz=1; non-cubic coarse "
-                f"grids (got {base}) run on the uniform solver "
-                "(levelmin=levelmax)")
+        self.root = tuple(
+            int(b) for b in
+            [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim])
+        if any(b != 1 for b in self.root):
+            # non-cubic coarse grids run the hydro solver family only
+            # for now: the PM/RT/physics layers still wrap positions at
+            # a scalar boxlen, and the non-hydro state layouts have
+            # their own dense paths
+            blocked = []
+            if getattr(self.cfg, "physics", "hydro") != "hydro" \
+                    or not self._noncubic_ok:
+                blocked.append(f"{type(self).__name__} solver family")
+            for flagname in ("pic", "rt", "tracer", "cosmo",
+                             "clumpfind", "mhd"):
+                if bool(getattr(params.run, flagname, False)):
+                    blocked.append(flagname)
+            if (params.raw or {}).get("sf_params"):
+                blocked.append("star formation")
+            if (params.raw or {}).get("sink_params"):
+                blocked.append("sinks")
+            if blocked:
+                raise NotImplementedError(
+                    f"non-cubic coarse grid {self.root} currently "
+                    f"supports the plain hydro hierarchy only (got: "
+                    f"{', '.join(blocked)})")
         self.lmin = params.amr.levelmin
         self.lmax = params.amr.levelmax
         self.t = 0.0
@@ -759,8 +790,8 @@ class AmrSim:
                 # dense path: restriction (+ refined mask) only.  The
                 # flat↔dense permutation is a bit-permutation transpose
                 # on cubic levels (amr/bitperm.py) — no device index
-                # arrays needed; non-cubic roots would ship perm maps
-                # here when the hierarchy grows that support.
+                # arrays needed; NON-cubic roots keep the index-gather
+                # conversion and ship the perm maps.
                 self.dev[l] = dict(
                     ok_dense=(self._place(jnp.asarray(m.ok_dense), "cells")
                               if m.ok_dense is not None else None),
@@ -769,6 +800,11 @@ class AmrSim:
                     valid_cell=self._place(jnp.asarray(valid_cell),
                                            "cells"),
                 )
+                if not K.pow2_cube(self.tree.cell_dims(l)):
+                    self.dev[l].update(
+                        perm=self._place(jnp.asarray(m.perm), "cells"),
+                        inv_perm=self._place(jnp.asarray(m.inv_perm),
+                                             "cells"))
                 continue
             self.dev[l] = dict(
                 stencil_src=self._place(jnp.asarray(m.stencil_src), "octs"),
@@ -856,7 +892,8 @@ class AmrSim:
     def _init_refine(self):
         """Iterative initial mesh build (``amr/init_refine.f90:5-154``):
         apply analytic ICs, flag, rebuild, repeat until stable."""
-        self.tree = Octree.base(self.tree_ndim, self.lmin, self.lmax)
+        self.tree = Octree.base(self.tree_ndim, self.lmin,
+                                self.lmax, root=self.root)
         self._rebuild_maps()
         self._alloc_from_ics()
         for _ in range(self.lmax - self.lmin + 2):
@@ -1055,7 +1092,7 @@ class AmrSim:
                 complete=tuple(self.maps[l].complete for l in lv),
                 gravity=self.gravity,
                 itype=int(self.params.refine.interpol_type),
-                cool=self.cool_spec,
+                root=self.root, cool=self.cool_spec,
                 comm=(tuple(cspecs.get(l) for l in lv) if cspecs
                       else ()),
                 want_flux=(self.tracer_x is not None
@@ -1166,7 +1203,10 @@ class AmrSim:
             mtot = float(self.totals()[0])
             if self.pic:
                 mtot += float(jnp.sum(self.p.m * self.p.active))
-            rho_mean = mtot / self.boxlen ** nd
+            vol_box = self.boxlen ** nd
+            for r in self.root:
+                vol_box *= r
+            rho_mean = mtot / vol_box
         else:
             rho_mean = 0.0       # isolated problem is well-posed as-is
         rho_max = None
@@ -1186,9 +1226,8 @@ class AmrSim:
                 # whole-box level: exact periodic FFT solve on the dense
                 # grid (or the isolated multipole-Dirichlet CG when the
                 # box is open), force by central differences
-                nb_ = 1 << l
                 ncell = m.noct * (1 << nd)
-                shp = (nb_,) * nd
+                shp = self.tree.cell_dims(l)
                 dense = K.rows_to_dense(rhs, d.get("inv_perm"), shp)
                 if self.grav_periodic:
                     phi_dense = fft_solve(dense, dx)
@@ -1537,7 +1576,8 @@ class AmrSim:
             relevance=float(cf.relevance_threshold),
             npart_min=int(cf.npart_min), unbind=bool(cf.unbind),
             saddle_pot=bool(cf.saddle_pot),
-            nmassbins=int(cf.nmassbins))
+            nmassbins=int(cf.nmassbins),
+            saddle_threshold=max(float(cf.saddle_threshold), 0.0))
         if cf.mass_threshold > 0 and act.any():
             mp = float(np.asarray(self.p.m)[act].mean())
             halos = [h for h in halos

@@ -118,14 +118,15 @@ def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
     noct = lev.noct
     noct_pad = noct_pad or bucket(noct)
     ncell_pad = noct_pad * twotondim
-    if noct == (1 << (lvl - 1)) ** ndim:
+    if noct == int(np.prod(tree.oct_dims(lvl))):
         return _build_complete_level_maps(tree, lvl, noct, noct_pad)
     soff = stencil_offsets(ndim)                       # [6^d, ndim]
     ns = len(soff)
 
     # --- stencil cell coords, BC-mapped ---
     fc = (2 * lev.og[:, None, :] - 2 + soff[None, :, :]).reshape(-1, ndim)
-    mapped, refl = map_coords(fc, lvl, bc_kinds, ndim)
+    mapped, refl = map_coords(fc, lvl, bc_kinds, ndim,
+                              dims=tree.cell_dims(lvl))
     oc = mapped >> 1
     off = np.zeros(len(mapped), dtype=np.int64)
     for d in range(ndim):
@@ -163,7 +164,8 @@ def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
             for side, s in ((0, -1), (1, +1)):
                 nc = ccoarse.copy()
                 nc[:, d] += s
-                ncm, nrefl = map_coords(nc, lvl - 1, bc_kinds, ndim)
+                ncm, nrefl = map_coords(nc, lvl - 1, bc_kinds, ndim,
+                                        dims=tree.cell_dims(lvl - 1))
                 n_oct = tree.lookup(lvl - 1, ncm >> 1)
                 n_off = np.zeros(ni, dtype=np.int64)
                 for d2 in range(ndim):
@@ -226,7 +228,7 @@ def build_level_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
                 inb = nc[:, d]
                 in_domain = np.ones(noct, dtype=bool)
                 lo, hi = bc_kinds[d]
-                n_l1 = 1 << (lvl - 1)
+                n_l1 = tree.cell_dims(lvl - 1)[d]
                 if lo == 0 and hi == 0:
                     nc[:, d] = np.mod(inb, n_l1)
                 else:
@@ -269,10 +271,10 @@ def _build_complete_level_maps(tree: Octree, lvl: int, noct: int,
     ndim = tree.ndim
     twotondim = 1 << ndim
     ncell = noct * twotondim
-    n = 1 << lvl
+    dims = tree.cell_dims(lvl)
     cc = tree.cell_coords(lvl)
     perm = np.ravel_multi_index(
-        tuple(cc[:, d] for d in range(ndim)), (n,) * ndim)
+        tuple(cc[:, d] for d in range(ndim)), dims)
     inv_perm = np.empty(ncell, dtype=np.int64)
     inv_perm[perm] = np.arange(ncell)
 
@@ -350,7 +352,8 @@ def build_prolong_maps(tree_new: Octree, tree_old: Octree, lvl: int,
         for side, s in ((0, -1), (1, +1)):
             nc = father.copy()
             nc[:, d] += s
-            ncm, nrefl = map_coords(nc, lvl - 1, bc_kinds, ndim)
+            ncm, nrefl = map_coords(nc, lvl - 1, bc_kinds, ndim,
+                                    dims=tree_new.cell_dims(lvl - 1))
             n_oct = tree_new.lookup(lvl - 1, ncm >> 1)
             n_off = np.zeros(nnew, dtype=np.int64)
             for d2 in range(ndim):
@@ -393,7 +396,7 @@ class GravityMaps:
 
 def build_mg_lattices(og: np.ndarray, lvl: int, bc_kinds: List[tuple],
                       noct: int, noct_pad: int,
-                      min_n: int = 32) -> tuple:
+                      min_n: int = 32, root=None) -> tuple:
     """Coarsened lattices of a partial level's oct set for the masked
     multigrid V-cycle (``poisson/multigrid_fine_fine.f90`` level
     ladder): depth ``j`` holds the unique ``og >> j`` coords with
@@ -402,13 +405,17 @@ def build_mg_lattices(og: np.ndarray, lvl: int, bc_kinds: List[tuple],
     (depth 0 = the oct lattice itself, padded rows -> sentinel).
     Coarsening stops at ``min_n`` cells or a one-cell-wide box."""
     ndim = og.shape[1]
+    root = tuple(root or (1,) * ndim)
     out = []
     prev_coords = og[:noct]
     prev_pad = noct_pad
     j = 1
     while True:
-        side = 1 << max(lvl - 1 - j, 0)
-        if len(prev_coords) <= min_n or side < 2:
+        shift = lvl - 1 - j
+        sides = tuple(r << max(shift, 0) for r in root)
+        # stop once another halving would merge ROOT cells (shift < 1):
+        # the lattice below the root grid has no consistent topology
+        if len(prev_coords) <= min_n or shift < 1:
             break
         coords = prev_coords >> 1
         keys = kmod.encode(coords, ndim)
@@ -429,11 +436,11 @@ def build_mg_lattices(og: np.ndarray, lvl: int, bc_kinds: List[tuple],
                 q = ucoords.copy()
                 q[:, d] += s
                 if lo_k == 0 and hi_k == 0:
-                    q[:, d] = np.mod(q[:, d], side)
+                    q[:, d] = np.mod(q[:, d], sides[d])
                     inside = np.ones(n, dtype=bool)
                 else:
-                    inside = (q[:, d] >= 0) & (q[:, d] < side)
-                    q[:, d] = np.clip(q[:, d], 0, side - 1)
+                    inside = (q[:, d] >= 0) & (q[:, d] < sides[d])
+                    q[:, d] = np.clip(q[:, d], 0, sides[d] - 1)
                 qk = kmod.encode(q, ndim)
                 pos = np.searchsorted(ukeys, qk)
                 pos = np.clip(pos, 0, n - 1)
@@ -467,7 +474,8 @@ def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
         for side, s in ((0, -1), (1, +1)):
             nc = cc.copy()
             nc[:, d] += s
-            ncm, _refl = map_coords(nc, lvl, bc_kinds, ndim)
+            ncm, _refl = map_coords(nc, lvl, bc_kinds, ndim,
+                                    dims=tree.cell_dims(lvl))
             oct_idx = tree.lookup(lvl, ncm >> 1)
             off = np.zeros(len(ncm), dtype=np.int64)
             for d2 in range(ndim):
@@ -501,7 +509,8 @@ def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
             for side, s in ((0, -1), (1, +1)):
                 nc2 = ccoarse.copy()
                 nc2[:, d] += s
-                ncm2, nrefl = map_coords(nc2, lvl - 1, bc_kinds, ndim)
+                ncm2, nrefl = map_coords(nc2, lvl - 1, bc_kinds, ndim,
+                                         dims=tree.cell_dims(lvl - 1))
                 n_oct = tree.lookup(lvl - 1, ncm2 >> 1)
                 n_off = np.zeros(ng, dtype=np.int64)
                 for d2 in range(ndim):
@@ -538,8 +547,8 @@ def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
 
     # oct-lattice adjacency for the coarse preconditioner level
     oct_nb = np.full((noct_pad, ndim, 2), noct_pad, dtype=np.int32)
-    n_oct_lat = 1 << (lvl - 1)
     for d in range(ndim):
+        n_oct_lat = tree.oct_dims(lvl)[d]
         lo_k, hi_k = bc_kinds[d]
         for side, s in ((0, -1), (1, +1)):
             oc = lev.og.copy()
@@ -560,4 +569,5 @@ def build_gravity_maps(tree: Octree, lvl: int, bc_kinds: List[tuple],
         nb=nb.astype(np.int32),
         g_cell=_padg(g_cell, ng_pad), g_nb=_padg(g_nb, ng_pad),
         g_sgn=_padg(g_sgn, ng_pad), valid_cell=valid, oct_nb=oct_nb,
-        mg=build_mg_lattices(lev.og, lvl, bc_kinds, noct, noct_pad))
+        mg=build_mg_lattices(lev.og, lvl, bc_kinds, noct,
+                             noct_pad, root=tree.root))

@@ -45,21 +45,39 @@ class OctLevel:
 
 
 class Octree:
-    """Per-level sorted oct sets for levels levelmin..levelmax."""
+    """Per-level sorted oct sets for levels levelmin..levelmax.
 
-    def __init__(self, ndim: int, levelmin: int, levelmax: int):
+    ``root``: coarse root-cell counts per dim (``nx, ny, nz`` of
+    &AMR_PARAMS; ``amr/init_amr.f90:37-60`` builds the tree over this
+    arbitrary coarse grid).  Level ``l`` then has ``root[d]·2^l`` cells
+    along dim ``d`` (cubic cells; the domain extent is
+    ``root[d]·boxlen``), reducing to the single-cube 2^l layout for
+    the default all-ones root."""
+
+    def __init__(self, ndim: int, levelmin: int, levelmax: int,
+                 root=None):
         self.ndim = ndim
         self.levelmin = levelmin
         self.levelmax = levelmax
+        self.root = tuple(int(r) for r in (root or (1,) * ndim))
         self.levels: Dict[int, OctLevel] = {}
 
+    def cell_dims(self, lvl: int):
+        """Cells per dim at level ``lvl``."""
+        return tuple(r << lvl for r in self.root)
+
+    def oct_dims(self, lvl: int):
+        """Octs per dim at level ``lvl``."""
+        return tuple(r << (lvl - 1) for r in self.root)
+
     @classmethod
-    def base(cls, ndim: int, levelmin: int, levelmax: int) -> "Octree":
+    def base(cls, ndim: int, levelmin: int, levelmax: int,
+             root=None) -> "Octree":
         """Complete base level (the reference's fully-refined levelmin)."""
-        t = cls(ndim, levelmin, levelmax)
-        n = 1 << (levelmin - 1)
-        ax = np.arange(n, dtype=np.int64)
-        grids = np.meshgrid(*([ax] * ndim), indexing="ij")
+        t = cls(ndim, levelmin, levelmax, root=root)
+        axes = [np.arange(n, dtype=np.int64)
+                for n in t.oct_dims(levelmin)]
+        grids = np.meshgrid(*axes, indexing="ij")
         og = np.stack([g.ravel() for g in grids], axis=1)
         t.set_level(levelmin, og)
         return t
@@ -155,21 +173,23 @@ def cell_offsets(ndim: int) -> np.ndarray:
 
 
 def map_coords(cc: np.ndarray, lvl: int, bc_kinds: List[tuple],
-               ndim: int):
+               ndim: int, dims=None):
     """Map (possibly out-of-domain) cell coords to in-domain coords per the
     physical boundaries (``amr/physical_boundaries.f90`` semantics realized
     as index mapping instead of ghost regions).
 
     ``bc_kinds[d] = (low_kind, high_kind)`` with kinds from
     ``grid.boundary``: 0 periodic, 1 reflecting, 2 outflow.
+    ``dims``: per-dim cell counts (``tree.cell_dims(lvl)``); defaults
+    to the single-cube ``2^lvl`` everywhere.
     Returns (mapped coords, reflect_mask [n, ndim] bool — True where the
     coordinate was mirrored an odd number of times, i.e. velocity component
     d must be sign-flipped).
     """
-    n = 1 << lvl
     out = cc.copy()
     refl = np.zeros(cc.shape, dtype=bool)
     for d in range(ndim):
+        n = (1 << lvl) if dims is None else int(dims[d])
         lo, hi = bc_kinds[d]
         x = out[:, d]
         if lo == 0 and hi == 0:            # periodic

@@ -82,6 +82,7 @@ class ClumpfindParams:
     """&CLUMPFIND_PARAMS (pm/clfind_commons.f90:12-17)."""
     density_threshold: float = -1.0   # code units; <0 → 5x mean density
     relevance_threshold: float = 2.0  # peak/saddle merge ratio
+    saddle_threshold: float = -1.0    # >0: HOP-style clump→halo merge
     mass_threshold: float = 0.0       # min clump mass [particle masses]
     npart_min: int = 10
     unbind: bool = True               # &UNBINDING_PARAMS role
@@ -240,6 +241,22 @@ class RtParams:
     rt_egy_bounds: List[float] = field(default_factory=list)
     rt_src_pos: List[float] = field(default_factory=lambda: [0.5, 0.5, 0.5])
     rt_ndot: float = 0.0              # source photons/s (0: no source)
+    # multi-source surface (rt_parameters.f90 rt_nsource point list,
+    # namelist/rad_beams.nml usage) — per-source centres in box units,
+    # rates in photons/s, optional beam direction (rt_u/v/w_source)
+    rt_nsource: int = 0
+    rt_source_type: List[str] = field(default_factory=list)
+    rt_src_x_center: List[float] = field(default_factory=list)
+    rt_src_y_center: List[float] = field(default_factory=list)
+    rt_src_z_center: List[float] = field(default_factory=list)
+    rt_n_source: List[float] = field(default_factory=list)
+    rt_u_source: List[float] = field(default_factory=list)
+    rt_v_source: List[float] = field(default_factory=list)
+    rt_w_source: List[float] = field(default_factory=list)
+    # pure photon propagation: skip the thermochemistry entirely
+    # (rt_pp / rt_freeflow of rt_parameters.f90)
+    rt_pp: bool = False
+    rt_freeflow: bool = False
     # stellar SED tables (rt/rt_spectra.f90): directory holding
     # metallicity_bins.dat / age_bins.dat / all_seds.dat; empty →
     # RAMSES_SED_DIR env, else the blackbody SED above
@@ -376,6 +393,15 @@ def params_from_dict(groups: Dict[str, Dict[str, Any]],
                 if isinstance(value, list):
                     value = value[0]
                 setattr(sub, key, value)
+    # initfile(1)=... indexed assignment (the reference's multi-level
+    # zoom IC syntax, amr/init_time.f90 initfile(1:nlevelmax)) parses
+    # to a {1-based-index: value} dict: densify to an ordered list
+    if isinstance(p.init.initfile, dict):
+        idx = p.init.initfile
+        nmax = max(idx)
+        p.init.initfile = [
+            (idx[i][0] if isinstance(idx.get(i), list) else idx.get(i, ""))
+            for i in range(1, nmax + 1)]
     # densify per-region / per-boundary lists
     for attr, spec in _LIST_FIELDS.items():
         sub = getattr(p, attr)

@@ -430,7 +430,7 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
     # per-level oct sets: scaffold 1..lmin-1 complete, lmin..finest real
     og_of: Dict[int, np.ndarray] = {}
     for l in range(1, lmin):
-        og_of[l] = _full_level_og(l, ndim)
+        og_of[l] = _full_level_og(l, ndim, base=tree.root)
     for l in range(lmin, lmax + 1):
         if tree.has(l):
             og_of[l] = tree.levels[l].og
@@ -452,9 +452,8 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
     for l in range(lmin - 1, 0, -1):
         if dense is None:
             # build dense array at lmin (complete base level)
-            n = 1 << lmin
             nv = nvar_raw
-            dense = np.zeros((n,) * ndim + (nv,))
+            dense = np.zeros(tree.cell_dims(lmin) + (nv,))
             cc = tree.cell_coords(lmin)
             dense[tuple(cc[:, d] for d in range(ndim))] = cellvals[lmin]
             dense = _dense_to_level(dense)

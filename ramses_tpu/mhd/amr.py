@@ -646,6 +646,7 @@ class MhdAmrSim(AmrSim):
 
     _needs_mig_log = True
     _pm_physics = False      # MHD state layout carries cell-centred B
+    _noncubic_ok = False     # dense CT path assumes one root cube
 
     def __init__(self, params: Params, dtype=jnp.float32, **kw):
         from ramses_tpu import patch

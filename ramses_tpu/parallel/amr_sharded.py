@@ -98,6 +98,18 @@ class ShardedAmrSim(AmrSim):
         return super().dump(iout, base_dir, namelist_path=namelist_path,
                             ncpu=self.ndev if ncpu is None else ncpu)
 
+    def dump_pario(self, iout: int = 1, base_dir: str = ".",
+                   io_group_size: Optional[int] = None,
+                   split_hosts: Optional[int] = None) -> str:
+        """Per-host concurrent sharded checkpoint (io/pario.py): every
+        host writes only its addressable shard rows, ``io_group_size``
+        bounding concurrent writers — the IOGROUPSIZE ring.  Restores
+        onto any device count via :func:`ramses_tpu.io.pario.
+        restore_pario`."""
+        from ramses_tpu.io.pario import dump_pario as _dp
+        return _dp(self, iout, base_dir, io_group_size=io_group_size,
+                   split_hosts=split_hosts)
+
     def _noct_pad(self, lvl: int, noct: int) -> int:
         """Bucketed oct count (with the base class's hysteresis) rounded
         to a multiple of the device count (shardable rows; cells stay

@@ -71,7 +71,8 @@ def watershed(rho, threshold: float, ndim: int):
 
 @dataclass
 class Clump:
-    """One clump's properties (``pm/clump_merger.f90`` table columns)."""
+    """One clump's properties (``pm/clump_merger.f90``
+    ``write_clump_properties`` columns)."""
     index: int
     peak_cell: Tuple[int, ...]
     peak_rho: float
@@ -79,6 +80,13 @@ class Clump:
     mass: float
     pos: np.ndarray          # mass-weighted centre [ndim]
     relevance: float         # peak / max saddle
+    # saddle-threshold halo membership (merge_clumps('saddleden')):
+    # the surviving peak index of this clump's halo (= index when the
+    # HOP-style halo pass is off or the clump is its own halo)
+    parent: int = -1
+    rho_min: float = 0.0
+    rho_av: float = 0.0
+    max_saddle: float = 0.0
 
 
 def _saddles(rho, labels, ndim: int) -> Dict[Tuple[int, int], float]:
@@ -101,13 +109,70 @@ def _saddles(rho, labels, ndim: int) -> Dict[Tuple[int, int], float]:
     return out
 
 
-def find_clumps(rho, threshold: float, relevance: float = 2.0,
-                dx: float = 1.0, merge: bool = True):
-    """Full PHEW pass: watershed → saddle merge → properties.
+def _merge_pass(rho, labels, ndim: int, action: str, thresh: float,
+                density_threshold: float) -> np.ndarray:
+    """Iterative peak merging to a fixed point — the two actions of
+    ``merge_clumps`` (``pm/clump_merger.f90:560-640``):
 
-    Peaks with peak/saddle < ``relevance`` are merged into the neighbour
-    across their highest saddle (``clump_merger`` relevance criterion).
-    Returns (labels [same shape, -1 outside], [Clump]).
+    * ``'relevance'``: a peak whose relevance
+      ``max_dens / max_saddle`` (``max_dens / density_threshold``
+      when it has no saddle) is below ``thresh`` merges into the
+      neighbour across its HIGHEST saddle;
+    * ``'saddleden'``: a peak whose highest saddle density exceeds
+      ``thresh`` merges the same way (the HOP-style halo grouping of
+      ``saddle_threshold > 0`` cosmo runs).
+
+    Both actions only move a peak into a DENSER partner (equal
+    densities tie-break to the smaller index), exactly like the
+    reference's ``max_dens(jpeak) > max_dens(ipeak)`` guard — the
+    fixed point is therefore order-independent.
+    """
+    flat_rho = rho.reshape(-1)
+    changed = True
+    while changed:
+        changed = False
+        saddles = _saddles(rho, labels, ndim)
+        best: Dict[int, Tuple[float, int]] = {}
+        for (a, b), v in saddles.items():
+            if v > best.get(a, (-np.inf, -1))[0]:
+                best[a] = (v, b)
+            if v > best.get(b, (-np.inf, -1))[0]:
+                best[b] = (v, a)
+        peaks = np.unique(labels[labels >= 0])
+        peak_rho = {p: flat_rho[p] for p in peaks}
+        # process the least dense peak first (deterministic; the fixed
+        # point matches any order by the denser-partner guard)
+        for p in sorted(peaks, key=lambda q: (peak_rho[q], q)):
+            s, partner = best.get(p, (0.0, -1))
+            if action == "relevance":
+                denom = s if s > 0 else max(density_threshold, 1e-300)
+                do = peak_rho[p] / denom < thresh
+            else:
+                do = s > thresh
+            if not (do and partner >= 0):
+                continue
+            rp = peak_rho[partner]
+            if rp > peak_rho[p] or (rp == peak_rho[p] and partner < p):
+                labels[labels == p] = partner
+                changed = True
+                break
+    return labels
+
+
+def find_clumps(rho, threshold: float, relevance: float = 2.0,
+                dx: float = 1.0, merge: bool = True,
+                saddle_threshold: float = 0.0):
+    """Full PHEW pass: watershed → relevance merge → properties
+    [→ saddle-threshold halo grouping].
+
+    ``saddle_threshold > 0`` additionally runs the HOP-style
+    ``merge_clumps('saddleden')`` pass AFTER the clump properties are
+    taken: clumps whose mutual saddle exceeds the threshold group into
+    halos, recorded per clump as ``parent`` (the reference's two-level
+    clump→halo hierarchy for cosmo runs).  Returns
+    (labels [same shape, -1 outside], [Clump]) — with the halo pass,
+    ``labels`` carries the HALO segmentation and each ``Clump.parent``
+    names its halo peak.
     """
     rho_j = jnp.asarray(rho)
     ndim = rho_j.ndim
@@ -115,30 +180,8 @@ def find_clumps(rho, threshold: float, relevance: float = 2.0,
     rho = np.asarray(rho_j)
 
     if merge:
-        changed = True
-        while changed:
-            changed = False
-            saddles = _saddles(rho, labels, ndim)
-            # per peak: highest saddle + partner
-            best: Dict[int, Tuple[float, int]] = {}
-            for (a, b), v in saddles.items():
-                if v > best.get(a, (-np.inf, -1))[0]:
-                    best[a] = (v, b)
-                if v > best.get(b, (-np.inf, -1))[0]:
-                    best[b] = (v, a)
-            peaks = np.unique(labels[labels >= 0])
-            peak_rho = {p: rho.reshape(-1)[p] for p in peaks}
-            # merge the least relevant peak first (deterministic order)
-            for p in sorted(peaks, key=lambda q: peak_rho[q]):
-                if p not in best:
-                    continue
-                s, partner = best[p]
-                if peak_rho[p] / max(s, 1e-300) < relevance:
-                    # absorb into the partner across the highest saddle
-                    tgt = partner
-                    labels[labels == p] = tgt
-                    changed = True
-                    break
+        labels = _merge_pass(rho, labels, ndim, "relevance", relevance,
+                             threshold)
 
     clumps: List[Clump] = []
     vol = dx ** ndim
@@ -157,19 +200,33 @@ def find_clumps(rho, threshold: float, relevance: float = 2.0,
             index=int(p), peak_cell=tuple(int(c) for c in pk),
             peak_rho=float(rho.reshape(-1)[p]), ncell=int(m.sum()),
             mass=float(mass), pos=(pos + 0.5) * dx,
-            relevance=float(rho.reshape(-1)[p] / max(smax, 1e-300))))
+            relevance=float(rho.reshape(-1)[p] / max(smax, 1e-300)),
+            parent=int(p), rho_min=float(rr.min()),
+            rho_av=float(rr.mean()), max_saddle=float(smax)))
+
+    if saddle_threshold > 0.0 and len(clumps) > 1:
+        labels = _merge_pass(rho, labels, ndim, "saddleden",
+                             saddle_threshold, threshold)
+        flat = labels.reshape(-1)
+        for c in clumps:
+            # the halo this clump's peak cell ended up in
+            c.parent = int(flat[c.index])
+
     clumps.sort(key=lambda c: -c.mass)
     return labels, clumps
 
 
 def write_clump_table(clumps: List[Clump], path: str):
-    """``output_clump``-style ascii table."""
+    """``output_clump``-style ascii table (the
+    ``write_clump_properties`` column set incl. the halo parent and
+    the rho min/av/max summary)."""
     with open(path, "w") as f:
-        f.write("# index ncell peak_x peak_y peak_z rho_peak mass "
-                "relevance\n")
+        f.write("# index parent ncell peak_x peak_y peak_z rho- rho+ "
+                "rho_av mass relevance\n")
         for c in clumps:
             pk = list(c.peak_cell) + [0] * (3 - len(c.peak_cell))
-            f.write(f"{c.index:8d} {c.ncell:8d} "
+            f.write(f"{c.index:8d} {c.parent:8d} {c.ncell:8d} "
                     f"{pk[0]:6d} {pk[1]:6d} {pk[2]:6d} "
-                    f"{c.peak_rho:14.6e} {c.mass:14.6e} "
+                    f"{c.rho_min:12.4e} {c.peak_rho:12.4e} "
+                    f"{c.rho_av:12.4e} {c.mass:14.6e} "
                     f"{c.relevance:10.3f}\n")

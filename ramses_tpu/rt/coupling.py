@@ -39,6 +39,28 @@ class RtCoupled:
             pos = [float(v) * dx_cgs * grid.shape[d]
                    for d, v in enumerate(r.rt_src_pos[:spec.ndim])]
             self.sim.point_source(pos, float(r.rt_ndot))
+        # rt_nsource point/beam list (rad_beams.nml usage): per-source
+        # box-unit centres, photons/s rates, optional beam direction
+        for k in range(int(r.rt_nsource)):
+            stype = (r.rt_source_type[k]
+                     if k < len(r.rt_source_type) else "point")
+            if str(stype).strip("'\" ") != "point":
+                raise NotImplementedError(
+                    f"rt_source_type={stype!r}: only 'point' sources "
+                    "are wired (shells/squares via &RT_REGIONS role)")
+            cen = [r.rt_src_x_center, r.rt_src_y_center,
+                   r.rt_src_z_center]
+            pos = [(float(cen[d][k]) if k < len(cen[d]) else 0.0)
+                   * dx_cgs * grid.shape[d] for d in range(spec.ndim)]
+            uvw = [r.rt_u_source, r.rt_v_source, r.rt_w_source]
+            direction = None
+            if any(k < len(uvw[d]) and float(uvw[d][k]) != 0.0
+                   for d in range(spec.ndim)):
+                direction = [float(uvw[d][k]) if k < len(uvw[d]) else 0.0
+                             for d in range(spec.ndim)]
+            rate = (float(r.rt_n_source[k])
+                    if k < len(r.rt_n_source) else 0.0)
+            self.sim.point_source(pos, rate, direction=direction)
 
     # ------------------------------------------------------------------
     def _mu(self):

@@ -37,6 +37,9 @@ class RtSpec:
     # SED-averaged Group3 tuple; empty → legacy single gray group
     groups3: tuple = ()
     y_he: float = 0.0
+    # pure photon propagation (rt_pp / rt_freeflow): transport only,
+    # no thermochemistry
+    pp: bool = False
 
     @property
     def c_red(self) -> float:
@@ -71,7 +74,8 @@ class RtSpec:
                    otsa=bool(r.rt_otsa),
                    periodic=not bool(r.rt_is_outflow_bound),
                    groups3=groups3,
-                   y_he=float(r.rt_y_he))
+                   y_he=float(r.rt_y_he),
+                   pp=bool(r.rt_pp) or bool(r.rt_freeflow))
 
 
 class RtSim:
@@ -106,6 +110,10 @@ class RtSim:
             self.N = jnp.full(self.shape, m1.SMALL_NP)
             self.F = jnp.zeros((ndim,) + self.shape)
             self.src = jnp.zeros(self.shape)
+        # flux (beam) source field: allocated lazily on the first
+        # DIRECTED point_source so beam-free runs don't carry and
+        # integrate an all-zeros (ng, ndim, *shape) array every substep
+        self.src_F = None
         self.t = 0.0
         self._step_fn = None
 
@@ -115,36 +123,63 @@ class RtSim:
         y = self.spec.y_he
         return self.nH * (y / (4.0 * max(1.0 - y, 1e-10)))
 
-    def point_source(self, pos: Sequence[float], ndot: float):
+    def point_source(self, pos: Sequence[float], ndot: float,
+                     direction: Optional[Sequence[float]] = None):
         """Add a point source of ``ndot`` photons/s (one-cell injection,
         the reference's cloud-smoothed stellar injection reduced);
-        multigroup sources split by the SED's photon-count shares."""
+        multigroup sources split by the SED's photon-count shares.
+        ``direction``: optional unit vector making the source a BEAM —
+        photons inject with streaming flux F = c_red·N·n̂ (the
+        rt_u/v/w_source directed sources of rad_beams.nml)."""
         idx = tuple(int(p / self.dx) for p in pos)
         vol = self.dx ** self.spec.ndim
         src = np.array(self.src)
+        nd = self.spec.ndim
+        if direction is not None and self.src_F is None:
+            shape = ((len(self.spec.groups3), nd) + self.shape
+                     if self.spec.full3 else (nd,) + self.shape)
+            self.src_F = jnp.zeros(shape)
+            self._step_fn = None          # recompile with the beam term
+        srcF = (np.array(self.src_F) if self.src_F is not None
+                else None)
         if self.spec.full3:
             for g, grp in enumerate(self.spec.groups3):
                 src[(g,) + idx] += grp.frac * ndot / vol
+                if direction is not None:
+                    for d in range(nd):
+                        srcF[(g, d) + idx] += (self.spec.c_red * grp.frac
+                                               * ndot / vol
+                                               * float(direction[d]))
         else:
             src[idx] += ndot / vol
+            if direction is not None:
+                for d in range(nd):
+                    srcF[(d,) + idx] += (self.spec.c_red * ndot / vol
+                                         * float(direction[d]))
         self.src = jnp.asarray(src)
+        if srcF is not None:
+            self.src_F = jnp.asarray(srcF)
 
     def _build_step(self):
         spec = self.spec
         dx = self.dx
+        has_beam = self.src_F is not None
 
         if not spec.full3:
             @partial(jax.jit, static_argnames=("nsub",))
-            def run(N, F, x, xh2, xh3, T, nH, nHe, src, dt_sub,
+            def run(N, F, x, xh2, xh3, T, nH, nHe, src, src_F, dt_sub,
                     nsub: int):
                 def body(carry, _):
                     N, F, x, T = carry
                     N = N + dt_sub * src
+                    if has_beam:
+                        F = F + dt_sub * src_F
                     N, F = m1.transport_step(N, F, dt_sub, dx, spec.c_red,
                                              spec.ndim, spec.periodic)
-                    N, x, T = chem_mod.chem_step(
-                        N, x, T, nH, dt_sub, spec.c_red, spec.group,
-                        spec.otsa, heating=spec.heating)
+                    if not spec.pp:      # rt_pp: free-flowing photons
+                        N, x, T = chem_mod.chem_step(
+                            N, x, T, nH, dt_sub, spec.c_red, spec.group,
+                            spec.otsa, heating=spec.heating)
                     return (N, F, x, T), None
                 (N, F, x, T), _ = jax.lax.scan(body, (N, F, x, T), None,
                                                length=nsub)
@@ -155,10 +190,13 @@ class RtSim:
         ng = len(groups)
 
         @partial(jax.jit, static_argnames=("nsub",))
-        def run(N, F, x, xh2, xh3, T, nH, nHe, src, dt_sub, nsub: int):
+        def run(N, F, x, xh2, xh3, T, nH, nHe, src, src_F, dt_sub,
+                nsub: int):
             def body(carry, _):
                 N, F, x, xh2, xh3, T = carry
                 N = N + dt_sub * src
+                if has_beam:
+                    F = F + dt_sub * src_F
                 Ns, Fs = [], []
                 for g in range(ng):          # per-group GLF transport
                     Ng, Fg = m1.transport_step(
@@ -166,9 +204,13 @@ class RtSim:
                         spec.periodic)
                     Ns.append(Ng)
                     Fs.append(Fg)
-                Ns, (x, xh2, xh3), T = chem_mod.chem_step_3ion(
-                    Ns, (x, xh2, xh3), T, nH, nHe, dt_sub, spec.c_red,
-                    groups, spec.otsa, heating=spec.heating)
+                if spec.pp:
+                    Ns = list(Ns)
+                else:
+                    Ns, (x, xh2, xh3), T = chem_mod.chem_step_3ion(
+                        Ns, (x, xh2, xh3), T, nH, nHe, dt_sub,
+                        spec.c_red, groups, spec.otsa,
+                        heating=spec.heating)
                 return (jnp.stack(Ns), jnp.stack(Fs), x, xh2, xh3,
                         T), None
             (N, F, x, xh2, xh3, T), _ = jax.lax.scan(
@@ -186,8 +228,10 @@ class RtSim:
         dt_sub = dt / nsub
         xh2 = getattr(self, "xHe2", jnp.zeros(self.shape))
         xh3 = getattr(self, "xHe3", jnp.zeros(self.shape))
+        srcF = (self.src_F if self.src_F is not None
+                else jnp.asarray(0.0))
         out = self._step_fn(self.N, self.F, self.x, xh2, xh3, self.T,
-                            self.nH, self.nHe, self.src,
+                            self.nH, self.nHe, self.src, srcF,
                             jnp.asarray(dt_sub), nsub)
         self.N, self.F, self.x, xh2, xh3, self.T = out
         if self.spec.full3:

@@ -57,7 +57,8 @@ def catalogue_from_arrays(x, v, m, ids, boxlen, nx: int = 64,
                           threshold_over_mean: float = 5.0,
                           relevance: float = 1.5, G: float = 1.0,
                           npart_min: int = 10, unbind: bool = True,
-                          saddle_pot: bool = False, nmassbins: int = 0):
+                          saddle_pot: bool = False, nmassbins: int = 0,
+                          saddle_threshold: float = 0.0):
     """PHEW chain on in-memory particle arrays: deposit → watershed →
     unbind.  Shared by the offline CLI and the in-run
     ``clumpfind=.true.`` pass.  ``threshold``: absolute density
@@ -70,7 +71,8 @@ def catalogue_from_arrays(x, v, m, ids, boxlen, nx: int = 64,
     np.add.at(rho, idx, m / dx ** nd)
     thr = (float(threshold) if threshold > 0
            else float(rho.mean()) * threshold_over_mean)
-    labels, _ = find_clumps(rho, thr, relevance=relevance, dx=dx)
+    labels, _ = find_clumps(rho, thr, relevance=relevance, dx=dx,
+                            saddle_threshold=saddle_threshold)
     pl = np.asarray(labels)[idx]        # NGP labels, one gather
     return build_catalogue(x, v, m, ids, pl, boxlen, G=G,
                            unbind=unbind, npart_min=npart_min,

@@ -271,3 +271,114 @@ class TestBitperm:
     def test_1d(self):
         for lvl in (1, 3, 6):
             self._check(1, lvl)
+
+
+class TestNonCubicAmr:
+    """Non-cubic coarse grids on the hierarchy (VERDICT-r04 Missing #4;
+    ``amr/init_amr.f90:37-60`` builds over an arbitrary nx,ny,nz root
+    grid)."""
+
+    NML = """
+&RUN_PARAMS
+hydro=.true.
+/
+&AMR_PARAMS
+levelmin={lmin}
+levelmax={lmax}
+boxlen={boxlen}
+nx={nx}
+ny={ny}
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='square'
+x_center={xc1},{xc2}
+y_center={yc},{yc}
+length_x={lx},{lx}
+length_y=10.0,10.0
+exp_region=10.0,10.0
+d_region=1.0,0.125
+p_region=1.0,0.1
+/
+&HYDRO_PARAMS
+riemann='hllc'
+/
+&REFINE_PARAMS
+err_grad_d=0.05
+err_grad_p=0.05
+/
+&OUTPUT_PARAMS
+tend=0.05
+/
+"""
+
+    def _mk(self, nx, ny, lmin, lmax, boxlen):
+        # same PHYSICAL setup on [0,1]^2 whatever the root grid:
+        # interface at x=0.5 (plus the periodic seam at 0/1)
+        ext = nx * boxlen
+        nml = self.NML.format(lmin=lmin, lmax=lmax, boxlen=boxlen,
+                              nx=nx, ny=ny, xc1=0.25 * ext / boxlen,
+                              xc2=0.75 * ext / boxlen,
+                              yc=0.5 * ny, lx=0.5 * ext / boxlen)
+        return params_from_string(nml, ndim=2)
+
+    def test_matches_equivalent_cubic_run(self):
+        # nx=ny=2, boxlen=0.5, lmin=4  ==  nx=ny=1, boxlen=1, lmin=5:
+        # identical cells (dx=1/32 on [0,1]^2), identical physics
+        pa = self._mk(2, 2, 4, 5, 0.5)
+        pa.init.x_center = [0.25, 0.75]
+        pa.init.y_center = [0.5, 0.5]
+        pa.init.length_x = [0.5, 0.5]
+        pb = self._mk(1, 1, 5, 6, 1.0)
+        pb.init.x_center = [0.25, 0.75]
+        pb.init.y_center = [0.5, 0.5]
+        pb.init.length_x = [0.5, 0.5]
+        sa = AmrSim(pa, dtype=jnp.float64)
+        sb = AmrSim(pb, dtype=jnp.float64)
+        assert sa.tree.cell_dims(4) == (32, 32)
+        # same refined geometry: A's level-5 octs at B's level-6 coords
+        for la, lb in ((5, 6),):
+            ka = set(map(tuple, sa.tree.levels[la].og)) \
+                if sa.tree.has(la) else set()
+            kb = set(map(tuple, sb.tree.levels[lb].og)) \
+                if sb.tree.has(lb) else set()
+            assert ka == kb and ka
+        sa.evolve(0.02, nstepmax=8)
+        sb.evolve(0.02, nstepmax=8)
+        assert sa.nstep == sb.nstep
+        # same leaf field on the shared cells
+        ca, ua = sa.leaf_sample(4)
+        cb, ub = sb.leaf_sample(5)
+        oa = np.lexsort(ca.T)
+        ob = np.lexsort(cb.T)
+        assert np.allclose(ca[oa], cb[ob], atol=1e-12)
+        assert np.allclose(ua[oa], ub[ob], rtol=1e-10, atol=1e-12)
+        m0, m1 = sa.totals()[0], sb.totals()[0]
+        assert abs(m0 - m1) < 1e-12
+
+    def test_snapshot_restart_roundtrip(self, tmp_path):
+        p = self._mk(2, 1, 4, 5, 1.0)
+        sim = AmrSim(p, dtype=jnp.float64)
+        assert sim.tree.has(5)                  # refinement present
+        sim.evolve(0.02, nstepmax=4)
+        out = sim.dump(iout=1, base_dir=str(tmp_path))
+        p2 = self._mk(2, 1, 4, 5, 1.0)
+        sim2 = AmrSim.from_snapshot(p2, out, dtype=jnp.float64)
+        assert sim2.tree.root == (2, 1)
+        assert sim2.t == sim.t and sim2.nstep == sim.nstep
+        for l in sim.levels():
+            assert np.array_equal(sim.tree.levels[l].og,
+                                  sim2.tree.levels[l].og)
+            nc = sim.maps[l].noct * 4
+            a = np.asarray(sim.u[l])[:nc]
+            b = np.asarray(sim2.u[l])[:nc]
+            assert np.allclose(a, b, rtol=1e-12, atol=1e-14), l
+        # both continue identically (restart oracle)
+        sim.evolve(0.04, nstepmax=sim.nstep + 3)
+        sim2.evolve(0.04, nstepmax=sim2.nstep + 3)
+        for l in sim.levels():
+            nc = sim.maps[l].noct * 4
+            assert np.allclose(np.asarray(sim.u[l])[:nc],
+                               np.asarray(sim2.u[l])[:nc],
+                               rtol=1e-10, atol=1e-12), l

@@ -210,3 +210,43 @@ def test_tracer_ids_stable_across_dumps(tmp_path):
     xs = np.asarray(sim.tracer_x)
     for i, xb in zip(np.array(sim.tracer_id), xs):
         assert np.allclose(x1[i], xb)
+
+
+def test_saddle_threshold_halo_grouping():
+    """merge_clumps('saddleden') semantics (pm/clump_merger.f90:592):
+    clumps joined by a saddle denser than saddle_threshold group into
+    one halo; clumps below stay their own halo."""
+    import numpy as np
+
+    from ramses_tpu.pm.clumps import find_clumps
+
+    n = 32
+    rho = np.full((n, n), 0.1)
+    x = np.arange(n)
+    xx, yy = np.meshgrid(x, x, indexing="ij")
+
+    def blob(cx, cy, amp, w):
+        return amp * np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2)
+                              / (2 * w ** 2)))
+    # pair A: two peaks joined by a HIGH ridge (saddle ~ 5) — one halo
+    rho += blob(8, 8, 10.0, 2.0) + blob(8, 14, 9.0, 2.0)
+    # pair B: distant peak with only low surroundings — its own halo
+    rho += blob(24, 24, 8.0, 2.0)
+    labels, clumps = find_clumps(rho, threshold=1.0, relevance=1.2,
+                                 saddle_threshold=3.0)
+    assert len(clumps) == 3
+    by_idx = {c.index: c for c in clumps}
+    # the A-pair shares a parent; B is its own parent
+    pa = [c.parent for c in clumps
+          if abs(c.peak_cell[0] - 8) <= 2]
+    assert len(set(pa)) == 1
+    cb = [c for c in clumps if c.peak_cell[0] > 16][0]
+    assert cb.parent == cb.index
+    assert cb.parent not in pa or pa[0] != cb.parent
+    # label field carries the halo segmentation: A-pair is one label
+    la = np.unique(labels[(xx < 16) & (labels >= 0)])
+    assert len(la) == 1
+    # richer properties populated
+    for c in clumps:
+        assert c.rho_av >= c.rho_min > 0
+        assert c.peak_rho >= c.rho_av

@@ -253,3 +253,37 @@ def test_lightcone_emission_during_cosmo_run(tmp_path, monkeypatch):
     # NEARER shells), with no overlap beyond roundoff
     for (lo1, hi1), (lo0, hi0) in zip(r_ranges[1:], r_ranges[:-1]):
         assert hi1 <= lo0 + 1e-8
+
+
+@pytest.mark.parametrize("name", ["mergertree.nml", "cosmo_gal.nml"])
+def test_shipped_cosmo_namelists_run_through_cli(name, tmp_path,
+                                                 monkeypatch):
+    """The grafic-IC production namelists (mergertree.nml DM-only +
+    clumpfind/unbinding/mergertree chain; cosmo_gal.nml hydro + SF +
+    feedback + cooling) run through the CLI against generated ICs —
+    the same coverage contract as test_namelist_suite for the
+    self-contained configs (cosmo.nml's siblings)."""
+    import os
+    import re
+
+    from ramses_tpu.__main__ import main
+
+    nmldir = os.path.join(os.path.dirname(__file__), "..", "namelists")
+    txt = open(os.path.join(nmldir, name)).read()
+    # shrink to the CPU-host budget: 16^3 ICs, 2 coarse steps
+    txt = re.sub(r"levelmin=\d+", "levelmin=4", txt)
+    txt = re.sub(r"levelmax=\d+", "levelmax=5", txt)
+    txt = txt.replace("&RUN_PARAMS", "&RUN_PARAMS\nnstepmax=2", 1)
+    txt = re.sub(r"aout=[0-9.,]+", "aout=1.0", txt)
+    txt = re.sub(r"noutput=\d+", "noutput=1", txt)
+    dst = str(tmp_path / name)
+    open(dst, "w").write(txt)
+    _single_mode_ics(str(tmp_path / "grafic_files"), n=16, amp=0.02)
+    monkeypatch.chdir(tmp_path)
+    assert main([dst, "--ndim", "3", "--dtype", "float64"]) == 0
+    outs = [d for d in os.listdir(tmp_path) if d.startswith("output_")]
+    assert outs, f"{name}: no snapshot written"
+    if name == "mergertree.nml":
+        # the in-run clump pass left its table next to the snapshot
+        files = os.listdir(os.path.join(tmp_path, outs[0]))
+        assert any(f.startswith("clump_") for f in files), files

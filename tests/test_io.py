@@ -463,10 +463,13 @@ def test_noncubic_box_roundtrip(tmp_path):
     assert np.isfinite(np.asarray(back.state.u)).all()
 
 
-def test_noncubic_box_amr_refuses():
+def test_noncubic_box_amr_gates_unsupported_physics():
+    # plain hydro AMR now RUNS on non-cubic roots (tests/test_amr.py
+    # TestNonCubicAmr); the unported physics layers must refuse loudly
     p = load_params("namelists/sedov3d.nml", ndim=3)
     p.amr.levelmin, p.amr.levelmax = 4, 5
     p.amr.ny = 3
+    p.run.pic = True
     from ramses_tpu.amr.hierarchy import AmrSim
-    with pytest.raises(NotImplementedError, match="nx=ny=nz"):
+    with pytest.raises(NotImplementedError, match="non-cubic"):
         AmrSim(p)
