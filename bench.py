@@ -57,6 +57,22 @@ def _load_baseline():
         return json.load(f).get("published", {})
 
 
+def measure_rtt(jnp, n=5):
+    """Median host→device→host round trip of a trivial fetch — the
+    tunnel-latency floor every sync in this process pays.  Reported
+    per sub so a degraded tunnel (r04's amr capture ran alongside a
+    backend-unavailable failure) can't masquerade as device time."""
+    import numpy as np
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        float(jnp.sum(x))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
 def bench_uniform(params, dtype, jnp):
     from ramses_tpu.driver import Simulation
     from ramses_tpu.grid.uniform import run_steps
@@ -83,6 +99,7 @@ def bench_uniform(params, dtype, jnp):
         "cell_updates_per_sec": updates / wall,
         "mus_per_cell_update": 1e6 * wall / max(updates, 1),
         "n": sim.grid.ncell, "steps": int(ndone), "wall_s": wall,
+        "tunnel_rtt_s": measure_rtt(jnp),
     }
 
 
@@ -196,6 +213,7 @@ def bench_amr(params, dtype, jnp):
         "timers_instrumented_s": inst_timers,
         "octs_per_level": {l: sim.tree.noct(l) for l in sim.levels()},
         "leaf_cells": sim.ncell_leaf(),
+        "tunnel_rtt_s": measure_rtt(jnp),
         "steady_state": {
             "cell_updates_per_sec": nss * upd1 / wss,
             "mus_per_cell_update": 1e6 * wss / (nss * upd1),
@@ -238,6 +256,7 @@ def bench_amr_poisson(params, dtype, jnp):
         "pcg_iters_per_sec": iters / wall,
         "pcg_iters_per_step": iters / nst,
         "steps": nst, "wall_s": wall,
+        "tunnel_rtt_s": measure_rtt(jnp),
     }
 
 
@@ -292,6 +311,7 @@ def bench_mg(dtype, jnp):
         "n": n, "wall_s": wall, "reps": reps,
         "sanity_max_vcycles_per_sec": vmax,
         "plausible": bool(vps <= vmax),
+        "tunnel_rtt_s": measure_rtt(jnp),
     }
 
 

@@ -161,7 +161,8 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     ``ret_flux``: additionally return the per-cell signed mass flux
     ``phi [ncell, ndim, 2]`` at each cell's (low, high) face — the MC
     gas-tracer capture of ``godunov_fine.f90:685-715`` (fluxes already
-    ×dt/dx, refined faces zeroed).  Forces the XLA path.
+    ×dt/dx, refined faces zeroed) — served by BOTH branches (the
+    Pallas kernel emits it as a third output).
     """
     ndim, nvar = cfg.ndim, cfg.nvar
     bcfg = dreplace(cfg, trailing_batch=True)
@@ -171,15 +172,24 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     okl = ok_ref.T.reshape((6,) * ndim + (noct,))
 
     from ramses_tpu.hydro import pallas_oct
-    if not ret_flux and pallas_oct.available(cfg, noct, u_flat.dtype,
-                                             gloc is not None):
-        # fused TPU oct-batch kernel (same physics, VMEM-resident)
-        du_k, corr_k = pallas_oct.oct_sweep(
-            uloc, okl.astype(uloc.dtype), dt, cfg, dx)
+    if gloc is None and pallas_oct.available(cfg, noct, u_flat.dtype):
+        # fused TPU oct-batch kernel (same physics, VMEM-resident);
+        # self-gravity rides as the hierarchy's separate traced
+        # half-kick, so gloc is None on every production path
+        out_k = pallas_oct.oct_sweep(
+            uloc, okl.astype(uloc.dtype), dt, cfg, dx,
+            want_flux=ret_flux)
+        du_k, corr_k = out_k[0], out_k[1]
         du_flat = jnp.transpose(
             du_k, (ndim + 1,) + tuple(range(1, ndim + 1)) + (0,)
         ).reshape(noct * 2 ** ndim, nvar)
-        return du_flat, jnp.transpose(corr_k, (3, 1, 2, 0))
+        corr_out = jnp.transpose(corr_k, (3, 1, 2, 0))
+        if not ret_flux:
+            return du_flat, corr_out
+        # phi [3, 2, 2,2,2, N] → flat [ncell, ndim, 2]
+        phi_k = jnp.transpose(out_k[2], (5, 2, 3, 4, 0, 1)).reshape(
+            noct * 2 ** ndim, ndim, 2)
+        return du_flat, corr_out, phi_k
 
     flux, tmp = _unsplit_fn(cfg)(uloc, gloc, dt, (dx,) * ndim, bcfg)
     # flux[d]: [nvar, 6..., noct], defined at the LOW face of each cell.
@@ -258,7 +268,8 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
 
     ``ret_flux``: additionally return ``phi [ncell, ndim, 2]`` — the
     per-cell (low, high) face mass flux ×dt/dx in flat row order (MC
-    gas-tracer capture).  Forces the XLA path.
+    gas-tracer capture) — served by BOTH branches (the fused kernel
+    emits it as a second output).
     """
     from ramses_tpu.grid import boundary as bmod
     from ramses_tpu.hydro import pallas_muscl as pk
@@ -269,17 +280,29 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
         ncell *= s
     ud = rows_to_dense(u_flat, inv_perm, shape)        # [*shape, nvar]
     ud = jnp.moveaxis(ud, -1, 0)                       # [nvar, *shape]
-    if not ret_flux and pk.kernel_available(cfg, shape, bc.faces,
-                                            ud.dtype):
+    if pk.kernel_available(cfg, shape, bc.faces, ud.dtype):
         # fused TPU kernel path (same physics, VMEM-resident pipeline);
-        # refined-face flux zeroing rides in as the mask input
+        # refined-face flux zeroing rides in as the mask input, the
+        # MC-tracer face-flux capture as a second kernel output
         ok = ok_dense.reshape(shape) if ok_dense is not None else None
         up, okp = pk.pad_xy(ud, bc, cfg, ok=ok)
-        un = pk.fused_step_padded(up, dt, cfg, dx, shape, ok_pad=okp)
+        if ret_flux:
+            un, phid = pk.fused_step_padded(up, dt, cfg, dx, shape,
+                                            ok_pad=okp, want_flux=True)
+        else:
+            un = pk.fused_step_padded(up, dt, cfg, dx, shape, ok_pad=okp)
         du_rows = dense_to_rows(jnp.moveaxis(un - ud, 0, -1), perm, shape)
         if u_flat.shape[0] > ncell:
             du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
-        return du_rows
+        if not ret_flux:
+            return du_rows
+        # phid [3, 2, *shape] → flat rows [ncell, ndim, 2]
+        phi = dense_to_rows(jnp.moveaxis(phid, (0, 1), (-2, -1)),
+                            perm, shape)
+        if u_flat.shape[0] > ncell:
+            phi = jnp.zeros((u_flat.shape[0], nd, 2),
+                            phi.dtype).at[:ncell].set(phi)
+        return du_rows, phi
     up = bmod.pad(ud, bc, cfg, muscl.NGHOST, dx=dx)
     flux, tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
     if ok_dense is not None:

@@ -199,10 +199,12 @@ def _hllc_flux(ql, qr, d: int, cfg: HydroStatic):
 
 
 def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
-                 masked: bool, courant: bool):
+                 masked: bool, courant: bool, want_flux: bool = False):
     """Kernel body closure; refs: u_pad [5, bx+4, WY, nz] window,
     (ok [bx+4, WY, nz] window,) dt [1,1] SMEM → out [5, bx, by, nz]
-    (+ per-block courant dt min [1, 1] SMEM when ``courant``)."""
+    (+ per-block courant dt min [1, 1] SMEM when ``courant``)
+    (+ phi [3, 2, bx, by, nz] per-cell (low, high) dt/dx-scaled face
+    MASS fluxes when ``want_flux`` — the MC-tracer capture)."""
     st = cfg.slope_type
     theta = float(getattr(cfg, "slope_theta", 1.5))
     solver = _llf_flux if cfg.riemann == "llf" else _hllc_flux
@@ -210,14 +212,16 @@ def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
     sy = slice(NG, NG + by)
 
     def kernel(*refs):
-        if masked and courant:
-            u_ref, ok_ref, dt_ref, out_ref, crt_ref = refs
-        elif masked:
-            u_ref, ok_ref, dt_ref, out_ref = refs
-        elif courant:
-            u_ref, dt_ref, out_ref, crt_ref = refs
-        else:
-            u_ref, dt_ref, out_ref = refs
+        i = 1
+        u_ref = refs[0]
+        ok_ref = refs[i] if masked else None
+        i += int(masked)
+        dt_ref = refs[i]
+        out_ref = refs[i + 1]
+        i += 2
+        crt_ref = refs[i] if courant else None
+        i += int(courant)
+        phi_ref = refs[i] if want_flux else None
         dt = dt_ref[0, 0]
         # ---- ctoprim (umuscl.f90:861-967) ----
         r = jnp.maximum(u_ref[0], cfg.smallr)
@@ -275,6 +279,9 @@ def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
                 keepf = (1.0 - okf) * (1.0 - _roll(okf, 1, d))
                 flux = tuple(f * keepf for f in flux)
             scale = dt / dx
+            if want_flux:
+                phi_ref[d, 0] = (flux[0] * scale)[sx, sy, :]
+                phi_ref[d, 1] = (_roll(flux[0], -1, d) * scale)[sx, sy, :]
             for c in range(5):
                 contrib = (flux[c] - _roll(flux[c], -1, d)) * scale
                 du[c] = contrib if du[c] is None else du[c] + contrib
@@ -316,11 +323,13 @@ def _make_kernel(cfg: HydroStatic, dx: float, bx: int, by: int,
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "dx", "shape", "courant", "interpret"))
+         static_argnames=("cfg", "dx", "shape", "courant", "interpret",
+                          "want_flux"))
 def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
                       shape: Tuple[int, int, int],
                       ok_pad: Optional[jnp.ndarray] = None,
-                      courant: bool = False, interpret: bool = False):
+                      courant: bool = False, interpret: bool = False,
+                      want_flux: bool = False):
     """Run the fused kernel on an x/y-ghost-padded state.
 
     u_pad: [5, nx+4, ny+8, nz] from :func:`pad_xy` (x: 2-cell ghosts
@@ -333,7 +342,8 @@ def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
     nx, ny, nz = shape
     bx, by = _pick_block(shape)
     dt2 = jnp.asarray(dt, u_pad.dtype).reshape(1, 1)
-    kern = _make_kernel(cfg, dx, bx, by, ok_pad is not None, courant)
+    kern = _make_kernel(cfg, dx, bx, by, ok_pad is not None, courant,
+                        want_flux)
     in_specs = [
         pl.BlockSpec(
             (Element(5), Element(bx + 2 * NG), Element(WY), Element(nz)),
@@ -350,15 +360,23 @@ def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
     in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0),
                                  memory_space=pltpu.SMEM))
     args.append(dt2)
-    out_specs = pl.BlockSpec((5, bx, by, nz), lambda i, j: (0, i, j, 0),
-                             memory_space=pltpu.VMEM)
-    out_shape = jax.ShapeDtypeStruct((5, nx, ny, nz), u_pad.dtype)
+    out_specs = [pl.BlockSpec((5, bx, by, nz), lambda i, j: (0, i, j, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((5, nx, ny, nz), u_pad.dtype)]
     if courant:
-        out_specs = (out_specs,
-                     pl.BlockSpec((1, 1), lambda i, j: (0, 0),
-                                  memory_space=pltpu.SMEM))
-        out_shape = (out_shape,
-                     jax.ShapeDtypeStruct((1, 1), u_pad.dtype))
+        out_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                                      memory_space=pltpu.SMEM))
+        out_shape.append(jax.ShapeDtypeStruct((1, 1), u_pad.dtype))
+    if want_flux:
+        out_specs.append(pl.BlockSpec(
+            (3, 2, bx, by, nz), lambda i, j: (0, 0, i, j, 0),
+            memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((3, 2, nx, ny, nz), u_pad.dtype))
+    if len(out_specs) == 1:
+        out_specs, out_shape = out_specs[0], out_shape[0]
+    else:
+        out_specs, out_shape = tuple(out_specs), tuple(out_shape)
     return pl.pallas_call(
         kern,
         grid=(nx // bx, ny // by),

@@ -18,8 +18,13 @@ consumes — exactly the XLA path's contract.
 
 Scope (gated by :func:`available`, falls back to the XLA path
 otherwise): ndim=3 hydro, nener=npassive=0, no pressure_fix,
-scheme=muscl, slope_type∈{1,2,8}, riemann∈{llf, hllc}, f32, no
-per-cell gravity block, single device.
+scheme=muscl, slope_type∈{1,2,8}, riemann∈{llf, hllc}, f32, single
+device.  Self-gravity needs NO kernel support: the hierarchy applies
+it as a separate traced half-kick around the sweep
+(``kick_flat`` — ``amr/hierarchy.py _advance_traced``), so gravity
+production runs take this kernel too.  ``want_flux=True`` adds the MC
+gas-tracer per-cell face mass-flux capture as a third output
+(``godunov_fine.f90:685-715``), covering tracer runs as well.
 """
 
 from __future__ import annotations
@@ -45,11 +50,11 @@ FORCE_INTERPRET = bool(__import__("os").environ
                        .get("RAMSES_PALLAS_OCT_INTERPRET"))
 
 
-def available(cfg: HydroStatic, noct_pad: int, dtype, has_grav: bool) -> bool:
+def available(cfg: HydroStatic, noct_pad: int, dtype) -> bool:
     """Availability gate for the oct-batch kernel (see module docstring;
     the single-device restriction mirrors ``pallas_muscl.kernel_available``
     — sharded levels must keep the XLA solver so GSPMD can partition)."""
-    if DISABLED or has_grav:
+    if DISABLED:
         return False
     if not FORCE_INTERPRET and (jax.default_backend() != "tpu"
                                 or jax.device_count() != 1):
@@ -77,16 +82,18 @@ def _tile(noct_pad: int) -> int:
     raise AssertionError("gated by available()")
 
 
-def _make_kernel(cfg: HydroStatic, dx: float):
+def _make_kernel(cfg: HydroStatic, dx: float, want_flux: bool = False):
     """Kernel body; refs: u [5,6,6,6,NT], ok [6,6,6,NT] (state-dtype
     0/1 refined mask), dt [1,1] SMEM → du [5,2,2,2,NT] (interior
-    update), corr [5,3,2,NT] (dt/dx-scaled boundary-face flux sums)."""
+    update), corr [5,3,2,NT] (dt/dx-scaled boundary-face flux sums)
+    [, phi [3,2,2,2,2,NT] (d, side, interior) dt/dx-scaled per-cell
+    face MASS fluxes — the MC-tracer capture]."""
     st = cfg.slope_type
     theta = float(getattr(cfg, "slope_theta", 1.5))
     solver = _llf_flux if cfg.riemann == "llf" else _hllc_flux
     core = (slice(2, 4), slice(2, 4), slice(2, 4))
 
-    def kernel(u_ref, ok_ref, dt_ref, du_ref, corr_ref):
+    def kernel(u_ref, ok_ref, dt_ref, du_ref, corr_ref, *phi_ref):
         dt = dt_ref[0, 0]
         # ---- ctoprim ----
         r = jnp.maximum(u_ref[0], cfg.smallr)
@@ -145,27 +152,55 @@ def _make_kernel(cfg: HydroStatic, dx: float):
                 corr_ref[c, d, 1] = flux[c][hi_ix].sum(axis=(0, 1)) * scale
                 contrib = (flux[c] - jnp.roll(flux[c], -1, axis=d)) * scale
                 du[c] = contrib if du[c] is None else du[c] + contrib
+            if want_flux:
+                # per-cell (low, high) face mass flux: the cell's low
+                # face sits at its own stencil slot, its high face at
+                # the next slot along d
+                phi_ref[0][d, 0] = (flux[0] * scale)[core]
+                phi_ref[0][d, 1] = (jnp.roll(flux[0], -1, axis=d)
+                                    * scale)[core]
         for c in range(5):
             du_ref[c] = du[c][core]
 
     return kernel
 
 
-@partial(jax.jit, static_argnames=("cfg", "dx", "interpret"))
+@partial(jax.jit, static_argnames=("cfg", "dx", "interpret",
+                                   "want_flux"))
 def oct_sweep(uloc, ok, dt, cfg: HydroStatic, dx: float,
-              interpret: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+              interpret: bool = False, want_flux: bool = False):
     """Fused partial-level sweep on a gathered stencil batch.
 
     uloc: [5, 6, 6, 6, N] (N = padded oct count, 128-multiple);
     ok: [6, 6, 6, N] refined-cell mask in the state dtype (0/1).
     Returns (du [5, 2, 2, 2, N], corr [5, 3, 2, N]) with corr already
-    ×dt/dx — the :func:`~ramses_tpu.amr.kernels.level_sweep` convention.
+    ×dt/dx — the :func:`~ramses_tpu.amr.kernels.level_sweep` convention
+    — plus, with ``want_flux``, phi [3, 2, 2, 2, 2, N]: per-cell
+    (d, side, interior) dt/dx-scaled face mass fluxes (the MC-tracer
+    capture).
     """
     n = uloc.shape[-1]
     nt = _tile(n)
     dt2 = jnp.asarray(dt, uloc.dtype).reshape(1, 1)
-    kern = _make_kernel(cfg, dx)
+    kern = _make_kernel(cfg, dx, want_flux)
     interpret = interpret or FORCE_INTERPRET
+    out_specs = [
+        pl.BlockSpec((5, 2, 2, 2, nt), lambda i: (0, 0, 0, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((5, 3, 2, nt), lambda i: (0, 0, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((5, 2, 2, 2, n), uloc.dtype),
+        jax.ShapeDtypeStruct((5, 3, 2, n), uloc.dtype),
+    ]
+    if want_flux:
+        out_specs.append(
+            pl.BlockSpec((3, 2, 2, 2, 2, nt),
+                         lambda i: (0, 0, 0, 0, 0, i),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((3, 2, 2, 2, 2, n), uloc.dtype))
     return pl.pallas_call(
         kern,
         grid=(n // nt,),
@@ -177,16 +212,8 @@ def oct_sweep(uloc, ok, dt, cfg: HydroStatic, dx: float,
             pl.BlockSpec((1, 1), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
         ],
-        out_specs=(
-            pl.BlockSpec((5, 2, 2, 2, nt), lambda i: (0, 0, 0, 0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((5, 3, 2, nt), lambda i: (0, 0, 0, i),
-                         memory_space=pltpu.VMEM),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((5, 2, 2, 2, n), uloc.dtype),
-            jax.ShapeDtypeStruct((5, 3, 2, n), uloc.dtype),
-        ),
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),

@@ -83,7 +83,12 @@ def write_frame(path: str, data, t: float = 0.0,
     arr = np.asarray(data, dtype=np.float32)
     with open(path, "wb") as f:
         frt.write_record(f, np.asarray([t, *bounds], dtype=np.float64))
-        frt.write_record(f, np.asarray(arr.shape[::-1], dtype=np.int32))
+        # the reference layout is Fortran column-major: the first int
+        # is the FASTEST-varying extent (utils/py/map2img.py reads
+        # reshape(ny, nx)); arr.T.ravel() puts axis 0 fastest, so the
+        # shape record is arr.shape, NOT reversed (square movie frames
+        # used to hide the distinction)
+        frt.write_record(f, np.asarray(arr.shape, dtype=np.int32))
         frt.write_record(f, arr.T.ravel())
 
 
@@ -91,7 +96,7 @@ def read_frame(path: str):
     with open(path, "rb") as f:
         head = frt.read_reals(f)
         nw, nh = frt.read_ints(f)
-        data = frt.read_array(f, np.float32).reshape(nw, nh).T
+        data = frt.read_array(f, np.float32).reshape(nh, nw).T
     return dict(t=head[0], bounds=tuple(head[1:5]), data=data)
 
 

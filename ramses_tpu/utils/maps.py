@@ -68,25 +68,85 @@ def part2map(outdir: str, axis: int = 2, nx: int = 256) -> np.ndarray:
     return grid * (nx / boxlen) ** len(axes2d)
 
 
+def read_map(path: str):
+    """Read a ``.map`` binary frame (the amr2map/movie format; one
+    parser — :func:`ramses_tpu.io.movie.read_frame` — serves both
+    consumers).  Returns (map [nx, ny] float64, meta dict with ``t``
+    and the window ``bounds``)."""
+    from ramses_tpu.io.movie import read_frame
+    fr = read_frame(path)
+    return (np.asarray(fr["data"], dtype=np.float64),
+            dict(t=float(fr["t"]), bounds=tuple(fr["bounds"])))
+
+
+# a compact viridis-like ramp (anchor RGB rows, linearly interpolated)
+_RAMP = np.array([[68, 1, 84], [59, 82, 139], [33, 145, 140],
+                  [94, 201, 98], [253, 231, 37]], dtype=np.float64)
+
+
+def map2img(map_path: str, img_path: str, log: bool = False,
+            vmin=None, vmax=None) -> tuple:
+    """``.map`` frame → image (the ``map2bmp.c`` / ``map2img.py``
+    role): log/linear scaling with optional clipping, colormapped to
+    a dependency-free binary PPM (or grayscale PGM with ``.pgm``).
+    ``vmin``/``vmax`` are in DATA units; with ``log`` they are
+    log10'd alongside the data (non-positive thresholds fall back to
+    the data range)."""
+    m, _meta = read_map(map_path)
+    if log:
+        m = np.log10(np.maximum(m, 1e-300))
+        vmin = np.log10(vmin) if vmin is not None and vmin > 0 else None
+        vmax = np.log10(vmax) if vmax is not None and vmax > 0 else None
+    lo = float(np.min(m) if vmin is None else vmin)
+    hi = float(np.max(m) if vmax is None else vmax)
+    u = np.clip((m - lo) / max(hi - lo, 1e-300), 0.0, 1.0)
+    img = u.T[::-1]                       # y up, like map2img.py
+    h, w = img.shape
+    if img_path.endswith(".pgm"):
+        with open(img_path, "wb") as f:
+            f.write(f"P5\n{w} {h}\n255\n".encode())
+            f.write((img * 255).astype(np.uint8).tobytes())
+    else:
+        pos = img * (len(_RAMP) - 1)
+        i0 = np.clip(pos.astype(int), 0, len(_RAMP) - 2)
+        fr = pos - i0
+        rgb = (_RAMP[i0] * (1 - fr[..., None])
+               + _RAMP[i0 + 1] * fr[..., None])
+        with open(img_path, "wb") as f:
+            f.write(f"P6\n{w} {h}\n255\n".encode())
+            f.write(rgb.astype(np.uint8).tobytes())
+    return w, h
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ramses_tpu.utils.maps")
-    ap.add_argument("tool", choices=["amr2map", "part2map"])
-    ap.add_argument("outdir")
-    ap.add_argument("mapfile")
+    ap.add_argument("tool", choices=["amr2map", "part2map", "map2img"])
+    ap.add_argument("src", help="output_NNNNN directory "
+                    "(amr2map/part2map) or .map file (map2img)")
+    ap.add_argument("dst", help=".map file (amr2map/part2map) or "
+                    "image file .ppm/.pgm (map2img)")
     ap.add_argument("--var", default="density")
     ap.add_argument("--dir", default="z", choices=["x", "y", "z"])
     ap.add_argument("--nx", type=int, default=256)
     ap.add_argument("--kind", default="mean",
                     choices=["mean", "max"])
+    ap.add_argument("--log", action="store_true")
+    ap.add_argument("--min", type=float, default=None)
+    ap.add_argument("--max", type=float, default=None)
     args = ap.parse_args(argv)
+    if args.tool == "map2img":
+        w, h = map2img(args.src, args.dst, log=args.log,
+                       vmin=args.min, vmax=args.max)
+        print(f"map2img: {w}x{h} -> {args.dst}")
+        return 0
     axis = "xyz".index(args.dir)
     if args.tool == "amr2map":
-        m = amr2map(args.outdir, var=args.var, axis=axis, nx=args.nx,
+        m = amr2map(args.src, var=args.var, axis=axis, nx=args.nx,
                     kind=args.kind)
     else:
-        m = part2map(args.outdir, axis=axis, nx=args.nx)
-    write_frame(args.mapfile, m)
-    print(f"{args.tool}: {m.shape} map -> {args.mapfile} "
+        m = part2map(args.src, axis=axis, nx=args.nx)
+    write_frame(args.dst, m)
+    print(f"{args.tool}: {m.shape} map -> {args.dst} "
           f"(min {m.min():.4e} max {m.max():.4e})")
     return 0
 

@@ -169,17 +169,75 @@ def test_oct_sweep_matches_level_sweep(riemann, monkeypatch):
 
     def run():
         jax.clear_caches()                  # force a fresh branch choice
-        du, corr = K.level_sweep(u_flat, interp, sten, None, ok, None,
-                                 dt, dx, cfg)
-        return np.asarray(du), np.asarray(corr)
+        du, corr, phi = K.level_sweep(u_flat, interp, sten, None, ok,
+                                      None, dt, dx, cfg, ret_flux=True)
+        return np.asarray(du), np.asarray(corr), np.asarray(phi)
 
     monkeypatch.setattr(pallas_oct, "FORCE_INTERPRET", True)
-    assert pallas_oct.available(cfg, noct, jnp.float32, False)
-    du_k, corr_k = run()
+    assert pallas_oct.available(cfg, noct, jnp.float32)
+    du_k, corr_k, phi_k = run()
     monkeypatch.setattr(pallas_oct, "FORCE_INTERPRET", False)
     monkeypatch.setattr(pallas_oct, "DISABLED", True)
-    assert not pallas_oct.available(cfg, noct, jnp.float32, False)
-    du_x, corr_x = run()
+    assert not pallas_oct.available(cfg, noct, jnp.float32)
+    du_x, corr_x, phi_x = run()
     jax.clear_caches()                      # do not leak into other tests
     np.testing.assert_allclose(du_k, du_x, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(corr_k, corr_x, rtol=2e-5, atol=2e-6)
+    # MC-tracer face-flux capture parity (want_flux kernel output)
+    np.testing.assert_allclose(phi_k, phi_x, rtol=2e-5, atol=2e-6)
+
+
+def test_fused_step_want_flux_matches_xla_dense_sweep():
+    """The dense kernel's MC-tracer face-flux capture (want_flux)
+    matches the XLA dense_sweep's ret_flux output."""
+    import ramses_tpu.hydro.pallas_muscl as pk
+    from ramses_tpu.amr import kernels as K
+    from ramses_tpu.grid.boundary import BoundarySpec
+
+    cfg = _cfg("hllc")
+    shape = (16, 16, 128)
+    bc = BoundarySpec.periodic(3)
+    rng = np.random.default_rng(9)
+    nvar = 5
+    r = 1.0 + 0.3 * rng.random(shape)
+    v = 0.2 * rng.standard_normal((3,) + shape)
+    p_ = 0.5 + 0.2 * rng.random(shape)
+    e = p_ / (cfg.gamma - 1.0) + 0.5 * r * (v ** 2).sum(axis=0)
+    ud = jnp.asarray(np.stack([r, r * v[0], r * v[1], r * v[2], e]),
+                     jnp.float32)
+    ok = jnp.asarray(rng.random(shape) < 0.1)
+    dt = jnp.asarray(1e-3, jnp.float32)
+    dx = 1.0 / shape[0]
+    # kernel path (interpreter mode)
+    up, okp = pk.pad_xy(ud, bc, cfg, ok=ok)
+    un_k, phi_k = pk.fused_step_padded(up, dt, cfg, dx, shape,
+                                       ok_pad=okp, interpret=True,
+                                       want_flux=True)
+    # XLA path through dense_sweep itself (identity layout: feed a
+    # flat array whose maps come from a tiny complete-level tree is
+    # overkill — compare against level-free dense formulation):
+    from ramses_tpu.grid import boundary as bmod
+    from ramses_tpu.hydro import muscl
+    up2 = bmod.pad(ud, bc, cfg, muscl.NGHOST, dx=dx)
+    flux, _tmp = muscl.unsplit(up2, None, dt, (dx,) * 3, cfg)
+    okp2 = ok
+    for d in range(3):
+        padw = [(muscl.NGHOST, muscl.NGHOST) if d2 == d else (0, 0)
+                for d2 in range(3)]
+        okp2 = jnp.pad(okp2, padw, mode="wrap")
+    masked = []
+    for d in range(3):
+        keep = ~(okp2 | jnp.roll(okp2, 1, axis=d))
+        masked.append(flux[d] * keep[None].astype(flux.dtype))
+    g = muscl.NGHOST
+    for d in range(3):
+        f0 = masked[d][0]
+        lo_ix = tuple(slice(g, g + shape[dd]) for dd in range(3))
+        hi_ix = tuple(slice(g + 1, g + 1 + shape[dd]) if dd == d
+                      else slice(g, g + shape[dd]) for dd in range(3))
+        np.testing.assert_allclose(np.asarray(phi_k[d, 0]),
+                                   np.asarray(f0[lo_ix]),
+                                   rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(phi_k[d, 1]),
+                                   np.asarray(f0[hi_ix]),
+                                   rtol=2e-5, atol=2e-6)

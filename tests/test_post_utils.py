@@ -239,3 +239,36 @@ def test_part2map_vrot_starlist(snap_dir, tmp_path):
     # no stars in this run -> empty table body
     rows = [l for l in open(fs) if not l.startswith("#")]
     assert len(rows) == 0
+
+
+def test_map2img_roundtrip(tmp_path):
+    """map2img (map2bmp.c / utils/py/map2img.py role): a .map frame
+    renders to PPM/PGM with correct dimensions and value mapping."""
+    import numpy as np
+
+    from ramses_tpu.io.movie import write_frame
+    from ramses_tpu.utils.maps import main as maps_main, map2img, read_map
+
+    m = np.outer(np.linspace(1.0, 10.0, 24),
+                 np.ones(16)).astype(np.float64)
+    p = str(tmp_path / "dens.map")
+    write_frame(p, m, t=0.5, bounds=(1.0, 1.0, 1.0))
+    back, meta = read_map(p)
+    assert back.shape == (24, 16)
+    np.testing.assert_allclose(back, m, rtol=1e-6)
+    assert meta["t"] == 0.5
+
+    img = str(tmp_path / "dens.ppm")
+    w, h = map2img(p, img, log=True)
+    hdr = open(img, "rb").read(20).split(b"\n")
+    assert hdr[0] == b"P6" and hdr[1] == b"24 16"
+    # darkest at the low end, brightest at the high end
+    data = np.frombuffer(open(img, "rb").read().split(b"255\n", 1)[1],
+                         np.uint8).reshape(16, 24, 3)
+    assert data[:, 0].sum() < data[:, -1].sum()
+    # grayscale + CLI path
+    pgm = str(tmp_path / "dens.pgm")
+    assert maps_main(["map2img", p, pgm, "--min", "1", "--max",
+                      "10"]) == 0
+    g = open(pgm, "rb").read()
+    assert g.startswith(b"P5\n24 16\n255\n")
