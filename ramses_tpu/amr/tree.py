@@ -59,7 +59,8 @@ class Octree:
         self.ndim = ndim
         self.levelmin = levelmin
         self.levelmax = levelmax
-        self.root = tuple(int(r) for r in (root or (1,) * ndim))
+        self.root = tuple(int(r) for r in
+                          (root if root is not None else (1,) * ndim))
         self.levels: Dict[int, OctLevel] = {}
 
     def cell_dims(self, lvl: int):
