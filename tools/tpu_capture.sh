@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-command TPU measurement session — run this the moment the axon
+# tunnel is healthy (probe first!).  Produces the artifacts round 5
+# could not capture (the tunnel was down for the whole build session):
+#
+#   1. probe        — 90 s timeout; abort early if the tunnel hangs
+#   2. profile      — per-kernel device times at the bench shapes
+#                     (tools/profile_amr.py, ##PROF## JSON line) +
+#                     optional jax.profiler trace
+#   3. bench        — the full budgeted protocol; one JSON line +
+#                     BENCH_PARTIAL.json incrementals, tunnel_rtt_s
+#                     recorded inside every sub
+#
+# Usage:  bash tools/tpu_capture.sh [outfile-prefix]
+set -u
+cd "$(dirname "$0")/.."
+PFX="${1:-TPU_CAPTURE}"
+
+echo "== probe =="
+if ! timeout 90 python -c "import jax; print(jax.devices())"; then
+    echo "tunnel down — aborting (do NOT trust any numbers captured now)"
+    exit 1
+fi
+
+echo "== per-kernel profile (bench shapes) =="
+timeout 2400 python tools/profile_amr.py 2>&1 | tee "${PFX}_profile.log"
+grep -o '##PROF##.*' "${PFX}_profile.log" | tail -1 \
+    | sed 's/##PROF##//' > "${PFX}_profile.json" || true
+
+echo "== bench (budgeted) =="
+BENCH_TOTAL_BUDGET=900 timeout 1000 python bench.py \
+    | tail -1 > "${PFX}_bench.json"
+cp -f BENCH_PARTIAL.json "${PFX}_partial.json" 2>/dev/null || true
+
+echo "== done =="
+ls -la "${PFX}"_*.json
+echo "Check tunnel_rtt_s in every sub before believing the numbers;"
+echo "then update docs/perf-trace-r05.md section 5 with the results."
