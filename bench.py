@@ -348,6 +348,47 @@ def run_sub_inproc(name):
     print(MARKER + json.dumps(d), flush=True)
 
 
+_PROBE_CODE = """
+import json, time
+t0 = time.perf_counter()
+import jax
+import jax.numpy as jnp
+devs = jax.devices()
+x = float(jnp.sum(jnp.zeros((8,))))   # one trivial device fetch
+print("##TUNNEL##" + json.dumps({
+    "ok": True, "ndev": len(devs),
+    "platform": str(devs[0].platform),
+    "elapsed_s": round(time.perf_counter() - t0, 3)}), flush=True)
+"""
+
+
+def tunnel_probe(timeout_s=60.0):
+    """Pre-flight device-tunnel health check: a subprocess imports jax,
+    lists devices, and round-trips one trivial fetch under a hard
+    timeout.  Returns ``{"ok": True, ...}`` or ``{"ok": False,
+    "error": ...}`` — NEVER raises, never hangs past the timeout.
+    Written at the TOP level of the bench JSON so a dead tunnel is a
+    first-class diagnosis, not four identical per-sub timeout errors.
+    """
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=HERE)
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("##TUNNEL##"):
+                return json.loads(line[len("##TUNNEL##"):])
+        tail = (r.stderr or r.stdout or "")[-1000:]
+        return {"ok": False,
+                "error": f"probe exited rc={r.returncode} without "
+                         "result", "tail": tail}
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"probe timed out after {timeout_s:.0f}s "
+                         "(device tunnel dead or backend hung)"}
+    except Exception:
+        return {"ok": False, "error": traceback.format_exc()[-1000:]}
+
+
 def _backend_ish(msg):
     return any(s in msg for s in (
         "UNAVAILABLE", "Unable to initialize backend", "DEADLINE",
@@ -411,12 +452,18 @@ def main():
 
     sub = {}
     device = dtype_name = None
+    # pre-flight tunnel probe: runs BEFORE any sub so a dead tunnel
+    # reads {"tunnel": {"ok": false}} at the top level instead of four
+    # identical per-sub timeout errors
+    tunnel = tunnel_probe(
+        float(os.environ.get("BENCH_PROBE_TIMEOUT", "60")))
     # clear any stale partial from a previous run BEFORE the first sub:
     # a driver kill during sub 1 must not leave run N-1's numbers
     # masquerading as run N's
     try:
         with open(partial_path, "w") as f:
-            json.dump({"budget_s": budget, "sub": {}}, f)
+            json.dump({"budget_s": budget, "tunnel": tunnel,
+                       "sub": {}}, f)
     except OSError:
         pass
     for name in wanted:
@@ -430,8 +477,9 @@ def main():
         # record, even if the driver kills this process mid-protocol
         try:
             with open(partial_path, "w") as f:
-                json.dump({"budget_s": budget, "device": device,
-                           "dtype": dtype_name, "sub": sub}, f)
+                json.dump({"budget_s": budget, "tunnel": tunnel,
+                           "device": device, "dtype": dtype_name,
+                           "sub": sub}, f)
         except OSError:
             pass
 
@@ -464,6 +512,7 @@ def main():
           (value / base_mg if base_mg and value is not None
            and "vcycles_per_sec" in head else None))
     out = {
+        "tunnel": tunnel,
         "metric": (f"cell-updates/sec/chip {head['config']}" if hydro_head
                    else (f"vcycles/sec/chip {head['config']}"
                          if "vcycles_per_sec" in head

@@ -402,9 +402,8 @@ def _place_u_rows(sim, l: int, rows: np.ndarray, og: np.ndarray,
     """Default row placement: cell-state array only (hydro/SRHD)."""
     nvar = sim.cfg.nvar
     ttd = 2 ** sim.cfg.ndim
-    m = sim.maps[l]
     out = np.array(sim.u[l])
-    out[:m.noct * ttd] = rows.reshape(
+    out[sim.cell_rows(l)] = rows.reshape(
         len(og), ttd, nvar)[order].reshape(-1, nvar)
     sim.u[l] = jnp.asarray(out, dtype=sim.dtype)
 
@@ -424,6 +423,7 @@ class AmrSim:
     """
 
     _needs_mig_log = False
+    ndev = 1          # device count of the row sharding (sharded subclass)
     # solver families whose state layout differs from the hydro
     # [rho, mom, E, ...] convention opt out of the shared SF/sink passes
     _pm_physics = True
@@ -630,6 +630,16 @@ class AmrSim:
         # extra per-cell state (the MHD staggered field); gated so the
         # plain hydro driver doesn't pin ncell-sized index buffers
         self._mig_log: Dict[int, tuple] = {}
+        # cost-weighted Hilbert load balancing (parallel/balance.py):
+        # per-level row layouts of partial levels (absent == identity,
+        # the seed's tree-order rows).  ``_built_lay`` records the
+        # (l-1, l, l+1) layout signatures each cached map was built
+        # under so map reuse stays layout-aware.
+        self.layouts: Dict[int, "object"] = {}
+        self._built_lay: Dict[int, tuple] = {}
+        self._rebalance_count = 0
+        self.balance_stats = None
+        self._force_rebalance = False
 
         if init_tree is not None:
             self.tree = init_tree
@@ -743,24 +753,96 @@ class AmrSim:
         a, b = self.tree.levels[l].keys, other.levels[l].keys
         return len(a) == len(b) and np.array_equal(a, b)
 
+    # ---------------------------------------------------------- layouts
+    def oct_rows(self, l: int) -> np.ndarray:
+        """Row slot of each tree oct of level ``l`` (identity when the
+        level has no layout)."""
+        lay = self.layouts.get(l)
+        if lay is None:
+            return np.arange(self.tree.noct(l), dtype=np.int64)
+        return lay.oct_row
+
+    def cell_rows(self, l: int) -> np.ndarray:
+        """Flat row of each tree cell of level ``l`` in tree order."""
+        ttd = 1 << self.tree.ndim
+        return (self.oct_rows(l)[:, None] * ttd
+                + np.arange(ttd, dtype=np.int64)).reshape(-1)
+
+    def tree_order_cells(self, arr, l: int) -> np.ndarray:
+        """Host copy of a cells-row array's REAL rows in tree order —
+        under a layout real rows are scattered between pads, so
+        ``[:ncell]`` slicing is only valid on identity levels."""
+        a = np.asarray(arr)
+        if l in self.layouts:
+            return a[self.cell_rows(l)]
+        ttd = 1 << self.tree.ndim
+        return a[:self.tree.noct(l) * ttd]
+
+    def _lay_triple(self, l: int) -> tuple:
+        from ramses_tpu.parallel import balance
+        return tuple(balance.layout_sig(self.layouts.get(j))
+                     for j in (l - 1, l, l + 1))
+
+    def request_rebalance(self):
+        """Force a layout recompute at the next regrid regardless of the
+        imbalance threshold."""
+        self._force_rebalance = True
+
+    def _maybe_rebalance(self, old_tree: Optional[Octree]):
+        """Regrid-time balance pass: drop layouts stale against the new
+        tree, measure imbalance under the surviving ones, and adopt
+        cost-weighted Hilbert cuts when over threshold (the
+        ``load_balance`` analog of the reference)."""
+        from ramses_tpu.parallel import balance
+        for l in list(self.layouts):
+            if (not self.tree.has(l)
+                    or not self._keys_same(old_tree, l)
+                    or self.tree.noct(l) == int(
+                        np.prod(self.tree.oct_dims(l)))):
+                del self.layouts[l]
+        if not balance.enabled(self):
+            self.layouts = {}
+            self.balance_stats = None
+            self._force_rebalance = False
+            return
+        stats = balance.measure(self)
+        thr = float(getattr(self.params.amr, "load_balance_threshold", 1.1))
+        if stats.imbalance > thr or self._force_rebalance:
+            cand = balance.compute_layouts(self)
+            cstats = balance.measure(self, cand)
+            # adopt only a meaningful improvement (or on request):
+            # re-cutting for noise would churn jit inputs every regrid
+            if self._force_rebalance or \
+                    cstats.imbalance < stats.imbalance * 0.95:
+                self.layouts = cand
+                self._rebalance_count += 1
+                stats = cstats
+        self._force_rebalance = False
+        self.balance_stats = stats
+
     def _rebuild_maps(self, old_tree: Optional[Octree] = None,
                       old_maps: Optional[dict] = None,
                       old_dev: Optional[dict] = None):
         """(Re)build per-level index maps, reusing cached maps for levels
         whose (l-1, l, l+1) oct sets are unchanged — the ``build_comm``
         amortization: steady-state steps do no host map construction."""
+        from ramses_tpu.parallel import balance
         prev_maps = old_maps or {}
         prev_dev = old_dev or {}
+        prev_lay = getattr(self, "_built_lay", {})
         self._spec = None
         self.maps: Dict[int, mapmod.LevelMaps] = {}
         self.dev: Dict[int, dict] = {}
+        self._built_lay = {}
         for l in range(self.lmin, self.lmax + 1):
             if not self.tree.has(l):
                 break
+            self._built_lay[l] = self._lay_triple(l)
             if (l in prev_maps
                     and self._keys_same(old_tree, l - 1)
                     and self._keys_same(old_tree, l)
-                    and self._keys_same(old_tree, l + 1)):
+                    and self._keys_same(old_tree, l + 1)
+                    and prev_lay.get(l) == self._built_lay[l]):
                 self.maps[l] = prev_maps[l]
                 self.dev[l] = prev_dev[l]
                 continue
@@ -772,6 +854,9 @@ class AmrSim:
                 # rebuild.  This skips the dominant host cost of the
                 # regrid (the base level's 2^(3·lmin)-cell perm).
                 m = mapmod.refresh_restriction(prev_maps[l], self.tree)
+                lay_p1 = self.layouts.get(l + 1)
+                if lay_p1 is not None:
+                    m = balance.remap_son_oct(m, lay_p1)
                 self.maps[l] = m
                 self.dev[l] = dict(
                     prev_dev[l],
@@ -784,6 +869,11 @@ class AmrSim:
             m = mapmod.build_level_maps(
                 self.tree, l, self.bc_kinds,
                 noct_pad=self._noct_pad(l, self.tree.noct(l)))
+            lay_m1, lay_l, lay_p1 = (self.layouts.get(l - 1),
+                                     self.layouts.get(l),
+                                     self.layouts.get(l + 1))
+            if lay_m1 is not None or lay_l is not None or lay_p1 is not None:
+                m = balance.apply_layout_level(m, lay_m1, lay_l, lay_p1)
             self.maps[l] = m
             valid_cell = np.repeat(m.valid_oct, 2 ** self.tree.ndim)
             if m.complete:
@@ -823,6 +913,8 @@ class AmrSim:
             if self.gravity:
                 g = mapmod.build_gravity_maps(self.tree, l, self.bc_kinds,
                                               noct_pad=m.noct_pad)
+                if lay_m1 is not None or lay_l is not None:
+                    g = balance.apply_layout_gravity(g, lay_m1, lay_l)
                 self.dev[l].update(
                     g_nb=self._place(jnp.asarray(g.nb), "cells"),
                     g_cell=self._place(jnp.asarray(g.g_cell), "rep"),
@@ -877,9 +969,9 @@ class AmrSim:
                                         self.cfg)
             u = regions.prim_to_cons(q, self.cfg)      # [nvar, ncell]
         out = np.zeros((m.ncell_pad, self.cfg.nvar))
-        out[:u.shape[1]] = u.T
-        out[u.shape[1]:, 0] = self.cfg.smallr
-        out[u.shape[1]:, self.cfg.ndim + 1] = self.cfg.smalle * self.cfg.smallr
+        out[:, 0] = self.cfg.smallr
+        out[:, self.cfg.ndim + 1] = self.cfg.smalle * self.cfg.smallr
+        out[self.cell_rows(lvl)] = u.T
         return self._place(jnp.asarray(out, dtype=self.dtype), "cells")
 
     def _alloc_from_ics(self):
@@ -939,7 +1031,12 @@ class AmrSim:
         crit: Dict[int, np.ndarray] = {}
         for fl, l in zip(flags, spec.levels):
             m = self.maps[l]
-            fl = np.asarray(fl)[:m.noct].reshape(-1)   # flat-cell order
+            fl = np.asarray(fl)
+            if l in self.layouts:      # rows → tree oct order first
+                fl = fl[self.layouts[l].oct_row]
+            else:
+                fl = fl[:m.noct]
+            fl = fl.reshape(-1)                        # flat-cell order
             i = l - 1                                  # 1-based level lists
             if i < len(r.r_refine) and r.r_refine[i] > 0.0:
                 fl = fl | flagmod.geometry_flags(
@@ -963,7 +1060,7 @@ class AmrSim:
                         / max(int(jnp.sum(self.p.active)), 1)
                     thr = r.m_refine[i] * mp \
                         / self.dx(l) ** self.tree_ndim
-                    rho_np = np.asarray(rho_dev)[:len(fl)]
+                    rho_np = self.tree_order_cells(rho_dev, l)[:len(fl)]
                     fl = fl | (rho_np > thr)
             crit[l] = fl
         with self.timers.section("regrid: tree build"):
@@ -981,9 +1078,15 @@ class AmrSim:
         old_u = self.u
         oldtree = self.tree
         old_maps, old_dev = self.maps, self.dev
+        old_layouts = dict(self.layouts)
         self.tree = newtree
-        unchanged = all(self._keys_same(oldtree, l)
-                        for l in range(self.lmin, self.lmax + 2))
+        with self.timers.section("regrid: balance"):
+            self._maybe_rebalance(oldtree)
+        from ramses_tpu.parallel import balance
+        lay_range = range(self.lmin, self.lmax + 2)
+        unchanged = (all(self._keys_same(oldtree, l) for l in lay_range)
+                     and balance.layouts_same(old_layouts, self.layouts,
+                                              lay_range))
         if unchanged:
             self.tree = oldtree
             return
@@ -996,13 +1099,32 @@ class AmrSim:
         new_u: Dict[int, jnp.ndarray] = {}
         for l in self.levels():
             m = self.maps[l]
+            lay_new = self.layouts.get(l)
+            lay_old = old_layouts.get(l)
+            same_lay = (balance.layout_sig(lay_new)
+                        == balance.layout_sig(lay_old))
             if (l == self.lmin or self._keys_same(oldtree, l)) \
-                    and old_u[l].shape[0] == m.ncell_pad:
+                    and same_lay and old_u[l].shape[0] == m.ncell_pad:
                 # identical oct set and identical padded layout: reuse
                 new_u[l] = old_u[l]
                 continue
             cd, cs, new_octs, f_cell, nb = mapmod.build_prolong_maps(
                 self.tree, oldtree, l, self.bc_kinds)
+            # convert tree-order oct/cell indices to row slots: dst via
+            # the NEW layouts, src via the OLD ones (both identity when
+            # absent); f_cell/nb point at l-1 cells already migrated to
+            # the new layout
+            if lay_new is not None:
+                cd_r = lay_new.oct_row[cd]
+                new_r = lay_new.oct_row[new_octs] if len(new_octs) \
+                    else new_octs
+            else:
+                cd_r, new_r = cd, new_octs
+            cs_r = lay_old.oct_row[cs] if lay_old is not None else cs
+            lay_m1 = self.layouts.get(l - 1)
+            if lay_m1 is not None:
+                f_cell = balance.remap_cells(f_cell, lay_m1, twotondim)
+                nb = balance.remap_cells(nb, lay_m1, twotondim)
             # Device-side migration with bucket-padded index maps: no
             # whole-level host round-trips, and jit shapes only change
             # when a bucket boundary is crossed.
@@ -1013,9 +1135,9 @@ class AmrSim:
             rows_d = np.full(cpad, m.ncell_pad, dtype=np.int64)   # drop
             rows_s = np.zeros(cpad, dtype=np.int64)
             if ncopy:
-                rows_d[:ncopy] = (cd[:, None] * twotondim
+                rows_d[:ncopy] = (cd_r[:, None] * twotondim
                                   + np.arange(twotondim)).reshape(-1)
-                rows_s[:ncopy] = (cs[:, None] * twotondim
+                rows_s[:ncopy] = (cs_r[:, None] * twotondim
                                   + np.arange(twotondim)).reshape(-1)
             cell_rep = np.zeros(npad, dtype=np.int64)
             nb_rep = np.zeros((npad, self.cfg.ndim, 2), dtype=np.int64)
@@ -1026,7 +1148,7 @@ class AmrSim:
                 cell_rep[:nnew] = np.repeat(f_cell, twotondim)
                 nb_rep[:nnew] = np.repeat(nb, twotondim, axis=0)
                 sgn_rep[:nnew] = np.tile(sgn, (len(new_octs), 1))
-                rows_new[:nnew] = (new_octs[:, None] * twotondim
+                rows_new[:nnew] = (new_r[:, None] * twotondim
                                    + np.arange(twotondim)).reshape(-1)
             old = old_u.get(l)
             if old is None:
@@ -1048,11 +1170,14 @@ class AmrSim:
         self.u = new_u
         if getattr(self, "rt_amr", None) is not None:
             self.rt_amr.apply_migration(self)
-        # prune stale gravity state: a level whose bucketed size changed
-        # (or that vanished) must not seed the next solve's warm start
+        # prune stale gravity state: a level whose bucketed size changed,
+        # vanished, or moved to a different row layout must not seed the
+        # next solve's warm start
         for l in list(self.phi):
             if (l not in self.maps
-                    or self.phi[l].shape[0] != self.maps[l].ncell_pad):
+                    or self.phi[l].shape[0] != self.maps[l].ncell_pad
+                    or not balance.layouts_same(old_layouts, self.layouts,
+                                                (l,))):
                 self.phi.pop(l, None)
                 self.fg.pop(l, None)
                 self.poisson_iters.pop(l, None)
@@ -1159,6 +1284,13 @@ class AmrSim:
         pm_maps = amr_pm.build_pm_maps(
             self.tree, x_host, self.boxlen, self.bc_kinds, ncp,
             scheme=deposit_scheme_from_params(self.params))
+        if self.layouts:
+            from ramses_tpu.parallel import balance
+            ttd = 1 << self.tree.ndim
+            for l, mp in pm_maps.items():
+                lay = self.layouts.get(l)
+                if lay is not None:   # ncell_pad drop-sentinel unchanged
+                    mp.idx = balance.remap_cells(mp.idx, lay, ttd)
         wdtype = self.dtype if self.p.x.dtype != jnp.float64 \
             else jnp.float64
         self._pm_dev = {
@@ -1490,17 +1622,15 @@ class AmrSim:
         cfg = self.cfg
         tot = np.zeros(cfg.nvar)
         for l in self.levels():
-            m = self.maps[l]
             vol = self.dx(l) ** cfg.ndim
-            u = np.asarray(self.u[l])[:m.noct * 2 ** cfg.ndim]
+            u = self.tree_order_cells(self.u[l], l)
             leaf = ~self.tree.refined_mask(l)
             tot += u[leaf].sum(axis=0) * vol
         return tot
 
     def leaf_sample(self, lvl: int):
         """(centers [n, ndim], u [n, nvar]) of leaf cells at one level."""
-        m = self.maps[lvl]
-        u = np.asarray(self.u[lvl])[:m.noct * 2 ** self.cfg.ndim]
+        u = self.tree_order_cells(self.u[lvl], lvl)
         leaf = ~self.tree.refined_mask(lvl)
         return self.tree.cell_centers(lvl, self.boxlen)[leaf], u[leaf]
 

@@ -75,6 +75,15 @@ class AmrParams:
     nx: int = 1
     ny: int = 1
     nz: int = 1
+    # cost-weighted Hilbert load balancing (amr/load_balance.f90
+    # cost_weighting): opt-in rebalance of partial-level row layouts at
+    # regrid time when max/mean device cost exceeds the threshold
+    load_balance: bool = False
+    load_balance_threshold: float = 1.1
+    cost_weight_hydro: float = 1.0
+    cost_weight_mhd: float = 2.0
+    cost_weight_rt: float = 1.5
+    cost_weight_part: float = 0.3
 
 
 @dataclass

@@ -27,7 +27,10 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax._src.pallas.core import Element
+try:  # Element block-indexing mode is absent from older jax releases
+    from jax._src.pallas.core import Element
+except ImportError:         # pragma: no cover - depends on jax version
+    Element = None
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -45,7 +48,7 @@ def kernel_available(cfg: HydroStatic, shape, bc_faces, dtype) -> bool:
     device (the kernel has no GSPMD partitioning rule — sharded runs
     must keep the XLA solver so the SPMD partitioner can insert halo
     collectives), and configuration coverage."""
-    if DISABLED:
+    if DISABLED or Element is None:
         return False
     if jax.default_backend() != "tpu" or jax.device_count() != 1:
         return False

@@ -30,9 +30,31 @@ from __future__ import annotations
 
 import os
 import threading
+import warnings
 from typing import Dict, Optional
 
 import numpy as np
+
+
+def _unpersisted_state(sim) -> list:
+    """Names of populated state layers pario does NOT checkpoint.
+
+    The fat-checkpoint path rides GAS state only (u + MHD faces); a
+    dump of a run carrying any of these loses that state on restore —
+    the reference-format snapshot path (io/snapshot.py) persists them.
+    """
+    out = []
+    p = getattr(sim, "p", None)
+    if p is not None and int(np.sum(np.asarray(p.active))) > 0:
+        out.append("particles")
+    if getattr(sim, "sinks", None) is not None:
+        out.append("sinks")
+    tx = getattr(sim, "tracer_x", None)
+    if tx is not None and len(tx) > 0:
+        out.append("tracers")
+    if getattr(sim, "rt_amr", None) is not None:
+        out.append("radiation")
+    return out
 
 
 def _level_arrays(sim) -> Dict[str, object]:
@@ -65,17 +87,34 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
     nproc = jax.process_count()
     me = jax.process_index()
 
+    lost = _unpersisted_state(sim)
+    if lost:
+        warnings.warn(
+            f"dump_pario: run carries {'/'.join(lost)} state that the "
+            "pario fat-checkpoint does NOT persist (gas only); a "
+            "restore re-creates it from ICs.  Use sim.dump() "
+            "(reference-format snapshots) for full-state checkpoints.",
+            stacklevel=2)
+
     # manifest: host tree + run meta (process 0 writes it)
     if me == 0:
         tree_payload = {}
         for l in sim.levels():
             tree_payload[f"og{l}"] = sim.tree.levels[l].og
+        # load-balance layouts: rows in the host files are in the dump
+        # sim's (possibly Hilbert-rebalanced) row order — persist the
+        # oct_row permutation so restore can return them to tree order
+        for l, lay in getattr(sim, "layouts", {}).items():
+            tree_payload[f"octrow{l}"] = np.asarray(lay.oct_row,
+                                                    np.int64)
+        dtc = getattr(sim, "_dt_cache", None)
         np.savez(os.path.join(out, "manifest.npz"),
                  levels=np.asarray(sim.levels()),
                  ndim=sim.cfg.ndim, root=np.asarray(sim.tree.root),
                  levelmin=sim.lmin, levelmax=sim.lmax,
                  t=float(sim.t), nstep=int(sim.nstep),
                  dt_old=float(getattr(sim, "dt_old", 0.0)),
+                 dtnew=float(dtc) if dtc is not None else 0.0,
                  nproc=nproc, **tree_payload)
 
     # partition this process's shards into host groups (by device)
@@ -151,7 +190,10 @@ def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
             for k in range(nsh):
                 per_name.setdefault(name, []).append(
                     (int(z[f"{name}_r{k}"][0]), z[f"{name}_d{k}"]))
+    ttd = 2 ** int(man["ndim"])
     for l in levels:
+        orow = (np.asarray(man[f"octrow{l}"], np.int64)
+                if f"octrow{l}" in man.files else None)
         for prefix, target in (("u", "u"), ("bf", "bf")):
             name = f"{prefix}{l}"
             if name not in per_name:
@@ -160,17 +202,41 @@ def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
             if tgt is None or l not in tgt:
                 continue
             cur = np.asarray(tgt[l])
-            buf = np.zeros(cur.shape, cur.dtype)
+            # reassemble at the DUMP's row extent first: a rebalanced
+            # dump scatters real rows across its whole bucket, and the
+            # dump's bucket may exceed this mesh's (hysteresis state
+            # isn't persisted) — clipping to cur.shape up front would
+            # drop real cells
+            ext = max((r0 + len(data) for r0, data in per_name[name]),
+                      default=0)
+            if orow is not None:
+                ext = max(ext, (int(orow.max()) + 1) * ttd)
+            dbuf = np.zeros((ext,) + cur.shape[1:], cur.dtype)
             for r0, data in per_name[name]:
-                # padded tails may differ between the dump's bucket
-                # and this mesh's (hysteresis state isn't persisted);
-                # real rows always fit both, pad filler is clipped
-                n = min(len(data), len(buf) - r0)
-                if n > 0:
-                    buf[r0:r0 + n] = data[:n]
+                dbuf[r0:r0 + len(data)] = data
+            if orow is not None:
+                # dump rows are in the dump sim's rebalanced layout:
+                # oct i lives at cell rows [orow[i]*ttd, ...) — gather
+                # back to tree order (the fresh sim starts identity)
+                idx = (orow[:, None] * ttd
+                       + np.arange(ttd)[None, :]).reshape(-1)
+                dbuf = dbuf[idx]
+            buf = np.zeros(cur.shape, cur.dtype)
+            n = min(len(dbuf), len(buf))
+            buf[:n] = dbuf[:n]
             tgt[l] = sim._place(jnp.asarray(buf, buf.dtype), "cells")
+    lost = _unpersisted_state(sim)
+    if lost:
+        warnings.warn(
+            f"restore_pario: restored run carries {'/'.join(lost)} "
+            "state that was NOT in the checkpoint (pario persists gas "
+            "only) — those layers are fresh from ICs, not the dumped "
+            "run.", stacklevel=2)
     sim.t = float(man["t"])
     sim.nstep = int(man["nstep"])
     sim.dt_old = float(man["dt_old"])
-    sim._dt_cache = None
+    dtn = float(man["dtnew"]) if "dtnew" in man.files else 0.0
+    # pending next-step dt: restore takes the same next step a
+    # continuous run would (dt hysteresis rides the manifest)
+    sim._dt_cache = dtn if dtn > 0.0 else None
     return sim

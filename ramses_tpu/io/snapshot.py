@@ -416,7 +416,13 @@ def snapshot_from_amr(sim, iout: int = 1, raw_of=None, to_out=None,
     params = sim.params
     ndim = cfg.ndim
     if raw_of is None:
-        raw_of = lambda l, nc: np.asarray(sim.u[l], dtype=np.float64)[:nc]
+        # tree_order_cells: under a balance layout (parallel/balance.py)
+        # real rows are scattered between pads, so [:nc] is only valid
+        # on identity levels
+        def raw_of(l, nc):
+            rows = sim.tree_order_cells(
+                np.asarray(sim.u[l], dtype=np.float64), l)
+            return rows[:nc]
     if to_out is None:
         to_out = lambda rows: cons_to_prim_out(rows, cfg)
     names = names if names is not None else hydro_var_names(cfg)

@@ -10,16 +10,20 @@ regrid pass already does.  Stencil gathers that cross shard boundaries
 become compiler-inserted collectives (P2/P3); CFL min-reduction is a
 ``jnp.min`` → ``AllReduce`` (P7).
 
-Why no cost weights (P4): the reference decomposes SPACE once — one
-Hilbert interval per rank spanning all levels — so a rank owning more
-fine octs does 2^(l-lmin)× more substep work, and ``load_balance``
-must weight the cuts by measured cost (``amr/load_balance.f90:285``).
-Here every LEVEL is row-sharded independently: each device holds
-exactly 1/ndev of each level's octs and therefore does 1/ndev of the
-work of every substep, whatever the refinement distribution.  Static
-equal splits achieve what the reference needs dynamic cost feedback
-for; the only residual imbalance is the <ndev remainder rows per
-level, which the mesh-aligned bucket padding absorbs.
+Cost weights (P4): the reference decomposes SPACE once — one Hilbert
+interval per rank spanning all levels — so a rank owning more fine
+octs does 2^(l-lmin)× more substep work, and ``load_balance`` must
+weight the cuts by measured cost (``amr/load_balance.f90:285``).
+Here every LEVEL is row-sharded independently, so equal splits already
+balance the SWEEP work; what they do NOT balance is per-oct cost that
+varies within a level (particles piled into a few octs) or the
+trailing-pad remainder of skewed partial levels.  The opt-in
+``&AMR_PARAMS load_balance`` path (:mod:`ramses_tpu.parallel.balance`)
+closes that: at regrid time each partial level's rows are re-laid-out
+as per-device contiguous Hilbert-key ranges whose summed cost
+(solver sweeps + particle counts) is balanced within the
+bucket-padding bound, and the explicit comm schedules below are
+rebuilt against the new cuts.
 
 Two comm backends coexist: the default global-view formulation (GSPMD
 inserts the collectives) and, with ``explicit_comm=True``, precomputed
@@ -43,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ramses_tpu.amr.hierarchy import AmrSim
 from ramses_tpu.amr.maps import bucket
 from ramses_tpu.config import Params
+from ramses_tpu.parallel.mesh import oct_mesh
 
 
 class ShardedAmrSim(AmrSim):
@@ -56,7 +61,7 @@ class ShardedAmrSim(AmrSim):
         devices = list(devices if devices is not None else jax.devices())
         self.ndev = len(devices)
         self._explicit_comm = explicit_comm and len(devices) > 1
-        self.mesh = Mesh(np.array(devices), ("oct",))
+        self.mesh = oct_mesh(devices)
         self._row_sharding = NamedSharding(self.mesh, P("oct"))
         self._row2_sharding = NamedSharding(self.mesh, P("oct", None))
         self._rep_sharding = NamedSharding(self.mesh, P())

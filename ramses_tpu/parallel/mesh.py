@@ -60,3 +60,16 @@ def spatial_sharding(mesh: Mesh, n_leading: int = 1) -> NamedSharding:
     """Sharding for arrays [*leading, nx(,ny(,nz))]: spatial axes on mesh."""
     spec = P(*([None] * n_leading), *mesh.axis_names)
     return NamedSharding(mesh, spec)
+
+
+OCT_AXIS = "oct"
+
+
+def oct_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over the AMR row ("oct") axis: every level batch is
+    row-sharded over this single axis, device ``d`` owning the row block
+    ``[d*cap, (d+1)*cap)`` — the cuts the cost-weighted balancer
+    (:mod:`ramses_tpu.parallel.balance`) fills with contiguous
+    Hilbert-key ranges."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (OCT_AXIS,))

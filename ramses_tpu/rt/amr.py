@@ -148,6 +148,9 @@ class RtAmrCoupled:
         self._sed_count = 0
         self._star_src = {}
         self._sink_src = {}
+        # cumulative photons injected by all sources [photons], the
+        # denominator of the rt_stats conservation ratio
+        self._injected = 0.0
         # homogeneous UV background (rt_UV_hom): amplitude follows the
         # cooling module's J21/a_spec/z_reion epoch dependence
         self.uv_on = bool(getattr(r, "rt_uv_hom", False))
@@ -263,6 +266,27 @@ class RtAmrCoupled:
         rad[:, ::1 + self.nd] = m1.SMALL_NP          # N columns
         return rad
 
+    def photon_total(self, sim) -> float:
+        """Total photon count over leaf cells, all groups (Σ N·dV)."""
+        tot = 0.0
+        for l in sim.levels():
+            rad = sim.tree_order_cells(self.rad[l], l)
+            leaf = ~sim.tree.refined_mask(l)
+            dv = (sim.dx(l) * self.un.scale_l) ** self.nd
+            for g in range(self.ng):
+                tot += float(np.sum(rad[leaf, self._ncol(g)])) * dv
+        return tot
+
+    def rt_stats(self, sim) -> dict:
+        """Photon-budget stats for the screen block (the reference's
+        ``output_rt_stats`` role, ``amr/amr_step.f90:467``): live photon
+        count vs cumulative injected; the ratio falls below 1 as gas
+        absorbs (and is ~1 for free streaming)."""
+        tot = self.photon_total(sim)
+        inj = float(self._injected)
+        return {"photons": tot, "injected": inj,
+                "ratio": (tot / inj) if inj > 0.0 else 0.0}
+
     @staticmethod
     def _fresh_x(ncp: int) -> jnp.ndarray:
         """Initial HII fraction rows (the reference's x_ini)."""
@@ -340,6 +364,18 @@ class RtAmrCoupled:
         nT = {l: self._gas_nT(sim, l) for l in sim.levels()}
         T = {l: nT[l][1] for l in sim.levels()}
         T0 = dict(T)
+
+        # photon-budget accounting (rt_stats): source rates are photon
+        # DENSITY rates [1/cm^3/s]; × cell volume × dt gives counts
+        if self._src_info is not None:
+            lsrc, _row, rate = self._src_info
+            vol = (sim.dx(lsrc) * self.un.scale_l) ** nd
+            frac = sum(g.frac for g in spec.groups3) if self.full3 else 1.0
+            self._injected += rate * vol * dt_cgs * frac
+        for srcmap in (self._star_src, self._sink_src):
+            for l, (_rows, dens) in srcmap.items():
+                vol = (sim.dx(l) * self.un.scale_l) ** nd
+                self._injected += float(jnp.sum(dens)) * vol * dt_cgs
 
         ng = self.ng
         ncols = ng * (1 + nd)

@@ -34,11 +34,16 @@ class RtCoupled:
         self.sim = RtSim(grid.shape, dx_cgs, spec, nH,
                          T=self._gas_T(u0))
         r = params.rt
+        # photon-budget bookkeeping for rt_stats: total registered
+        # source rate [photons/s] and cumulative injected count
+        self._ndot_total = 0.0
+        self._injected = 0.0
         if float(r.rt_ndot) > 0.0:
             # rt_src_pos is in box-fraction units → cgs position
             pos = [float(v) * dx_cgs * grid.shape[d]
                    for d, v in enumerate(r.rt_src_pos[:spec.ndim])]
             self.sim.point_source(pos, float(r.rt_ndot))
+            self._ndot_total += float(r.rt_ndot)
         # rt_nsource point/beam list (rad_beams.nml usage): per-source
         # box-unit centres, photons/s rates, optional beam direction
         for k in range(int(r.rt_nsource)):
@@ -61,8 +66,17 @@ class RtCoupled:
             rate = (float(r.rt_n_source[k])
                     if k < len(r.rt_n_source) else 0.0)
             self.sim.point_source(pos, rate, direction=direction)
+            self._ndot_total += rate
 
     # ------------------------------------------------------------------
+    def rt_stats(self, sim=None) -> dict:
+        """Photon-budget stats (the reference's ``output_rt_stats``
+        role): live photon count vs cumulative injected."""
+        tot = self.sim.photon_total()
+        inj = float(self._injected)
+        return {"photons": tot, "injected": inj,
+                "ratio": (tot / inj) if inj > 0.0 else 0.0}
+
     def _mu(self):
         """Mean molecular weight from the current ion state."""
         x = np.asarray(self.sim.x, np.float64)
@@ -97,7 +111,9 @@ class RtCoupled:
         rho = np.maximum(np.asarray(u[0], np.float64), cfg.smallr)
         self.sim.nH = jnp.asarray(rho * un.scale_d * self.x_frac / mH)
         self.sim.T = jnp.asarray(self._gas_T(u))
-        self.sim.advance(float(dt_code) * un.scale_t)
+        dt_cgs = float(dt_code) * un.scale_t
+        self._injected += self._ndot_total * dt_cgs
+        self.sim.advance(dt_cgs)
         if not self.spec.heating:
             return u
         # write the updated temperature back into the gas energy

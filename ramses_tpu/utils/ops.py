@@ -180,8 +180,8 @@ class OpsGuard:
                 f"dt={getattr(sim, 'dt_old', 0.0):11.4e} "
                 f"mem={self._max_rss:8.1f}M/{device_mb():8.1f}M")
         self._nblock += 1
-        if hasattr(sim, "totals") and \
-                (self._nblock - 1) % max(self.cons_every, 1) == 0:
+        audit = (self._nblock - 1) % max(self.cons_every, 1) == 0
+        if hasattr(sim, "totals") and audit:
             # conservation audit line (the reference's mcons/econs
             # print, ``amr/update_time.f90`` output block) —
             # amortized: totals() syncs the full device state
@@ -192,6 +192,22 @@ class OpsGuard:
                 line += f" econs={tot[ie]:.6e}"
         if hasattr(sim, "aexp_now") and sim.cosmo is not None:
             line += f" a={sim.aexp_now():8.5f}"
+        bs = getattr(sim, "balance_stats", None)
+        if bs is not None:
+            # load-balance observability (the reference's load_balance
+            # screen report): per-device cost extrema + rebalance count
+            line += (f" lb[max/mean={bs.max_cost:.4g}/{bs.mean_cost:.4g}"
+                     f" imb={bs.imbalance:.3f}"
+                     f" nreb={getattr(sim, '_rebalance_count', 0)}]")
+        rt = getattr(sim, "rt_amr", None) or getattr(sim, "rt", None)
+        if rt is not None and hasattr(rt, "rt_stats") and audit:
+            # photon budget line (the reference's output_rt_stats,
+            # amr/amr_step.f90:467): total photons vs cumulative
+            # injected — the conservation ratio drops as gas absorbs
+            st = rt.rt_stats(sim)
+            line += (f" rt[N={st['photons']:.4e}"
+                     f" inj={st['injected']:.4e}"
+                     f" ratio={st['ratio']:.4f}]")
         if octs:
             line += f" octs={octs}"
         return line + (" " + extra if extra else "")

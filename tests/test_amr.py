@@ -185,6 +185,7 @@ def test_gradedness_invariant():
             assert (f >= 0).all(), f"level {l} offset {offs}"
 
 
+@pytest.mark.slow
 def test_sod_amr_beats_coarse():
     """Adaptive 1D Sod: leaf solution closer to the exact Riemann
     solution than the uniform levelmin run."""
@@ -218,6 +219,7 @@ def test_sod_amr_beats_coarse():
     assert l1_amr < 0.01
 
 
+@pytest.mark.slow
 def test_outflow_momentum_flux():
     """Waves leaving through outflow boundaries change totals only via
     boundary fluxes — no NaNs, positive density everywhere."""

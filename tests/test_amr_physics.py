@@ -69,6 +69,7 @@ def test_amr_cooling_radiates():
     assert cool.totals()[4] < adia.totals()[4] * (1 - 1e-6)
 
 
+@pytest.mark.slow
 def test_star_formation_on_hierarchy():
     """Stars form in the refined dense blob at its finest covering
     level; gas+stars mass is conserved; SN feedback fires once."""
@@ -94,6 +95,7 @@ def test_star_formation_on_hierarchy():
     assert int((np.asarray(sim.p.flags) & 1).sum()) > 0   # SNe fired
 
 
+@pytest.mark.slow
 def test_sinks_on_hierarchy():
     """Threshold sinks form in the refined blob and accrete; gas+sink
     mass conserved."""
@@ -112,6 +114,7 @@ def test_sinks_on_hierarchy():
     assert abs(m1 + ms - m0) < 1e-11
 
 
+@pytest.mark.slow
 def test_tracers_follow_gas_on_hierarchy():
     """Velocity tracers advect with the flow: a tracer in the expanding
     blast moves outward, all positions stay finite/periodic."""
@@ -132,6 +135,7 @@ def test_tracers_follow_gas_on_hierarchy():
     assert r1.mean() > r0 + 1e-4          # net outward advection
 
 
+@pytest.mark.slow
 def test_stellar_objects_from_sinks_and_sn():
     """&STELLAR_PARAMS: sink growth spawns IMF-sampled stellar objects
     every stellar_msink_th of accreted mass; with sn_direct they

@@ -1,0 +1,67 @@
+"""bench.py pre-flight tunnel probe: a dead device tunnel must read as
+a single top-level ``{"tunnel": {"ok": false}}`` in BOTH the final
+bench JSON and BENCH_PARTIAL.json — not as four identical per-sub
+timeout errors.  The parent process never imports jax, so these tests
+exercise the real subprocess plumbing cheaply."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench
+
+pytestmark = pytest.mark.smoke
+
+
+def test_tunnel_probe_failure(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_CODE", "import sys; sys.exit(3)")
+    r = bench.tunnel_probe(timeout_s=30.0)
+    assert r["ok"] is False
+    assert "rc=3" in r["error"]
+
+
+def test_tunnel_probe_hang_hits_hard_timeout(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_CODE",
+                        "import time; time.sleep(600)")
+    r = bench.tunnel_probe(timeout_s=2.0)
+    assert r["ok"] is False
+    assert "timed out" in r["error"]
+
+
+def test_tunnel_probe_marker_parse(monkeypatch):
+    code = ('import json\n'
+            'print("noise")\n'
+            'print("##TUNNEL##" + json.dumps('
+            '{"ok": True, "ndev": 8, "platform": "cpu",'
+            ' "elapsed_s": 0.1}))\n')
+    monkeypatch.setattr(bench, "_PROBE_CODE", code)
+    r = bench.tunnel_probe(timeout_s=30.0)
+    assert r == {"ok": True, "ndev": 8, "platform": "cpu",
+                 "elapsed_s": 0.1}
+
+
+def test_dead_tunnel_tops_both_jsons(monkeypatch, tmp_path, capsys):
+    """main() with a dead tunnel and stubbed subs: the top-level
+    ``tunnel`` key lands in stdout JSON and in BENCH_PARTIAL.json."""
+    dead = {"ok": False, "error": "probe timed out after 60s "
+                                  "(device tunnel dead or backend hung)"}
+    monkeypatch.setattr(bench, "tunnel_probe", lambda *a, **k: dead)
+    monkeypatch.setattr(
+        bench, "run_sub",
+        lambda name, deadline, weight=None:
+            {"error": "sub-bench timed out after 45s", "attempt": 2})
+    partial = tmp_path / "BENCH_PARTIAL.json"
+    monkeypatch.setenv("BENCH_PARTIAL_PATH", str(partial))
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "900")
+    monkeypatch.delenv("BENCH_ONLY", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tunnel"] == dead
+    assert out["value"] is None
+    part = json.loads(partial.read_text())
+    assert part["tunnel"] == dead
+    assert set(part["sub"]) == set(bench.SUBS)

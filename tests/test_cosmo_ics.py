@@ -137,6 +137,7 @@ def test_linear_growth_uniform_pm(tmp_path):
     assert growth == pytest.approx(a_end / 0.02, rel=0.12)
 
 
+@pytest.mark.slow
 def test_cosmo_amr_growth(tmp_path):
     """The same oracle through the AMR driver (hierarchy PM + cosmo
     supercomoving stepping + m_refine quasi-Lagrangian criterion)."""
@@ -211,6 +212,7 @@ def test_grafic_tools_roundtrip(tmp_path):
     assert main(["degrade", str(indir), str(tmp_path / "d2")]) == 0
 
 
+@pytest.mark.slow
 def test_lightcone_emission_during_cosmo_run(tmp_path, monkeypatch):
     """&RUN_PARAMS lightcone: each coarse step emits the comoving shell
     swept since the previous one (amr/light_cone.f90 output_cone role);
@@ -255,6 +257,7 @@ def test_lightcone_emission_during_cosmo_run(tmp_path, monkeypatch):
         assert hi1 <= lo0 + 1e-8
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["mergertree.nml", "cosmo_gal.nml"])
 def test_shipped_cosmo_namelists_run_through_cli(name, tmp_path,
                                                  monkeypatch):

@@ -39,6 +39,7 @@ def _state_bits(sim):
     return out
 
 
+@pytest.mark.slow
 @needs_mesh
 def test_amr_f32_1dev_vs_8dev_bitwise():
     """Hydro AMR with flux-correction scatter-adds: 3 coarse steps with

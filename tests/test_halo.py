@@ -104,6 +104,7 @@ def test_merger_tree_links_and_merger():
     assert {l.prog for l in got} == progs
 
 
+@pytest.mark.slow
 def test_halo_cli_on_snapshots(tmp_path):
     """End-to-end: PM sim → two dumps → halos CLI → tables + tree."""
     import jax.numpy as jnp

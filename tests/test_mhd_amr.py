@@ -48,6 +48,7 @@ def test_amr_matches_uniform_on_complete_level():
     np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_briowu_amr_beats_coarse_uniform():
     """AMR (lmin=5, lmax=7) L1 error vs the 2^7 uniform run must be
     well below the 2^5 uniform run's — refinement is doing its job."""
@@ -144,6 +145,7 @@ def _make_ot(lmin, lmax, n_warm_flags=2):
     return sim
 
 
+@pytest.mark.slow
 def test_ot_divb_machine_zero_across_regrids():
     sim = _make_ot(4, 6)
     assert sim.max_divb() < 1e-12
@@ -210,6 +212,7 @@ def test_mhd_amr_snapshot_roundtrip(tmp_path):
             rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_mhd_amr_self_gravity_collapse():
     """poisson=.true. on the MHD hierarchy: a dense magnetised blob
     develops inward radial momentum under its own gravity while divB

@@ -35,6 +35,7 @@ CONFIGS = {
     "smbh_bondi.nml": (2, []),
     "tracer_sedov.nml": (2, []),
     "sedov2d.nml": (2, []),
+    "sedov2d_balance.nml": (2, []),
     "sedov3d.nml": (3, []),
     "static.nml": (3, []),
     "iliev1.nml": (3, []),

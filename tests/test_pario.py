@@ -72,6 +72,82 @@ def test_pario_roundtrip_any_device_count(tmp_path):
         assert np.allclose(a, b, rtol=2e-6, atol=1e-7), l
 
 
+def test_pario_dtnew_roundtrip(tmp_path):
+    """The pending next-step dt rides the manifest: a restore takes the
+    same next step a continuous run would (dt hysteresis preserved)."""
+    sim = AmrSim(params_from_string(NML, ndim=2), dtype=jnp.float64)
+    sim.evolve(0.004, nstepmax=3)
+    assert sim._dt_cache is not None
+    out = dump_pario(sim, 3, str(tmp_path))
+    r = restore_pario(AmrSim, params_from_string(NML, ndim=2), out,
+                      dtype=jnp.float64)
+    assert r._dt_cache == pytest.approx(sim._dt_cache, rel=0, abs=0)
+    assert r.dt_old == sim.dt_old
+    # next coarse step bitwise-identical to the continuous run
+    sim.step_coarse(sim.coarse_dt())
+    r.step_coarse(r.coarse_dt())
+    assert r.t == sim.t
+    for l in sim.levels():
+        nc = sim.maps[l].noct * 2 ** sim.cfg.ndim
+        assert np.array_equal(np.asarray(r.u[l])[:nc],
+                              np.asarray(sim.u[l])[:nc]), l
+
+
+def test_pario_warns_gas_only(tmp_path):
+    """pario is a gas-only fat checkpoint: dumping or restoring a run
+    that carries particle state warns that it is not persisted."""
+    import jax
+
+    from ramses_tpu.pm.particles import ParticleSet
+
+    nml = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "poisson=.true.", "pic=.true.",
+        "/",
+        "&AMR_PARAMS", "levelmin=4", "levelmax=4", "boxlen=1.0", "/",
+        "&POISSON_PARAMS", "solver='cg'", "/",
+        "&INIT_PARAMS", "nregion=1", "region_type(1)='square'",
+        "d_region=1.0", "p_region=1.0", "/",
+        "&HYDRO_PARAMS", "riemann='hllc'", "/",
+        "&OUTPUT_PARAMS", "tend=0.01", "/",
+    ])
+    rng = np.random.default_rng(3)
+    ps = ParticleSet.make(rng.uniform(0, 1, (16, 2)),
+                          np.zeros((16, 2)), np.full(16, 1.0 / 16))
+    sim = AmrSim(params_from_string(nml, ndim=2), dtype=jnp.float32,
+                 particles=jax.device_put(ps))
+    with pytest.warns(UserWarning, match="does NOT persist"):
+        out = dump_pario(sim, 1, str(tmp_path))
+    with pytest.warns(UserWarning, match="fresh from ICs"):
+        restore_pario(AmrSim, params_from_string(nml, ndim=2), out,
+                      dtype=jnp.float32,
+                      particles=jax.device_put(ps))
+
+
+def test_pario_layout_roundtrip(tmp_path):
+    """A dump taken under a Hilbert-rebalanced layout restores to tree
+    order: host files carry rows in the dump sim's layout; the manifest
+    oct_row permutation brings them back."""
+    nml = NML.replace("levelmax=6",
+                      "levelmax=5\nload_balance=.true.")
+    sim = AmrSim(params_from_string(nml, ndim=2), dtype=jnp.float64)
+    sim.evolve(0.004, nstepmax=3)
+    sim.request_rebalance()
+    sim.regrid()
+    assert sim.layouts, "no layout adopted; test needs a partial level"
+    out = dump_pario(sim, 4, str(tmp_path), split_hosts=3)
+    r = restore_pario(AmrSim,
+                      params_from_string(NML.replace("levelmax=6",
+                                                     "levelmax=5"),
+                                         ndim=2),
+                      out, dtype=jnp.float64)
+    assert not r.layouts
+    for l in sim.levels():
+        nc = sim.tree.noct(l) * 2 ** sim.cfg.ndim
+        a = sim.tree_order_cells(np.asarray(sim.u[l]), l)[:nc]
+        b = np.asarray(r.u[l])[:nc]
+        assert np.array_equal(a, b), l
+
+
 def test_pario_io_group_throttle(tmp_path, monkeypatch):
     """io_group_size=1 serializes the writers (the IOGROUPSIZE token
     ring); the files still land and restore."""

@@ -148,6 +148,7 @@ def test_pressure_fix_enew_accuracy_f64():
     np.testing.assert_allclose(pf, pn, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pressure_fix_on_amr_blast():
     """The fix rides the AMR stencil + dense sweeps without breaking
     mass conservation."""

@@ -63,6 +63,7 @@ def test_dump8_restore_sharded(tmp_path, source_sim):
     assert np.isfinite(np.asarray(back.totals())).all()
 
 
+@pytest.mark.slow
 def test_particle_multidomain_restore(tmp_path):
     """Particle files merge across domains on restore (scalar header
     entries must not be concatenated)."""
@@ -89,6 +90,7 @@ def test_particle_multidomain_restore(tmp_path):
     assert len(np.unique(pd["identity"])) == npart
 
 
+@pytest.mark.slow
 def test_sharded_dump_restore1(tmp_path):
     if jax.device_count() < 8:
         pytest.skip("needs the 8-device virtual mesh")

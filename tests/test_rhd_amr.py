@@ -48,6 +48,7 @@ def _leaf_rho_on(sim: RhdAmrSim, n: int):
     return rho
 
 
+@pytest.mark.slow
 def test_amr_blast_tube_beats_coarse_uniform():
     """Marti-Mueller-style blast: the 5→7 AMR run's L1(ρ) error vs a
     fine (levelmin=9) uniform oracle beats the uniform levelmin=5 run."""
@@ -93,6 +94,7 @@ def test_lorentz_refinement_triggers():
     assert sim.max_lorentz() > 1.2
 
 
+@pytest.mark.slow
 def test_conservation_periodic_2d_amr():
     """D, S, τ conserved across refined interfaces + regrids."""
     groups = {

@@ -104,6 +104,30 @@ def test_stromgren_through_driver():
     assert np.max(u[4]) > 1.5 * eint0     # heated cells
 
 
+def test_rt_photon_budget_stats():
+    """``rt_stats`` (the reference ``output_rt_stats`` role): cumulative
+    injected photons, photons in the box, and their conservation ratio
+    — and the screen block prints them."""
+    from ramses_tpu.driver import Simulation
+    from ramses_tpu.utils.ops import OpsGuard
+
+    p = load_params(NML, ndim=3)
+    p.amr.levelmin = p.amr.levelmax = 4     # shrink for speed
+    p.output.tout = [4e13]
+    sim = Simulation(p, dtype=jnp.float64)
+    assert sim.rt is not None
+    sim.evolve(verbose=False)
+    st = sim.rt.rt_stats()
+    assert set(st) >= {"photons", "injected", "ratio"}
+    # the source injected ndot*t photons; what's still in the box is
+    # positive and no more than that (absorption only removes)
+    assert st["injected"] > 0.0
+    assert 0.0 < st["photons"] <= st["injected"] * 1.05
+    assert st["ratio"] == pytest.approx(st["photons"] / st["injected"])
+    line = OpsGuard(sim, install_signals=False).screen_block()
+    assert " rt[" in line and "ratio=" in line
+
+
 def test_rt_cli_smoke(tmp_path, capsys):
     """python -m ramses_tpu with rt=.true. runs end to end."""
     from ramses_tpu.__main__ import main
