@@ -193,7 +193,9 @@ def bench_amr(params, dtype, jnp):
     # run-to-run determinism: the same 3 steps from the same state must
     # be BITWISE identical on this device (north-star "bitwise-stable")
     import numpy as np
-    u_saved = dict(sim.u)
+    # deep-copy: the fused step donates its state input, so a dict of
+    # bare references would be dead buffers after the first replay
+    u_saved = {l: jnp.array(v) for l, v in sim.u.items()}
     dt_saved, t_saved, n_saved = sim._dt_cache, sim.t, sim.nstep
     sim.evolve(1e9, nstepmax=sim.nstep + 3)
     run1 = {l: np.asarray(sim.u[l]) for l in sim.levels()}

@@ -22,6 +22,19 @@ movement with static regular strides that XLA vectorizes.
 
 Only valid for cubic complete levels (2^lvl cells per dim); callers
 fall back to the index-permutation gather otherwise (non-cubic roots).
+
+Slab (shard-local) variant: fixing the top ``mbits`` flat index bits
+selects one contiguous flat row chunk of ``ncell / 2^mbits`` rows — a
+device's shard under the equal row-split ``P("oct")`` sharding.  The
+remaining bits are a bit permutation of a DENSE SUB-BOX: the fixed top
+bits are the most significant coordinate bits (z-major interleave), so
+chunk ``D`` is the axis-aligned box whose per-axis origin is the
+device-grid coordinate × the local extent.  Each shard can therefore
+run the same reshape→transpose→reshape on only the rows it owns — no
+cross-device data motion at all (:mod:`ramses_tpu.parallel.dense_slab`
+builds the halo exchange separately).  ``mbits`` must not reach into
+the within-oct bits (``mbits <= ndim*(lvl-1)``) so every chunk cut
+lands on an oct boundary.
 """
 
 from __future__ import annotations
@@ -32,30 +45,106 @@ import jax.numpy as jnp
 
 
 @lru_cache(maxsize=None)
-def _bit_axes(lvl: int, ndim: int) -> tuple:
-    """Transpose permutation taking flat bit-axis order to dense
-    (coordinate-major) bit-axis order.  Axis p of the reshaped flat
-    array holds the p-th most significant flat index bit."""
-    pos = {}
-    p = 0
-    for i in range(lvl - 1, 0, -1):           # oct Morton triplets
-        for d in range(ndim - 1, -1, -1):     # z most significant
-            pos[(d, i)] = p
-            p += 1
-    for d in range(ndim):                     # within-oct: x slowest
-        pos[(d, 0)] = p
-        p += 1
-    return tuple(pos[(d, i)] for d in range(ndim)
-                 for i in range(lvl - 1, -1, -1))
+def _bit_seq(lvl: int, ndim: int) -> tuple:
+    """The flat index's bit slots MSB→LSB as (axis, coord_bit) pairs:
+    oct Morton triplets (z most significant) then the within-oct
+    offset (x slowest)."""
+    seq = [(d, i) for i in range(lvl - 1, 0, -1)
+           for d in range(ndim - 1, -1, -1)]
+    seq += [(d, 0) for d in range(ndim)]
+    return tuple(seq)
 
 
 @lru_cache(maxsize=None)
-def _inv_bit_axes(lvl: int, ndim: int) -> tuple:
-    fwd = _bit_axes(lvl, ndim)
+def _slab_axes(lvl: int, ndim: int, mbits: int = 0) -> tuple:
+    """Transpose permutation taking the REMAINING flat bit axes (after
+    fixing the top ``mbits`` device bits) to dense coordinate-major
+    order over the local sub-box.  ``mbits=0`` is the full-box case:
+    axis p of the reshaped flat array holds the p-th most significant
+    flat index bit."""
+    seq = _bit_seq(lvl, ndim)
+    pos = {bit: p - mbits for p, bit in enumerate(seq) if p >= mbits}
+    return tuple(pos[(d, i)] for d in range(ndim)
+                 for i in range(lvl - 1, -1, -1) if (d, i) in pos)
+
+
+@lru_cache(maxsize=None)
+def _inv_slab_axes(lvl: int, ndim: int, mbits: int = 0) -> tuple:
+    fwd = _slab_axes(lvl, ndim, mbits)
     inv = [0] * len(fwd)
     for i, a in enumerate(fwd):
         inv[a] = i
     return tuple(inv)
+
+
+def _bit_axes(lvl: int, ndim: int) -> tuple:
+    return _slab_axes(lvl, ndim, 0)
+
+
+def _inv_bit_axes(lvl: int, ndim: int) -> tuple:
+    return _inv_slab_axes(lvl, ndim, 0)
+
+
+@lru_cache(maxsize=None)
+def grid_bits(lvl: int, ndim: int, mbits: int) -> tuple:
+    """Per-axis device-bit counts of an ``mbits``-bit chunk split: the
+    top ``mbits`` flat bits in MSB→LSB order, tallied by axis.  The
+    device grid is ``(2^b for b in grid_bits)`` and the local box is
+    ``(2^(lvl-b))`` — z is cut first (it carries the most significant
+    flat bits), then y, then x."""
+    if mbits > ndim * (lvl - 1):
+        raise ValueError(
+            f"mbits={mbits} would cut inside octs at lvl={lvl}")
+    md = [0] * ndim
+    for d, _ in _bit_seq(lvl, ndim)[:mbits]:
+        md[d] += 1
+    return tuple(md)
+
+
+@lru_cache(maxsize=None)
+def slab_shape(lvl: int, ndim: int, mbits: int) -> tuple:
+    """Local dense sub-box shape owned by one of ``2^mbits`` chunks."""
+    return tuple(1 << (lvl - b) for b in grid_bits(lvl, ndim, mbits))
+
+
+@lru_cache(maxsize=None)
+def chunk_coords(lvl: int, ndim: int, mbits: int) -> tuple:
+    """Device-grid coordinates of every chunk: ``coords[D][d]`` is
+    chunk D's position along axis d (D = the top ``mbits`` flat bits
+    verbatim; its axis-d bits are the coordinate's high bits in
+    order)."""
+    seq = _bit_seq(lvl, ndim)[:mbits]
+    out = []
+    for D in range(1 << mbits):
+        g = [0] * ndim
+        for j, (d, _) in enumerate(seq):
+            g[d] = (g[d] << 1) | ((D >> (mbits - 1 - j)) & 1)
+        out.append(tuple(g))
+    return tuple(out)
+
+
+def flat_to_dense_slab(rows, lvl: int, ndim: int, mbits: int):
+    """One chunk's flat-order rows ``[ncell/2^mbits, *trailing]`` →
+    its dense local sub-box ``slab_shape + trailing`` (pure
+    reshape/transpose, shard-local)."""
+    loc = slab_shape(lvl, ndim, mbits)
+    trailing = rows.shape[1:]
+    nb = ndim * lvl - mbits
+    x = rows.reshape((2,) * nb + trailing)
+    ax = _slab_axes(lvl, ndim, mbits) + tuple(range(nb, nb + len(trailing)))
+    return jnp.transpose(x, ax).reshape(loc + trailing)
+
+
+def dense_to_flat_slab(dense, lvl: int, ndim: int, mbits: int):
+    """Dense local sub-box → one chunk's flat-order rows (inverse of
+    :func:`flat_to_dense_slab`)."""
+    ncell = 1 << (ndim * lvl - mbits)
+    trailing = dense.shape[ndim:]
+    nb = ndim * lvl - mbits
+    x = dense.reshape((2,) * nb + trailing)
+    ax = _inv_slab_axes(lvl, ndim, mbits) + tuple(
+        range(nb, nb + len(trailing)))
+    return jnp.transpose(x, ax).reshape((ncell,) + trailing)
 
 
 def flat_to_dense(rows, lvl: int, ndim: int):
@@ -63,20 +152,10 @@ def flat_to_dense(rows, lvl: int, ndim: int):
     ``(2^lvl,)*ndim + trailing`` array (pure reshape/transpose)."""
     n = 1 << lvl
     ncell = n ** ndim
-    trailing = rows.shape[1:]
-    nb = ndim * lvl
-    x = rows[:ncell].reshape((2,) * nb + trailing)
-    ax = _bit_axes(lvl, ndim) + tuple(range(nb, nb + len(trailing)))
-    return jnp.transpose(x, ax).reshape((n,) * ndim + trailing)
+    return flat_to_dense_slab(rows[:ncell], lvl, ndim, 0)
 
 
 def dense_to_flat(dense, lvl: int, ndim: int):
     """Dense ``(2^lvl,)*ndim + trailing`` array → [ncell, *trailing]
     flat-order rows (inverse of :func:`flat_to_dense`)."""
-    n = 1 << lvl
-    ncell = n ** ndim
-    trailing = dense.shape[ndim:]
-    nb = ndim * lvl
-    x = dense.reshape((2,) * nb + trailing)
-    ax = _inv_bit_axes(lvl, ndim) + tuple(range(nb, nb + len(trailing)))
-    return jnp.transpose(x, ax).reshape((ncell,) + trailing)
+    return dense_to_flat_slab(dense, lvl, ndim, 0)

@@ -89,6 +89,10 @@ class FusedSpec(NamedTuple):
     # capture per-cell face mass fluxes for the MC gas tracers
     # (godunov_fine.f90:685-715); hydro single-device path only
     want_flux: bool = False
+    # per-level slab decomposition (parallel/dense_slab.SlabSpec or
+    # None) for COMPLETE levels on a multi-chip mesh; empty tuple =
+    # global-view dense sweep everywhere (the single-device default)
+    slab: tuple = ()
 
 
 def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
@@ -129,10 +133,21 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
             advance(i + 1, 0.5 * dtl)
             advance(i + 1, 0.5 * dtl)
         if spec.complete[i]:
-            out = K.dense_sweep(u[l], d.get("inv_perm"), d.get("perm"),
-                                d["ok_dense"], dtl, dx(l),
-                                shape(l), spec.bspec, cfg,
-                                ret_flux=spec.want_flux)
+            sl = spec.slab[i] if spec.slab else None
+            if sl is not None:
+                # explicit slab-sharded formulation: shard-local bitperm
+                # + ring ppermute halos (parallel/dense_slab.py) — the
+                # GSPMD partitioner never sees the bit-interleaved
+                # transpose, so no involuntary full rematerialization
+                from ramses_tpu.parallel import dense_slab
+                out = dense_slab.dense_sweep_slab(
+                    u[l], d.get("ok_flat"), dtl, dx(l), sl, cfg,
+                    ret_flux=spec.want_flux)
+            else:
+                out = K.dense_sweep(u[l], d.get("inv_perm"),
+                                    d.get("perm"), d["ok_dense"], dtl,
+                                    dx(l), shape(l), spec.bspec, cfg,
+                                    ret_flux=spec.want_flux)
             du = out[0] if spec.want_flux else out
             if spec.want_flux:
                 phi[l] = phi[l] + out[1]
@@ -199,9 +214,14 @@ def _courant_traced(u, dev, spec: FusedSpec, fg=None):
     return jnp.stack(dts)
 
 
-@partial(jax.jit, static_argnames=("spec",))
+@partial(jax.jit, static_argnames=("spec",), donate_argnums=(0,))
 def _fused_coarse_step(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
     """One coarse step + the NEXT step's Courant dt, one dispatch.
+
+    The state dict ``u`` is DONATED: the output state aliases the input
+    buffers, so the dense base level exists once in HBM instead of
+    twice.  Callers must rebind their reference to the returned state
+    (``sim.u = out[0]``) — the argument arrays die with the call.
 
     Returning dt(u^{n+1}) from the same program is the reference's
     ``dtnew`` bookkeeping (``amr/update_time.f90``): the next coarse
@@ -248,12 +268,22 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
     for i, l in enumerate(spec.levels):
         d = dev[l]
         if spec.complete[i]:
-            shp = tuple(r << l for r in root[:cfg.ndim])
-            fl = K.dense_refine_flags(u[l], d.get("inv_perm"),
-                                      d.get("perm"), eg,
-                                      fls, shp,
-                                      spec.bspec, cfg,
-                                      dx=spec.boxlen / (1 << l))
+            sl = spec.slab[i] if spec.slab else None
+            if sl is not None:
+                from functools import partial as _partial
+
+                from ramses_tpu.parallel import dense_slab
+                fn = _partial(K._flags_fn(cfg), err_grad=eg, floors=fls,
+                              spatial0=0, cfg=cfg)
+                fl = dense_slab.dense_flags_slab(u[l], sl, fn,
+                                                 2 ** cfg.ndim)
+            else:
+                shp = tuple(r << l for r in root[:cfg.ndim])
+                fl = K.dense_refine_flags(u[l], d.get("inv_perm"),
+                                          d.get("perm"), eg,
+                                          fls, shp,
+                                          spec.bspec, cfg,
+                                          dx=spec.boxlen / (1 << l))
         else:
             if l == spec.lmin:
                 interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
@@ -268,11 +298,15 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
     return tuple(out)
 
 
-@partial(jax.jit, static_argnames=("spec", "nsteps"))
+@partial(jax.jit, static_argnames=("spec", "nsteps"), donate_argnums=(0,))
 def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int,
                       cool_tables=None):
     """``nsteps`` hydro-only coarse steps as ONE device program
     (``lax.scan``), zero host round-trips between steps.
+
+    ``u`` is donated (the scan carry aliases the input buffers — one
+    copy of the dense base level in HBM); callers rebind to the
+    returned state.
 
     Steps past ``tend`` become no-ops (the ``run_steps`` active-flag
     pattern).  Only valid while the tree is frozen — callers chunk by
@@ -862,6 +896,8 @@ class AmrSim:
                     prev_dev[l],
                     ok_dense=(self._place(jnp.asarray(m.ok_dense), "cells")
                               if m.ok_dense is not None else None),
+                    ok_flat=(self._place(jnp.asarray(m.ok_flat), "cells")
+                             if m.ok_flat is not None else None),
                     ref_cell=self._place(jnp.asarray(m.ref_cell), "rep"),
                     son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
                 )
@@ -885,6 +921,8 @@ class AmrSim:
                 self.dev[l] = dict(
                     ok_dense=(self._place(jnp.asarray(m.ok_dense), "cells")
                               if m.ok_dense is not None else None),
+                    ok_flat=(self._place(jnp.asarray(m.ok_flat), "cells")
+                             if m.ok_flat is not None else None),
                     ref_cell=self._place(jnp.asarray(m.ref_cell), "rep"),
                     son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
                     valid_cell=self._place(jnp.asarray(valid_cell),
@@ -1225,7 +1263,18 @@ class AmrSim:
                            and getattr(self.cfg, "physics",
                                        "hydro") == "hydro"
                            and not cspecs))
+            slab = tuple(self._slab_spec(l) if self.maps[l].complete
+                         else None for l in lv)
+            if any(s is not None for s in slab):
+                self._spec = self._spec._replace(slab=slab)
         return self._spec
+
+    def _slab_spec(self, l: int):
+        """SlabSpec for a complete level's explicit slab-sharded dense
+        path, or None for the global-view sweep.  The single-device sim
+        has no mesh — :class:`ramses_tpu.parallel.amr_sharded.
+        ShardedAmrSim` overrides this with the real gate."""
+        return None
 
     def _cool_bundle(self):
         """(tables, traced [scale_T2, scale_nH, scale_t]) for the fused

@@ -254,6 +254,68 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     return du_flat, corr, phi
 
 
+def dense_interior_update(up, okp, dt, dx: float, shape: Tuple[int, ...],
+                          cfg: HydroStatic, ret_flux: bool = False):
+    """Padded-halo interior update shared by the global-view dense sweep
+    and the per-shard slab path (:mod:`ramses_tpu.parallel.dense_slab`).
+
+    ``up``: ``[nvar, *(shape + 2*NGHOST)]`` ghost-padded state; ``okp``:
+    optional refined-cell mask over the same padded box, ALREADY in the
+    state dtype (1.0 = refined) — faces touching a refined cell get zero
+    flux.  Returns ``du [nvar, *shape]`` (+ ``phi [*shape, ndim, 2]``
+    per-cell (low, high) dt/dx-scaled face mass fluxes when
+    ``ret_flux``).
+    """
+    from ramses_tpu.grid import boundary as bmod
+
+    nd = cfg.ndim
+    flux, tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
+    if okp is not None:
+        masked = []
+        masked_tmp = []
+        for d in range(nd):
+            # arithmetic (1-ok)(1-ok_roll) instead of pred ~(ok|roll):
+            # the pred→f32 convert of the bit-permuted mask is exactly
+            # the op the SPMD partitioner could only reshard by full
+            # rematerialization (MULTICHIP_r05 tail)
+            keep = (1.0 - okp) * (1.0 - jnp.roll(okp, 1, axis=d))
+            masked.append(flux[d] * keep[None])
+            if tmp is not None:
+                masked_tmp.append(tmp[d] * keep[None])
+        flux = jnp.stack(masked)
+        if tmp is not None:
+            tmp = jnp.stack(masked_tmp)
+    un = muscl.apply_fluxes(up, flux, cfg)
+    if tmp is not None and (cfg.pressure_fix or cfg.nener):
+        un = muscl.dual_energy_fix(up, un, tmp, dt, (dx,) * nd, cfg)
+    du = bmod.unpad(un, nd, muscl.NGHOST) - bmod.unpad(up, nd,
+                                                       muscl.NGHOST)
+    if not ret_flux:
+        return du
+    g = muscl.NGHOST
+    phis = []
+    for d in range(nd):
+        f0 = flux[d][0]                                # [*padded] mass
+        lo_ix = tuple(slice(g, g + shape[dd]) for dd in range(nd))
+        hi_ix = tuple(slice(g + 1, g + 1 + shape[dd]) if dd == d
+                      else slice(g, g + shape[dd]) for dd in range(nd))
+        phis.append(jnp.stack([f0[lo_ix], f0[hi_ix]], axis=-1))
+    return du, jnp.stack(phis, axis=-2)                # [*shape, ndim, 2]
+
+
+def pad_ok_dense(ok_dense, shape: Tuple[int, ...], bc, dtype, ng: int):
+    """Dense-ravel refined mask → ghost-padded arithmetic mask in the
+    state dtype (the convert happens BEFORE the pad/bit-permuted views,
+    on the cleanly row-sharded array)."""
+    okp = ok_dense.astype(dtype).reshape(shape)
+    for d in range(len(shape)):
+        mode = "wrap" if bc.faces[d][0].kind == 0 else "edge"
+        padw = [(ng, ng) if d2 == d else (0, 0)
+                for d2 in range(len(shape))]
+        okp = jnp.pad(okp, padw, mode=mode)
+    return okp
+
+
 @partial(jax.jit, static_argnames=("cfg", "shape", "bc", "dx", "ret_flux"))
 def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
                 shape: Tuple[int, ...], bc, cfg: HydroStatic,
@@ -304,43 +366,17 @@ def dense_sweep(u_flat, inv_perm, perm, ok_dense, dt, dx: float,
                             phi.dtype).at[:ncell].set(phi)
         return du_rows, phi
     up = bmod.pad(ud, bc, cfg, muscl.NGHOST, dx=dx)
-    flux, tmp = _unsplit_fn(cfg)(up, None, dt, (dx,) * nd, cfg)
-    if ok_dense is not None:
-        okp = ok_dense.reshape(shape)
-        for d in range(nd):
-            mode = "wrap" if bc.faces[d][0].kind == 0 else "edge"
-            padw = [(muscl.NGHOST, muscl.NGHOST) if d2 == d else (0, 0)
-                    for d2 in range(nd)]
-            okp = jnp.pad(okp, padw, mode=mode)
-        masked = []
-        masked_tmp = []
-        for d in range(nd):
-            keep = ~(okp | jnp.roll(okp, 1, axis=d))
-            masked.append(flux[d] * keep[None].astype(flux.dtype))
-            if tmp is not None:
-                masked_tmp.append(tmp[d] * keep[None].astype(flux.dtype))
-        flux = jnp.stack(masked)
-        if tmp is not None:
-            tmp = jnp.stack(masked_tmp)
-    un = muscl.apply_fluxes(up, flux, cfg)
-    if tmp is not None and (cfg.pressure_fix or cfg.nener):
-        un = muscl.dual_energy_fix(up, un, tmp, dt, (dx,) * nd, cfg)
-    du_dense = bmod.unpad(un, nd, muscl.NGHOST) - ud   # [nvar, *shape]
+    okp = (pad_ok_dense(ok_dense, shape, bc, up.dtype, muscl.NGHOST)
+           if ok_dense is not None else None)
+    out = dense_interior_update(up, okp, dt, dx, shape, cfg,
+                                ret_flux=ret_flux)
+    du_dense = out[0] if ret_flux else out             # [nvar, *shape]
     du_rows = dense_to_rows(jnp.moveaxis(du_dense, 0, -1), perm, shape)
     if u_flat.shape[0] > ncell:
         du_rows = jnp.zeros_like(u_flat).at[:ncell].set(du_rows)
     if not ret_flux:
         return du_rows
-    g = muscl.NGHOST
-    phis = []
-    for d in range(nd):
-        f0 = flux[d][0]                                # [*padded] mass
-        lo_ix = tuple(slice(g, g + shape[dd]) for dd in range(nd))
-        hi_ix = tuple(slice(g + 1, g + 1 + shape[dd]) if dd == d
-                      else slice(g, g + shape[dd]) for dd in range(nd))
-        phis.append(jnp.stack([f0[lo_ix], f0[hi_ix]], axis=-1))
-    phi = dense_to_rows(jnp.stack(phis, axis=-2), perm,
-                        shape)                         # [ncell, ndim, 2]
+    phi = dense_to_rows(out[1], perm, shape)           # [ncell, ndim, 2]
     if u_flat.shape[0] > ncell:
         phi = jnp.zeros((u_flat.shape[0], nd, 2),
                         phi.dtype).at[:ncell].set(phi)

@@ -62,6 +62,9 @@ class LevelMaps:
     perm: Optional[np.ndarray] = None      # [ncell] flat row → dense ravel
     inv_perm: Optional[np.ndarray] = None  # [ncell] dense ravel → flat row
     ok_dense: Optional[np.ndarray] = None  # [ncell] bool refined, dense order
+    # same mask in FLAT row order (shardable over contiguous row chunks
+    # for the slab-sharded dense path, parallel/dense_slab.py)
+    ok_flat: Optional[np.ndarray] = None   # [ncell] bool refined, flat order
 
     @property
     def ndim(self) -> int:
@@ -284,6 +287,7 @@ def _build_complete_level_maps(tree: Octree, lvl: int, noct: int,
         ok_dense[perm] = rmask
     else:
         ok_dense = None
+    ok_flat = rmask
 
     valid_oct = np.zeros(noct_pad, dtype=bool)
     valid_oct[:noct] = True
@@ -297,7 +301,8 @@ def _build_complete_level_maps(tree: Octree, lvl: int, noct: int,
         corr_idx=np.full((noct_pad, ndim, 2), -1, dtype=np.int32),
         nref=nref, nref_pad=nref_pad, ref_cell=ref_cell, son_oct=son_oct,
         valid_oct=valid_oct, complete=True,
-        perm=perm.astype(np.int64), inv_perm=inv_perm, ok_dense=ok_dense)
+        perm=perm.astype(np.int64), inv_perm=inv_perm, ok_dense=ok_dense,
+        ok_flat=ok_flat)
 
 
 def refresh_restriction(m: LevelMaps, tree: Octree) -> LevelMaps:
@@ -313,7 +318,7 @@ def refresh_restriction(m: LevelMaps, tree: Octree) -> LevelMaps:
         ok_dense = np.zeros(len(m.perm), dtype=bool)
         ok_dense[m.perm] = rmask
     return replace(m, nref=nref, nref_pad=nref_pad, ref_cell=ref_cell,
-                   son_oct=son_oct, ok_dense=ok_dense)
+                   son_oct=son_oct, ok_dense=ok_dense, ok_flat=rmask)
 
 
 def build_prolong_maps(tree_new: Octree, tree_old: Octree, lvl: int,

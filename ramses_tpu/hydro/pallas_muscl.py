@@ -392,6 +392,96 @@ def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
     )(*args)
 
 
+def shard_axes(cfg: HydroStatic, loc, cut, dtype):
+    """Axis relabel for a PER-SHARD fused-kernel call, or None.
+
+    The kernel wants its lane ("z") axis whole, periodic, %128 and
+    uncut by the slab decomposition (the in-kernel roll would otherwise
+    wrap inside one shard).  A slab cut always takes z first
+    (amr/bitperm.py), so the per-shard call picks any UNCUT axis whose
+    local extent fits the lane rules and relabels it to the kernel's z,
+    permuting the momentum components to match.  Returns ``(a0, a1,
+    az)``: the original axes taking the kernel's (x, y, z) roles.
+    Unlike :func:`kernel_available` this gate has no single-device
+    requirement — inside ``shard_map`` the kernel runs on the local
+    block, so no GSPMD partitioning rule is needed.
+    """
+    if DISABLED or Element is None:
+        return None
+    if jax.default_backend() != "tpu":
+        return None
+    if getattr(cfg, "physics", "hydro") != "hydro" or cfg.ndim != 3:
+        return None
+    if cfg.nener != 0 or cfg.npassive != 0 or cfg.scheme != "muscl" \
+            or cfg.slope_type not in (1, 2, 8) or cfg.pressure_fix \
+            or cfg.riemann not in ("llf", "hllc"):
+        return None
+    if dtype not in (jnp.float32, jnp.dtype("float32")):
+        return None
+    for az in (2, 1, 0):
+        if cut[az]:
+            continue
+        nz = loc[az]
+        if nz % 128 or nz > 1024:
+            continue
+        a0, a1 = (d for d in range(3) if d != az)
+        bx, by = _pick_block((loc[a0], loc[a1], nz))
+        if bx is not None:
+            return (a0, a1, az)
+    return None
+
+
+def fused_step_shard(up, okp, dt, cfg: HydroStatic, dx: float,
+                     loc: Tuple[int, int, int], axes: Tuple[int, int, int],
+                     want_flux: bool = False, interpret: bool = False):
+    """Per-shard fused kernel on a halo-extended local box.
+
+    ``up``: [5, *ext] in ORIGINAL axis order with NG ghost slabs on
+    ``axes[0]``/``axes[1]`` and the bare local extent on the lane axis
+    ``axes[2]`` (handled by the in-kernel periodic roll — valid because
+    the slab gate guarantees that axis is uncut).  ``okp``: optional
+    refined mask in the state dtype over the same extended box.
+    Returns ``du [5, *loc]`` (+ ``phi [*loc, 3, 2]`` when
+    ``want_flux``), both in original axis/component order — the same
+    contract as :func:`ramses_tpu.amr.kernels.dense_interior_update`.
+
+    NOTE: the relabeled kernel applies the directional sweeps in
+    relabeled order, so it is NOT bitwise against the unrelabeled
+    global kernel (float accumulation order differs); shard-invariance
+    bitwise pins hold on the XLA path (CPU tests), the pallas shard
+    path is tolerance-pinned.
+    """
+    a0, a1, az = axes
+    vp = (0, 1 + a0, 1 + a1, 1 + az, 4)
+    ivp = (0, 1 + axes.index(0), 1 + axes.index(1), 1 + axes.index(2), 4)
+    sp = (0, 1 + a0, 1 + a1, 1 + az)               # relabel transpose
+    isp = (0, 1 + axes.index(0), 1 + axes.index(1), 1 + axes.index(2))
+    ur = jnp.transpose(up, sp)[jnp.asarray(vp)]
+    # y window slack: 4 junk rows at the high end (values never used)
+    ur = jnp.pad(ur, ((0, 0), (0, 0), (0, WY - BY - NG * 2), (0, 0)),
+                 mode="edge")
+    okr = None
+    if okp is not None:
+        okr = jnp.transpose(okp, (a0, a1, az))
+        okr = jnp.pad(okr, ((0, 0), (0, WY - BY - NG * 2), (0, 0)),
+                      mode="edge")
+    shape_rel = (loc[a0], loc[a1], loc[az])
+    out = fused_step_padded(ur, dt, cfg, dx, shape_rel, ok_pad=okr,
+                            want_flux=want_flux, interpret=interpret)
+    un = out[0] if want_flux else out
+    du = un - ur[:, NG:-NG, NG:NG + shape_rel[1], :]
+    du = jnp.transpose(du[jnp.asarray(ivp)], isp)
+    if not want_flux:
+        return du
+    phis = []
+    for d in range(3):
+        f = out[1][axes.index(d)]                  # [2, *rel spatial]
+        f = jnp.transpose(f, (0,) + tuple(1 + axes.index(dd)
+                                          for dd in range(3)))
+        phis.append(jnp.moveaxis(f, 0, -1))        # [*loc, 2]
+    return du, jnp.stack(phis, axis=-2)            # [*loc, 3, 2]
+
+
 def pad_xy(u, bc, cfg: HydroStatic, ok=None):
     """Ghost-pad x (2/2) and y (2 low / 6 high — window slack) only;
     z periodic is handled in-kernel."""

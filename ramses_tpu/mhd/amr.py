@@ -400,17 +400,31 @@ def _mhd_fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
     for i, l in enumerate(spec.levels):
         d = dev[l]
         if spec.complete[i]:
-            shape = (1 << l,) * nd
-            ncell = shape[0] ** nd
-            ud = jnp.moveaxis(
-                K.rows_to_dense(u[l], d.get("inv_perm"), shape), -1, 0)
-            # ghost-pad per the physical BCs: a raw roll would wrap the
-            # two domain edges together and flag phantom gradients there
-            up = mu._pad(ud, nd, bc_kinds, 1)
-            ok = _mhd_grad_flags(up, eg, fls, 0, cfg)
-            ok = ok[tuple(slice(1, -1) for _ in range(nd))]
-            fl = K.dense_to_rows(ok, d.get("perm"), shape).reshape(
-                ncell // 2 ** nd, 2 ** nd)
+            sl = spec.slab[i] if spec.slab else None
+            if sl is not None:
+                # explicit slab-sharded flags (parallel/dense_slab.py):
+                # shard-local bitperm + depth-1 ppermute halos instead
+                # of the global-view transpose
+                from functools import partial as _partial
+
+                from ramses_tpu.parallel import dense_slab
+                fn = _partial(_mhd_grad_flags, eg=eg, fls=fls,
+                              spatial0=0, cfg=cfg)
+                fl = dense_slab.dense_flags_slab(u[l], sl, fn, 2 ** nd)
+            else:
+                shape = (1 << l,) * nd
+                ncell = shape[0] ** nd
+                ud = jnp.moveaxis(
+                    K.rows_to_dense(u[l], d.get("inv_perm"), shape),
+                    -1, 0)
+                # ghost-pad per the physical BCs: a raw roll would wrap
+                # the two domain edges together and flag phantom
+                # gradients there
+                up = mu._pad(ud, nd, bc_kinds, 1)
+                ok = _mhd_grad_flags(up, eg, fls, 0, cfg)
+                ok = ok[tuple(slice(1, -1) for _ in range(nd))]
+                fl = K.dense_to_rows(ok, d.get("perm"), shape).reshape(
+                    ncell // 2 ** nd, 2 ** nd)
         else:
             if l == spec.lmin:
                 interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
@@ -903,6 +917,14 @@ class MhdAmrSim(AmrSim):
                 complete=tuple(self.maps[l].complete for l in lv),
                 gravity=self.gravity,
                 itype=int(self.params.refine.interpol_type))
+            # slab-sharded complete-level FLAGS only: the CT advance
+            # keeps the global-view path (its EMF override is a global
+            # index scatter), so only the gradient-flag evaluation gets
+            # the explicit formulation on a multi-device mesh
+            slab = tuple(self._slab_spec(l) if self.maps[l].complete
+                         else None for l in lv)
+            if any(s is not None for s in slab):
+                self._spec = self._spec._replace(slab=slab)
         return self._spec
 
     def coarse_dt(self) -> float:

@@ -31,8 +31,13 @@ per-shard halo schedules for partial levels — ring-offset ``ppermute``
 halos plus a deterministic owner-fold, rebuilt at regrid like the
 reference's ``build_comm`` (:mod:`ramses_tpu.parallel.amr_comm`; the
 uniform path's analogue is :mod:`ramses_tpu.parallel.halo`).  Complete
-levels always take the dense global-view sweep, whose halos are
-compiler-inserted collectives on the bit-permuted dense axes.
+levels take the EXPLICIT slab-sharded dense path whenever the level is
+a fully periodic unpadded power-of-two cube on a power-of-two device
+count (:mod:`ramses_tpu.parallel.dense_slab`): shard-local bitperm +
+ring ``ppermute`` halos, so the GSPMD partitioner never sees the
+bit-interleaved transpose that previously degenerated to involuntary
+full rematerialization (MULTICHIP_r05).  Levels outside that envelope
+keep the global-view sweep with compiler-inserted collectives.
 """
 
 from __future__ import annotations
@@ -114,6 +119,19 @@ class ShardedAmrSim(AmrSim):
         from ramses_tpu.io.pario import dump_pario as _dp
         return _dp(self, iout, base_dir, io_group_size=io_group_size,
                    split_hosts=split_hosts)
+
+    def _slab_spec(self, lvl: int):
+        """Explicit slab decomposition for a complete level, or None
+        when the level falls outside the slab envelope (non-periodic,
+        non-cubic root, padded rows, non-power-of-two mesh) and must
+        keep the global-view sweep."""
+        from ramses_tpu.parallel import dense_slab
+        root = self.root or (1,) * self.cfg.ndim
+        shape = tuple(r << lvl for r in root[:self.cfg.ndim])
+        ncell_pad = self.maps[lvl].noct_pad * 2 ** self.cfg.ndim
+        return dense_slab.build_slab_spec(
+            self.mesh, lvl, self.cfg.ndim, shape, ncell_pad,
+            self.bc_kinds)
 
     def _noct_pad(self, lvl: int, noct: int) -> int:
         """Bucketed oct count (with the base class's hysteresis) rounded

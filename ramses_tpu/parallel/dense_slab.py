@@ -1,0 +1,243 @@
+"""Explicit slab-sharded dense sweep for COMPLETE levels.
+
+The global-view :func:`ramses_tpu.amr.kernels.dense_sweep` hands the
+flat↔dense bit-permutation transpose to XLA's SPMD partitioner; on a
+multi-chip mesh the partitioner cannot follow the bit-interleaved
+reshape and falls back to "involuntary full rematerialization" — the
+whole base grid is gathered to every chip and re-split each coarse
+step (MULTICHIP_r05 tail).  This module is the EXPLICIT formulation:
+the complete level's row batch stays sharded ``P("oct")`` exactly as
+it already is, and a ``shard_map`` body does per device
+
+1. a SHARD-LOCAL bit-permutation (:func:`ramses_tpu.amr.bitperm.
+   flat_to_dense_slab`): a contiguous flat row chunk IS an axis-aligned
+   dense sub-box (the top ``log2(ndev)`` flat bits are the most
+   significant coordinate bits, z-major), so each chip converts only
+   the rows it owns — no cross-chip gather exists;
+2. a ring ``lax.ppermute`` halo exchange per cut axis (the pipeline
+   proven in :mod:`ramses_tpu.parallel.halo`), sequenced axis-by-axis
+   over the progressively extended block so corner ghosts fill with
+   their true global values; uncut axes wrap locally;
+3. the unchanged padded-interior kernel
+   (:func:`ramses_tpu.amr.kernels.dense_interior_update`) on the local
+   box — per-cell arithmetic identical to the global path, so mesh-of-1
+   and mesh-of-N agree BITWISE (asserted in tests/test_dense_slab.py);
+4. the inverse shard-local bit-permutation back to flat rows.
+
+Geometry: the cut degenerates to z-slabs for 2 devices, (z, y) pencils
+for 4, and octants for 8 — always aligned with oct boundaries.  Scope:
+fully periodic cubic power-of-two levels with unpadded row batches and
+a power-of-two device count; everything else falls back to the
+global-view sweep (kept bitwise-pinned as the single-device reference).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ramses_tpu.amr import bitperm
+from ramses_tpu.hydro import muscl
+from ramses_tpu.parallel.mesh import OCT_AXIS
+
+
+def _shard_map():
+    try:
+        return jax.shard_map                          # jax >= 0.8
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+        return shard_map
+
+
+class SlabSpec(NamedTuple):
+    """Static (hashable) description of one complete level's slab
+    decomposition — rides inside ``FusedSpec`` as part of the jit key."""
+    lvl: int
+    ndim: int
+    mbits: int             # log2(ndev): top flat bits = device index
+    mesh: Mesh             # the 1-D "oct" mesh the rows shard over
+    grid: Tuple[int, ...]  # device grid extent per axis (prod = ndev)
+    loc: Tuple[int, ...]   # local dense sub-box shape per device
+    # per-axis ppermute schedules ((fwd, bwd) pairs of (src, dst)
+    # tuples) for cut axes; None = uncut (local periodic wrap)
+    perms: tuple
+
+
+def build_slab_spec(mesh: Mesh, lvl: int, ndim: int,
+                    shape: Tuple[int, ...], ncell_pad: int,
+                    bc_kinds) -> Optional[SlabSpec]:
+    """SlabSpec for a complete level, or None when the level must keep
+    the global-view path (non-periodic, non-cubic, padded rows, or a
+    non-power-of-two / single-device mesh)."""
+    if tuple(mesh.axis_names) != (OCT_AXIS,):
+        return None
+    ndev = int(mesh.devices.size)
+    if ndev <= 1 or ndev & (ndev - 1):
+        return None
+    if tuple(shape) != (1 << lvl,) * ndim:
+        return None
+    ncell = (1 << lvl) ** ndim
+    if ncell_pad != ncell:
+        return None
+    mbits = ndev.bit_length() - 1
+    if mbits > ndim * (lvl - 1):
+        return None
+    if any(k != 0 for lohi in bc_kinds for k in lohi):
+        return None                                   # periodic only
+    gb = bitperm.grid_bits(lvl, ndim, mbits)
+    grid = tuple(1 << b for b in gb)
+    loc = bitperm.slab_shape(lvl, ndim, mbits)
+    if any(loc[d] < muscl.NGHOST for d in range(ndim)):
+        return None                                   # shard < stencil
+    coords = bitperm.chunk_coords(lvl, ndim, mbits)
+    dev_of = {g: D for D, g in enumerate(coords)}
+    perms = []
+    for d in range(ndim):
+        if grid[d] == 1:
+            perms.append(None)
+            continue
+        fwd = []
+        bwd = []
+        for D, g in enumerate(coords):
+            up = list(g)
+            dn = list(g)
+            up[d] = (g[d] + 1) % grid[d]
+            dn[d] = (g[d] - 1) % grid[d]
+            fwd.append((D, dev_of[tuple(up)]))
+            bwd.append((D, dev_of[tuple(dn)]))
+        perms.append((tuple(fwd), tuple(bwd)))
+    return SlabSpec(lvl=lvl, ndim=ndim, mbits=mbits, mesh=mesh,
+                    grid=grid, loc=loc, perms=tuple(perms))
+
+
+def _take(a, ax: int, sl: slice):
+    idx = [slice(None)] * a.ndim
+    idx[ax] = sl
+    return a[tuple(idx)]
+
+
+def halo_extend(a, spec: SlabSpec, ng: int, spatial0: int,
+                axes=None):
+    """Extend the local dense block by ``ng`` ghost cells on every
+    spatial axis (axes ``spatial0 .. spatial0+ndim-1``): ring ppermute
+    slabs on cut axes, local periodic wrap on uncut ones.  Later axes
+    exchange the already-extended block, so corner ghosts carry their
+    exact global-periodic values.  ``axes``: optional subset of the
+    original spatial axes to extend (the pallas shard path leaves its
+    lane axis bare for the in-kernel periodic roll)."""
+    for d in range(spec.ndim):
+        if axes is not None and d not in axes:
+            continue
+        ax = spatial0 + d
+        if spec.perms[d] is None:
+            pads = [(0, 0)] * a.ndim
+            pads[ax] = (ng, ng)
+            a = jnp.pad(a, pads, mode="wrap")
+        else:
+            fwd, bwd = spec.perms[d]
+            lo = jax.lax.ppermute(_take(a, ax, slice(-ng, None)),
+                                  OCT_AXIS, list(fwd))
+            hi = jax.lax.ppermute(_take(a, ax, slice(0, ng)),
+                                  OCT_AXIS, list(bwd))
+            a = jnp.concatenate([lo, a, hi], axis=ax)
+    return a
+
+
+def dense_apply_slab(rows, spec: SlabSpec, local_fn, ng: int,
+                     out_ndim: Optional[int] = None):
+    """Generic slab engine: flat rows → per-shard dense sub-box →
+    ``ng``-deep halo extension → ``local_fn(extended) -> [*loc,
+    *trailing_out]`` → flat rows.  ``local_fn`` sees the block with the
+    spatial axes LEADING (trailing feature axes untouched) and must
+    return the un-extended local box.  ``out_ndim``: rank of the
+    returned rows array (defaults to the input rank)."""
+    sm = _shard_map()
+    nd = spec.ndim
+
+    def body(r_loc):
+        dense = bitperm.flat_to_dense_slab(r_loc, spec.lvl, nd,
+                                           spec.mbits)
+        out = local_fn(halo_extend(dense, spec, ng, 0))
+        return bitperm.dense_to_flat_slab(out, spec.lvl, nd, spec.mbits)
+
+    in_spec = P(OCT_AXIS, *([None] * (rows.ndim - 1)))
+    out_rank = out_ndim if out_ndim is not None else rows.ndim
+    out_spec = P(OCT_AXIS, *([None] * (out_rank - 1)))
+    return sm(body, mesh=spec.mesh, in_specs=(in_spec,),
+              out_specs=out_spec)(rows)
+
+
+def dense_sweep_slab(u_flat, ok_flat, dt, dx: float, spec: SlabSpec,
+                     cfg, ret_flux: bool = False):
+    """Slab-sharded complete-level hydro sweep — the explicit-comm
+    formulation of :func:`ramses_tpu.amr.kernels.dense_sweep` (same
+    physics, bitwise-identical du/phi).  ``ok_flat``: flat-row refined
+    mask or None; ``dt`` traced scalar.  Returns du rows (+ phi rows
+    when ``ret_flux``), sharded like the input."""
+    from ramses_tpu.amr import kernels as K
+    from ramses_tpu.hydro import pallas_muscl as pk
+
+    sm = _shard_map()
+    nd = spec.ndim
+    ng = muscl.NGHOST
+    masked = ok_flat is not None
+    # per-shard fused TPU kernel: relabel an uncut %128 axis to the
+    # kernel lane role; None (e.g. every CPU run, or all axes cut)
+    # takes the shared XLA interior update
+    cut = tuple(p is not None for p in spec.perms)
+    kaxes = (pk.shard_axes(cfg, spec.loc, cut, u_flat.dtype)
+             if nd == 3 else None)
+
+    def body(u_loc, ok_loc, dt_):
+        ud = bitperm.flat_to_dense_slab(u_loc, spec.lvl, nd, spec.mbits)
+        ext = None if kaxes is None else kaxes[:2]
+        up = halo_extend(jnp.moveaxis(ud, -1, 0), spec, ng, 1, axes=ext)
+        okp = None
+        if masked:
+            # convert on the flat rows (clean shard-local op), halo the
+            # arithmetic mask exactly like the state
+            okd = bitperm.flat_to_dense_slab(
+                ok_loc.astype(u_loc.dtype), spec.lvl, nd, spec.mbits)
+            okp = halo_extend(okd, spec, ng, 0, axes=ext)
+        if kaxes is not None:
+            out = pk.fused_step_shard(up, okp, dt_, cfg, dx, spec.loc,
+                                      kaxes, want_flux=ret_flux)
+        else:
+            out = K.dense_interior_update(up, okp, dt_, dx, spec.loc,
+                                          cfg, ret_flux=ret_flux)
+        du = out[0] if ret_flux else out
+        du_rows = bitperm.dense_to_flat_slab(
+            jnp.moveaxis(du, 0, -1), spec.lvl, nd, spec.mbits)
+        if not ret_flux:
+            return du_rows
+        phi_rows = bitperm.dense_to_flat_slab(out[1], spec.lvl, nd,
+                                              spec.mbits)
+        return du_rows, phi_rows
+
+    ok_in = P(OCT_AXIS) if masked else P()
+    out_specs = ((P(OCT_AXIS, None), P(OCT_AXIS, None, None))
+                 if ret_flux else P(OCT_AXIS, None))
+    if not masked:
+        # shard_map needs a concrete operand for every spec slot
+        ok_flat = jnp.zeros((), u_flat.dtype)
+    return sm(body, mesh=spec.mesh,
+              in_specs=(P(OCT_AXIS, None), ok_in, P()),
+              out_specs=out_specs)(u_flat, ok_flat, dt)
+
+
+def dense_flags_slab(u_flat, spec: SlabSpec, flags_fn, twotondim: int):
+    """Slab-sharded complete-level refinement flags: ``flags_fn`` maps
+    the 1-ghost-extended local block ``[nvar, *loc+2]`` to a bool grid
+    of the same spatial shape (the shared ``_grad_flags`` family); the
+    interior is sliced here.  Returns ``[noct, 2^ndim]`` flags rows."""
+    nd = spec.ndim
+
+    def local_fn(dense_ext):
+        ok = flags_fn(jnp.moveaxis(dense_ext, -1, 0))
+        return ok[tuple(slice(1, -1) for _ in range(nd))]
+
+    flags = dense_apply_slab(u_flat, spec, local_fn, ng=1, out_ndim=1)
+    return flags.reshape(flags.shape[0] // twotondim, twotondim)

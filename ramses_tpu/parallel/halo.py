@@ -67,7 +67,9 @@ def _exchange(u_loc, ng: int):
     neighbour's high interior slab and vice versa (periodic ring, so
     device 0's left neighbour is device n-1: the wrap IS the physical
     periodic boundary)."""
-    n = jax.lax.axis_size(AXIS)
+    # jax.lax.axis_size is absent from older jax releases; psum of a
+    # unit weight is the portable spelling
+    n = int(jax.lax.psum(1, AXIS))
     fwd = [(i, (i + 1) % n) for i in range(n)]    # data moves +x
     bwd = [(i, (i - 1) % n) for i in range(n)]    # data moves -x
     lo_ghost = jax.lax.ppermute(u_loc[:, -ng:], AXIS, fwd)
@@ -116,8 +118,12 @@ def _build_run(grid: UniformGrid, mesh: Mesh, nsteps: int):
             ndone = ndone + jnp.where(active, 1, 0)
             return (u_loc, t, ndone), None
 
+        # seed the step counter FROM t: older shard_map tracks a fresh
+        # constant's replication as unknown, and the scan carry check
+        # then rejects the (known-replicated) output counter
+        ndone0 = (t - t).astype(jnp.int32)
         (u_loc, t, ndone), _ = jax.lax.scan(
-            body, (u_loc, t, jnp.array(0)), None, length=nsteps)
+            body, (u_loc, t, ndone0), None, length=nsteps)
         return u_loc, t, ndone
 
     return jax.jit(shard_map(shard_body, mesh=mesh,

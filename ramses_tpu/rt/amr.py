@@ -415,6 +415,37 @@ class RtAmrCoupled:
                 if m.complete:
                     nb = 1 << l
                     shp = (nb,) * nd
+                    sl = (sim._slab_spec(l) if spec.periodic else None)
+                    if sl is not None:
+                        # explicit slab-sharded transport: the GLF
+                        # stencil is 1-deep, so one ppermute halo ring
+                        # + the interior of an extended-box
+                        # transport_step reproduces the global result
+                        # (parallel/dense_slab.py)
+                        from ramses_tpu.parallel import dense_slab
+
+                        def _transport_local(ext, _dx=dx_cgs):
+                            cols = []
+                            for g in range(ng):
+                                c0 = self._ncol(g)
+                                N = ext[..., c0]
+                                F = jnp.stack(
+                                    [ext[..., c0 + 1 + c]
+                                     for c in range(nd)])
+                                N, F = m1.transport_step(
+                                    N, F, dt_sub, _dx, spec.c_red, nd,
+                                    periodic=True)
+                                cols.append(N[..., None])
+                                cols.extend(F[c][..., None]
+                                            for c in range(nd))
+                            out = jnp.concatenate(cols, axis=-1)
+                            return out[tuple(slice(1, -1)
+                                             for _ in range(nd))]
+
+                        rad = dense_slab.dense_apply_slab(
+                            rad, sl, _transport_local, ng=1)
+                        self.rad[l] = rad
+                        continue
                     dense = K.rows_to_dense(rad, d.get("inv_perm"), shp)
                     cols = []
                     for g in range(ng):

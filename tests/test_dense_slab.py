@@ -1,0 +1,316 @@
+"""Shard-invariance suite for the explicit slab-sharded dense path.
+
+The contract of :mod:`ramses_tpu.parallel.dense_slab`: on the XLA
+path, mesh-of-1 (global-view ``dense_sweep``) and mesh-of-8 (slab
+``shard_map`` + ppermute halos) agree BITWISE — ghost cells are exact
+copies of their global-periodic values and the per-cell arithmetic is
+the shared :func:`ramses_tpu.amr.kernels.dense_interior_update`, so no
+float differs.  Both sides must be jitted: XLA's fusion differs from
+eager op-by-op execution at the ULP level, but is shape-stable, which
+is exactly what the slab decomposition relies on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from ramses_tpu.amr import bitperm
+from ramses_tpu.amr import kernels as K
+from ramses_tpu.grid.boundary import BoundarySpec
+from ramses_tpu.hydro.core import HydroStatic
+from ramses_tpu.parallel import dense_slab as DS
+from ramses_tpu.parallel.mesh import oct_mesh
+
+
+def _kinds(bc):
+    return tuple((f[0].kind, f[1].kind) for f in bc.faces)
+
+
+def _sedov_like(ncell, nvar, ndim, seed=0):
+    """Smooth random periodic state: positive density/energy, small
+    velocities (keeps the hllc solver away from vacuum floors)."""
+    rng = np.random.default_rng(seed)
+    u = np.ones((ncell, nvar), np.float32)
+    u[:, 0] = 1.0 + 0.1 * rng.random(ncell, dtype=np.float64)
+    u[:, 1:1 + ndim] = 0.05 * rng.standard_normal(
+        (ncell, ndim)).astype(np.float32)
+    u[:, nvar - 1] = 1.0 + 0.1 * rng.random(ncell, dtype=np.float64)
+    return jnp.asarray(u)
+
+
+def _oct_mask(ncell, ndim, frac=0.3, seed=1):
+    """Oct-aligned refined mask (flat order) + its dense-ravel twin."""
+    rng = np.random.default_rng(seed)
+    noct = ncell // (1 << ndim)
+    lvl = 0
+    n = ncell
+    # recover lvl from ncell = 2**(ndim*lvl)
+    while (1 << (ndim * lvl)) != ncell:
+        lvl += 1
+    ok_flat = np.repeat(rng.random(noct) < frac, 1 << ndim)
+    ok_dense = np.asarray(
+        bitperm.flat_to_dense(jnp.asarray(ok_flat), lvl, ndim)
+    ).reshape(-1)
+    return jnp.asarray(ok_flat), jnp.asarray(ok_dense), n
+
+
+# ----------------------------------------------------------------------
+# bitperm slab locality
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("ndim,lvl,mbits", [
+    (3, 3, 0), (3, 3, 1), (3, 3, 2), (3, 3, 3), (3, 4, 4),
+    (2, 4, 3), (2, 3, 1), (1, 5, 3),
+])
+def test_bitperm_slab_locality(ndim, lvl, mbits):
+    """Per-chunk conversion == global conversion sliced: a contiguous
+    flat row chunk IS an axis-aligned dense sub-box, converted with
+    zero cross-chunk data motion."""
+    ncell = 1 << (ndim * lvl)
+    rows = jnp.arange(ncell * 2, dtype=jnp.int64).reshape(ncell, 2)
+    dense = np.asarray(bitperm.flat_to_dense(rows, lvl, ndim))
+    loc = bitperm.slab_shape(lvl, ndim, mbits)
+    coords = bitperm.chunk_coords(lvl, ndim, mbits)
+    csz = ncell >> mbits
+    for D, g in enumerate(coords):
+        chunk = rows[D * csz:(D + 1) * csz]
+        got = np.asarray(
+            bitperm.flat_to_dense_slab(chunk, lvl, ndim, mbits))
+        sl = tuple(slice(g[d] * loc[d], (g[d] + 1) * loc[d])
+                   for d in range(ndim))
+        np.testing.assert_array_equal(got, dense[sl])
+        # and the inverse round-trips
+        back = np.asarray(
+            bitperm.dense_to_flat_slab(jnp.asarray(got), lvl, ndim,
+                                       mbits))
+        np.testing.assert_array_equal(back, np.asarray(chunk))
+
+
+def test_slab_spec_geometry():
+    """z is cut first: 2 devices -> z-slabs, 8 -> octants (3D); the
+    2D lvl-4 8-way cut is a (2, 4) pencil grid."""
+    mesh = oct_mesh(jax.devices())
+    bc = _kinds(BoundarySpec.periodic(3))
+    spec = DS.build_slab_spec(mesh, 3, 3, (8, 8, 8), 512, bc)
+    assert spec is not None
+    assert spec.grid == (2, 2, 2) and spec.loc == (4, 4, 4)
+    bc2 = _kinds(BoundarySpec.periodic(2))
+    spec2 = DS.build_slab_spec(mesh, 4, 2, (16, 16), 256, bc2)
+    assert spec2 is not None
+    assert spec2.grid == (2, 4) and spec2.loc == (8, 4)
+    # gates: padded rows, non-cubic shape, non-periodic bc, tiny shards
+    assert DS.build_slab_spec(mesh, 3, 3, (8, 8, 8), 520, bc) is None
+    assert DS.build_slab_spec(mesh, 3, 3, (8, 8, 16), 1024, bc) is None
+    assert DS.build_slab_spec(mesh, 3, 3, (8, 8, 8), 512,
+                              ((0, 0), (0, 0), (2, 2))) is None
+    assert DS.build_slab_spec(mesh, 1, 3, (2, 2, 2), 8, bc) is None
+
+
+# ----------------------------------------------------------------------
+# hydro sweep shard invariance (mask + ret_flux included)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("ndim,lvl", [(3, 3), (2, 4)])
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("ret_flux", [False, True])
+def test_dense_sweep_slab_bitwise(ndim, lvl, masked, ret_flux):
+    cfg = HydroStatic(ndim=ndim, gamma=1.4, riemann="hllc")
+    bc = BoundarySpec.periodic(ndim)
+    n = 1 << lvl
+    shape = (n,) * ndim
+    ncell = n ** ndim
+    u = _sedov_like(ncell, cfg.nvar, ndim)
+    ok_flat = ok_dense = None
+    if masked:
+        ok_flat, ok_dense, _ = _oct_mask(ncell, ndim)
+    dt = jnp.float32(1e-3)
+    dx = 1.0 / n
+    mesh = oct_mesh(jax.devices())
+    spec = DS.build_slab_spec(mesh, lvl, ndim, shape, ncell,
+                              _kinds(bc))
+    assert spec is not None
+    slab = jax.jit(partial(DS.dense_sweep_slab, spec=spec, cfg=cfg,
+                           dx=dx, ret_flux=ret_flux))
+    ref = K.dense_sweep(u, None, None, ok_dense, dt, dx, shape, bc,
+                        cfg, ret_flux=ret_flux)
+    got = slab(u, ok_flat, dt)
+    if ret_flux:
+        np.testing.assert_array_equal(np.asarray(ref[0]),
+                                      np.asarray(got[0]))
+        np.testing.assert_array_equal(np.asarray(ref[1]),
+                                      np.asarray(got[1]))
+    else:
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+# ----------------------------------------------------------------------
+# refine flags shard invariance (hydro + MHD criteria)
+# ----------------------------------------------------------------------
+def test_refine_flags_slab_bitwise():
+    ndim, lvl = 2, 4
+    cfg = HydroStatic(ndim=ndim, gamma=1.4)
+    bc = BoundarySpec.periodic(ndim)
+    n = 1 << lvl
+    shape = (n,) * ndim
+    ncell = n ** ndim
+    u = _sedov_like(ncell, cfg.nvar, ndim, seed=2)
+    mesh = oct_mesh(jax.devices())
+    spec = DS.build_slab_spec(mesh, lvl, ndim, shape, ncell,
+                              _kinds(bc))
+    eg = (0.05, 0.05, -1.0)
+    fls = (1e-10, 1e-10, 1e-10)
+    ref = K.dense_refine_flags(u, None, None, eg, fls, shape, bc, cfg,
+                               dx=1.0 / n)
+    fn = partial(K._flags_fn(cfg), err_grad=eg, floors=fls, spatial0=0,
+                 cfg=cfg)
+    got = jax.jit(partial(DS.dense_flags_slab, spec=spec, flags_fn=fn,
+                          twotondim=2 ** ndim))(u)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_mhd_flags_slab_bitwise():
+    from ramses_tpu.mhd import uniform as mu
+    from ramses_tpu.mhd.amr import _mhd_grad_flags
+    from ramses_tpu.mhd.core import MhdStatic
+
+    ndim, lvl = 2, 4
+    cfg = MhdStatic(ndim=ndim, gamma=1.4)
+    n = 1 << lvl
+    shape = (n,) * ndim
+    ncell = n ** ndim
+    rng = np.random.default_rng(3)
+    u = np.zeros((ncell, cfg.nvar), np.float32)
+    u[:, 0] = 1.0 + 0.1 * rng.random(ncell)
+    u[:, 4] = 1.0 + 0.1 * rng.random(ncell)      # E (mhd IP slot)
+    u[:, 5] = 0.1 * rng.standard_normal(ncell)   # B_left x
+    u = jnp.asarray(u)
+    eg = (0.05, 0.05, -1.0)
+    fls = (1e-10, 1e-10, 1e-10)
+    bc_kinds = ((0, 0),) * ndim
+
+    def global_flags(u_flat):
+        ud = jnp.moveaxis(K.rows_to_dense(u_flat, None, shape), -1, 0)
+        up = mu._pad(ud, ndim, bc_kinds, 1)
+        ok = _mhd_grad_flags(up, eg, fls, 0, cfg)
+        ok = ok[tuple(slice(1, -1) for _ in range(ndim))]
+        return K.dense_to_rows(ok, None, shape).reshape(
+            ncell // 2 ** ndim, 2 ** ndim)
+
+    mesh = oct_mesh(jax.devices())
+    spec = DS.build_slab_spec(mesh, lvl, ndim, shape, ncell, bc_kinds)
+    fn = partial(_mhd_grad_flags, eg=eg, fls=fls, spatial0=0, cfg=cfg)
+    ref = jax.jit(global_flags)(u)
+    got = jax.jit(partial(DS.dense_flags_slab, spec=spec, flags_fn=fn,
+                          twotondim=2 ** ndim))(u)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+# ----------------------------------------------------------------------
+# RT transport shard invariance
+# ----------------------------------------------------------------------
+def test_rt_transport_slab_bitwise():
+    from ramses_tpu.rt import m1
+
+    ndim, lvl = 2, 4
+    n = 1 << lvl
+    shape = (n,) * ndim
+    ncell = n ** ndim
+    ncols = 1 + ndim
+    rng = np.random.default_rng(4)
+    rad = jnp.asarray(rng.random((ncell, ncols)).astype(np.float64))
+    dt, dx, c_red = 1e-3, 1.0 / n, 1.0
+
+    def global_step(rows):
+        dense = K.rows_to_dense(rows, None, shape)
+        N, F = dense[..., 0], jnp.stack(
+            [dense[..., 1 + c] for c in range(ndim)])
+        N, F = m1.transport_step(N, F, dt, dx, c_red, ndim,
+                                 periodic=True)
+        cols = [N[..., None]] + [F[c][..., None] for c in range(ndim)]
+        return K.dense_to_rows(jnp.concatenate(cols, axis=-1), None,
+                               shape)
+
+    def local_fn(ext):
+        N, F = ext[..., 0], jnp.stack(
+            [ext[..., 1 + c] for c in range(ndim)])
+        N, F = m1.transport_step(N, F, dt, dx, c_red, ndim,
+                                 periodic=True)
+        cols = [N[..., None]] + [F[c][..., None] for c in range(ndim)]
+        out = jnp.concatenate(cols, axis=-1)
+        return out[tuple(slice(1, -1) for _ in range(ndim))]
+
+    mesh = oct_mesh(jax.devices())
+    spec = DS.build_slab_spec(mesh, lvl, ndim, shape, ncell,
+                              ((0, 0),) * ndim)
+    ref = jax.jit(global_step)(rad)
+    got = jax.jit(partial(DS.dense_apply_slab, spec=spec,
+                          local_fn=local_fn, ng=1))(rad)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+# ----------------------------------------------------------------------
+# full coarse step: mesh-of-1 sim vs mesh-of-8 sharded sim
+# ----------------------------------------------------------------------
+def test_sedov_step_shard_invariance():
+    """Complete-level 3D sedov: two coarse steps of the single-device
+    AmrSim vs the 8-device ShardedAmrSim (slab path), bitwise."""
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import params_from_string
+    from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+
+    nml = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "/",
+        "&AMR_PARAMS", "levelmin=3", "levelmax=3", "boxlen=1.0", "/",
+        "&INIT_PARAMS", "nregion=1", "region_type(1)='square'",
+        "d_region=1.0", "p_region=1.0", "/",
+        "&HYDRO_PARAMS", "riemann='hllc'", "/",
+        "&OUTPUT_PARAMS", "tend=0.01", "/",
+    ])
+    p1 = params_from_string(nml, ndim=3)
+    s1 = AmrSim(p1, dtype=jnp.float32)
+    p8 = params_from_string(nml, ndim=3)
+    s8 = ShardedAmrSim(p8, devices=jax.devices(), dtype=jnp.float32)
+    spec8 = s8._fused_spec()
+    assert spec8.slab and spec8.slab[0] is not None, \
+        "slab path did not engage on the 8-device mesh"
+    for _ in range(2):
+        dt = min(s1.coarse_dt(), s8.coarse_dt())
+        s1.step_coarse(dt)
+        s8.step_coarse(dt)
+    for l in s1.levels():
+        np.testing.assert_array_equal(np.asarray(s1.u[l]),
+                                      np.asarray(s8.u[l]))
+
+
+def test_multi_step_donation_no_warnings():
+    """The steady-state jits donate the state dict: compiling and
+    running them must not emit donation warnings, and threading the
+    returned state back in must work (buffers alias)."""
+    import warnings
+
+    from ramses_tpu.amr.hierarchy import (AmrSim, _fused_coarse_step,
+                                          _fused_multi_step)
+    from ramses_tpu.config import params_from_string
+
+    nml = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "/",
+        "&AMR_PARAMS", "levelmin=3", "levelmax=3", "boxlen=1.0", "/",
+        "&INIT_PARAMS", "nregion=1", "region_type(1)='square'",
+        "d_region=1.0", "p_region=1.0", "/",
+        "&OUTPUT_PARAMS", "tend=0.01", "/",
+    ])
+    sim = AmrSim(params_from_string(nml, ndim=3), dtype=jnp.float32)
+    spec = sim._fused_spec()
+    dt = jnp.asarray(1e-4, sim.dtype)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        u, dtn = _fused_coarse_step(sim.u, sim.dev, {}, dt, spec, None)
+        u, t, dtc, ndone = _fused_multi_step(
+            u, sim.dev, jnp.asarray(0.0), jnp.asarray(1e9),
+            dtn.astype(jnp.result_type(float)), spec, 4, None)
+        jax.block_until_ready(u)
+    donate_msgs = [str(w.message) for w in rec
+                   if "donat" in str(w.message).lower()]
+    assert not donate_msgs, donate_msgs
+    assert int(ndone) == 4

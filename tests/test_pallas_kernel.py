@@ -249,3 +249,55 @@ def test_fused_step_want_flux_matches_xla_dense_sweep():
         np.testing.assert_allclose(np.asarray(phi_k[d, 1]),
                                    np.asarray(f0[hi_ix]),
                                    rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("want_flux", [False, True])
+def test_fused_step_shard_relabel_parity(want_flux):
+    """Per-shard relabeled entry == unrelabeled interior kernel.
+
+    ``shard_axes`` gates to TPU, so drive ``fused_step_shard`` directly
+    in interpreter mode: original axis 0 (extent 128) takes the lane
+    role, axes 1/2 carry NG ghost slabs — the relabel the slab path
+    produces when z was cut first.  Tolerance (not bitwise): the
+    relabeled kernel sweeps directions in relabeled order.
+    """
+    from ramses_tpu.amr import kernels as K
+
+    cfg = _cfg("hllc")
+    loc = (128, 16, 16)
+    axes = (1, 2, 0)
+    rng = np.random.default_rng(7)
+    r = 1.0 + 0.3 * rng.random(loc)
+    v = 0.2 * rng.standard_normal((3,) + loc)
+    p_ = 0.5 + 0.2 * rng.random(loc)
+    e = p_ / (cfg.gamma - 1.0) + 0.5 * r * (v ** 2).sum(axis=0)
+    u = jnp.asarray(np.stack([r, r * v[0], r * v[1], r * v[2], e]),
+                    jnp.float32)
+    okf = jnp.asarray(rng.random(loc) < 0.1, jnp.float32)
+    dt = jnp.asarray(1e-3, jnp.float32)
+    dx = 1.0 / loc[0]
+    g = muscl.NGHOST
+    # shard-path block: ghosts on axes[0]/axes[1] only, lane axis bare
+    up, okp = u, okf
+    for ax in axes[:2]:
+        padw = [(g, g) if d == 1 + ax else (0, 0) for d in range(4)]
+        up = jnp.pad(up, padw, mode="wrap")
+        okp = jnp.pad(okp, [w for w in padw[1:]], mode="wrap")
+    out_k = pk.fused_step_shard(up, okp, dt, cfg, dx, loc, axes,
+                                want_flux=want_flux, interpret=True)
+    # reference: fully ghost-padded unrelabeled interior kernel
+    upf, okpf = u, okf
+    for ax in range(3):
+        padw = [(g, g) if d == 1 + ax else (0, 0) for d in range(4)]
+        upf = jnp.pad(upf, padw, mode="wrap")
+        okpf = jnp.pad(okpf, [w for w in padw[1:]], mode="wrap")
+    out_r = K.dense_interior_update(upf, okpf, dt, dx, loc, cfg,
+                                    ret_flux=want_flux)
+    du_k = out_k[0] if want_flux else out_k
+    du_r = out_r[0] if want_flux else out_r
+    np.testing.assert_allclose(np.asarray(du_k), np.asarray(du_r),
+                               rtol=2e-5, atol=2e-6)
+    if want_flux:
+        np.testing.assert_allclose(np.asarray(out_k[1]),
+                                   np.asarray(out_r[1]),
+                                   rtol=2e-5, atol=2e-6)

@@ -1,0 +1,80 @@
+"""Multi-chip dryrun with SPMD partitioner-health gating.
+
+Runs ``__graft_entry__.dryrun_multichip(n)`` in a subprocess (CPU
+host-device mesh), captures stderr, and counts XLA's "Involuntary full
+rematerialization" SPMD warnings — the signature of a global-view op
+the partitioner could only reshard by replicating the full tensor
+(MULTICHIP_r05 showed the complete-level dense sweep doing exactly
+that every coarse step).  Writes ``MULTICHIP_local.json`` with the
+same shape as the driver's ``MULTICHIP_*.json`` plus a top-level
+``remat_warnings`` count, and exits nonzero when the count is > 0 so
+CI fails loudly on a partitioner regression.
+
+Usage::
+
+    python tools/multichip.py [--devices N] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REMAT_MARK = "Involuntary full rematerialization"
+TAIL_BYTES = 8000
+
+
+def run_dryrun(n_devices: int, repo: str) -> dict:
+    """One subprocess dryrun; returns the result record."""
+    env = dict(os.environ)
+    # force the CPU backend even where an accelerator plugin's
+    # sitecustomize overrides JAX_PLATFORMS
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.setdefault("XLA_FLAGS", "")
+    code = (f"import __graft_entry__ as g; "
+            f"g.dryrun_multichip({n_devices})")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=1800)
+    stderr = proc.stderr or ""
+    tail = (proc.stdout or "")[-TAIL_BYTES:] + stderr[-TAIL_BYTES:]
+    remat = stderr.count(REMAT_MARK)
+    return {
+        "n_devices": n_devices,
+        "rc": proc.returncode,
+        "ok": proc.returncode == 0 and remat == 0,
+        "skipped": False,
+        "remat_warnings": remat,
+        "tail": tail,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--out", default="MULTICHIP_local.json")
+    args = ap.parse_args(argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = run_dryrun(args.devices, repo)
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"dryrun on {res['n_devices']} devices: rc={res['rc']} "
+          f"remat_warnings={res['remat_warnings']} -> {args.out}")
+    if res["rc"] != 0:
+        sys.stderr.write(res["tail"] + "\n")
+        return res["rc"]
+    if res["remat_warnings"]:
+        sys.stderr.write(
+            f"FAIL: {res['remat_warnings']} involuntary full "
+            "rematerialization warning(s) — a global-view op reached "
+            "the SPMD partitioner (see parallel/dense_slab.py)\n")
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,9 +79,13 @@ def main():
     t = {}
 
     # --- full fused coarse step (the steady-state unit of work) ------
-    t["fused_coarse_step"] = timeit(
-        lambda: _fused_coarse_step(sim.u, sim.dev, {}, dt, spec, None),
-        reps, _sync)
+    # the step jit donates its state argument, so thread the returned
+    # state through exactly like the evolve loop does
+    def _step():
+        out = _fused_coarse_step(sim.u, sim.dev, {}, dt, spec, None)
+        sim.u = out[0]
+        return out
+    t["fused_coarse_step"] = timeit(_step, reps, _sync)
 
     # --- per-component, exact live shapes ----------------------------
     lb = sim.lmin
