@@ -37,6 +37,13 @@ BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
 BENCH_ONLY=uniform|amr|mg|amr_poisson, BENCH_SUB_TIMEOUT,
 BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH.
+
+Each child writes a phase-marker heartbeat sidecar
+(BENCH_HEARTBEAT_<sub>.jsonl, format: ramses_tpu/telemetry/heartbeat.py);
+on a timeout the parent folds the child's last phase into the error
+object as ``phase_at_timeout`` — a hang in backend init, warmup, or the
+timed window each read differently instead of as four identical
+"sub-bench timed out" errors.
 """
 
 import json
@@ -50,6 +57,44 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 MARKER = "##BENCH_SUB##"
+
+
+def _hb_path(name):
+    return os.path.join(HERE, f"BENCH_HEARTBEAT_{name}.jsonl")
+
+
+def _read_phases(path):
+    """Inline reader for the heartbeat sidecar format
+    (ramses_tpu/telemetry/heartbeat.py): the parent must never import
+    ramses_tpu — the package __init__ may pull jax in."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _load_heartbeat_mod():
+    """Child-side loader of the canonical heartbeat module BY FILE PATH
+    so marking 'start' doesn't first import the ramses_tpu package
+    (whose compile-cache setup can import jax — the very phase the
+    heartbeat exists to time)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_bench_heartbeat",
+        os.path.join(HERE, "ramses_tpu", "telemetry", "heartbeat.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _load_baseline():
@@ -73,13 +118,14 @@ def measure_rtt(jnp, n=5):
     return float(np.median(ts))
 
 
-def bench_uniform(params, dtype, jnp):
+def bench_uniform(params, dtype, jnp, hb=lambda *a, **k: None):
     from ramses_tpu.driver import Simulation
     from ramses_tpu.grid.uniform import run_steps
 
     lvl = int(os.environ.get("BENCH_LEVEL", params.amr.levelmin))
     params.amr.levelmin = params.amr.levelmax = lvl
     sim = Simulation(params, dtype=dtype)
+    hb("init")
     nsteps = int(os.environ.get("BENCH_STEPS", "20"))
     u = sim.state.u
     t = jnp.asarray(0.0, jnp.float32)
@@ -89,6 +135,7 @@ def bench_uniform(params, dtype, jnp):
     # over a tunneled device)
     u1, t1, _ = run_steps(sim.grid, u, t, tend, nsteps)
     float(jnp.sum(u1[0]))
+    hb("warm")
     t0 = time.perf_counter()
     u2, t2, ndone = run_steps(sim.grid, u1, t1, tend, nsteps)
     float(jnp.sum(u2[0]))
@@ -103,7 +150,7 @@ def bench_uniform(params, dtype, jnp):
     }
 
 
-def bench_amr(params, dtype, jnp):
+def bench_amr(params, dtype, jnp, hb=lambda *a, **k: None):
     from ramses_tpu.amr.hierarchy import AmrSim
     from ramses_tpu.utils.timers import Timers
 
@@ -118,9 +165,15 @@ def bench_amr(params, dtype, jnp):
     params.refine.err_grad_d = 0.1
     params.refine.err_grad_p = 0.1
     sim = AmrSim(params, dtype=dtype)
+    # un-instrumented sims now default to NullTimers (telemetry's
+    # zero-overhead contract); this bench reads the growth-phase
+    # breakdown, so it opts back into live timers explicitly
+    sim.timers = Timers()
+    hb("init")
     # develop the blast until the refined shell is a real working set
     warm = int(os.environ.get("BENCH_AMR_WARM", "10"))
     sim.evolve(1e9, nstepmax=warm)       # compile + develop the blast
+    hb("warm")
     sim.timers.acc.clear()
     ttd = 2 ** sim.cfg.ndim
 
@@ -143,6 +196,7 @@ def bench_amr(params, dtype, jnp):
     sim.drain()
     wall = time.perf_counter() - t0
     sim.timers.stop()
+    hb("growth")
     growth_timers = {k: round(v, 3) for k, v in sim.timers.acc.items()}
 
     # instrumented pass: drain the device at every section switch so the
@@ -156,6 +210,7 @@ def bench_amr(params, dtype, jnp):
     sim.timers.stop()
     inst_timers = {k: round(v, 3) for k, v in sim.timers.acc.items()}
     sim.timers = Timers()
+    hb("instrumented")
 
     # steady-state: frozen tree -> static shapes, the whole window runs
     # as a handful of fused multi-step scans (zero host round-trips).
@@ -171,6 +226,7 @@ def bench_amr(params, dtype, jnp):
     sim.evolve(1e9, nstepmax=sim.nstep + nss)
     sim.drain()
     wss = time.perf_counter() - t0
+    hb("steady_state")
 
     # production cadence (VERDICT-r04 Weak #9): regrids back ON at the
     # per-step cadence, on the developed quasi-static blast — the
@@ -189,6 +245,7 @@ def bench_amr(params, dtype, jnp):
         sim.step_coarse(sim.coarse_dt())
     sim.drain()
     wprod = time.perf_counter() - t0
+    hb("production")
 
     # run-to-run determinism: the same 3 steps from the same state must
     # be BITWISE identical on this device (north-star "bitwise-stable")
@@ -204,6 +261,7 @@ def bench_amr(params, dtype, jnp):
     sim.evolve(1e9, nstepmax=sim.nstep + 3)
     bitwise = all(run1[l].tobytes() == np.asarray(sim.u[l]).tobytes()
                   for l in sim.levels())
+    hb("bitwise")
     return {
         "config": f"sedov3d AMR levelmin={lmin} levelmax={lmax}",
         # headline: all-in growth phase (every regrid + recompile cost)
@@ -230,7 +288,7 @@ def bench_amr(params, dtype, jnp):
     }
 
 
-def bench_amr_poisson(params, dtype, jnp):
+def bench_amr_poisson(params, dtype, jnp, hb=lambda *a, **k: None):
     """AMR Poisson: live PCG iterations/sec on the hierarchy (the
     'multigrid iters/sec' driver metric covering partial levels —
     multigrid_fine's role; uniform V-cycles are bench_mg)."""
@@ -243,7 +301,9 @@ def bench_amr_poisson(params, dtype, jnp):
     params.refine.err_grad_p = 0.1
     params.run.poisson = True
     sim = AmrSim(params, dtype=dtype)
+    hb("init")
     sim.evolve(1e9, nstepmax=6)          # compile + develop + warm start
+    hb("warm")
     nst = 4
     iters = 0
     t0 = time.perf_counter()
@@ -262,7 +322,7 @@ def bench_amr_poisson(params, dtype, jnp):
     }
 
 
-def bench_mg(dtype, jnp):
+def bench_mg(dtype, jnp, hb=lambda *a, **k: None):
     import numpy as np
 
     from ramses_tpu.poisson.solver import mg_solve, residual
@@ -277,6 +337,7 @@ def bench_mg(dtype, jnp):
     phi = mg_solve(rhs, dx, phi0=rhs * 0.0, ncycle=ncyc)
     float(jnp.sum(phi))    # hard sync (block_until_ready can return
                            # early over the tunneled device)
+    hb("warm")
 
     def run(reps):
         # feed phi*0 back as phi0: same problem (phi0 defaults to
@@ -328,23 +389,31 @@ SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35, "amr_poisson": 0.95}
 
 def run_sub_inproc(name):
     """Child-process entry: run ONE sub-bench, print its dict after MARKER."""
+    hb = _load_heartbeat_mod().Heartbeat.from_env()
+    hb.mark("start", sub=name)
+
     import jax
     import jax.numpy as jnp
+    hb.mark("import jax")
 
     from ramses_tpu.config import load_params
+    hb.mark("load params")
 
     dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16") else jnp.float32
     nml = os.path.join(HERE, "namelists", "sedov3d.nml")
     if name == "uniform":
-        d = bench_uniform(load_params(nml, ndim=3), dtype, jnp)
+        d = bench_uniform(load_params(nml, ndim=3), dtype, jnp,
+                          hb=hb.mark)
     elif name == "amr":
-        d = bench_amr(load_params(nml, ndim=3), dtype, jnp)
+        d = bench_amr(load_params(nml, ndim=3), dtype, jnp, hb=hb.mark)
     elif name == "mg":
-        d = bench_mg(dtype, jnp)
+        d = bench_mg(dtype, jnp, hb=hb.mark)
     elif name == "amr_poisson":
-        d = bench_amr_poisson(load_params(nml, ndim=3), dtype, jnp)
+        d = bench_amr_poisson(load_params(nml, ndim=3), dtype, jnp,
+                              hb=hb.mark)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
+    hb.mark("done")
     d["_device"] = str(jax.devices()[0].platform)
     d["_dtype"] = str(dtype.__name__)
     print(MARKER + json.dumps(d), flush=True)
@@ -407,6 +476,21 @@ def run_sub(name, deadline, weight=None):
                                    SUB_TIMEOUTS.get(name, 600)))
     if weight is None:
         weight = SUB_WEIGHTS.get(name, 0.5)
+    hb_path = _hb_path(name)
+    env = dict(os.environ, BENCH_HEARTBEAT_PATH=hb_path)
+
+    def _hb_diag():
+        """phase_at_timeout + recent phase trail from the child's
+        heartbeat sidecar — the diagnosis BENCH_r05's four identical
+        timeout errors lacked."""
+        phases = _read_phases(hb_path)
+        if not phases:
+            return {"phase_at_timeout": "no heartbeat (child never "
+                                        "started or sidecar unwritable)"}
+        return {"phase_at_timeout": phases[-1].get("phase"),
+                "phase_t_s": phases[-1].get("t_s"),
+                "heartbeat": phases[-5:]}
+
     last = None
     for attempt in (1, 2):
         remaining = deadline - time.monotonic()
@@ -415,21 +499,28 @@ def run_sub(name, deadline, weight=None):
                                      "exhausted", "attempt": attempt}
         timeout = min(ceiling, max(45.0, weight * remaining))
         try:
+            # stale sidecar from a previous attempt/run must not
+            # masquerade as this child's last phase
+            os.path.exists(hb_path) and os.remove(hb_path)
+        except OSError:
+            pass
+        try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--sub", name],
-                capture_output=True, text=True, timeout=timeout, cwd=HERE)
+                capture_output=True, text=True, timeout=timeout,
+                cwd=HERE, env=env)
             for line in reversed(r.stdout.splitlines()):
                 if line.startswith(MARKER):
                     return json.loads(line[len(MARKER):])
             tail = (r.stderr or r.stdout or "")[-2000:]
             last = {"error": f"sub-bench exited rc={r.returncode} "
                              f"without result", "tail": tail,
-                    "attempt": attempt}
+                    "attempt": attempt, **_hb_diag()}
             if not _backend_ish(tail):
                 return last
         except subprocess.TimeoutExpired:
             last = {"error": f"sub-bench timed out after {timeout:.0f}s",
-                    "attempt": attempt}
+                    "attempt": attempt, **_hb_diag()}
         except Exception:
             last = {"error": traceback.format_exc()[-2000:],
                     "attempt": attempt}

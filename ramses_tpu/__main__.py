@@ -155,6 +155,12 @@ def main(argv=None) -> int:
         guard = make_guard(sim)
         guard.run_guarded(lambda: sim.evolve(verbose=args.verbose,
                                              guard=guard))
+    # run-footer + output_timer breakdown (telemetry also closes via
+    # atexit, but a clean exit should flush before the interpreter
+    # teardown races the JSONL file handle)
+    tel = getattr(sim, "telemetry", None)
+    if tel is not None:
+        tel.close(sim)
     return 0
 
 

@@ -18,6 +18,7 @@ standard regrid-interval relaxation).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, NamedTuple, Optional
 
@@ -33,7 +34,9 @@ from ramses_tpu.config import Params
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init import regions
-from ramses_tpu.utils.timers import Timers
+from ramses_tpu.telemetry import make_telemetry, sim_run_info
+from ramses_tpu.telemetry import screen as telemetry_screen
+from ramses_tpu.utils.timers import NullTimers, Timers
 
 
 class _Cfg1:
@@ -298,9 +301,10 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
     return tuple(out)
 
 
-@partial(jax.jit, static_argnames=("spec", "nsteps"), donate_argnums=(0,))
+@partial(jax.jit, static_argnames=("spec", "nsteps", "trace"),
+         donate_argnums=(0,))
 def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int,
-                      cool_tables=None):
+                      cool_tables=None, trace: bool = False):
     """``nsteps`` hydro-only coarse steps as ONE device program
     (``lax.scan``), zero host round-trips between steps.
 
@@ -310,7 +314,10 @@ def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int,
 
     Steps past ``tend`` become no-ops (the ``run_steps`` active-flag
     pattern).  Only valid while the tree is frozen — callers chunk by
-    the regrid interval.  Returns (u, t, dt_next, n_done).
+    the regrid interval.  Returns (u, t, dt_next, n_done); with
+    ``trace=True`` (telemetry-instrumented runs) the scan also stacks
+    per-step ``(t_after, dt)`` so one summary fetch yields exact
+    per-coarse-step records without leaving the fused fast path.
     """
     def body(carry, _):
         u, t, dtc, ndone = carry
@@ -323,10 +330,13 @@ def _fused_multi_step(u, dev, t, tend, dt0, spec: FusedSpec, nsteps: int,
         t = jnp.where(active, t + dt, t)
         dtc = jnp.where(active, dtn.astype(dtc.dtype), dtc)
         ndone = ndone + jnp.where(active, 1, 0)
-        return (u, t, dtc, ndone), None
+        ys = (t, jnp.where(active, dt, 0.0)) if trace else None
+        return (u, t, dtc, ndone), ys
 
-    (u, t, dtc, ndone), _ = jax.lax.scan(
+    (u, t, dtc, ndone), hist = jax.lax.scan(
         body, (u, t, dt0, jnp.array(0)), None, length=nsteps)
+    if trace:
+        return u, t, dtc, ndone, hist
     return u, t, dtc, ndone
 
 
@@ -530,7 +540,12 @@ class AmrSim:
         # coarse steps (amr/amr_step.f90:100-123); our regrid is the
         # rebuild, so nremap maps onto its interval (>=1).
         self.regrid_interval = max(1, int(getattr(params.run, "nremap", 0)))
-        self.timers = Timers()
+        # telemetry recorder (&OUTPUT_PARAMS telemetry=): the shared
+        # no-op NULL when off.  Timers follow the same contract — an
+        # un-instrumented run makes zero label switches (instrumented
+        # passes, e.g. bench.py, install a real Timers explicitly).
+        self.telemetry = make_telemetry(params)
+        self.timers = Timers() if self.telemetry.enabled else NullTimers()
         # cosmology: supercomoving conformal-time integration
         # (``amr/update_time.f90``; aexp/hexp from the Friedmann tables)
         self.cosmo = None
@@ -1587,10 +1602,15 @@ class AmrSim:
             # sweep for the same reason); force a fresh evaluation
             self._dt_cache = None
 
-    def step_chunk(self, nsteps: int, tend: float) -> int:
+    def step_chunk(self, nsteps: int, tend: float, trace: bool = False):
         """Run up to ``nsteps`` hydro-only coarse steps in ONE device
         dispatch (``_fused_multi_step``); returns steps done.  Callers
-        guarantee no regrid is due inside the chunk."""
+        guarantee no regrid is due inside the chunk.
+
+        ``trace=True`` (telemetry-instrumented runs only): also return
+        per-step ``(t, dt)`` host arrays from the scan's stacked
+        outputs — one extra summary fetch, the fused program itself is
+        unchanged in structure."""
         assert not self.gravity and not self.pic
         spec = self._fused_spec()
         tdtype = jnp.result_type(float)
@@ -1600,16 +1620,23 @@ class AmrSim:
             dt0 = jnp.min(_fused_courant(self.u, self.dev, spec)) \
                 .astype(tdtype)
         with self.timers.section("hydro - godunov"):
-            u, t, dtn, ndone = _fused_multi_step(
+            out = _fused_multi_step(
                 self.u, self.dev, jnp.asarray(self.t, tdtype),
                 jnp.asarray(tend, tdtype), dt0, spec, nsteps,
-                self._cool_bundle())
+                self._cool_bundle(), trace=trace)
+            if trace:
+                u, t, dtn, ndone, hist = out
+            else:
+                u, t, dtn, ndone = out
             self.u = u
             self._dt_cache = dtn
         self.t = float(t)
         n = int(ndone)
         self.nstep += n
         self.dt_old = float(dtn)
+        if trace:
+            ts, dts = jax.device_get(hist)
+            return n, (ts[:n], dts[:n])
         return n
 
     def evolve(self, tend: float, nstepmax: int = 10 ** 9,
@@ -1618,6 +1645,14 @@ class AmrSim:
         :class:`ramses_tpu.utils.ops.OpsGuard` — signal/walltime/stop-file
         handling + the per-``ncontrol`` screen block."""
         ncontrol = max(1, int(self.params.run.ncontrol))
+        telem = self.telemetry
+        # verbose/telemetry are pure reporting: the chunked fast path
+        # stays eligible and reports from its summary (``trace``) —
+        # the old behaviour of dropping to the per-step slow path on
+        # ``verbose=True`` silently benchmarked a different program
+        instrumented = telem.enabled or verbose
+        if telem.enabled and not telem.run_info:
+            telem.run_info.update(sim_run_info(self))
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
             if guard is not None:
                 if not guard.check():
@@ -1643,19 +1678,34 @@ class AmrSim:
             # nstepmax) combination decomposes into the same handful of
             # compiled programs instead of compiling one per remainder
             chunk = 1 << (max(lim, 1).bit_length() - 1)
-            if not self.gravity and not self.pic and not verbose \
+            if not self.gravity and not self.pic \
                     and self.cosmo is None and self.sinks is None \
                     and self.tracer_x is None and self.movie is None \
                     and getattr(self, "rt_amr", None) is None \
                     and _patch.hook("source") is None and chunk > 1:
-                if self.step_chunk(chunk, tend) == 0:
+                if not instrumented:
+                    if self.step_chunk(chunk, tend) == 0:
+                        break
+                    continue
+                t0 = time.perf_counter()
+                n, (ts, dts) = self.step_chunk(chunk, tend, trace=True)
+                if n == 0:
                     break
+                wall = time.perf_counter() - t0
+                telem.record_chunk(self, ts, dts, n, wall)
+                if verbose:
+                    print(telemetry_screen.step_line(
+                        self, dt=float(dts[-1]), chunk=n))
                 continue
             dt = min(self.coarse_dt(), tend - self.t)
+            t0 = time.perf_counter() if instrumented else 0.0
             self.step_coarse(dt)
-            if verbose:
-                print(f"step {self.nstep} t={self.t:.5e} dt={dt:.3e} "
-                      f"octs={[self.tree.noct(l) for l in self.levels()]}")
+            if instrumented:
+                if telem.enabled:
+                    telem.record_step(
+                        self, dt=dt, wall_s=time.perf_counter() - t0)
+                if verbose:
+                    print(telemetry_screen.step_line(self, dt=dt))
 
     # ------------------------------------------------------------------
     # diagnostics

@@ -122,6 +122,11 @@ class OutputParams:
     walltime_hrs: float = -1.0
     minutes_dump: float = 1.0
     output_dir: str = "."
+    # structured run telemetry (ramses_tpu/telemetry): JSONL event-log
+    # path ('' = off — the zero-overhead default) and the coarse-step
+    # cadence of emitted records
+    telemetry: str = ""
+    telemetry_interval: int = 1
 
 
 @dataclass

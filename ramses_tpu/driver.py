@@ -24,6 +24,8 @@ from ramses_tpu.pm.coupling import PMSpec, run_steps_pm, total_density
 from ramses_tpu.pm.cosmology import Cosmology
 from ramses_tpu.pm.particles import ParticleSet
 from ramses_tpu.poisson.coupling import GravitySpec, gravity_field
+from ramses_tpu.telemetry import make_telemetry, sim_run_info
+from ramses_tpu.telemetry import screen as telemetry_screen
 
 
 @dataclass
@@ -233,6 +235,9 @@ class Simulation:
         # perf accounting (mus/pt of adaptive_loop.f90:204-212)
         self.cell_updates = 0
         self.wall_s = 0.0
+        # structured run telemetry (&OUTPUT_PARAMS telemetry=; the
+        # shared no-op NULL when off — zero-overhead contract)
+        self.telemetry = make_telemetry(params)
 
     @property
     def tend(self) -> float:
@@ -246,6 +251,9 @@ class Simulation:
         (signal dumps, stop_run file, walltime watchdog)."""
         st = self.state
         nstepmax = self.params.run.nstepmax
+        telem = self.telemetry
+        if telem.enabled:
+            telem.run_info.update(sim_run_info(self))
         from ramses_tpu import patch
         if patch.hook("source") is not None:
             # the source hook is documented at coarse-step cadence
@@ -281,6 +289,7 @@ class Simulation:
                         self._movie_next = st.nstep + self.movie_imov
                     continue
                 t0 = time.perf_counter()
+                hist = None
                 if (self.pspec.enabled or self.gspec.enabled
                         or self.cosmo is not None):
                     u, st.p, st.f, t, dt_old, ndone = run_steps_pm(
@@ -296,15 +305,37 @@ class Simulation:
                         self.grid, st.u, jnp.asarray(st.t, tdtype),
                         jnp.asarray(tout, tdtype), n,
                         self.cool_tables, self.cool_spec)
+                elif telem.enabled:
+                    # instrumented run: the scan additionally stacks
+                    # per-step (t, dt) so the event log gets one record
+                    # per coarse step from this single summary fetch —
+                    # the chunk stays one device program
+                    u, t, ndone, hist = run_steps(
+                        self.grid, st.u, jnp.asarray(st.t, tdtype),
+                        jnp.asarray(tout, tdtype), n, trace=True)
                 else:
                     u, t, ndone = run_steps(self.grid, st.u,
                                             jnp.asarray(st.t, tdtype),
                                             jnp.asarray(tout, tdtype), n)
                 u.block_until_ready()
-                self.wall_s += time.perf_counter() - t0
+                wall = time.perf_counter() - t0
+                self.wall_s += wall
                 ndone = int(ndone)
                 st.u, st.t, st.nstep = u, float(t), st.nstep + ndone
                 self.cell_updates += ndone * self.grid.ncell
+                if telem.enabled and ndone:
+                    if hist is not None:
+                        ts, dts = jax.device_get(hist)
+                        telem.record_chunk(self, ts[:ndone], dts[:ndone],
+                                           ndone, wall,
+                                           nstep_end=st.nstep)
+                    else:
+                        # pm/cool scans don't expose per-step history:
+                        # one aggregate record per dispatch
+                        telem.record_step(
+                            self, dt=(st.t - t_before) / ndone,
+                            wall_s=wall, steps=ndone, t=st.t,
+                            nstep=st.nstep, chunked=ndone)
                 self._source_passes(st.t - t_before)
                 if self.rt is not None and st.t > t_before:
                     st.u = self.rt.advance(st.u, st.t - t_before)
@@ -313,9 +344,9 @@ class Simulation:
                     self.movie.emit(self)
                     self._movie_next = st.nstep + self.movie_imov
                 if verbose:
-                    mus_pt = (1e6 * self.wall_s / max(self.cell_updates, 1))
-                    print(f"step {st.nstep:6d}  t={st.t:.6e} "
-                          f"mus/pt={mus_pt:.4f}")
+                    print(telemetry_screen.step_line(
+                        self, dt=((st.t - t_before) / ndone
+                                  if ndone else None), chunk=ndone))
                 if ndone == 0:
                     break
             if st.t < tout - ttol:
@@ -383,6 +414,11 @@ class Simulation:
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
+
+    def totals(self):
+        """Conservation audit (``check_cons``) over the active grid."""
+        from ramses_tpu.grid.uniform import totals as _totals
+        return _totals(self.state.u, self.cfg, self.dx)
 
     # ------------------------------------------------------------------
     # snapshot / restart (SURVEY.md §3.4, §5.4)

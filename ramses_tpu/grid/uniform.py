@@ -94,19 +94,23 @@ def cfl_dt(grid: UniformGrid, u):
     return compute_dt(u, None, grid.dx, grid.cfg)
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps"))
-def run_steps(grid: UniformGrid, u, t, tend, nsteps: int):
+@partial(jax.jit, static_argnames=("grid", "nsteps", "trace"))
+def run_steps(grid: UniformGrid, u, t, tend, nsteps: int,
+              trace: bool = False):
     """Advance up to ``nsteps`` steps entirely on device.
 
     dt is recomputed each step (``courant_fine``), clipped to land exactly
-    on ``tend``; steps past ``tend`` are no-ops.  Returns (u, t, n_done).
+    on ``tend``; steps past ``tend`` are no-ops.  Returns (u, t, n_done);
+    ``trace=True`` (telemetry-instrumented runs) additionally stacks
+    per-step ``(t_after, dt)`` scan outputs so the driver can emit one
+    record per coarse step from a single summary fetch.
 
     On the Pallas path the Courant reduction of the updated state comes
     out of the step kernel itself (free — the primitives are already in
     VMEM), so each iteration is exactly one kernel launch.
     """
     if _pallas_ok(grid, u.dtype):
-        return _run_steps_pallas(grid, u, t, tend, nsteps)
+        return _run_steps_pallas(grid, u, t, tend, nsteps, trace=trace)
 
     def body(carry, _):
         u, t, ndone = carry
@@ -117,15 +121,19 @@ def run_steps(grid: UniformGrid, u, t, tend, nsteps: int):
         u = jnp.where(active, un, u)
         t = jnp.where(active, t + dt, t)
         ndone = ndone + jnp.where(active, 1, 0)
-        return (u, t, ndone), None
+        ys = (t, jnp.where(active, dt, 0.0)) if trace else None
+        return (u, t, ndone), ys
 
-    (u, t, ndone), _ = jax.lax.scan(body, (u, t, jnp.array(0)), None,
-                                    length=nsteps)
+    (u, t, ndone), hist = jax.lax.scan(body, (u, t, jnp.array(0)), None,
+                                       length=nsteps)
+    if trace:
+        return u, t, ndone, hist
     return u, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps"))
-def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int):
+@partial(jax.jit, static_argnames=("grid", "nsteps", "trace"))
+def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int,
+                      trace: bool = False):
     from ramses_tpu.hydro import pallas_muscl as pk
 
     cfg = grid.cfg
@@ -145,10 +153,13 @@ def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int):
         t = jnp.where(active, t + dt, t)
         dtc = jnp.where(active, dtn, dtc)
         ndone = ndone + jnp.where(active, 1, 0)
-        return (u, t, ndone, dtc), None
+        ys = (t, jnp.where(active, dt, 0.0)) if trace else None
+        return (u, t, ndone, dtc), ys
 
-    (u, t, ndone, _), _ = jax.lax.scan(
+    (u, t, ndone, _), hist = jax.lax.scan(
         body, (u, t, jnp.array(0), dt0), None, length=nsteps)
+    if trace:
+        return u, t, ndone, hist
     return u, t, ndone
 
 

@@ -624,9 +624,9 @@ def _mhd_fused_courant(u, bf, dev, spec: FusedSpec, fg=None):
     return _mhd_courant_traced(u, bf, dev, spec, fg)
 
 
-@partial(jax.jit, static_argnames=("spec", "nsteps"))
+@partial(jax.jit, static_argnames=("spec", "nsteps", "trace"))
 def _mhd_fused_multi_step(u, bf, dev, t, tend, dt0, spec: FusedSpec,
-                          nsteps: int):
+                          nsteps: int, trace: bool = False):
     def body(carry, _):
         u, bf, t, dtc, ndone = carry
         dt = jnp.minimum(dtc, jnp.maximum(tend - t, 0.0))
@@ -640,10 +640,13 @@ def _mhd_fused_multi_step(u, bf, dev, t, tend, dt0, spec: FusedSpec,
         t = jnp.where(active, t + dt, t)
         dtc = jnp.where(active, dtn.astype(dtc.dtype), dtc)
         ndone = ndone + jnp.where(active, 1, 0)
-        return (u, bf, t, dtc, ndone), None
+        ys = (t, jnp.where(active, dt, 0.0)) if trace else None
+        return (u, bf, t, dtc, ndone), ys
 
-    (u, bf, t, dtc, ndone), _ = jax.lax.scan(
+    (u, bf, t, dtc, ndone), hist = jax.lax.scan(
         body, (u, bf, t, dt0, jnp.array(0)), None, length=nsteps)
+    if trace:
+        return u, bf, t, dtc, ndone, hist
     return u, bf, t, dtc, ndone
 
 
@@ -954,7 +957,7 @@ class MhdAmrSim(AmrSim):
         self.dt_old = float(dt)
         self.nstep += 1
 
-    def step_chunk(self, nsteps: int, tend: float) -> int:
+    def step_chunk(self, nsteps: int, tend: float, trace: bool = False):
         assert not self.gravity and not self.pic  # chunks are solver-only
         spec = self._fused_spec()
         tdtype = jnp.result_type(float)
@@ -964,15 +967,23 @@ class MhdAmrSim(AmrSim):
             dt0 = jnp.min(_mhd_fused_courant(
                 self.u, self.bfs, self.dev, spec)).astype(tdtype)
         with self.timers.section("hydro - godunov"):
-            u, bf, t, dtn, ndone = _mhd_fused_multi_step(
+            out = _mhd_fused_multi_step(
                 self.u, self.bfs, self.dev, jnp.asarray(self.t, tdtype),
-                jnp.asarray(tend, tdtype), dt0, spec, nsteps)
+                jnp.asarray(tend, tdtype), dt0, spec, nsteps,
+                trace=trace)
+            if trace:
+                u, bf, t, dtn, ndone, hist = out
+            else:
+                u, bf, t, dtn, ndone = out
             self.u, self.bfs = u, bf
             self._dt_cache = dtn
         self.t = float(t)
         n = int(ndone)
         self.nstep += n
         self.dt_old = float(dtn)
+        if trace:
+            ts, dts = jax.device_get(hist)
+            return n, (ts[:n], dts[:n])
         return n
 
     # ---- diagnostics ---------------------------------------------------

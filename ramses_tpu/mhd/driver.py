@@ -21,6 +21,8 @@ from ramses_tpu.config import Params, load_params
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.mhd import core, uniform as mu
 from ramses_tpu.mhd.core import IBX, IP, MhdStatic, NCOMP
+from ramses_tpu.telemetry import make_telemetry, sim_run_info
+from ramses_tpu.telemetry import screen as telemetry_screen
 
 
 def _region_mask(x, k, init, ndim):
@@ -117,6 +119,10 @@ class MhdSimulation:
         self.iout = 1
         self.cell_updates = 0
         self.wall_s = 0.0
+        self.telemetry = make_telemetry(params)
+
+    def mus_per_cell_update(self) -> float:
+        return 1e6 * self.wall_s / max(self.cell_updates, 1)
 
     def evolve(self, tend: Optional[float] = None, chunk: int = 16,
                nstepmax: int = 10 ** 9, verbose: bool = False,
@@ -126,23 +132,35 @@ class MhdSimulation:
             p.output.tout[-1] if p.output.tout else p.output.tend)
         tdtype = (jnp.float64 if jax.config.jax_enable_x64
                   else jnp.float32)
+        telem = self.telemetry
+        if telem.enabled:
+            telem.run_info.update(sim_run_info(self))
         while self.t < tend * (1.0 - 1e-12) and self.nstep < nstepmax:
             if guard is not None and not guard.check():
                 break
             n = min(chunk, nstepmax - self.nstep)
             t0 = time.perf_counter()
+            t_before = self.t
             u, bf, t, ndone = mu.run_steps(
                 self.grid, self.u, self.bf,
                 jnp.asarray(self.t, tdtype), jnp.asarray(tend, tdtype), n)
             u.block_until_ready()
-            self.wall_s += time.perf_counter() - t0
+            wall = time.perf_counter() - t0
+            self.wall_s += wall
             ndone = int(ndone)
             self.u, self.bf, self.t = u, bf, float(t)
             self.nstep += ndone
             self.cell_updates += ndone * self.grid.ncell
+            if telem.enabled and ndone:
+                telem.record_step(
+                    self, dt=(self.t - t_before) / ndone, wall_s=wall,
+                    steps=ndone, t=self.t, nstep=self.nstep,
+                    chunked=ndone)
             if verbose:
-                print(f"mhd step {self.nstep} t={self.t:.5e} "
-                      f"divb={float(self.max_divb()):.2e}")
+                print(telemetry_screen.step_line(
+                    self, dt=((self.t - t_before) / ndone
+                              if ndone else None), chunk=ndone,
+                    extra=f"divb={float(self.max_divb()):.2e}"))
             if ndone == 0:
                 break
 

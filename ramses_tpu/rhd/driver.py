@@ -14,6 +14,8 @@ from ramses_tpu.config import Params
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.rhd import core, uniform as ru
 from ramses_tpu.rhd.core import NCOMP, RhdStatic
+from ramses_tpu.telemetry import make_telemetry, sim_run_info
+from ramses_tpu.telemetry import screen as telemetry_screen
 
 
 def rhd_region_prims(xc, p: Params, cfg: RhdStatic):
@@ -87,6 +89,14 @@ class RhdSimulation:
                                           self.cfg), dtype=dtype)
         self.t = 0.0
         self.nstep = 0
+        # perf accounting (mus/pt, adaptive_loop.f90:204-212) — the
+        # hydro/mhd uniform drivers track the same pair
+        self.cell_updates = 0
+        self.wall_s = 0.0
+        self.telemetry = make_telemetry(params)
+
+    def mus_per_cell_update(self) -> float:
+        return 1e6 * self.wall_s / max(self.cell_updates, 1)
 
     def evolve(self, tend: Optional[float] = None, chunk: int = 16,
                nstepmax: int = 10 ** 9, verbose: bool = False,
@@ -96,21 +106,37 @@ class RhdSimulation:
             p.output.tout[-1] if p.output.tout else p.output.tend)
         tdtype = (jnp.float64 if jax.config.jax_enable_x64
                   else jnp.float32)
+        telem = self.telemetry
+        if telem.enabled:
+            telem.run_info.update(sim_run_info(self))
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
             if guard is not None and not guard.check():
                 break
             n = min(chunk, nstepmax - self.nstep)
+            t0 = time.perf_counter()
+            t_before = self.t
             u, t, ndone = ru.run_steps(
                 self.grid, self.u, jnp.asarray(self.t, tdtype),
                 jnp.asarray(tend, tdtype), n)
             u.block_until_ready()
+            wall = time.perf_counter() - t0
+            self.wall_s += wall
             ndone = int(ndone)
             self.u, self.t = u, float(t)
             self.nstep += ndone
+            self.cell_updates += ndone * self.grid.ncell
+            if telem.enabled and ndone:
+                telem.record_step(
+                    self, dt=(self.t - t_before) / ndone, wall_s=wall,
+                    steps=ndone, t=self.t, nstep=self.nstep,
+                    chunked=ndone)
             if verbose:
                 q = core.cons_to_prim(self.u, self.cfg)
-                print(f"rhd step {self.nstep} t={self.t:.4e} "
-                      f"lor_max={float(jnp.max(core.lorentz(q))):.3f}")
+                print(telemetry_screen.step_line(
+                    self, dt=((self.t - t_before) / ndone
+                              if ndone else None), chunk=ndone,
+                    extra=("lor_max="
+                           f"{float(jnp.max(core.lorentz(q))):.3f}")))
             if ndone == 0:
                 break
 

@@ -116,6 +116,11 @@ class RtSim:
         self.src_F = None
         self.t = 0.0
         self._step_fn = None
+        # RtSim is built from arrays, not Params, so telemetry attaches
+        # explicitly: ``sim.telemetry = make_telemetry(params)`` (or a
+        # host driver shares its recorder); default is the no-op NULL
+        from ramses_tpu.telemetry import NULL
+        self.telemetry = NULL
 
     @property
     def nHe(self):
@@ -237,6 +242,12 @@ class RtSim:
         if self.spec.full3:
             self.xHe2, self.xHe3 = xh2, xh3
         self.t += dt
+        if self.telemetry.enabled:
+            # substep census only — photon/ionization totals sync the
+            # device, so they stay in the amortized rt_stats audit
+            self.telemetry.record_event(
+                "rt_advance", t=float(self.t), dt=float(dt),
+                nsub=int(nsub), dt_sub=float(dt_sub))
 
     # diagnostics ------------------------------------------------------
     def ionized_volume(self) -> float:

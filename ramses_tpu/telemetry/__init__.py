@@ -1,0 +1,21 @@
+"""Run-telemetry subsystem: one recorder, three sinks.
+
+See :mod:`ramses_tpu.telemetry.recorder` for the design; drivers only
+need :func:`make_telemetry` (returns the shared no-op :data:`NULL`
+when &OUTPUT_PARAMS leaves telemetry off — the zero-overhead-off
+contract) and the :mod:`~ramses_tpu.telemetry.screen` formatting.
+"""
+
+from ramses_tpu.telemetry.recorder import (                # noqa: F401
+    NULL,
+    REQUIRED_STEP_KEYS,
+    SCHEMA_VERSION,
+    NullTelemetry,
+    Telemetry,
+    TelemetrySpec,
+    cell_updates_per_step,
+    compile_count,
+    make_telemetry,
+    mesh_census,
+    sim_run_info,
+)

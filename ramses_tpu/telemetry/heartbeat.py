@@ -1,0 +1,71 @@
+"""Phase-marker heartbeats for subprocess tools (bench, multichip).
+
+A heartbeat is an append-only JSONL sidecar the CHILD process writes
+one line to at every phase boundary; when the PARENT's hard timeout
+fires, the sidecar's last line says exactly where the child hung —
+turning BENCH_r05's four indistinguishable "sub-bench timed out"
+errors into ``phase_at_timeout: "backend init"`` diagnoses.
+
+Deliberately stdlib-only and side-effect free at import: the bench
+parent never imports jax, and ``ramses_tpu/__init__`` may pull jax in
+(compile-cache setup), so jax-free parents read the format with their
+own three-line loader (see ``bench.py``) while children and tools use
+this module.  Writes are single ``write()`` calls of one line, flushed
+— a reader never sees a torn record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Heartbeat:
+    """Append phase markers to ``path``; no-op when ``path`` is falsy."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path or ""
+        self._t0 = time.monotonic()
+
+    @classmethod
+    def from_env(cls, var: str = "BENCH_HEARTBEAT_PATH") -> "Heartbeat":
+        return cls(os.environ.get(var, ""))
+
+    def mark(self, phase: str, **fields: Any):
+        if not self.path:
+            return
+        rec = {"phase": str(phase),
+               "t_s": round(time.monotonic() - self._t0, 3)}
+        rec.update(fields)
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+        except OSError:
+            pass                    # a full disk must not kill the bench
+
+
+def read_phases(path: str) -> List[Dict[str, Any]]:
+    """All phase markers in the sidecar (unparsable lines skipped)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def last_phase(path: str) -> Optional[Dict[str, Any]]:
+    """The most recent phase marker, or None."""
+    phases = read_phases(path)
+    return phases[-1] if phases else None

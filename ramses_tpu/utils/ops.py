@@ -170,44 +170,12 @@ class OpsGuard:
 
 
     def screen_block(self, extra: str = "") -> str:
-        """The reference's per-ncontrol control line
-        (``adaptive_loop.f90:199-214`` + memory census)."""
-        sim = self.sim
-        octs = {l: sim.tree.noct(l) for l in sim.levels()} \
-            if hasattr(sim, "tree") else {}
-        line = (f" Main step={getattr(sim, 'nstep', 0):7d} "
-                f"t={getattr(sim, 't', 0.0):13.6e} "
-                f"dt={getattr(sim, 'dt_old', 0.0):11.4e} "
-                f"mem={self._max_rss:8.1f}M/{device_mb():8.1f}M")
+        """The reference's per-ncontrol control line — formatting lives
+        in the telemetry screen sink (:mod:`ramses_tpu.telemetry.
+        screen`); this wrapper keeps the guard's amortized-audit
+        cadence and RSS high-water state."""
+        from ramses_tpu.telemetry import screen as tscreen
         self._nblock += 1
         audit = (self._nblock - 1) % max(self.cons_every, 1) == 0
-        if hasattr(sim, "totals") and audit:
-            # conservation audit line (the reference's mcons/econs
-            # print, ``amr/update_time.f90`` output block) —
-            # amortized: totals() syncs the full device state
-            tot = np.asarray(sim.totals())
-            ie = getattr(getattr(sim, "cfg", None), "ienergy", None)
-            line += f" mcons={tot[0]:.6e}"
-            if ie is not None and ie < len(tot):
-                line += f" econs={tot[ie]:.6e}"
-        if hasattr(sim, "aexp_now") and sim.cosmo is not None:
-            line += f" a={sim.aexp_now():8.5f}"
-        bs = getattr(sim, "balance_stats", None)
-        if bs is not None:
-            # load-balance observability (the reference's load_balance
-            # screen report): per-device cost extrema + rebalance count
-            line += (f" lb[max/mean={bs.max_cost:.4g}/{bs.mean_cost:.4g}"
-                     f" imb={bs.imbalance:.3f}"
-                     f" nreb={getattr(sim, '_rebalance_count', 0)}]")
-        rt = getattr(sim, "rt_amr", None) or getattr(sim, "rt", None)
-        if rt is not None and hasattr(rt, "rt_stats") and audit:
-            # photon budget line (the reference's output_rt_stats,
-            # amr/amr_step.f90:467): total photons vs cumulative
-            # injected — the conservation ratio drops as gas absorbs
-            st = rt.rt_stats(sim)
-            line += (f" rt[N={st['photons']:.4e}"
-                     f" inj={st['injected']:.4e}"
-                     f" ratio={st['ratio']:.4f}]")
-        if octs:
-            line += f" octs={octs}"
-        return line + (" " + extra if extra else "")
+        return tscreen.control_block(self.sim, max_rss=self._max_rss,
+                                     audit=audit, extra=extra)

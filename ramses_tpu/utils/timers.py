@@ -43,6 +43,17 @@ class Timers:
     def stop(self):
         self.timer("stop")
 
+    def snapshot(self) -> Dict[str, float]:
+        """Accumulated seconds per label, including the still-running
+        portion of the active label, without switching labels.  The
+        telemetry recorder diffs consecutive snapshots to attribute
+        wallclock to phases per record."""
+        out = dict(self.acc)
+        if self._label is not None:
+            out[self._label] = out.get(self._label, 0.0) \
+                + (time.perf_counter() - self._t0)
+        return out
+
     @contextlib.contextmanager
     def section(self, label: str):
         prev = self._label
@@ -68,6 +79,28 @@ class Timers:
         if file is not None:
             print(out, file=file)
         return out
+
+
+class NullTimers(Timers):
+    """Zero-cost stand-in for un-instrumented runs.
+
+    The reference's timers are compiled in unconditionally; here a run
+    without telemetry must pay NOTHING — no ``perf_counter`` calls, no
+    label switches (the telemetry subsystem's zero-overhead-off
+    contract).  Drivers swap in a real :class:`Timers` only when
+    telemetry (or an explicit instrumentation pass, e.g. bench.py's
+    ``Timers(sync=sim.drain)``) asks for it.
+    """
+
+    def timer(self, label: str):
+        pass
+
+    @contextlib.contextmanager
+    def section(self, label: str):
+        yield
+
+    def snapshot(self) -> Dict[str, float]:
+        return {}
 
 
 GLOBAL = Timers()

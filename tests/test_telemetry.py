@@ -1,0 +1,368 @@
+"""Run-telemetry subsystem (ramses_tpu/telemetry/).
+
+Pins the subsystem's two contracts:
+
+  * instrumented runs get ONE JSONL record per coarse step carrying the
+    full schema (REQUIRED_STEP_KEYS) — including through the fused
+    ``step_chunk`` fast path, which must stay engaged (``verbose=True``
+    used to silently drop to the per-step slow path);
+  * un-instrumented runs pay ZERO overhead — no ``jax.device_get``,
+    NullTimers (no label switches), the shared no-op NULL recorder.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench
+
+from ramses_tpu.config import params_from_string
+from ramses_tpu.telemetry import (NULL, REQUIRED_STEP_KEYS, NullTelemetry,
+                                  Telemetry, TelemetrySpec)
+from ramses_tpu.telemetry import heartbeat as hb_mod
+from ramses_tpu.telemetry import screen as screen_mod
+from ramses_tpu.utils.timers import NullTimers, Timers
+
+pytestmark = pytest.mark.smoke
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+SEDOV2D = """
+&RUN_PARAMS
+hydro=.true.
+nstepmax={nstep}
+ncontrol=1
+/
+&AMR_PARAMS
+levelmin=4
+levelmax=5
+boxlen=1.0
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='point'
+x_center=0.5,0.5
+y_center=0.5,0.5
+length_x=10.0,1.0
+length_y=10.0,1.0
+exp_region=10.0,10.0
+d_region=1.0,0.0
+p_region=1e-5,0.1
+/
+&OUTPUT_PARAMS
+{output}
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+/
+&REFINE_PARAMS
+err_grad_p=0.1
+/
+"""
+
+
+def _amr_sim(tmp_path, nstep=6, telemetry=True):
+    from ramses_tpu.amr.hierarchy import AmrSim
+    out = (f"telemetry='{tmp_path}/run.jsonl'\ntelemetry_interval=1"
+           if telemetry else "tend=1.0")
+    p = params_from_string(SEDOV2D.format(nstep=nstep, output=out),
+                           ndim=2)
+    return AmrSim(p)
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------
+# JSONL schema roundtrip
+# ---------------------------------------------------------------------
+def test_jsonl_schema_one_record_per_coarse_step(tmp_path):
+    sim = _amr_sim(tmp_path, nstep=5)
+    assert sim.telemetry.enabled
+    assert isinstance(sim.timers, Timers) \
+        and not isinstance(sim.timers, NullTimers)
+    sim.evolve(1e9, nstepmax=5)
+    sim.telemetry.close(sim, print_timers=False)
+    recs = _records(tmp_path / "run.jsonl")
+    assert recs[0]["kind"] == "run_header"
+    assert recs[0]["schema_version"] == 1
+    assert recs[0]["run_info"]["driver"] == "AmrSim"
+    assert recs[-1]["kind"] == "run_footer"
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert len(steps) == sim.nstep == 5
+    assert [r["nstep"] for r in steps] == [1, 2, 3, 4, 5]
+    for r in steps:
+        missing = [k for k in REQUIRED_STEP_KEYS if k not in r]
+        assert not missing, missing
+        assert r["octs"], "per-level oct census must be present"
+        assert r["steps"] == 1
+    # phase wallclock must reach the records (timers are live)
+    assert any(r["phases_s"] for r in steps)
+    assert recs[-1]["records"] == 5
+    # a second close is a no-op, not a duplicate footer
+    sim.telemetry.close(sim, print_timers=False)
+    assert len(_records(tmp_path / "run.jsonl")) == len(recs)
+
+
+def test_telemetry_interval_coalesces(tmp_path):
+    tel = Telemetry(TelemetrySpec(path=str(tmp_path / "i.jsonl"),
+                                  interval=3))
+    sim = types.SimpleNamespace(nstep=0, t=0.0, dt_old=1e-3)
+    for i in range(7):
+        tel.record_step(sim, dt=1e-3, wall_s=0.5, nstep=i + 1,
+                        t=(i + 1) * 1e-3)
+    tel.close(print_timers=False)
+    steps = [r for r in _records(tmp_path / "i.jsonl")
+             if r["kind"] == "step"]
+    assert len(steps) == 2                 # 7 steps // interval 3
+    assert [r["steps"] for r in steps] == [3, 3]
+    # wallclock between emissions accumulates onto the emitted record
+    assert steps[0]["wall_s"] == pytest.approx(1.5)
+
+
+# ---------------------------------------------------------------------
+# the chunked fast path must stay engaged under verbose/telemetry
+# ---------------------------------------------------------------------
+def test_chunked_fast_path_survives_instrumentation(tmp_path, capsys):
+    sim = _amr_sim(tmp_path, nstep=8)
+    sim.regrid_interval = 0                # frozen tree: chunk-eligible
+
+    def boom(dt):
+        raise AssertionError(
+            "instrumentation forced the per-step slow path")
+
+    sim.step_coarse = boom
+    sim.evolve(1e9, nstepmax=8, verbose=True)
+    sim.telemetry.close(sim, print_timers=False)
+    steps = [r for r in _records(tmp_path / "run.jsonl")
+             if r["kind"] == "step"]
+    # per-step records reconstructed from the chunk's scan summary
+    assert len(steps) == sim.nstep == 8
+    assert all(r.get("chunked", 0) > 1 for r in steps)
+    assert [r["nstep"] for r in steps] == list(range(1, 9))
+    # strictly advancing time, positive dt — real per-step values, not
+    # a smeared aggregate
+    ts = [r["t"] for r in steps]
+    assert all(b > a for a, b in zip(ts, ts[1:]))
+    assert all(r["dt"] > 0 for r in steps)
+    out = capsys.readouterr().out
+    assert "chunk=" in out                 # verbose line from the sink
+
+
+# ---------------------------------------------------------------------
+# zero overhead when off
+# ---------------------------------------------------------------------
+def test_zero_overhead_when_off(tmp_path, monkeypatch):
+    import jax
+
+    sim = _amr_sim(tmp_path, nstep=16, telemetry=False)
+    assert sim.telemetry is NULL
+    assert isinstance(sim.timers, NullTimers)
+    sim.regrid_interval = 0
+    sim.evolve(1e9, nstepmax=4)            # warm the fused chunk
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    sim.evolve(1e9, nstepmax=sim.nstep + 8)
+    assert calls["n"] == 0, \
+        "un-instrumented evolve must not add device fetches"
+
+
+def test_null_telemetry_is_shared_noop():
+    assert isinstance(NULL, NullTelemetry)
+    assert NULL.enabled is False
+    NULL.record_step(None, dt=1.0)
+    NULL.record_chunk(None, [], [], 0, 0.0, nstep_end=3)
+    NULL.record_event("x", a=1)
+    NULL.warn("w")
+    NULL.close(None, print_timers=False)   # all no-ops, no raises
+
+
+# ---------------------------------------------------------------------
+# timers: sync-mode attribution
+# ---------------------------------------------------------------------
+def test_timers_sync_attributes_drain_to_enqueuing_label(monkeypatch):
+    from ramses_tpu.utils import timers as tmod
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(
+        tmod, "time", types.SimpleNamespace(
+            perf_counter=lambda: clock["t"]))
+
+    def drain():                            # a 5s device drain
+        clock["t"] += 5.0
+
+    tm = tmod.Timers(sync=drain)
+    tm.timer("hydro")
+    clock["t"] += 1.0                       # 1s of host work under hydro
+    tm.timer("regrid")                      # drain runs BEFORE the switch
+    clock["t"] += 2.0
+    tm.stop()
+    # the 5s drain is work hydro ENQUEUED: it must land on hydro, not
+    # on whichever section happens to block next
+    assert tm.acc["hydro"] == pytest.approx(6.0)
+    assert tm.acc["regrid"] == pytest.approx(7.0)
+
+
+def test_timers_snapshot_includes_active_label(monkeypatch):
+    from ramses_tpu.utils import timers as tmod
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(
+        tmod, "time", types.SimpleNamespace(
+            perf_counter=lambda: clock["t"]))
+    tm = tmod.Timers()
+    tm.timer("a")
+    clock["t"] += 2.0
+    snap = tm.snapshot()                    # no label switch
+    assert snap["a"] == pytest.approx(2.0)
+    assert tm._label == "a" and tm.acc == {}
+
+
+# ---------------------------------------------------------------------
+# screen sink
+# ---------------------------------------------------------------------
+class _FakeTree:
+    def noct(self, l):
+        return {4: 64, 5: 120}[l]
+
+
+def test_control_block_golden():
+    sim = types.SimpleNamespace(
+        nstep=12, t=0.5, dt_old=1e-3, tree=_FakeTree(),
+        levels=lambda: [4, 5], balance_stats=None)
+    line = screen_mod.control_block(sim, max_rss=100.0, dev_mb=50.0,
+                                    audit=False)
+    assert line == (" Main step=     12 t= 5.000000e-01 dt= 1.0000e-03 "
+                    "mem=   100.0M/    50.0M octs={4: 64, 5: 120}")
+
+
+def test_step_line_chunk_and_extra():
+    sim = types.SimpleNamespace(nstep=7, t=0.25)
+    line = screen_mod.step_line(sim, dt=2e-3, chunk=8, extra="x=1")
+    assert line == "step      7  t=2.500000e-01 dt=2.000e-03 chunk=8 x=1"
+
+
+# ---------------------------------------------------------------------
+# warning capture
+# ---------------------------------------------------------------------
+def test_warning_capture_folds_into_records(tmp_path):
+    import warnings
+
+    prev = warnings.showwarning
+    tel = Telemetry(TelemetrySpec(path=str(tmp_path / "w.jsonl")))
+    tel.install_warning_capture()
+    try:
+        warnings.warn("arrays REPLICATE on every device")
+        sim = types.SimpleNamespace(nstep=1, t=0.0)
+        tel.record_step(sim, dt=1e-3)
+    finally:
+        tel.close(print_timers=False)
+    assert warnings.showwarning is prev    # close() restores the hook
+    steps = [r for r in _records(tmp_path / "w.jsonl")
+             if r["kind"] == "step"]
+    assert any("REPLICATE" in w["msg"]
+               for r in steps for w in r.get("warnings", []))
+
+
+# ---------------------------------------------------------------------
+# report tool
+# ---------------------------------------------------------------------
+def test_report_renders_markdown(tmp_path):
+    src = tmp_path / "r.jsonl"
+    with open(src, "w") as f:
+        f.write(json.dumps({"kind": "run_header", "schema_version": 1,
+                            "telemetry_interval": 1,
+                            "run_info": {"driver": "AmrSim",
+                                         "ndim": 2}}) + "\n")
+        f.write(json.dumps({"kind": "step", "nstep": 1, "t": 1e-3,
+                            "dt": 1e-3, "steps": 1, "wall_s": 0.5,
+                            "phases_s": {"hydro": 0.4},
+                            "cell_updates": 1000,
+                            "mus_per_cell_update": 500.0,
+                            "octs": {"4": 64}, "rss_mb": 10.0,
+                            "device_mb": 1.0, "rss_hwm_mb": 10.0,
+                            "device_hwm_mb": 1.0, "recompiles": 2,
+                            "recompiles_total": 2}) + "\n")
+        f.write(json.dumps({"kind": "run_footer", "wall_s": 1.0,
+                            "records": 1, "recompiles_total": 2,
+                            "warnings_total": 0}) + "\n")
+    out = tmp_path / "r.md"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(HERE), "tools",
+                      "telemetry_report.py"),
+         str(src), "-o", str(out)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    md = out.read_text()
+    assert "# Telemetry report" in md
+    assert "| 1 | 1.000000e-03 |" in md    # the step row
+    assert "hydro" in md                   # phase table
+
+
+# ---------------------------------------------------------------------
+# heartbeats (bench sidecar)
+# ---------------------------------------------------------------------
+def test_heartbeat_roundtrip(tmp_path):
+    path = str(tmp_path / "hb.jsonl")
+    hb = hb_mod.Heartbeat(path)
+    hb.mark("start", sub="amr")
+    hb.mark("warm")
+    phases = hb_mod.read_phases(path)
+    assert [p["phase"] for p in phases] == ["start", "warm"]
+    assert phases[0]["sub"] == "amr"
+    assert hb_mod.last_phase(path)["phase"] == "warm"
+    # no-op heartbeat (unset env) never touches the filesystem
+    hb_mod.Heartbeat("").mark("x")
+
+
+def test_bench_timeout_reports_phase(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_hb_path",
+                        lambda name: str(tmp_path / f"hb_{name}.jsonl"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    def fake_run(cmd, **kw):
+        # the child got as far as warmup, then hung
+        with open(kw["env"]["BENCH_HEARTBEAT_PATH"], "w") as f:
+            f.write(json.dumps({"phase": "start", "t_s": 0.0}) + "\n")
+            f.write(json.dumps({"phase": "import jax",
+                                "t_s": 1.1}) + "\n")
+            f.write(json.dumps({"phase": "warm", "t_s": 3.2}) + "\n")
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    r = bench.run_sub("uniform", deadline=time.monotonic() + 1000.0)
+    assert "timed out" in r["error"]
+    assert r["phase_at_timeout"] == "warm"
+    assert r["phase_t_s"] == pytest.approx(3.2)
+    assert [p["phase"] for p in r["heartbeat"]][-1] == "warm"
+
+
+def test_bench_timeout_without_heartbeat(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_hb_path",
+                        lambda name: str(tmp_path / "never_written.jsonl"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    r = bench.run_sub("mg", deadline=time.monotonic() + 1000.0)
+    assert "no heartbeat" in r["phase_at_timeout"]

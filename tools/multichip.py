@@ -12,7 +12,15 @@ CI fails loudly on a partitioner regression.
 
 Usage::
 
+Also mirrors the result into a telemetry JSONL event log (run-header +
+``dryrun`` event + one ``xla_warning`` event per captured remat line)
+next to ``--out`` so ``tools/telemetry_report.py`` renders dryruns and
+runs from the same schema.
+
+Usage::
+
     python tools/multichip.py [--devices N] [--out PATH]
+                              [--telemetry PATH.jsonl]
 """
 
 from __future__ import annotations
@@ -27,8 +35,8 @@ REMAT_MARK = "Involuntary full rematerialization"
 TAIL_BYTES = 8000
 
 
-def run_dryrun(n_devices: int, repo: str) -> dict:
-    """One subprocess dryrun; returns the result record."""
+def run_dryrun(n_devices: int, repo: str):
+    """One subprocess dryrun; returns (result record, raw stderr)."""
     env = dict(os.environ)
     # force the CPU backend even where an accelerator plugin's
     # sitecustomize overrides JAX_PLATFORMS
@@ -50,16 +58,50 @@ def run_dryrun(n_devices: int, repo: str) -> dict:
         "skipped": False,
         "remat_warnings": remat,
         "tail": tail,
-    }
+    }, stderr
+
+
+def emit_telemetry(path: str, res: dict, stderr: str, repo: str):
+    """Mirror the dryrun result into a telemetry JSONL event log: a
+    run-header, one ``dryrun`` event, one ``xla_warning`` event per
+    rematerialization line XLA wrote to the subprocess's raw stderr
+    (C++ warnings never reach Python's ``warnings`` machinery — this
+    fold is how they land next to the step records CI plots)."""
+    sys.path.insert(0, repo)
+    from ramses_tpu.telemetry import Telemetry, TelemetrySpec
+    tel = Telemetry(TelemetrySpec(path=path),
+                    run_info={"driver": "multichip_dryrun",
+                              "ndev": res["n_devices"]})
+    for line in stderr.splitlines():
+        if REMAT_MARK in line:
+            tel.warn(line.strip(), source="xla:stderr")
+    tel.record_event("dryrun", n_devices=res["n_devices"],
+                     rc=res["rc"], ok=res["ok"],
+                     remat_warnings=res["remat_warnings"])
+    for line in stderr.splitlines():
+        if REMAT_MARK in line:
+            tel.record_event("xla_warning", msg=line.strip()[:500],
+                             source="xla:stderr")
+    tel.close(print_timers=False)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--out", default="MULTICHIP_local.json")
+    ap.add_argument("--telemetry", default=None,
+                    help="telemetry JSONL path (default: --out with a "
+                         ".jsonl suffix)")
     args = ap.parse_args(argv)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    res = run_dryrun(args.devices, repo)
+    res, stderr = run_dryrun(args.devices, repo)
+    tpath = args.telemetry or (
+        os.path.splitext(args.out)[0] + ".jsonl")
+    try:
+        emit_telemetry(tpath, res, stderr, repo)
+        res["telemetry"] = tpath
+    except Exception as e:      # the gate result must survive regardless
+        print(f"multichip: telemetry emit failed: {e}", file=sys.stderr)
     with open(args.out, "w") as f:
         json.dump(res, f, indent=1)
     print(f"dryrun on {res['n_devices']} devices: rc={res['rc']} "

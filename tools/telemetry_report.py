@@ -1,0 +1,177 @@
+"""Render a telemetry JSONL event log as a markdown report.
+
+Companion of :mod:`ramses_tpu.telemetry`: reads the file written by
+``&OUTPUT_PARAMS telemetry='run.jsonl'`` and produces the human/CI
+summary — run header, per-step table (nstep, t, dt, wall, µs/pt, octs,
+memory), aggregated phase breakdown, captured warnings, footer totals.
+Stdlib-only so CI can run it without the jax stack.
+
+Usage::
+
+    python tools/telemetry_report.py RUN.jsonl [-o REPORT.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    recs = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{ln}: bad JSONL record: {e}")
+    return recs
+
+
+def _fmt(v, spec: str = "") -> str:
+    if v is None:
+        return "-"
+    return format(v, spec) if spec else str(v)
+
+
+def _octs_str(octs: Dict[str, int]) -> str:
+    if not octs:
+        return "-"
+    return " ".join(f"{l}:{n}" for l, n in sorted(
+        octs.items(), key=lambda kv: int(kv[0])))
+
+
+def render(recs: List[Dict[str, Any]], source: str = "") -> str:
+    header = next((r for r in recs if r.get("kind") == "run_header"), {})
+    footer = next((r for r in recs if r.get("kind") == "run_footer"), {})
+    steps = [r for r in recs if r.get("kind") == "step"]
+    events = [r for r in recs
+              if r.get("kind") not in ("run_header", "run_footer", "step")]
+
+    out = ["# Telemetry report", ""]
+    if source:
+        out.append(f"Source: `{source}`")
+        out.append("")
+
+    info = header.get("run_info", {})
+    out.append("## Run")
+    out.append("")
+    out.append("| key | value |")
+    out.append("|---|---|")
+    out.append(f"| schema | {header.get('schema_version', '-')} |")
+    for k in ("driver", "ndev", "ndim", "levelmin", "levelmax",
+              "boxlen", "nvar"):
+        if k in info:
+            out.append(f"| {k} | {info[k]} |")
+    out.append(f"| interval | {header.get('telemetry_interval', '-')} |")
+    out.append(f"| step records | {len(steps)} |")
+    if footer:
+        out.append(f"| total wall [s] | {_fmt(footer.get('wall_s'))} |")
+        out.append(f"| recompiles | "
+                   f"{_fmt(footer.get('recompiles_total'))} |")
+        out.append(f"| compile time [s] | "
+                   f"{_fmt(footer.get('compile_s_total'))} |")
+        out.append(f"| RSS high-water [MiB] | "
+                   f"{_fmt(footer.get('rss_hwm_mb'))} |")
+        out.append(f"| device high-water [MiB] | "
+                   f"{_fmt(footer.get('device_hwm_mb'))} |")
+        out.append(f"| warnings | {_fmt(footer.get('warnings_total'))} |")
+    out.append("")
+
+    if steps:
+        out.append("## Steps")
+        out.append("")
+        out.append("| nstep | t | dt | wall [s] | µs/pt | octs "
+                   "| RSS [MiB] | dev [MiB] | recompiles |")
+        out.append("|---|---|---|---|---|---|---|---|---|")
+        for r in steps:
+            out.append(
+                f"| {r.get('nstep')} "
+                f"| {_fmt(r.get('t'), '.6e')} "
+                f"| {_fmt(r.get('dt'), '.3e')} "
+                f"| {_fmt(r.get('wall_s'), '.4f')} "
+                f"| {_fmt(r.get('mus_per_cell_update'), '.4f')} "
+                f"| {_octs_str(r.get('octs', {}))} "
+                f"| {_fmt(r.get('rss_mb'))} "
+                f"| {_fmt(r.get('device_mb'))} "
+                f"| {_fmt(r.get('recompiles'))} |")
+        out.append("")
+
+        # aggregated phase wallclock over all step records
+        phases: Dict[str, float] = {}
+        for r in steps:
+            for k, v in (r.get("phases_s") or {}).items():
+                phases[k] = phases.get(k, 0.0) + float(v)
+        if phases:
+            total = sum(phases.values()) or 1.0
+            out.append("## Phases")
+            out.append("")
+            out.append("| phase | time [s] | % |")
+            out.append("|---|---|---|")
+            for k, v in sorted(phases.items(), key=lambda kv: -kv[1]):
+                out.append(f"| {k} | {v:.4f} | {100 * v / total:.1f} |")
+            out.append("")
+
+        cons = [r["cons"] for r in steps if "cons" in r]
+        if cons:
+            last = cons[-1]
+            out.append("## Conservation")
+            out.append("")
+            out.append(f"- mass drift: {_fmt(last.get('mcons_drift'), '.3e')}"
+                       f" (over {len(cons)} audits)")
+            if "econs_drift" in last:
+                out.append("- energy drift: "
+                           f"{_fmt(last.get('econs_drift'), '.3e')}")
+            out.append("")
+
+    warns = []
+    for r in recs:
+        for w in r.get("warnings", []) or []:
+            warns.append(w)
+    if warns:
+        out.append("## Warnings")
+        out.append("")
+        for w in warns[:50]:
+            src = f" ({w['source']})" if w.get("source") else ""
+            out.append(f"- {w.get('msg', '')}{src}")
+        out.append("")
+
+    if events:
+        out.append("## Events")
+        out.append("")
+        kinds: Dict[str, int] = {}
+        for r in events:
+            kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+        for k, n in sorted(kinds.items()):
+            out.append(f"- {k}: {n}")
+        out.append("")
+
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("jsonl", help="telemetry JSONL event log")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write markdown here (default: stdout)")
+    args = ap.parse_args(argv)
+    recs = load_records(args.jsonl)
+    if not recs:
+        raise SystemExit(f"{args.jsonl}: no records")
+    md = render(recs, source=args.jsonl)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md + "\n")
+        print(f"wrote {args.out} ({len(recs)} records)")
+    else:
+        sys.stdout.write(md + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
