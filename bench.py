@@ -526,9 +526,12 @@ def run_sub(name, deadline, weight=None):
                     "attempt": attempt}
         if attempt == 1:
             # tunnel hiccups can outlast a short pause — but never
-            # sleep the budget away
-            time.sleep(min(30.0, max(0.0,
-                                     deadline - time.monotonic() - 60.0)))
+            # sleep the budget away; pacing shared with the namelist
+            # supervisor so both retry loops back off identically
+            from ramses_tpu.resilience.supervisor import backoff_delay
+            time.sleep(min(backoff_delay(attempt, base=30.0, cap=30.0),
+                           max(0.0,
+                               deadline - time.monotonic() - 60.0)))
     return last
 
 

@@ -38,6 +38,15 @@ def main(argv=None) -> int:
                     help="wall-clock budget in hours; the watchdog dumps "
                          "a restartable snapshot and stops before it "
                          "expires (amr/adaptive_loop.f90:216-226)")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="resume from the newest manifest-valid "
+                         "checkpoint in the output dir (same as "
+                         "&RUN_PARAMS auto_resume=.true.)")
+    ap.add_argument("--max-attempts", type=int, default=1,
+                    help="supervised retry-with-resume: on an "
+                         "interrupted or failed run, rebuild from the "
+                         "latest valid checkpoint and continue, up to "
+                         "this many attempts (exponential backoff)")
     args = ap.parse_args(argv)
 
     import jax.numpy as jnp
@@ -70,16 +79,40 @@ def main(argv=None) -> int:
                         walltime_s=(args.walltime * 3600.0
                                     if args.walltime else None))
 
-    if solver == "rhd":
-        if args.amr or params.amr.levelmax > params.amr.levelmin:
-            from ramses_tpu.rhd.amr import RhdAmrSim
-            sim = RhdAmrSim(params, dtype=dtype)
-            tend = (params.output.tout[-1] if params.output.tout
-                    else params.output.tend)
+    # Supervised retry-with-resume (ramses_tpu/resilience): every branch
+    # is phrased as build(restart_dir)/drive(sim) and routed through the
+    # supervisor, which resolves nrestart/auto_resume on attempt 1 and
+    # rebuilds from the newest manifest-valid checkpoint on later ones.
+    if args.auto_resume:
+        params.run.auto_resume = True
+    supervised = (args.max_attempts > 1 or params.run.auto_resume
+                  or params.run.nrestart == -1)
+    attempts = max(2, args.max_attempts) if supervised else 1
+
+    def launch(build, drive, tend=None):
+        from ramses_tpu.resilience import supervisor as rsup
+        return rsup.supervise(build, drive, params,
+                              base_dir=params.output.output_dir,
+                              max_attempts=attempts, tend=tend)
+
+    def drive_amr(tend):
+        def drive(sim):
             guard = make_guard(sim)
             guard.run_guarded(lambda: sim.evolve(
                 tend, nstepmax=params.run.nstepmax,
                 verbose=args.verbose, guard=guard))
+        return drive
+
+    if solver == "rhd":
+        if args.amr or params.amr.levelmax > params.amr.levelmin:
+            from ramses_tpu.rhd.amr import RhdAmrSim
+            tend = (params.output.tout[-1] if params.output.tout
+                    else params.output.tend)
+            sim = launch(
+                lambda restart: (
+                    RhdAmrSim.from_snapshot(params, restart, dtype=dtype)
+                    if restart else RhdAmrSim(params, dtype=dtype)),
+                drive_amr(tend), tend=tend)
             print(f"rhd-amr t={sim.t:.5e} nstep={sim.nstep} "
                   f"lor_max={sim.max_lorentz():.3f} "
                   f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
@@ -87,74 +120,107 @@ def main(argv=None) -> int:
                      namelist_path=args.namelist)
         else:
             from ramses_tpu.rhd.driver import RhdSimulation
-            sim = RhdSimulation(params, dtype=dtype)
-            guard = make_guard(sim)
-            guard.run_guarded(lambda: sim.evolve(
-                nstepmax=params.run.nstepmax, verbose=args.verbose,
-                guard=guard))
+
+            def drive(sim):
+                guard = make_guard(sim)
+                guard.run_guarded(lambda: sim.evolve(
+                    nstepmax=params.run.nstepmax, verbose=args.verbose,
+                    guard=guard))
+
+            sim = launch(
+                lambda restart: (
+                    RhdSimulation.from_snapshot(params, restart,
+                                                dtype=dtype)
+                    if restart else RhdSimulation(params, dtype=dtype)),
+                drive)
             sim.dump(1, params.output.output_dir,
                      namelist_path=args.namelist)
     elif solver == "mhd":
         if args.amr or params.amr.levelmax > params.amr.levelmin:
             from ramses_tpu.mhd.amr import MhdAmrSim
-            sim = MhdAmrSim(params, dtype=dtype)
             tend = (params.output.tout[-1] if params.output.tout
                     else params.output.tend)
-            guard = make_guard(sim)
-            guard.run_guarded(lambda: sim.evolve(
-                tend, nstepmax=params.run.nstepmax,
-                verbose=args.verbose, guard=guard))
+            sim = launch(
+                lambda restart: (
+                    MhdAmrSim.from_snapshot(params, restart, dtype=dtype)
+                    if restart else MhdAmrSim(params, dtype=dtype)),
+                drive_amr(tend), tend=tend)
             print(f"mhd-amr t={sim.t:.5e} nstep={sim.nstep} "
                   f"max|divB|/max|B|*dx={sim.max_divb():.3e}")
             sim.dump(1, params.output.output_dir,
                      namelist_path=args.namelist)
         else:
             from ramses_tpu.mhd.driver import MhdSimulation
-            sim = MhdSimulation(params, dtype=dtype)
-            guard = make_guard(sim)
-            guard.run_guarded(lambda: sim.evolve(
-                nstepmax=params.run.nstepmax, verbose=args.verbose,
-                guard=guard))
+
+            def drive(sim):
+                guard = make_guard(sim)
+                guard.run_guarded(lambda: sim.evolve(
+                    nstepmax=params.run.nstepmax, verbose=args.verbose,
+                    guard=guard))
+
+            sim = launch(
+                lambda restart: (
+                    MhdSimulation.from_snapshot(params, restart,
+                                                dtype=dtype)
+                    if restart else MhdSimulation(params, dtype=dtype)),
+                drive)
             sim.dump(1, params.output.output_dir,
                      namelist_path=args.namelist)
     elif args.amr or params.amr.levelmax > params.amr.levelmin:
         from ramses_tpu.amr.hierarchy import AmrSim
-        particles = None
-        dense = None
-        if (params.run.cosmo and params.init.initfile
-                and params.init.filetype in ("grafic", "gadget")):
-            from ramses_tpu.driver import load_cosmo_ics
-            from ramses_tpu.hydro.core import HydroStatic
-            from ramses_tpu.pm.cosmology import Cosmology
-            cosmo = Cosmology.from_params(params)
-            n = 2 ** params.amr.levelmin
-            particles, dense = load_cosmo_ics(
-                params, cosmo, HydroStatic.from_params(params),
-                (n,) * params.ndim)
-        sim = AmrSim(params, dtype=dtype, particles=particles,
-                     init_dense_u=dense)
-        if sim.cosmo is not None and params.output.aout:
-            tend = float(sim.cosmo.tau_of_aexp(
-                min(params.output.aout[-1], 1.0)))
-        else:
-            tend = (params.output.tout[-1] if params.output.tout
+
+        def build(restart):
+            if restart:
+                return AmrSim.from_snapshot(params, restart, dtype=dtype)
+            particles = None
+            dense = None
+            if (params.run.cosmo and params.init.initfile
+                    and params.init.filetype in ("grafic", "gadget")):
+                from ramses_tpu.driver import load_cosmo_ics
+                from ramses_tpu.hydro.core import HydroStatic
+                from ramses_tpu.pm.cosmology import Cosmology
+                cosmo = Cosmology.from_params(params)
+                n = 2 ** params.amr.levelmin
+                particles, dense = load_cosmo_ics(
+                    params, cosmo, HydroStatic.from_params(params),
+                    (n,) * params.ndim)
+            return AmrSim(params, dtype=dtype, particles=particles,
+                          init_dense_u=dense)
+
+        def amr_tend(sim):
+            if sim.cosmo is not None and params.output.aout:
+                return float(sim.cosmo.tau_of_aexp(
+                    min(params.output.aout[-1], 1.0)))
+            return (params.output.tout[-1] if params.output.tout
                     else params.output.tend)
-        guard = make_guard(sim)
-        guard.run_guarded(lambda: sim.evolve(
-            tend, nstepmax=params.run.nstepmax, verbose=args.verbose,
-            guard=guard))
+
+        def drive(sim):
+            guard = make_guard(sim)
+            guard.run_guarded(lambda: sim.evolve(
+                amr_tend(sim), nstepmax=params.run.nstepmax,
+                verbose=args.verbose, guard=guard))
+
+        sim = launch(build, drive)
         if sim.cosmo is not None:
             print(f"cosmo-amr aexp={sim.aexp_now():.4f} nstep={sim.nstep} "
                   f"octs={[sim.tree.noct(l) for l in sim.levels()]}")
         sim.dump(1, params.output.output_dir, namelist_path=args.namelist)
     else:
         from ramses_tpu.driver import Simulation
-        sim = Simulation(params, dtype=dtype)
-        sim.on_output = lambda s, i: s.dump(
-            i, namelist_path=args.namelist)
-        guard = make_guard(sim)
-        guard.run_guarded(lambda: sim.evolve(verbose=args.verbose,
-                                             guard=guard))
+
+        def build(restart):
+            sim = (Simulation.from_snapshot(params, restart, dtype=dtype)
+                   if restart else Simulation(params, dtype=dtype))
+            sim.on_output = lambda s, i: s.dump(
+                i, namelist_path=args.namelist)
+            return sim
+
+        def drive(sim):
+            guard = make_guard(sim)
+            guard.run_guarded(lambda: sim.evolve(verbose=args.verbose,
+                                                 guard=guard))
+
+        sim = launch(build, drive)
     # run-footer + output_timer breakdown (telemetry also closes via
     # atexit, but a clean exit should flush before the interpreter
     # teardown races the JSONL file handle)

@@ -546,6 +546,14 @@ class AmrSim:
         # passes, e.g. bench.py, install a real Timers explicitly).
         self.telemetry = make_telemetry(params)
         self.timers = Timers() if self.telemetry.enabled else NullTimers()
+        # in-run fault recovery (&RUN_PARAMS max_step_retries): None
+        # when off — evolve then captures nothing and fetches nothing
+        from ramses_tpu.resilience.faultinject import FaultInjector
+        from ramses_tpu.resilience.stepguard import StepGuard
+        self._sguard = StepGuard.from_params(params,
+                                             telemetry=self.telemetry)
+        self._fault = FaultInjector.from_params(params)
+        self._guard_snap = None
         # cosmology: supercomoving conformal-time integration
         # (``amr/update_time.f90``; aexp/hexp from the Friedmann tables)
         self.cosmo = None
@@ -1639,6 +1647,118 @@ class AmrSim:
             return n, (ts[:n], dts[:n])
         return n
 
+    # ------------------------------------------------------------------
+    # in-run fault recovery (resilience/stepguard; &RUN_PARAMS
+    # max_step_retries) — shared by every AmrSim solver family via
+    # inheritance (sharded, MHD, RHD)
+    # ------------------------------------------------------------------
+    def _guard_capture(self):
+        """Retain a pre-step device-side copy of the advancing state.
+        The fused steps DONATE their input buffers, so the capture must
+        be real device copies (``.copy()`` — no host transfer), not
+        references; the tree/layouts are untouched by step_coarse/
+        step_chunk so host references suffice for everything else."""
+        snap = {
+            "u": {l: self.u[l].copy() for l in self.levels()},
+            "t": float(self.t), "nstep": int(self.nstep),
+            "dt_old": float(getattr(self, "dt_old", 0.0)),
+            "dt_cache": (float(self._dt_cache)
+                         if self._dt_cache is not None else None),
+        }
+        bf = getattr(self, "bf", None)
+        if isinstance(bf, dict):
+            snap["bf"] = {l: v.copy() for l, v in bf.items()}
+        self._guard_snap = snap
+
+    def _guard_restore(self):
+        """Reinstate the captured pre-step state with FRESH copies —
+        a retried step donates its inputs too, so handing out the
+        capture itself would die on the first retry."""
+        snap = self._guard_snap
+        self.u = {l: v.copy() for l, v in snap["u"].items()}
+        if "bf" in snap:
+            self.bf = {l: v.copy() for l, v in snap["bf"].items()}
+        self.t = snap["t"]
+        self.nstep = snap["nstep"]
+        self.dt_old = snap["dt_old"]
+        self._dt_cache = snap["dt_cache"]
+
+    def _probe_finite(self) -> bool:
+        """Did the step just taken stay finite?  Reads the dtnew the
+        next ``coarse_dt`` fetches anyway (the fused step's Courant
+        reduction touches every updated cell, so a NaN anywhere
+        poisons it); when source passes invalidated the cache, one
+        Courant fetch is paid and stashed back for coarse_dt."""
+        from ramses_tpu.resilience.stepguard import StepGuard
+        if self._dt_cache is None:
+            self._dt_cache = float(jnp.min(_fused_courant(
+                self.u, self.dev, self._fused_spec(),
+                self.fg if (self.gravity and self.fg) else None)))
+        return StepGuard.ok(float(self._dt_cache), self.t,
+                            getattr(self, "dt_old", 0.0))
+
+    def _recover_step(self, tend: float):
+        """Redo-step ladder: restore the retained capture, retry ONE
+        coarse step at dt halved per attempt, escalating the Riemann
+        solver to diffusive LLF from the second attempt
+        (``dataclasses.replace`` + spec rebuild; not sticky).  When the
+        ladder is spent: restore the clean state, emergency-dump it
+        (iout 999) and raise :class:`StepRetryExhausted`."""
+        import dataclasses as _dc
+
+        from ramses_tpu.resilience.stepguard import (StepGuard,
+                                                     StepRetryExhausted)
+        sg = self._sguard
+        if self._guard_snap is None:
+            raise StepRetryExhausted(
+                "non-finite state with no retained pre-step capture "
+                "(initial conditions already non-finite?)")
+        sg.record_trip(self)
+        cfg0 = self.cfg
+        can_escalate = hasattr(cfg0, "riemann")   # RhdStatic has none
+        try:
+            for attempt in range(1, sg.max_retries + 1):
+                self._guard_restore()
+                escalated = attempt >= 2 and can_escalate
+                if escalated:
+                    self.cfg = _dc.replace(cfg0, riemann="llf")
+                    self._spec = None
+                dt = min(self.coarse_dt(), tend - self.t) \
+                    * (0.5 ** attempt)
+                if not StepGuard.ok(dt) or dt <= 0.0:
+                    continue
+                sg.record_rollback(self, attempt, dt, escalated)
+                t0 = time.perf_counter()
+                try:
+                    self.step_coarse(dt)
+                except FloatingPointError:
+                    continue      # jax_debug_nans raised mid-retry
+                if self._probe_finite():
+                    sg.record_recovered(self, attempt)
+                    if self.telemetry.enabled:
+                        # one record for the recovered step, keeping
+                        # the step-record count identical to a clean
+                        # run's (the poisoned window emitted none)
+                        self.telemetry.record_step(
+                            self, dt=dt,
+                            wall_s=time.perf_counter() - t0)
+                    return
+        finally:
+            if self.cfg is not cfg0:
+                self.cfg = cfg0
+                self._spec = None
+        self._guard_restore()     # the abort path leaves a CLEAN state
+        out = None
+        try:
+            out = self.dump(999, str(self.params.output.output_dir))
+        except Exception as e:    # the abort itself must not be masked
+            print(f"resilience: emergency dump failed: {e}")
+        sg.record_abort(self, out)
+        raise StepRetryExhausted(
+            f"coarse step {self.nstep} non-finite after "
+            f"{sg.max_retries} retries (t={self.t:.6g}); last clean "
+            f"state dumped to {out}")
+
     def evolve(self, tend: float, nstepmax: int = 10 ** 9,
                verbose: bool = False, guard=None):
         """Advance to ``tend``.  ``guard``: optional
@@ -1653,6 +1773,7 @@ class AmrSim:
         instrumented = telem.enabled or verbose
         if telem.enabled and not telem.run_info:
             telem.run_info.update(sim_run_info(self))
+        sguard = self._sguard
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
             if guard is not None:
                 if not guard.check():
@@ -1678,17 +1799,40 @@ class AmrSim:
             # nstepmax) combination decomposes into the same handful of
             # compiled programs instead of compiling one per remainder
             chunk = 1 << (max(lim, 1).bit_length() - 1)
+            if self._fault is not None:
+                # pending step-indexed faults must land exactly at
+                # their target step, not at a chunk boundary (clamped
+                # to 1 this drops to the per-step path below)
+                chunk = self._fault.clamp_window(self.nstep, chunk)
             if not self.gravity and not self.pic \
                     and self.cosmo is None and self.sinks is None \
                     and self.tracer_x is None and self.movie is None \
                     and getattr(self, "rt_amr", None) is None \
                     and _patch.hook("source") is None and chunk > 1:
+                if sguard is not None:
+                    # capture BEFORE injection: the injected NaN plays
+                    # a transient solver fault, so the retained state
+                    # must be the clean pre-fault one
+                    self._guard_capture()
+                if self._fault is not None:
+                    self._fault.maybe_nan(self)
                 if not instrumented:
-                    if self.step_chunk(chunk, tend) == 0:
+                    n = self.step_chunk(chunk, tend)
+                    if sguard is not None \
+                            and not sguard.ok(self.t, self.dt_old):
+                        self._recover_step(tend)
+                        continue
+                    if n == 0:
                         break
                     continue
                 t0 = time.perf_counter()
                 n, (ts, dts) = self.step_chunk(chunk, tend, trace=True)
+                if sguard is not None \
+                        and not sguard.ok(self.t, self.dt_old):
+                    # rolled-back window: its poisoned records are
+                    # dropped; the recovery emits one step record
+                    self._recover_step(tend)
+                    continue
                 if n == 0:
                     break
                 wall = time.perf_counter() - t0
@@ -1698,8 +1842,19 @@ class AmrSim:
                         self, dt=float(dts[-1]), chunk=n))
                 continue
             dt = min(self.coarse_dt(), tend - self.t)
+            if sguard is not None:
+                self._guard_capture()
+            if self._fault is not None:
+                self._fault.maybe_nan(self)
             t0 = time.perf_counter() if instrumented else 0.0
             self.step_coarse(dt)
+            # trip detection BEFORE the telemetry record and before the
+            # next iteration's regrid rebuilds the tree on a poisoned
+            # state (which would make the capture unrestorable): the
+            # probe reads the dtnew the next coarse_dt fetches anyway
+            if sguard is not None and not self._probe_finite():
+                self._recover_step(tend)
+                continue
             if instrumented:
                 if telem.enabled:
                     telem.record_step(
@@ -1752,18 +1907,35 @@ class AmrSim:
         synchronously, the file writing happens on its background
         thread (the ``pario`` offload, SURVEY.md §2.10)."""
         import os
+        import shutil
 
         from ramses_tpu.io import snapshot as snapmod
         snap = snapmod.snapshot_from_amr(self, iout)
+        final = os.path.join(base_dir, f"output_{iout:05d}")
+        # driver extras (sink/stellar CSVs, clump catalogues, merger
+        # tree) are gathered synchronously into a staging dir that
+        # dump_all folds into the checkpoint BEFORE the manifest +
+        # atomic rename — writing them into the final directory
+        # afterwards would leave them outside the manifest
+        extra = final + ".extras.tmp"
+        if os.path.isdir(extra):
+            shutil.rmtree(extra)
+        self._dump_csv_extras(extra, iout)
+        self._clumpfind_pass(extra, iout)
+        if not os.path.isdir(extra) or not os.listdir(extra):
+            shutil.rmtree(extra, ignore_errors=True)
+            extra = None
+        keep = int(getattr(self.params.output, "checkpoint_keep", 0))
         if dumper is not None:
             dumper.submit(snap, iout, base_dir,
-                          namelist_path=namelist_path, ncpu=ncpu)
-            out = os.path.join(base_dir, f"output_{iout:05d}")
+                          namelist_path=namelist_path, ncpu=ncpu,
+                          extra_dir=extra, keep_last=keep)
+            out = final
         else:
             out = snapmod.dump_all(snap, iout, base_dir,
-                                   namelist_path=namelist_path, ncpu=ncpu)
-        self._dump_csv_extras(out, iout, dumper)
-        self._clumpfind_pass(out, iout)
+                                   namelist_path=namelist_path,
+                                   ncpu=ncpu, extra_dir=extra,
+                                   keep_last=keep)
         return out
 
     def _clumpfind_pass(self, out: str, iout: int):
@@ -1881,13 +2053,12 @@ class AmrSim:
             self._mergertree.write(
                 os.path.join(out, f"mergertree_{iout:05d}.txt"))
 
-    def _dump_csv_extras(self, out: str, iout: int, dumper=None):
-        """Sink/stellar CSV companions in the output directory
+    def _dump_csv_extras(self, out: str, iout: int):
+        """Sink/stellar CSV companions for the output
         (``pm/output_sink.f90``, ``pm/output_stellar.f90`` — the
-        reference oracle reads both).  Tiny host writes, so they skip
-        the async queue; the directory is pre-created so the CSVs never
-        wait on the background writer (dump_all's own makedirs is
-        exist_ok, so this cannot race it)."""
+        reference oracle reads both).  Tiny host writes into the
+        extras staging dir, folded under the checkpoint manifest by
+        dump_all before the atomic rename."""
         import os
 
         from ramses_tpu.io import snapshot as snapmod

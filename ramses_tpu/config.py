@@ -59,6 +59,15 @@ class RunParams:
     # level plus per-step finite checks in the ops guard, which dumps a
     # crash snapshot and stops the run on the first non-finite state
     debug_nan: bool = False
+    # fault-tolerant execution (ramses_tpu/resilience): auto_resume (or
+    # nrestart=-1) restarts from the newest manifest-valid checkpoint;
+    # max_step_retries>0 arms rollback-with-halved-dt on non-finite
+    # steps (redo-step semantics, LLF escalation on the 2nd retry);
+    # fault_inject is the deterministic test harness ('nan@K',
+    # 'sigterm@K', 'truncate:NAME')
+    auto_resume: bool = False
+    max_step_retries: int = 0
+    fault_inject: str = ""
 
 
 @dataclass
@@ -127,6 +136,9 @@ class OutputParams:
     # cadence of emitted records
     telemetry: str = ""
     telemetry_interval: int = 1
+    # keep only the newest N manifest-valid checkpoints (0 = keep all);
+    # rotation never touches pre-atomic output dirs without manifests
+    checkpoint_keep: int = 0
 
 
 @dataclass

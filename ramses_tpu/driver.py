@@ -238,6 +238,21 @@ class Simulation:
         # structured run telemetry (&OUTPUT_PARAMS telemetry=; the
         # shared no-op NULL when off — zero-overhead contract)
         self.telemetry = make_telemetry(params)
+        # in-run fault recovery (&RUN_PARAMS max_step_retries) + the
+        # deterministic fault-injection harness (fault_inject)
+        from ramses_tpu.resilience.faultinject import FaultInjector
+        from ramses_tpu.resilience.stepguard import StepGuard
+        self._sguard = StepGuard.from_params(params,
+                                             telemetry=self.telemetry)
+        self._fault = FaultInjector.from_params(params)
+
+    @property
+    def nstep(self) -> int:
+        return int(self.state.nstep)
+
+    @property
+    def t(self) -> float:
+        return float(self.state.t)
 
     @property
     def tend(self) -> float:
@@ -276,6 +291,10 @@ class Simulation:
                     # fused chunks may not run past the movie cadence
                     # (frames sample at chunk boundaries)
                     n = min(n, self.movie_imov)
+                if self._fault is not None:
+                    # pending step-indexed faults must land exactly at
+                    # their target step, not at a chunk boundary
+                    n = self._fault.clamp_window(int(st.nstep), n)
                 t_before = st.t
                 if self.rt is not None and self.params.run.static:
                     # frozen gas: pure RT evolution to the output time
@@ -288,6 +307,18 @@ class Simulation:
                         self.movie.emit(self)
                         self._movie_next = st.nstep + self.movie_imov
                     continue
+                # redo-step guard: on the plain-hydro dispatch (no
+                # donation — these are live references, not copies) the
+                # pre-step state is retained so a non-finite window can
+                # roll back; pm/cool scans expose no dt_scale hook and
+                # rely on OpsGuard's trap instead
+                plain = not (self.pspec.enabled or self.gspec.enabled
+                             or self.cosmo is not None
+                             or self.cool_tables is not None)
+                prev = ((st.u, st.t, st.nstep, st.dt_old)
+                        if self._sguard is not None and plain else None)
+                if self._fault is not None:
+                    self._fault.maybe_nan(self)
                 t0 = time.perf_counter()
                 hist = None
                 if (self.pspec.enabled or self.gspec.enabled
@@ -323,6 +354,11 @@ class Simulation:
                 ndone = int(ndone)
                 st.u, st.t, st.nstep = u, float(t), st.nstep + ndone
                 self.cell_updates += ndone * self.grid.ncell
+                if prev is not None and not self._sguard.ok(st.t):
+                    # non-finite window: roll back and redo at halved
+                    # dt (raises StepRetryExhausted after the ladder)
+                    ndone = self._retry_window(prev, tout, tdtype)
+                    hist = None
                 if telem.enabled and ndone:
                     if hist is not None:
                         ts, dts = jax.device_get(hist)
@@ -412,6 +448,57 @@ class Simulation:
             # in both drivers
             user_source(self, dt_chunk)
 
+    def _retry_window(self, prev, tout, tdtype) -> int:
+        """Redo-step ladder for a non-finite fused window: restore the
+        retained pre-step state, retry ONE step at halved dt (halving
+        again per attempt), escalating the Riemann solver to diffusive
+        LLF from the second attempt; emergency-dump the last clean
+        state and raise :class:`StepRetryExhausted` when the ladder is
+        spent.  Returns the number of steps recovered (for the
+        telemetry aggregate record)."""
+        import dataclasses as _dc
+
+        from ramses_tpu.resilience.stepguard import (StepGuard,
+                                                     StepRetryExhausted)
+        sg = self._sguard
+        st = self.state
+        u0, t0, nstep0, dt_old0 = prev
+        sg.record_trip(self)
+        grid0 = self.grid
+        try:
+            for attempt in range(1, sg.max_retries + 1):
+                st.u, st.t, st.nstep, st.dt_old = u0, t0, nstep0, dt_old0
+                escalated = attempt >= 2
+                if escalated:
+                    self.grid = _dc.replace(
+                        grid0, cfg=_dc.replace(grid0.cfg, riemann="llf"))
+                scale = 0.5 ** attempt
+                sg.record_rollback(self, attempt, scale, escalated)
+                tw0 = time.perf_counter()
+                u, t, ndone = run_steps(
+                    self.grid, u0, jnp.asarray(t0, tdtype),
+                    jnp.asarray(tout, tdtype), 1, dt_scale=scale)
+                u.block_until_ready()
+                tf = float(t)
+                if StepGuard.ok(tf):
+                    st.u, st.t, st.nstep = u, tf, nstep0 + int(ndone)
+                    self.cell_updates += int(ndone) * self.grid.ncell
+                    self.wall_s += time.perf_counter() - tw0
+                    sg.record_recovered(self, attempt)
+                    return int(ndone)
+        finally:
+            self.grid = grid0     # escalation is per-retry, not sticky
+        st.u, st.t, st.nstep, st.dt_old = u0, t0, nstep0, dt_old0
+        out = None
+        try:
+            out = self.dump(999, self.params.output.output_dir)
+        except Exception as e:    # the abort itself must not be masked
+            print(f"resilience: emergency dump failed: {e}")
+        sg.record_abort(self, out)
+        raise StepRetryExhausted(
+            f"step {nstep0} non-finite after {sg.max_retries} retries "
+            f"(t={t0:.6g}); last clean state dumped to {out}")
+
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
 
@@ -431,16 +518,22 @@ class Simulation:
         from ramses_tpu.io import snapshot as snapmod
         iout = iout if iout is not None else self.state.iout
         snap = snapmod.snapshot_from_uniform(self, iout)
-        out = snapmod.dump_all(snap, iout,
-                               base_dir or self.params.output.output_dir,
-                               namelist_path=namelist_path)
+        base = base_dir or self.params.output.output_dir
+        extra = None
         if self.turb is not None:
             # the OU spectral state + RNG key ride in every snapshot
             # (``turb/write_turb_fields.f90``) so a driven-turbulence
             # restart continues the SAME forcing realization instead of
-            # silently re-seeding
-            self.turb.save(os.path.join(out, "turb_fields.npz"))
-        return out
+            # silently re-seeding; staged alongside the file set so it
+            # lands under the checkpoint manifest, not after the rename
+            extra = os.path.join(base, f"output_{iout:05d}.extras.tmp")
+            os.makedirs(extra, exist_ok=True)
+            self.turb.save(os.path.join(extra, "turb_fields.npz"))
+        return snapmod.dump_all(
+            snap, iout, base, namelist_path=namelist_path,
+            extra_dir=extra,
+            keep_last=int(getattr(self.params.output,
+                                  "checkpoint_keep", 0)))
 
     @classmethod
     def from_snapshot(cls, params: Params, outdir: str,
@@ -465,7 +558,18 @@ class Simulation:
         sim.state.u = jnp.asarray(dense, dtype=dtype)
         sim.state.t = float(meta["t"])
         sim.state.nstep = int(meta["nstep"])
-        sim.state.iout = max(int(meta["iout"]), 1) + 1
+        iout_meta = int(meta["iout"])
+        if iout_meta < 900:
+            sim.state.iout = max(iout_meta, 1) + 1
+        else:
+            # emergency checkpoint (OpsGuard 900+, StepGuard 999): its
+            # iout is NOT an output-schedule index — derive the next
+            # pending output from the restored time so the resumed
+            # evolve() continues the tout schedule instead of indexing
+            # past its end
+            sim.state.iout = 1 + sum(
+                1 for tt in sim.output_times
+                if sim.state.t >= tt - 1e-12 * (abs(tt) + 1.0))
         if sim.turb is not None:
             import os
 
@@ -495,7 +599,29 @@ class Simulation:
 
 
 def run_namelist(path: str, ndim: int = 3, dtype=jnp.float32,
-                 verbose: bool = False) -> Simulation:
-    sim = Simulation(load_params(path, ndim=ndim), dtype=dtype)
+                 verbose: bool = False,
+                 max_attempts: int = 1) -> Simulation:
+    """Build-and-evolve from a namelist.  With ``max_attempts > 1`` or
+    ``&RUN_PARAMS auto_resume``/``nrestart=-1`` the run is supervised:
+    an interrupted attempt resumes from the newest manifest-valid
+    checkpoint with exponential backoff between attempts."""
+    params = load_params(path, ndim=ndim)
+    supervised = (max_attempts > 1 or params.run.auto_resume
+                  or params.run.nrestart == -1)
+    if supervised:
+        from ramses_tpu.resilience import supervisor as rsup
+
+        def build(restart):
+            if restart is not None:
+                return Simulation.from_snapshot(params, restart,
+                                                dtype=dtype)
+            return Simulation(params, dtype=dtype)
+
+        return rsup.supervise(build,
+                              lambda sim: sim.evolve(verbose=verbose),
+                              params,
+                              base_dir=params.output.output_dir,
+                              max_attempts=max(2, int(max_attempts)))
+    sim = Simulation(params, dtype=dtype)
     sim.evolve(verbose=verbose)
     return sim

@@ -94,9 +94,9 @@ def cfl_dt(grid: UniformGrid, u):
     return compute_dt(u, None, grid.dx, grid.cfg)
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps", "trace"))
+@partial(jax.jit, static_argnames=("grid", "nsteps", "trace", "dt_scale"))
 def run_steps(grid: UniformGrid, u, t, tend, nsteps: int,
-              trace: bool = False):
+              trace: bool = False, dt_scale: float = 1.0):
     """Advance up to ``nsteps`` steps entirely on device.
 
     dt is recomputed each step (``courant_fine``), clipped to land exactly
@@ -105,16 +105,21 @@ def run_steps(grid: UniformGrid, u, t, tend, nsteps: int,
     per-step ``(t_after, dt)`` scan outputs so the driver can emit one
     record per coarse step from a single summary fetch.
 
+    ``dt_scale < 1`` shrinks every Courant dt by that factor — the
+    redo-step retry ladder (resilience/stepguard) re-runs a tripped
+    window at halved dt, mirroring the reference's dtnew halving.
+
     On the Pallas path the Courant reduction of the updated state comes
     out of the step kernel itself (free — the primitives are already in
     VMEM), so each iteration is exactly one kernel launch.
     """
     if _pallas_ok(grid, u.dtype):
-        return _run_steps_pallas(grid, u, t, tend, nsteps, trace=trace)
+        return _run_steps_pallas(grid, u, t, tend, nsteps, trace=trace,
+                                 dt_scale=dt_scale)
 
     def body(carry, _):
         u, t, ndone = carry
-        dt = cfl_dt(grid, u)
+        dt = cfl_dt(grid, u) * dt_scale
         dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
         active = t < tend
         un = step(grid, u, jnp.where(active, dt, 0.0))
@@ -131,14 +136,14 @@ def run_steps(grid: UniformGrid, u, t, tend, nsteps: int,
     return u, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps", "trace"))
+@partial(jax.jit, static_argnames=("grid", "nsteps", "trace", "dt_scale"))
 def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int,
-                      trace: bool = False):
+                      trace: bool = False, dt_scale: float = 1.0):
     from ramses_tpu.hydro import pallas_muscl as pk
 
     cfg = grid.cfg
     dtmax = cfg.courant_factor * grid.dx / cfg.smallc
-    dt0 = compute_dt(u, None, grid.dx, cfg)
+    dt0 = compute_dt(u, None, grid.dx, cfg) * dt_scale
 
     def body(carry, _):
         u, t, ndone, dtc = carry
@@ -148,7 +153,7 @@ def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int,
         un, crt = pk.fused_step_padded(up, jnp.where(active, dt, 0.0),
                                        cfg, grid.dx, grid.shape,
                                        courant=True)
-        dtn = jnp.minimum(dtmax, crt[0, 0])
+        dtn = jnp.minimum(dtmax, crt[0, 0] * dt_scale)
         u = jnp.where(active, un, u)
         t = jnp.where(active, t + dt, t)
         dtc = jnp.where(active, dtn, dtc)

@@ -96,6 +96,21 @@ class AsyncDumper:
         self._q.join()
         self._raise_pending()
 
+    def drain(self) -> List[BaseException]:
+        """Block until every queued dump is on disk and RETURN (not
+        raise) the captured writer errors — the stop-path variant for
+        OpsGuard's SIGTERM/walltime handling, where an I/O failure must
+        be reported in the run footer but must not pre-empt the clean
+        shutdown itself."""
+        try:
+            self._q.join()
+        except Exception:
+            pass
+        with self._lock:
+            errs = list(self._errors)
+            self._errors.clear()
+        return errs
+
     def close(self):
         self.wait()
         if self._thread is not None and self._thread.is_alive():

@@ -78,13 +78,30 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
     ``split_hosts``: partition this process's shards into that many
     host files written CONCURRENTLY — on a real pod every process is
     one writer already; on a single-host test mesh this exercises the
-    same per-host decomposition and writer concurrency."""
+    same per-host decomposition and writer concurrency.
+
+    Single-process runs get the atomic-checkpoint treatment (stage to
+    ``pario_NNNNN.tmp/`` + ``manifest.json`` + rename); multi-process
+    runs write in place because the rename would race the other hosts'
+    writers — there the npz manifest from process 0 remains the only
+    completeness signal."""
     import jax
 
-    out = os.path.join(base_dir, f"pario_{iout:05d}")
-    os.makedirs(out, exist_ok=True)
-    arrs = _level_arrays(sim)
+    from ramses_tpu.resilience import checkpoint as ckpt
+
+    final = os.path.join(base_dir, f"pario_{iout:05d}")
     nproc = jax.process_count()
+    atomic = nproc == 1
+    if atomic:
+        out = final + ".tmp"
+        if os.path.isdir(out):
+            import shutil
+            shutil.rmtree(out)
+        os.makedirs(out)
+    else:
+        out = final
+        os.makedirs(out, exist_ok=True)
+    arrs = _level_arrays(sim)
     me = jax.process_index()
 
     lost = _unpersisted_state(sim)
@@ -155,6 +172,10 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
         th.join()
     if errs:
         raise errs[0]
+    if atomic:
+        out = ckpt.finalize_checkpoint(out, final, meta={
+            "kind": "pario", "iout": int(iout),
+            "nstep": int(sim.nstep), "t": float(sim.t)})
     return out
 
 

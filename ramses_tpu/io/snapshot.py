@@ -975,21 +975,38 @@ def split_snapshot(snap: Snapshot, ncpu: int) -> List[Snapshot]:
 
 def dump_all(snap: Snapshot, iout: int, base_dir: str = ".",
              namelist_path: Optional[str] = None,
-             write_grav: bool = False, ncpu: int = 1) -> str:
+             write_grav: bool = False, ncpu: int = 1,
+             extra_dir: Optional[str] = None,
+             keep_last: int = 0) -> str:
     """Write ``output_NNNNN/`` with the full reference file set; returns
     the output directory path (``dump_all``, ``amr/output_amr.f90:5-206``).
+
+    The file set is staged into ``output_NNNNN.tmp/``, hashed into a
+    ``manifest.json`` and atomically renamed into place — a crash
+    mid-dump never leaves a directory that validates as a checkpoint,
+    and a stale ``output_NNNNN/`` from an earlier run is replaced, not
+    merged.  ``extra_dir`` names a directory of driver extras (movie
+    CSVs, clump catalogs, turbulence phases) folded into the stage
+    before finalize so they are covered by the manifest too;
+    ``keep_last > 0`` rotates older manifest-valid checkpoints away.
 
     ``ncpu > 1`` writes one file set per domain (multi-domain
     checkpoint); the restore path re-concatenates any domain count onto
     any device count."""
+    from ramses_tpu.resilience import checkpoint as ckpt
+    from ramses_tpu.resilience import faultinject
+
     if ncpu > 1 and any(b != 1 for b in snap.base):
         # the domain split orders octs by Hilbert keys over a 2^l cube;
         # non-cubic coarse grids need the reference's multi-root walk
         raise NotImplementedError(
             "multi-domain output with nx,ny,nz != 1 is unsupported "
             f"(base={snap.base}, ncpu={ncpu})")
-    outdir = os.path.join(base_dir, f"output_{iout:05d}")
-    os.makedirs(outdir, exist_ok=True)
+    final = os.path.join(base_dir, f"output_{iout:05d}")
+    outdir = final + ".tmp"
+    if os.path.isdir(outdir):
+        shutil.rmtree(outdir)     # stale stage from a killed dump
+    os.makedirs(outdir)
     suffix = f"{iout:05d}"
     write_info_file(os.path.join(outdir, f"info_{suffix}.txt"), snap,
                     ncpu=ncpu)
@@ -1016,4 +1033,18 @@ def dump_all(snap: Snapshot, iout: int, base_dir: str = ".",
     write_header_file(os.path.join(outdir, f"header_{suffix}.txt"), snap)
     if namelist_path and os.path.exists(namelist_path):
         shutil.copy(namelist_path, os.path.join(outdir, "namelist.txt"))
-    return outdir
+    if extra_dir and os.path.isdir(extra_dir):
+        for name in sorted(os.listdir(extra_dir)):
+            shutil.move(os.path.join(extra_dir, name),
+                        os.path.join(outdir, name))
+        shutil.rmtree(extra_dir, ignore_errors=True)
+    out = ckpt.finalize_checkpoint(outdir, final, meta={
+        "kind": "output", "iout": int(iout), "nstep": int(snap.nstep),
+        "nstep_coarse": int(snap.nstep_coarse), "t": float(snap.t),
+        "aexp": float(snap.aexp), "ncpu": int(ncpu),
+        "dtold": None if snap.dtold is None
+        else [float(x) for x in np.asarray(snap.dtold)]})
+    if keep_last > 0:
+        ckpt.rotate_checkpoints(base_dir, keep_last, protect=out)
+    faultinject.post_dump(out)
+    return out

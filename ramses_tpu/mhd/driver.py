@@ -120,6 +120,11 @@ class MhdSimulation:
         self.cell_updates = 0
         self.wall_s = 0.0
         self.telemetry = make_telemetry(params)
+        from ramses_tpu.resilience.faultinject import FaultInjector
+        from ramses_tpu.resilience.stepguard import StepGuard
+        self._sguard = StepGuard.from_params(params,
+                                             telemetry=self.telemetry)
+        self._fault = FaultInjector.from_params(params)
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
@@ -139,6 +144,13 @@ class MhdSimulation:
             if guard is not None and not guard.check():
                 break
             n = min(chunk, nstepmax - self.nstep)
+            # redo-step guard: run_steps does not donate, so plain
+            # references retain the pre-window state for rollback
+            prev = ((self.u, self.bf, self.t, self.nstep)
+                    if self._sguard is not None else None)
+            if self._fault is not None:
+                n = self._fault.clamp_window(self.nstep, n)
+                self._fault.maybe_nan(self)
             t0 = time.perf_counter()
             t_before = self.t
             u, bf, t, ndone = mu.run_steps(
@@ -151,6 +163,8 @@ class MhdSimulation:
             self.u, self.bf, self.t = u, bf, float(t)
             self.nstep += ndone
             self.cell_updates += ndone * self.grid.ncell
+            if prev is not None and not self._sguard.ok(self.t):
+                ndone = self._retry_window(prev, tend, tdtype)
             if telem.enabled and ndone:
                 telem.record_step(
                     self, dt=(self.t - t_before) / ndone, wall_s=wall,
@@ -163,6 +177,56 @@ class MhdSimulation:
                     extra=f"divb={float(self.max_divb()):.2e}"))
             if ndone == 0:
                 break
+
+    def _retry_window(self, prev, tend, tdtype) -> int:
+        """Redo-step ladder after a non-finite window (RAMSES redo-step):
+        rollback, halve dt, escalate the 1D Riemann solver to LLF on the
+        second retry, emergency-dump + abort when exhausted."""
+        import dataclasses as _dc
+
+        from ramses_tpu.resilience.stepguard import (StepGuard,
+                                                     StepRetryExhausted)
+        sg = self._sguard
+        u0, bf0, t0, nstep0 = prev
+        sg.record_trip(self)
+        grid0 = self.grid
+        try:
+            for attempt in range(1, sg.max_retries + 1):
+                self.u, self.bf, self.t = u0, bf0, t0
+                self.nstep = nstep0
+                escalated = attempt >= 2
+                if escalated:
+                    self.grid = _dc.replace(
+                        grid0, cfg=_dc.replace(grid0.cfg, riemann="llf"))
+                scale = 0.5 ** attempt
+                sg.record_rollback(self, attempt, scale, escalated)
+                tw = time.perf_counter()
+                u, bf, t, ndone = mu.run_steps(
+                    self.grid, u0, bf0, jnp.asarray(t0, tdtype),
+                    jnp.asarray(tend, tdtype), 1, dt_scale=scale)
+                u.block_until_ready()
+                tf = float(t)
+                if StepGuard.ok(tf):
+                    ndone = int(ndone)
+                    self.u, self.bf, self.t = u, bf, tf
+                    self.nstep = nstep0 + ndone
+                    self.cell_updates += ndone * self.grid.ncell
+                    self.wall_s += time.perf_counter() - tw
+                    sg.record_recovered(self, attempt)
+                    return ndone
+        finally:
+            self.grid = grid0
+        self.u, self.bf, self.t = u0, bf0, t0
+        self.nstep = nstep0
+        out = None
+        try:
+            out = self.dump(999, str(self.params.output.output_dir))
+        except Exception as e:             # noqa: BLE001 - abort path
+            print(f"resilience: emergency dump failed: {e}")
+        sg.record_abort(self, out)
+        raise StepRetryExhausted(
+            f"mhd step at t={t0:.6g} still non-finite after "
+            f"{sg.max_retries} retries")
 
     def max_divb(self):
         return jnp.max(jnp.abs(core.div_b(
@@ -234,4 +298,56 @@ class MhdSimulation:
             units=units_fn(params), levelmin=lmin, nstep=self.nstep,
             nstep_coarse=self.nstep, tout=[params.output.tend or 0.0])
         return sm.dump_all(snap, iout, base_dir,
-                           namelist_path=namelist_path)
+                           namelist_path=namelist_path,
+                           keep_last=int(getattr(params.output,
+                                                 "checkpoint_keep", 0)))
+
+    @classmethod
+    def from_snapshot(cls, params: Params, outdir: str,
+                      dtype=jnp.float64) -> "MhdSimulation":
+        """Rebuild from a :meth:`dump` directory (auto-resume restore).
+
+        The MHD columns store B as left/right face pairs: the staggered
+        ``bf`` comes straight back from the left columns and the
+        cell-centred field from their average, so dump→restore round
+        trips exactly at file precision.  Velocity components beyond
+        ndim are not written by :meth:`output_vars` and restore as zero.
+        """
+        from ramses_tpu.amr.tree import cell_offsets
+        from ramses_tpu.io.restart import restore_tree_state
+        cfg = MhdStatic.from_params(params)
+        lmin = params.amr.levelmin
+        tree_og, rows_lv, meta, _parts = restore_tree_state(
+            outdir, cfg, lmin, to_cons=lambda q: q)   # raw output rows
+        if lmin not in rows_lv:
+            raise ValueError(f"snapshot has no level {lmin} data")
+        ndim = cfg.ndim
+        n = 1 << lmin
+        og = tree_og[lmin]
+        offs = cell_offsets(ndim)
+        cc = (2 * og[:, None, :] + offs[None, :, :]).reshape(-1, ndim)
+        rows = rows_lv[lmin]                          # [ncell, nvar_out]
+        dense = np.zeros((rows.shape[1],) + (n,) * ndim)
+        idx = tuple(cc[:, d] for d in range(ndim))
+        for iv in range(rows.shape[1]):
+            dense[iv][idx] = rows[:, iv]
+        ib = 1 + ndim                                 # first B_left column
+        bl = dense[ib:ib + 3]
+        br = dense[ib + 3:ib + 6]
+        q = np.zeros((cfg.nvar,) + (n,) * ndim)
+        q[0] = dense[0]
+        for d in range(ndim):
+            q[1 + d] = dense[1 + d]
+        for c in range(NCOMP):
+            q[IBX + c] = 0.5 * (bl[c] + br[c])
+        q[IP] = dense[ib + 6]
+        for s in range(cfg.npassive):
+            q[8 + s] = dense[ib + 7 + s]              # per-mass scalar
+        sim = cls(params, dtype=dtype)
+        sim.u = jnp.asarray(np.asarray(core.prim_to_cons(
+            jnp.asarray(q), cfg)), dtype=dtype)
+        sim.bf = jnp.asarray(bl, dtype=dtype)
+        sim.t = float(meta["t"])
+        sim.nstep = int(meta["nstep"])
+        sim.iout = max(int(meta["iout"]), 0) + 1
+        return sim

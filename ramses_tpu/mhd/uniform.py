@@ -369,12 +369,14 @@ def cfl_dt(grid: MhdGrid, u, bf):
 _jit_step = jax.jit(step, static_argnames=("grid",))
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps"))
-def run_steps(grid: MhdGrid, u, bf, t, tend, nsteps: int):
-    """Advance up to nsteps entirely on device (cf. hydro run_steps)."""
+@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+def run_steps(grid: MhdGrid, u, bf, t, tend, nsteps: int,
+              dt_scale: float = 1.0):
+    """Advance up to nsteps entirely on device (cf. hydro run_steps).
+    ``dt_scale < 1``: redo-step retry at reduced Courant dt."""
     def body(carry, _):
         u, bf, t, ndone = carry
-        dt = cfl_dt(grid, u, bf)
+        dt = cfl_dt(grid, u, bf) * dt_scale
         dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
         active = t < tend
         un, bfn = step(grid, u, bf, jnp.where(active, dt, 0.0))

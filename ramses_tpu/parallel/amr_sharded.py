@@ -38,6 +38,13 @@ ring ``ppermute`` halos, so the GSPMD partitioner never sees the
 bit-interleaved transpose that previously degenerated to involuntary
 full rematerialization (MULTICHIP_r05).  Levels outside that envelope
 keep the global-view sweep with compiler-inserted collectives.
+
+Fault tolerance is inherited from :class:`~ramses_tpu.amr.hierarchy.
+AmrSim` unchanged: atomic manifest-validated dumps, the
+``max_step_retries`` non-finite step guard (capture → probe → rollback
+with halved dt), and supervised auto-resume all operate on the
+host-side level dict, so the retained pre-step state re-shards exactly
+like fresh init when a retry or restore replays it onto the mesh.
 """
 
 from __future__ import annotations

@@ -1,0 +1,39 @@
+"""Fault-tolerant execution layer (ISSUE 5, SURVEY.md resilience).
+
+Three pillars, each independently usable:
+
+  1. **Atomic validated checkpoints** (:mod:`.checkpoint`):
+     ``dump_all``/``dump_pario`` stage into ``output_NNNNN.tmp/``,
+     fsync, write a ``manifest.json`` (per-file SHA-256 + sizes +
+     nstep/t/dt metadata), then ``os.replace``-rename to the final
+     name — a kill -9 mid-dump can never leave a directory that
+     validates as a checkpoint.  ``keep_last``-N rotation removes old
+     manifest-valid outputs only.
+
+  2. **Auto-resume** (:mod:`.checkpoint` ``resolve_restart_dir`` +
+     :mod:`.supervisor`): ``nrestart=-1`` or ``auto_resume=.true.``
+     scans the run directory for the newest manifest-valid checkpoint,
+     skipping corrupt/partial ones with a logged reason;
+     :func:`supervisor.supervise` wraps build-and-evolve in a bounded
+     retry-with-resume loop (exponential backoff) so preemption
+     mid-run resumes instead of failing.
+
+  3. **In-run numerical fault recovery** (:mod:`.stepguard`): with
+     ``&RUN_PARAMS max_step_retries > 0`` the drivers retain the
+     pre-step device state, check the scan-stacked (t, dt) summaries
+     they already fetch for finiteness, and on a trip roll back and
+     retry with halved dt (the reference's redo-step), escalating the
+     Riemann solver to diffusive LLF on the second retry, emergency
+     dumping + aborting when the ladder is exhausted.  Zero overhead
+     when off: no capture, no extra host↔device fetches.
+
+:mod:`.faultinject` makes all three deterministically testable
+(``&RUN_PARAMS fault_inject`` / env ``RAMSES_FAULT_INJECT``: NaN at
+step k, SIGTERM at step k, truncate a checkpoint file).
+"""
+
+from ramses_tpu.resilience.checkpoint import (  # noqa: F401
+    finalize_checkpoint, latest_valid_checkpoint, resolve_restart_dir,
+    rotate_checkpoints, validate_checkpoint)
+from ramses_tpu.resilience.stepguard import (  # noqa: F401
+    StepGuard, StepRetryExhausted)

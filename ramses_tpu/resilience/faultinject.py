@@ -1,0 +1,159 @@
+"""Deterministic fault injection for resilience testing.
+
+Spec syntax (``&RUN_PARAMS fault_inject='...'`` or env
+``RAMSES_FAULT_INJECT``), comma-separable:
+
+  ``nan@K``            poison one cell of the state with NaN just
+                       before the coarse step that starts at nstep K
+  ``sigterm@K``        deliver SIGTERM to this process at the guard
+                       check when nstep >= K
+  ``truncate:NAME``    after the next checkpoint finalize, truncate
+                       the file whose basename contains NAME (breaks
+                       its manifest hash — validation must catch it)
+
+Arming is strict: a fault fires only if the run is seen at
+``nstep < K`` first, so a resumed run that restarts at nstep >= K does
+not re-fire the same fault — exactly-once per logical run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+ENV_VAR = "RAMSES_FAULT_INJECT"
+
+
+def _parse(spec: str):
+    faults = []
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith("nan@"):
+            faults.append(("nan", int(part[4:])))
+        elif part.startswith("sigterm@"):
+            faults.append(("sigterm", int(part[8:])))
+        elif part.startswith("truncate:"):
+            faults.append(("truncate", part[len("truncate:"):]))
+        else:
+            raise ValueError(f"unknown fault_inject spec {part!r}")
+    return faults
+
+
+class FaultInjector:
+    """Holds the parsed fault list and per-fault armed/fired state."""
+
+    def __init__(self, spec: str):
+        self.faults = _parse(spec)
+        self._armed = {}          # idx -> bool (saw nstep < K)
+        self._fired = set()
+
+    @classmethod
+    def from_params(cls, params) -> Optional["FaultInjector"]:
+        spec = str(getattr(getattr(params, "run", None),
+                           "fault_inject", "") or "")
+        env = os.environ.get(ENV_VAR, "")
+        joined = ",".join(s for s in (spec, env) if s)
+        if not joined:
+            return None
+        inj = cls(joined)
+        return inj if inj.faults else None
+
+    def _should_fire(self, idx: int, kind: str, nstep: int) -> bool:
+        k = self.faults[idx][1]
+        if idx in self._fired:
+            return False
+        if idx not in self._armed:
+            # Strict arming: only a run first observed BEFORE the
+            # trigger step can fire — a resume at nstep >= K won't.
+            self._armed[idx] = nstep < k
+        if not self._armed[idx]:
+            return False
+        if nstep >= k:
+            self._fired.add(idx)
+            return True
+        return False
+
+    def maybe_nan(self, sim) -> bool:
+        """Poison one cell of ``sim``'s state with NaN when armed."""
+        nstep = int(getattr(sim, "nstep",
+                            getattr(getattr(sim, "state", None),
+                                    "nstep", 0)))
+        for i, (kind, _arg) in enumerate(self.faults):
+            if kind != "nan" or not self._should_fire(i, kind, nstep):
+                continue
+            import numpy as np
+            u = getattr(sim, "u", None)
+            if u is None and getattr(sim, "state", None) is not None:
+                u = sim.state.u
+            if isinstance(u, dict):
+                lv = min(u)
+                arr = u[lv]
+                u[lv] = arr.at[(0,) * (arr.ndim - 1) + (0,)].set(
+                    np.nan)
+            else:
+                poisoned = u.at[(0,) * u.ndim].set(np.nan)
+                if getattr(sim, "state", None) is not None and \
+                        getattr(sim.state, "u", None) is u:
+                    sim.state.u = poisoned
+                else:
+                    sim.u = poisoned
+            print(f" fault-inject: NaN poisoned at nstep={nstep}")
+            return True
+        return False
+
+    def clamp_window(self, nstep: int, n: int) -> int:
+        """Largest window size <= ``n`` that does not fuse past the
+        next pending step-indexed fault target.  The uniform drivers
+        run many coarse steps per device dispatch; without this clamp
+        a ``nan@K``/``sigterm@K`` could only land on chunk boundaries.
+        """
+        nstep = int(nstep)
+        for i, (kind, k) in enumerate(self.faults):
+            if kind not in ("nan", "sigterm") or i in self._fired:
+                continue
+            if self._armed.get(i) is False:
+                continue               # resumed past K: will never fire
+            if nstep < int(k):
+                n = min(n, int(k) - nstep)
+        return max(1, int(n))
+
+    def maybe_signal(self, nstep: int) -> bool:
+        """SIGTERM this process when armed (OpsGuard handles it)."""
+        for i, (kind, _arg) in enumerate(self.faults):
+            if kind != "sigterm" or not self._should_fire(i, kind,
+                                                          int(nstep)):
+                continue
+            print(f" fault-inject: SIGTERM at nstep={int(nstep)}")
+            os.kill(os.getpid(), signal.SIGTERM)
+            return True
+        return False
+
+
+# ---- post-dump truncation (module-level: dump may run on the
+#      AsyncDumper thread with no sim in reach) -----------------------
+
+_truncate_fired = set()
+
+
+def post_dump(outdir: str):
+    """Called by dump_all after finalize; truncates a matching file
+    once per process when a ``truncate:NAME`` fault is configured."""
+    spec = os.environ.get(ENV_VAR, "")
+    if "truncate:" not in spec:
+        return
+    for kind, name in _parse(spec):
+        if kind != "truncate" or name in _truncate_fired:
+            continue
+        for root, _dirs, files in os.walk(outdir):
+            for fn in files:
+                if name in fn and fn != "manifest.json":
+                    p = os.path.join(root, fn)
+                    sz = os.path.getsize(p)
+                    with open(p, "r+b") as f:
+                        f.truncate(max(0, sz // 2))
+                    _truncate_fired.add(name)
+                    print(f" fault-inject: truncated {p}")
+                    return

@@ -1,0 +1,105 @@
+"""Numerical step-guard: finiteness checks + rollback bookkeeping.
+
+The drivers already fetch scalar (t, dt) summaries per coarse step /
+chunk; :class:`StepGuard` checks those for finiteness (a NaN from the
+fused step poisons t within one iteration because the scan's active
+flag ``t < tend`` compares False for NaN, so stepping freezes and the
+NaN propagates to the returned time).  On a trip the driver restores
+its retained pre-step state and retries with halved dt — the
+reference's redo-step — escalating the Riemann solver to diffusive
+LLF on the second retry.  This module holds only the policy and the
+telemetry plumbing; the state capture/restore lives with each driver
+because capture semantics differ (donated fused buffers need device
+copies, the uniform path keeps plain refs).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+class StepRetryExhausted(RuntimeError):
+    """Raised after ``max_step_retries`` rollback attempts all failed;
+    the driver emergency-dumps the last clean state before raising."""
+
+
+class StepGuard:
+    """Retry policy + telemetry for in-run numerical fault recovery.
+
+    Stateless between steps apart from counters; ``ok()`` is the hot
+    check and touches only already-host scalars — arming the guard
+    adds no host<->device fetches.
+    """
+
+    def __init__(self, max_retries: int = 2, telemetry=None):
+        self.max_retries = int(max_retries)
+        self.telemetry = telemetry
+        self.rollbacks = 0      # retry attempts taken (all steps)
+        self.recovered = 0      # steps saved by the ladder
+        self.aborts = 0
+
+    @classmethod
+    def from_params(cls, params, telemetry=None) -> Optional["StepGuard"]:
+        """A guard when ``&RUN_PARAMS max_step_retries > 0``, else
+        None (zero-overhead off switch: drivers skip capture)."""
+        n = int(getattr(getattr(params, "run", None),
+                        "max_step_retries", 0) or 0)
+        if n <= 0:
+            return None
+        return cls(max_retries=n, telemetry=telemetry)
+
+    @staticmethod
+    def ok(*vals) -> bool:
+        """All host scalars finite (None entries skipped).  Non-finite
+        OR the guard's caller passing an already-NaN dt both trip."""
+        for v in vals:
+            if v is None:
+                continue
+            if not math.isfinite(float(v)):
+                return False
+        return True
+
+    # ---- telemetry / screen ------------------------------------------
+
+    def _emit(self, kind: str, **fields):
+        tel = self.telemetry
+        if tel is not None:
+            try:
+                tel.record_event(kind, **fields)
+            except Exception:
+                pass
+
+    def record_trip(self, sim, reason: str = "nonfinite"):
+        self._emit("fault", reason=reason,
+                   nstep=int(getattr(sim, "nstep", 0)),
+                   t=float(getattr(sim, "t", 0.0)))
+        print(f" step guard: non-finite state at nstep="
+              f"{int(getattr(sim, 'nstep', 0))} ({reason}); "
+              "rolling back")
+
+    def record_rollback(self, sim, attempt: int, dt: float,
+                        escalated: bool):
+        self.rollbacks += 1
+        self._emit("rollback", attempt=int(attempt), dt=float(dt),
+                   escalated=bool(escalated),
+                   nstep=int(getattr(sim, "nstep", 0)),
+                   t=float(getattr(sim, "t", 0.0)))
+        extra = ", riemann->llf" if escalated else ""
+        print(f" step guard: retry {attempt}/{self.max_retries} "
+              f"with dt={dt:.6e}{extra}")
+
+    def record_recovered(self, sim, attempt: int):
+        self.recovered += 1
+        self._emit("rollback_recovered", attempt=int(attempt),
+                   nstep=int(getattr(sim, "nstep", 0)),
+                   t=float(getattr(sim, "t", 0.0)))
+        print(f" step guard: step recovered on retry {attempt}")
+
+    def record_abort(self, sim, outdir: Optional[str]):
+        self.aborts += 1
+        self._emit("rollback_abort", nstep=int(getattr(sim, "nstep", 0)),
+                   t=float(getattr(sim, "t", 0.0)),
+                   emergency_dump=outdir or "")
+        print(" step guard: retry ladder exhausted"
+              + (f"; emergency dump -> {outdir}" if outdir else ""))

@@ -94,6 +94,11 @@ class RhdSimulation:
         self.cell_updates = 0
         self.wall_s = 0.0
         self.telemetry = make_telemetry(params)
+        from ramses_tpu.resilience.faultinject import FaultInjector
+        from ramses_tpu.resilience.stepguard import StepGuard
+        self._sguard = StepGuard.from_params(params,
+                                             telemetry=self.telemetry)
+        self._fault = FaultInjector.from_params(params)
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
@@ -113,6 +118,13 @@ class RhdSimulation:
             if guard is not None and not guard.check():
                 break
             n = min(chunk, nstepmax - self.nstep)
+            # redo-step guard: run_steps does not donate, so plain
+            # references retain the pre-window state for rollback
+            prev = ((self.u, self.t, self.nstep)
+                    if self._sguard is not None else None)
+            if self._fault is not None:
+                n = self._fault.clamp_window(self.nstep, n)
+                self._fault.maybe_nan(self)
             t0 = time.perf_counter()
             t_before = self.t
             u, t, ndone = ru.run_steps(
@@ -125,6 +137,8 @@ class RhdSimulation:
             self.u, self.t = u, float(t)
             self.nstep += ndone
             self.cell_updates += ndone * self.grid.ncell
+            if prev is not None and not self._sguard.ok(self.t):
+                ndone = self._retry_window(prev, tend, tdtype)
             if telem.enabled and ndone:
                 telem.record_step(
                     self, dt=(self.t - t_before) / ndone, wall_s=wall,
@@ -139,6 +153,44 @@ class RhdSimulation:
                            f"{float(jnp.max(core.lorentz(q))):.3f}")))
             if ndone == 0:
                 break
+
+    def _retry_window(self, prev, tend, tdtype) -> int:
+        """Redo-step ladder after a non-finite window: rollback and halve
+        dt per attempt (RhdStatic has no 1D Riemann knob, so there is no
+        LLF escalation rung), emergency-dump + abort when exhausted."""
+        from ramses_tpu.resilience.stepguard import (StepGuard,
+                                                     StepRetryExhausted)
+        sg = self._sguard
+        u0, t0, nstep0 = prev
+        sg.record_trip(self)
+        for attempt in range(1, sg.max_retries + 1):
+            self.u, self.t, self.nstep = u0, t0, nstep0
+            scale = 0.5 ** attempt
+            sg.record_rollback(self, attempt, scale, escalated=False)
+            tw = time.perf_counter()
+            u, t, ndone = ru.run_steps(
+                self.grid, u0, jnp.asarray(t0, tdtype),
+                jnp.asarray(tend, tdtype), 1, dt_scale=scale)
+            u.block_until_ready()
+            tf = float(t)
+            if StepGuard.ok(tf):
+                ndone = int(ndone)
+                self.u, self.t = u, tf
+                self.nstep = nstep0 + ndone
+                self.cell_updates += ndone * self.grid.ncell
+                self.wall_s += time.perf_counter() - tw
+                sg.record_recovered(self, attempt)
+                return ndone
+        self.u, self.t, self.nstep = u0, t0, nstep0
+        out = None
+        try:
+            out = self.dump(999, str(self.params.output.output_dir))
+        except Exception as e:             # noqa: BLE001 - abort path
+            print(f"resilience: emergency dump failed: {e}")
+        sg.record_abort(self, out)
+        raise StepRetryExhausted(
+            f"rhd step at t={t0:.6g} still non-finite after "
+            f"{sg.max_retries} retries")
 
     def prims(self):
         return np.asarray(core.cons_to_prim(self.u, self.cfg))
@@ -171,7 +223,9 @@ class RhdSimulation:
             nstep_coarse=int(self.nstep),
             tout=[params.output.tend or 0.0])
         return snapmod.dump_all(snap, iout, base_dir,
-                                namelist_path=namelist_path)
+                                namelist_path=namelist_path,
+                                keep_last=int(getattr(
+                                    params.output, "checkpoint_keep", 0)))
 
     @classmethod
     def from_snapshot(cls, params: Params, outdir: str,

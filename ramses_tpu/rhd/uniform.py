@@ -128,11 +128,13 @@ def cfl_dt(grid: RhdGrid, u):
 _jit_step = jax.jit(step, static_argnames=("grid",))
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps"))
-def run_steps(grid: RhdGrid, u, t, tend, nsteps: int):
+@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+def run_steps(grid: RhdGrid, u, t, tend, nsteps: int,
+              dt_scale: float = 1.0):
+    # dt_scale < 1: redo-step retry at reduced Courant dt
     def body(carry, _):
         u, t, ndone = carry
-        dt = cfl_dt(grid, u)
+        dt = cfl_dt(grid, u) * dt_scale
         dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
         active = t < tend
         un = step(grid, u, jnp.where(active, dt, 0.0))

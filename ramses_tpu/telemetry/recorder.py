@@ -172,6 +172,9 @@ class NullTelemetry:
     def record_event(self, kind, **fields):
         pass
 
+    def mark_resumed(self, outdir, attempt=1):
+        pass
+
     def warn(self, msg, source=""):
         pass
 
@@ -214,6 +217,8 @@ class Telemetry:
         self._warn_pending: List[Dict[str, str]] = []
         self._nwarn = 0
         self._prev_showwarning = None
+        self._append = False           # resume: keep prior attempts' log
+        self._event_counts: Dict[str, int] = {}
         _install_compile_listener()
 
     # -- sinks ---------------------------------------------------------
@@ -224,7 +229,8 @@ class Telemetry:
             d = os.path.dirname(self.spec.path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            self._fh = open(self.spec.path, "w")
+            self._fh = open(self.spec.path,
+                            "a" if self._append else "w")
             atexit.register(self.close)
             self._fh.write(json.dumps({
                 "kind": "run_header",
@@ -404,10 +410,22 @@ class Telemetry:
 
     def record_event(self, kind: str, **fields):
         """Free-form record (tool integrations: multichip dryruns,
-        bench summaries, XLA warning folds)."""
-        rec = {"kind": str(kind)}
+        bench summaries, resilience rollback/resume/fault events,
+        XLA warning folds)."""
+        k = str(kind)
+        self._event_counts[k] = self._event_counts.get(k, 0) + 1
+        rec = {"kind": k}
         rec.update(fields)
         self._write(rec)
+
+    def mark_resumed(self, outdir: str, attempt: int = 1):
+        """Flip the sink to append mode (must run before the first
+        write opens the file) and log a ``resume`` event — a supervised
+        restart extends the same JSONL log rather than truncating the
+        earlier attempts' records."""
+        self._append = True
+        self.record_event("resume", outdir=str(outdir),
+                          attempt=int(attempt))
 
     # -- end of run ----------------------------------------------------
     def close(self, sim=None, print_timers: bool = True):
@@ -430,6 +448,8 @@ class Telemetry:
             "device_hwm_mb": round(self._dev_hwm, 1),
             "warnings_total": self._nwarn,
         }
+        if self._event_counts:
+            footer["events"] = dict(self._event_counts)
         if sim is not None:
             footer["nstep"] = int(getattr(sim, "nstep", 0))
             footer["t"] = float(getattr(sim, "t", 0.0))

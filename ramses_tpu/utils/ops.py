@@ -58,11 +58,16 @@ class OpsGuard:
                  walltime_s: Optional[float] = None,
                  stop_file: str = "stop_run",
                  install_signals: bool = True,
-                 nan_check: Optional[bool] = None):
+                 nan_check: Optional[bool] = None,
+                 dumper=None):
         self.sim = sim
         self.base_dir = base_dir
         self.walltime_s = walltime_s
         self.stop_file = stop_file
+        # queued async snapshots must hit disk (manifests finalized)
+        # before a SIGTERM/walltime stop returns the allocation
+        self.dumper = dumper if dumper is not None \
+            else getattr(sim, "dumper", None)
         # NaN trap (&RUN_PARAMS debug_nan; SURVEY.md §5.2): cheap dt
         # check every step, full-state audit at the conservation cadence
         if nan_check is None:
@@ -109,28 +114,67 @@ class OpsGuard:
             return None
 
     # -- per-step hook --------------------------------------------------
-    def _nan_trapped(self) -> bool:
-        """True when the state went non-finite: cheap dt probe every
-        step, full leaf audit (a whole-device download) amortized to
-        every ``cons_every``-th check."""
+    def _nan_trapped(self) -> Optional[str]:
+        """Reason string when the state went unphysical, else None:
+        cheap dt probe every step (non-finite OR non-positive once the
+        run is under way — a dt that collapsed to zero stalls the run
+        as surely as a NaN), full leaf audit (a whole-device download)
+        amortized to every ``cons_every``-th check."""
         dt = float(getattr(self.sim, "dt_old", 0.0))
         if not np.isfinite(dt):
-            return True
+            return "nonfinite_dt"
+        if dt <= 0.0 and int(getattr(self.sim, "nstep", 0)) > 0:
+            return "nonpositive_dt"
         self._ncheck += 1
         if self._ncheck % max(self.cons_every, 1) == 0 \
                 and hasattr(self.sim, "totals"):
-            return not np.isfinite(np.asarray(
-                self.sim.totals())).all()
-        return False
+            if not np.isfinite(np.asarray(self.sim.totals())).all():
+                return "nonfinite_totals"
+        return None
+
+    def _record_fault(self, reason: str):
+        tel = getattr(self.sim, "telemetry", None)
+        if tel is not None:
+            try:
+                tel.record_event(
+                    "fault", reason=reason,
+                    nstep=int(getattr(self.sim, "nstep", 0)),
+                    t=float(getattr(self.sim, "t", 0.0)),
+                    dt=float(getattr(self.sim, "dt_old", 0.0)))
+            except Exception:
+                pass
+
+    def _drain_dumper(self):
+        """Flush queued async snapshots before a stop returns; report
+        writer failures into telemetry + screen rather than raising
+        past the clean-shutdown path."""
+        if self.dumper is None:
+            return
+        for e in self.dumper.drain():
+            print(f"ops: async snapshot write failed during stop: {e}")
+            tel = getattr(self.sim, "telemetry", None)
+            if tel is not None:
+                try:
+                    tel.record_event("io_error", error=repr(e))
+                except Exception:
+                    pass
 
     def check(self) -> bool:
         self._max_rss = max(self._max_rss, rss_mb())
-        if self.nan_check and self._nan_trapped():
-            out = self._dump()
-            print("ops: NaN TRAP: non-finite state detected "
-                  f"(step {getattr(self.sim, 'nstep', '?')}); crash "
-                  f"snapshot -> {out}")
-            return False
+        fault = getattr(self.sim, "_fault", None)
+        if fault is not None:
+            fault.maybe_signal(int(getattr(self.sim, "nstep", 0)))
+        if self.nan_check:
+            reason = self._nan_trapped()
+            if reason is not None:
+                self._record_fault(reason)
+                out = self._dump()
+                print("ops: NaN TRAP: unphysical state detected "
+                      f"({reason}, step "
+                      f"{getattr(self.sim, 'nstep', '?')}); crash "
+                      f"snapshot -> {out}")
+                self._drain_dumper()
+                return False
         if self._dump_requested:
             self._dump_requested = False
             out = self._dump()
@@ -138,10 +182,12 @@ class OpsGuard:
         if self._stop_requested:
             out = self._dump()
             print(f"ops: stop signal: snapshot -> {out}")
+            self._drain_dumper()
             return False
         if os.path.exists(os.path.join(self.base_dir, self.stop_file)):
             out = self._dump()
             print(f"ops: {self.stop_file} found: snapshot -> {out}")
+            self._drain_dumper()
             return False
         if self.walltime_s is not None:
             used = time.perf_counter() - self.t0
@@ -150,6 +196,7 @@ class OpsGuard:
             if used + 2.0 * last > self.walltime_s:
                 out = self._dump()
                 print(f"ops: walltime watchdog: snapshot -> {out}")
+                self._drain_dumper()
                 return False
         self._step_wall = time.perf_counter()
         return True

@@ -1,0 +1,533 @@
+"""Fault-tolerant execution layer (ramses_tpu/resilience/).
+
+Pins the three pillars:
+
+  * atomic validated checkpoints — a kill mid-dump never leaves a
+    directory that scans as a checkpoint, stale dirs are replaced (not
+    merged), corrupt manifests/payloads are skipped for the next-oldest
+    valid one, rotation keeps the last N;
+  * supervised auto-resume — bounded retry-with-resume reproduces an
+    uninterrupted run within round-off after a SIGTERM mid-run;
+  * in-run NaN rollback — an injected NaN is recovered by the redo-step
+    ladder with the telemetry step-record stream indistinguishable in
+    length from a clean run, at zero device-fetch overhead when armed.
+"""
+
+import json
+import os
+import signal
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.config import params_from_string
+from ramses_tpu.resilience import checkpoint as ckpt
+from ramses_tpu.resilience import faultinject as finj
+from ramses_tpu.resilience import supervisor as rsup
+from ramses_tpu.resilience.stepguard import StepGuard
+
+pytestmark = pytest.mark.smoke
+
+AMR2D = """
+&RUN_PARAMS
+hydro=.true.
+nstepmax={nstep}
+ncontrol=1
+{run_extra}
+/
+&AMR_PARAMS
+levelmin=4
+levelmax=5
+boxlen=1.0
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='point'
+x_center=0.5,0.5
+y_center=0.5,0.5
+length_x=10.0,1.0
+length_y=10.0,1.0
+exp_region=10.0,10.0
+d_region=1.0,0.0
+p_region=1e-5,0.1
+/
+&OUTPUT_PARAMS
+{out_extra}
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+/
+&REFINE_PARAMS
+err_grad_p=0.1
+/
+"""
+
+UNI2D = """
+&RUN_PARAMS
+hydro=.true.
+nstepmax={nstep}
+ncontrol=1
+{run_extra}
+/
+&AMR_PARAMS
+levelmin=4
+levelmax=4
+boxlen=1.0
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='point'
+x_center=0.5,0.5
+y_center=0.5,0.5
+length_x=10.0,1.0
+length_y=10.0,1.0
+exp_region=10.0,10.0
+d_region=1.0,0.0
+p_region=1e-5,0.1
+/
+&OUTPUT_PARAMS
+noutput=1
+tout=1.0
+{out_extra}
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+/
+"""
+
+
+def _uni_params(nstep=6, run_extra="", out_extra=""):
+    return params_from_string(
+        UNI2D.format(nstep=nstep, run_extra=run_extra,
+                     out_extra=out_extra), ndim=2)
+
+
+def _uni_sim(nstep=6, run_extra="", out_extra="", dtype=jnp.float64):
+    from ramses_tpu.driver import Simulation
+    return Simulation(_uni_params(nstep, run_extra, out_extra),
+                      dtype=dtype)
+
+
+def _amr_sim(tmp_path, nstep=6, run_extra="", telemetry=True):
+    from ramses_tpu.amr.hierarchy import AmrSim
+    out = (f"telemetry='{tmp_path}/run.jsonl'\ntelemetry_interval=1"
+           if telemetry else "tend=1.0")
+    p = params_from_string(AMR2D.format(nstep=nstep, run_extra=run_extra,
+                                        out_extra=out), ndim=2)
+    return AmrSim(p)
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------
+# config plumbing + fault spec
+# ---------------------------------------------------------------------
+def test_config_keys_parse():
+    p = _uni_params(
+        run_extra=("auto_resume=.true.\nmax_step_retries=3\n"
+                   "fault_inject='nan@3'"),
+        out_extra="checkpoint_keep=2")
+    assert p.run.auto_resume is True
+    assert p.run.max_step_retries == 3
+    assert p.run.fault_inject == "nan@3"
+    assert p.output.checkpoint_keep == 2
+
+
+def test_fault_spec_parse_arming_and_window_clamp():
+    inj = finj.FaultInjector("nan@3, sigterm@5")
+    assert inj.faults == [("nan", 3), ("sigterm", 5)]
+    with pytest.raises(ValueError):
+        finj.FaultInjector("explode@1")
+    # strict arming: a run first observed AT/AFTER the trigger step
+    # (i.e. a resumed run) never re-fires the fault
+    resumed = types.SimpleNamespace(nstep=7, u=jnp.zeros((4, 4)))
+    assert inj.maybe_nan(resumed) is False
+    assert inj.clamp_window(7, 16) == 16    # disarmed: no clamping
+    # pending faults clamp fused windows to land exactly on step K
+    inj2 = finj.FaultInjector("nan@5")
+    assert inj2.clamp_window(0, 16) == 5
+    assert inj2.clamp_window(3, 16) == 2
+    assert inj2.clamp_window(5, 16) == 16   # past the target
+
+
+# ---------------------------------------------------------------------
+# atomic checkpoints
+# ---------------------------------------------------------------------
+def test_kill_mid_dump_never_leaves_valid_checkpoint(tmp_path,
+                                                     monkeypatch):
+    sim = _uni_sim(nstep=2)
+    base = str(tmp_path)
+
+    def killed(src, dst):
+        raise RuntimeError("simulated kill -9 before the atomic rename")
+
+    with monkeypatch.context() as m:
+        m.setattr(os, "replace", killed)
+        with pytest.raises(RuntimeError, match="kill -9"):
+            sim.dump(1, base)
+    # the staged dir never became output_00001 and nothing in the base
+    # dir parses as a checkpoint
+    assert not os.path.exists(os.path.join(base, "output_00001"))
+    assert ckpt.latest_valid_checkpoint(base, log=lambda *_: None) is None
+    # the retry cleans the stale stage and finalizes atomically
+    out = sim.dump(1, base)
+    ok, reason = ckpt.validate_checkpoint(out)
+    assert ok, reason
+    assert ckpt.latest_valid_checkpoint(base, log=lambda *_: None) == out
+
+
+def test_stale_output_dir_replaced_not_merged(tmp_path):
+    sim = _uni_sim(nstep=2)
+    base = str(tmp_path)
+    stale = os.path.join(base, "output_00001")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "junk_from_older_run.out"), "w") as f:
+        f.write("stale")
+    out = sim.dump(1, base)
+    assert out == stale
+    assert not os.path.exists(
+        os.path.join(stale, "junk_from_older_run.out")), \
+        "dump must REPLACE a pre-existing output dir, not merge into it"
+    ok, reason = ckpt.validate_checkpoint(out)
+    assert ok, reason
+
+
+def test_scan_skips_corrupt_and_picks_next_oldest(tmp_path):
+    sim = _uni_sim(nstep=2)
+    base = str(tmp_path)
+    d1 = sim.dump(1, base)
+    sim.state.nstep, sim.state.t = 3, 0.25
+    d2 = sim.dump(2, base)
+    assert ckpt.latest_valid_checkpoint(base, log=lambda *_: None) == d2
+    # truncate one payload file in the newest: hash/size mismatch
+    files = [f for f in sorted(os.listdir(d2)) if f != ckpt.MANIFEST_NAME]
+    victim = os.path.join(d2, files[0])
+    with open(victim, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(victim) // 2))
+    ok, reason = ckpt.validate_checkpoint(d2)
+    assert not ok and files[0] in reason
+    skips = []
+    assert ckpt.latest_valid_checkpoint(
+        base, log=lambda m: skips.append(str(m))) == d1
+    assert any("output_00002" in s for s in skips), \
+        "a skipped corrupt checkpoint must be logged with a reason"
+    # corrupt the survivor's manifest JSON too: nothing valid remains
+    with open(os.path.join(d1, ckpt.MANIFEST_NAME), "w") as f:
+        f.write("{not json")
+    assert ckpt.latest_valid_checkpoint(base, log=lambda *_: None) is None
+
+
+def test_rotation_keeps_last_n_manifest_dirs_only(tmp_path):
+    sim = _uni_sim(nstep=2, out_extra="checkpoint_keep=2")
+    base = str(tmp_path)
+    # a pre-manifest (legacy) science output must never be rotated away
+    legacy = os.path.join(base, "output_00077")
+    os.makedirs(legacy)
+    for i in (1, 2, 3):
+        sim.state.nstep = i
+        sim.dump(i, base)
+    assert not os.path.exists(os.path.join(base, "output_00001")), \
+        "keep_last=2 must delete the oldest manifest-valid checkpoint"
+    assert os.path.exists(os.path.join(base, "output_00002"))
+    assert os.path.exists(os.path.join(base, "output_00003"))
+    assert os.path.exists(legacy)
+
+
+def test_resolve_restart_dir_modes(tmp_path):
+    base = str(tmp_path)
+    p = _uni_params()
+    p.run.nrestart = 2
+    with pytest.raises(FileNotFoundError):
+        ckpt.resolve_restart_dir(p, base_dir=base, log=lambda *_: None)
+    sim = _uni_sim(nstep=2)
+    d2 = sim.dump(2, base)
+    assert ckpt.resolve_restart_dir(p, base_dir=base,
+                                    log=lambda *_: None) == d2
+    # explicit restart from a checkpoint that fails validation is loud
+    with open(os.path.join(d2, ckpt.MANIFEST_NAME), "a") as f:
+        f.write("garbage")
+    with pytest.raises(RuntimeError, match="nrestart=-1"):
+        ckpt.resolve_restart_dir(p, base_dir=base, log=lambda *_: None)
+    # auto mode skips it and finds the next valid one
+    d1 = sim.dump(1, base)
+    p.run.nrestart = -1
+    assert ckpt.resolve_restart_dir(p, base_dir=base,
+                                    log=lambda *_: None) == d1
+
+
+def test_truncate_fault_injection_breaks_validation(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv(finj.ENV_VAR, "truncate:hydro")
+    finj._truncate_fired.clear()
+    try:
+        sim = _uni_sim(nstep=2)
+        out = sim.dump(1, str(tmp_path))
+        ok, reason = ckpt.validate_checkpoint(out)
+        assert not ok and "hydro" in reason
+        assert ckpt.latest_valid_checkpoint(
+            str(tmp_path), log=lambda *_: None) is None
+    finally:
+        finj._truncate_fired.clear()
+
+
+# ---------------------------------------------------------------------
+# NaN rollback-with-retry
+# ---------------------------------------------------------------------
+def test_amr_nan_rollback_recovers_with_identical_record_stream(
+        tmp_path):
+    clean = _amr_sim(tmp_path / "clean", nstep=6)
+    clean.evolve(1e9, nstepmax=6)
+    clean.telemetry.close(clean, print_timers=False)
+    clean_steps = [r for r in _records(tmp_path / "clean" / "run.jsonl")
+                   if r["kind"] == "step"]
+
+    faulty = _amr_sim(tmp_path / "faulty", nstep=6,
+                      run_extra="max_step_retries=2\nfault_inject='nan@3'")
+    faulty.evolve(1e9, nstepmax=6)
+    faulty.telemetry.close(faulty, print_timers=False)
+    recs = _records(tmp_path / "faulty" / "run.jsonl")
+    steps = [r for r in recs if r["kind"] == "step"]
+
+    assert faulty.nstep == 6 and np.isfinite(faulty.t)
+    assert len(steps) == len(clean_steps) == 6, \
+        "a recovered step must emit exactly one step record"
+    assert [r["nstep"] for r in steps] == [1, 2, 3, 4, 5, 6]
+    kinds = [r["kind"] for r in recs]
+    assert "fault" in kinds and "rollback" in kinds \
+        and "rollback_recovered" in kinds
+    rb = next(r for r in recs if r["kind"] == "rollback")
+    assert rb["attempt"] == 1 and 0 < rb["dt"] <= 0.5
+    assert recs[-1]["kind"] == "run_footer"
+    assert recs[-1]["events"]["rollback_recovered"] == 1
+
+
+def test_uniform_nan_rollback_recovers():
+    sim = _uni_sim(nstep=5,
+                   run_extra="max_step_retries=2\nfault_inject='nan@2'")
+    sim.evolve()
+    assert sim.nstep == 5
+    assert np.isfinite(sim.t) and sim.t > 0
+    assert np.isfinite(np.asarray(sim.state.u)).all()
+    assert sim._sguard.rollbacks >= 1
+    assert sim._sguard.recovered >= 1
+    assert sim._sguard.aborts == 0
+
+
+def test_retry_ladder_exhaustion_emergency_dumps_and_raises(tmp_path,
+                                                            monkeypatch):
+    from ramses_tpu.resilience.stepguard import StepRetryExhausted
+    sim = _uni_sim(nstep=4,
+                   run_extra="max_step_retries=2\nfault_inject='nan@1'",
+                   out_extra=f"output_dir='{tmp_path}'")
+    # make every retry fail too: the ladder must exhaust, dump the last
+    # clean state, and abort loudly
+    monkeypatch.setattr(StepGuard, "ok",
+                        staticmethod(lambda *vals: False))
+    with pytest.raises(StepRetryExhausted):
+        sim.evolve()
+    assert sim._sguard.aborts == 1
+    out = os.path.join(str(tmp_path), "output_00999")
+    assert os.path.exists(out)
+    ok, reason = ckpt.validate_checkpoint(out)
+    assert ok, reason
+    # the emergency dump is the retained CLEAN pre-step state: the
+    # all-False guard trips on the very first window, so nstep is 0
+    meta = ckpt.read_manifest_meta(out)
+    assert int(meta["nstep"]) == 0
+
+
+def test_zero_overhead_when_guard_armed(tmp_path, monkeypatch):
+    sim = _amr_sim(tmp_path, nstep=16, telemetry=False,
+                   run_extra="max_step_retries=2")
+    assert sim._sguard is not None
+    sim.regrid_interval = 0
+    sim.evolve(1e9, nstepmax=4)            # warm the fused chunk
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    sim.evolve(1e9, nstepmax=sim.nstep + 8)
+    assert calls["n"] == 0, \
+        "arming the step guard must not add host<->device fetches"
+
+
+# ---------------------------------------------------------------------
+# OpsGuard trap + dump-thread draining
+# ---------------------------------------------------------------------
+def _fake_sim(tmp_path, **kw):
+    events = []
+    tel = types.SimpleNamespace(
+        record_event=lambda k, **f: events.append((k, f)))
+    sim = types.SimpleNamespace(
+        dt_old=1e-3, nstep=3, t=0.1, telemetry=tel,
+        dump=lambda iout, base: str(tmp_path), **kw)
+    return sim, events
+
+
+def test_opsguard_traps_nonfinite_and_nonpositive_dt(tmp_path):
+    from ramses_tpu.utils.ops import OpsGuard
+    sim, events = _fake_sim(tmp_path)
+    sim.dt_old = float("nan")
+    g = OpsGuard(sim, str(tmp_path), install_signals=False,
+                 nan_check=True)
+    assert g.check() is False
+    assert events[0][0] == "fault"
+    assert events[0][1]["reason"] == "nonfinite_dt"
+
+    sim2, events2 = _fake_sim(tmp_path)
+    sim2.dt_old = 0.0
+    g2 = OpsGuard(sim2, str(tmp_path), install_signals=False,
+                  nan_check=True)
+    assert g2.check() is False
+    assert events2[0][1]["reason"] == "nonpositive_dt"
+
+    # dt == 0 before the first step is normal startup, not a fault
+    sim3, events3 = _fake_sim(tmp_path)
+    sim3.dt_old, sim3.nstep = 0.0, 0
+    g3 = OpsGuard(sim3, str(tmp_path), install_signals=False,
+                  nan_check=True)
+    assert g3.check() is True
+    assert not events3
+
+
+def test_async_dumper_drain_and_stop_path_reporting(tmp_path,
+                                                    monkeypatch):
+    from ramses_tpu.io import snapshot as snapmod
+    from ramses_tpu.io.async_writer import AsyncDumper
+    from ramses_tpu.utils.ops import OpsGuard
+
+    def boom(*a, **kw):
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(snapmod, "dump_all", boom)
+    d = AsyncDumper()
+    d.submit(None, 1, str(tmp_path))
+    errs = d.drain()
+    assert len(errs) == 1 and "disk full" in str(errs[0])
+    assert d.drain() == []                 # drained errors are cleared
+
+    # the OpsGuard stop path must surface writer failures as io_error
+    # telemetry events instead of raising past the clean shutdown
+    d.submit(None, 2, str(tmp_path))
+    sim, events = _fake_sim(tmp_path, dumper=d)
+    g = OpsGuard(sim, str(tmp_path), install_signals=False,
+                 nan_check=False)
+    g._stop_requested = True
+    assert g.check() is False
+    assert any(k == "io_error" and "disk full" in f["error"]
+               for k, f in events)
+    d.close()
+
+
+# ---------------------------------------------------------------------
+# supervised auto-resume
+# ---------------------------------------------------------------------
+def test_supervisor_bounded_attempts_with_backoff(tmp_path, monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(rsup.time, "sleep", lambda s: sleeps.append(s))
+    p = _uni_params(nstep=5)
+    calls = {"n": 0}
+
+    def build(restart):
+        assert restart is None             # no checkpoints on disk
+        return types.SimpleNamespace(nstep=0, t=0.0, telemetry=None)
+
+    def drive(sim):
+        calls["n"] += 1
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        rsup.supervise(build, drive, p, base_dir=str(tmp_path),
+                       max_attempts=3, log=lambda *_: None)
+    assert calls["n"] == 3
+    assert sleeps == [1.0, 2.0]            # exponential, from base 1 s
+    assert rsup.backoff_delay(10) == 30.0  # capped
+
+
+def test_run_complete_semantics():
+    p = _uni_params(nstep=5)
+    assert rsup.run_complete(
+        types.SimpleNamespace(nstep=5, t=0.0), p)      # nstepmax hit
+    assert rsup.run_complete(
+        types.SimpleNamespace(nstep=1, t=1.0), p)      # tend reached
+    assert not rsup.run_complete(
+        types.SimpleNamespace(nstep=1, t=0.1), p)
+
+
+def test_sigterm_supervised_resume_matches_uninterrupted_run(
+        tmp_path, monkeypatch):
+    from ramses_tpu.driver import Simulation
+    from ramses_tpu.utils.ops import OpsGuard
+    monkeypatch.setattr(rsup.time, "sleep", lambda s: None)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_int = signal.getsignal(signal.SIGINT)
+    try:
+        ref = _uni_sim(nstep=8, dtype=jnp.float64)
+        ref.evolve()
+        assert ref.nstep == 8
+
+        outdir = str(tmp_path / "run")
+        os.makedirs(outdir)
+        p = _uni_params(nstep=8, run_extra="fault_inject='sigterm@4'")
+
+        def build(restart):
+            return (Simulation.from_snapshot(p, restart,
+                                             dtype=jnp.float64)
+                    if restart else Simulation(p, dtype=jnp.float64))
+
+        def drive(sim):
+            guard = OpsGuard(sim, outdir)
+            guard.run_guarded(lambda: sim.evolve(guard=guard))
+
+        logs = []
+        sim = rsup.supervise(build, drive, p, base_dir=outdir,
+                             max_attempts=3,
+                             log=lambda m: logs.append(str(m)))
+        assert any("resuming from" in m for m in logs), \
+            "the SIGTERM must interrupt the run mid-way (attempt 2 " \
+            "resumes from the stop checkpoint)"
+        assert sim.nstep == 8
+        np.testing.assert_allclose(
+            np.asarray(sim.state.u), np.asarray(ref.state.u),
+            rtol=1e-9, atol=1e-12)
+        assert abs(sim.t - ref.t) <= 1e-12 * max(abs(ref.t), 1.0)
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+
+
+def test_telemetry_resume_appends_and_counts_events(tmp_path):
+    from ramses_tpu.telemetry import Telemetry, TelemetrySpec
+    path = tmp_path / "t.jsonl"
+    tel = Telemetry(TelemetrySpec(path=str(path), interval=1))
+    sim = types.SimpleNamespace(nstep=1, t=0.1, dt_old=1e-3)
+    tel.record_step(sim, dt=1e-3)
+    tel.close(print_timers=False)
+    n0 = len(_records(path))
+
+    tel2 = Telemetry(TelemetrySpec(path=str(path), interval=1))
+    tel2.mark_resumed("output_00042", attempt=2)
+    sim.nstep = 2
+    tel2.record_step(sim, dt=1e-3)
+    tel2.close(print_timers=False)
+    recs = _records(path)
+    assert len(recs) > n0, "a resumed sink must APPEND, not truncate"
+    resume = [r for r in recs if r["kind"] == "resume"]
+    assert resume and resume[0]["attempt"] == 2
+    assert resume[0]["outdir"] == "output_00042"
+    assert recs[-1]["events"]["resume"] == 1
