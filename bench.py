@@ -35,8 +35,9 @@ The parent ALWAYS prints the JSON line and exits 0.
 Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
-BENCH_ONLY=uniform|amr|mg|amr_poisson, BENCH_SUB_TIMEOUT,
-BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH.
+BENCH_ONLY=uniform|amr|mg|amr_poisson|ensemble, BENCH_SUB_TIMEOUT,
+BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH, BENCH_ENS_LEVEL,
+BENCH_ENS_STEPS, BENCH_ENS_BATCHES.
 
 Each child writes a phase-marker heartbeat sidecar
 (BENCH_HEARTBEAT_<sub>.jsonl, format: ramses_tpu/telemetry/heartbeat.py);
@@ -146,6 +147,72 @@ def bench_uniform(params, dtype, jnp, hb=lambda *a, **k: None):
         "cell_updates_per_sec": updates / wall,
         "mus_per_cell_update": 1e6 * wall / max(updates, 1),
         "n": sim.grid.ncell, "steps": int(ndone), "wall_s": wall,
+        "tunnel_rtt_s": measure_rtt(jnp),
+    }
+
+
+def bench_ensemble(params, dtype, jnp, hb=lambda *a, **k: None):
+    """Batched ensemble throughput (ensemble/batch.py): the uniform
+    Sedov scenario vmapped over batch sizes {1, 8, 32} through ONE
+    compiled fused step chain.  Reports scenarios/sec (batched scenario
+    windows drained per second) and aggregate cell-updates/sec per
+    batch size — the fleet-amortisation curve the run service rides."""
+    import numpy as np
+
+    from ramses_tpu.ensemble.batch import EnsembleSpec, build_member
+    from ramses_tpu.grid.uniform import run_steps_batch
+
+    lvl = int(os.environ.get("BENCH_ENS_LEVEL", "6"))
+    nsteps = int(os.environ.get("BENCH_ENS_STEPS", "8"))
+    batches = tuple(int(b) for b in os.environ.get(
+        "BENCH_ENS_BATCHES", "1,8,32").split(","))
+    params.amr.levelmin = params.amr.levelmax = lvl
+    params.ensemble.nmember = max(batches)
+    # small IC perturbations make every member's data distinct without
+    # splitting the compile group (traced values, not jit keys)
+    params.ensemble.perturb_amp = 1e-3
+    spec = EnsembleSpec.from_params(params, solver="hydro")
+    hb("spec")
+    per_batch = {}
+    grid = None
+    for b in batches:
+        members = [build_member(spec, k, dtype=dtype) for k in range(b)]
+        grid = members[0][0]
+        u = jnp.stack([m[1][0] for m in members])
+        t = jnp.zeros((b,), jnp.float32)
+        tend = jnp.full((b,), 1e9, jnp.float32)
+        # warm with the SAME (grid, nsteps) so the timed window holds
+        # zero compiles — only the leading batch dim changes per b
+        u1, t1, _ = run_steps_batch(grid, u, t, tend, nsteps)
+        float(jnp.sum(u1[:, 0]))
+        hb(f"warm_b{b}")
+        t0 = time.perf_counter()
+        u2, t2, nd = run_steps_batch(grid, u1, t1, tend, nsteps)
+        float(jnp.sum(u2[:, 0]))
+        wall = time.perf_counter() - t0
+        steps = int(np.min(np.asarray(nd)))
+        updates = grid.ncell * steps * b
+        per_batch[str(b)] = {
+            "scenarios_per_sec": b / wall,
+            "cell_updates_per_sec": updates / wall,
+            "mus_per_cell_update": 1e6 * wall / max(updates, 1),
+            "steps_per_member": steps, "wall_s": wall,
+        }
+        hb(f"timed_b{b}")
+    one = per_batch.get("1", {}).get("cell_updates_per_sec")
+    for d in per_batch.values():
+        if one:
+            # >1 means the batch amortises fixed per-step costs (launch
+            # overhead, reductions) across members
+            d["efficiency_vs_solo"] = d["cell_updates_per_sec"] / one
+    big = per_batch[str(max(batches))]
+    return {
+        "config": f"sedov3d ensemble 2^{lvl}^3 x batch "
+                  f"{{{','.join(str(b) for b in batches)}}}",
+        "cell_updates_per_sec": big["cell_updates_per_sec"],
+        "scenarios_per_sec": big["scenarios_per_sec"],
+        "n": grid.ncell if grid else 0,
+        "per_batch": per_batch,
         "tunnel_rtt_s": measure_rtt(jnp),
     }
 
@@ -378,13 +445,15 @@ def bench_mg(dtype, jnp, hb=lambda *a, **k: None):
     }
 
 
-SUBS = ("uniform", "amr", "mg", "amr_poisson")
+SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
 # ceilings per sub; the GLOBAL budget (BENCH_TOTAL_BUDGET) always wins —
 # four rounds of rc=124 driver kills came from these summing past the
 # driver's wall clock whenever the tunnel hung
-SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500}
+SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500,
+                "ensemble": 300}
 # share of the REMAINING budget each sub may claim at launch
-SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35, "amr_poisson": 0.95}
+SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35,
+               "amr_poisson": 0.95, "ensemble": 0.95}
 
 
 def run_sub_inproc(name):
@@ -411,6 +480,9 @@ def run_sub_inproc(name):
     elif name == "amr_poisson":
         d = bench_amr_poisson(load_params(nml, ndim=3), dtype, jnp,
                               hb=hb.mark)
+    elif name == "ensemble":
+        d = bench_ensemble(load_params(nml, ndim=3), dtype, jnp,
+                           hb=hb.mark)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
     hb.mark("done")
@@ -539,7 +611,8 @@ def main():
     only = os.environ.get("BENCH_ONLY", "")
     if only not in ("",) + SUBS:
         raise SystemExit(
-            f"BENCH_ONLY={only!r}: expected uniform|amr|mg|amr_poisson")
+            f"BENCH_ONLY={only!r}: expected "
+            f"uniform|amr|mg|amr_poisson|ensemble")
     wanted = SUBS if only == "" else (only,)
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "900"))
     deadline = time.monotonic() + budget
@@ -599,7 +672,7 @@ def main():
         return d if d and "error" not in d else None
 
     head = (ok("amr") or ok("uniform") or ok("mg") or ok("amr_poisson")
-            or {"config": "all sub-benches failed"})
+            or ok("ensemble") or {"config": "all sub-benches failed"})
     hydro_head = "cell_updates_per_sec" in head
     value = head.get("cell_updates_per_sec",
                      head.get("vcycles_per_sec",

@@ -15,7 +15,32 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ramses_tpu",
         description="TPU-native AMR astrophysics framework")
-    ap.add_argument("namelist", help="Fortran-namelist runtime config")
+    ap.add_argument("namelist", nargs="?", default=None,
+                    help="Fortran-namelist runtime config (optional "
+                         "with --serve)")
+    ap.add_argument("--serve", metavar="QUEUE_DIR", default=None,
+                    help="run-service worker: claim jobs from this "
+                         "queue dir and run them under the supervised "
+                         "ensemble engine (ramses_tpu/ensemble)")
+    ap.add_argument("--submit", metavar="QUEUE_DIR", default=None,
+                    help="enqueue the namelist as a job instead of "
+                         "running it; prints the job id")
+    ap.add_argument("--sweep", action="append", metavar="KEY=V1,V2,...",
+                    help="with --submit: per-member parameter sweep "
+                         "rows, dotted paths into the namelist "
+                         "(e.g. init.p_region[1]=0.3,0.5); repeatable")
+    ap.add_argument("--max-jobs", type=int, default=0,
+                    help="with --serve: stop after this many jobs "
+                         "(0 = keep serving)")
+    ap.add_argument("--idle-exit", action="store_true",
+                    help="with --serve: exit once the queue is drained "
+                         "instead of polling")
+    ap.add_argument("--stale-timeout", type=float, default=300.0,
+                    help="with --serve: reclaim running jobs whose "
+                         "heartbeat is older than this many seconds")
+    ap.add_argument("--worker-id", default="",
+                    help="with --serve: worker name stamped on claimed "
+                         "jobs (default host:pid)")
     ap.add_argument("--ndim", type=int, default=3,
                     help="spatial dimensions (compile-time in the reference)")
     ap.add_argument("--dtype", default="float32",
@@ -48,6 +73,31 @@ def main(argv=None) -> int:
                          "latest valid checkpoint and continue, up to "
                          "this many attempts (exponential backoff)")
     args = ap.parse_args(argv)
+
+    # run-service front-end: --submit enqueues and exits; --serve is
+    # the worker loop (no namelist needed — jobs carry their own)
+    if args.submit:
+        if not args.namelist:
+            ap.error("--submit requires a namelist")
+        from ramses_tpu.ensemble.service import (parse_sweep_args,
+                                                 submit_namelist)
+        job_id = submit_namelist(
+            args.submit, args.namelist,
+            sweeps=parse_sweep_args(args.sweep),
+            solver=args.solver or "", ndim=args.ndim, dtype=args.dtype)
+        print(job_id)
+        return 0
+    if args.serve:
+        from ramses_tpu.ensemble.service import serve
+        counts = serve(args.serve, worker=args.worker_id,
+                       max_jobs=args.max_jobs, idle_exit=args.idle_exit,
+                       stale_s=args.stale_timeout,
+                       max_attempts=max(1, args.max_attempts),
+                       verbose=args.verbose)
+        print(f"serve: done={counts['done']} failed={counts['failed']}")
+        return 1 if counts["failed"] else 0
+    if not args.namelist:
+        ap.error("a namelist is required (or use --serve/--submit)")
 
     import jax.numpy as jnp
 
@@ -94,6 +144,26 @@ def main(argv=None) -> int:
         return rsup.supervise(build, drive, params,
                               base_dir=params.output.output_dir,
                               max_attempts=attempts, tend=tend)
+
+    # &ENSEMBLE_PARAMS nmember > 1: the whole namelist is an ensemble —
+    # one compiled program advances every member (ramses_tpu/ensemble)
+    if params.ensemble.nmember > 1:
+        from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
+        spec = EnsembleSpec.from_params(params, solver=args.solver or "")
+
+        def build(restart):
+            if restart:
+                return EnsembleEngine.from_checkpoint(spec, restart,
+                                                      dtype=dtype)
+            return EnsembleEngine(spec, dtype=dtype)
+
+        eng = launch(build, lambda e: e.run(verbose=args.verbose))
+        snap = eng.save(params.output.output_dir)
+        print(f"ensemble: {eng.nmember} members "
+              f"{len(eng.groups)} compile groups t_min={eng.t:.5e} "
+              f"nstep_max={eng.nstep} -> {snap}")
+        eng.telemetry.close(eng)
+        return 0
 
     def drive_amr(tend):
         def drive(sim):

@@ -326,6 +326,32 @@ class UnitsParams:
 
 
 @dataclass
+class EnsembleParams:
+    """&ENSEMBLE_PARAMS (ours: the batched many-scenario engine,
+    ramses_tpu/ensemble — no reference equivalent; the reference runs
+    one namelist per MPI job).
+
+    ``nmember > 1`` turns the namelist into an ensemble: the uniform
+    fused step chain is vmapped over a leading member axis so one
+    compiled program advances every member.  ``sweep_name`` rows give
+    dotted parameter paths ("init.p_region[1]", "hydro.gamma") ramped
+    linearly from ``sweep_start`` to ``sweep_stop`` across members;
+    ``perturb_amp > 0`` additionally applies a deterministic per-member
+    density perturbation seeded by ``perturb_seed + member``."""
+    nmember: int = 0
+    sweep_name: List[str] = field(default_factory=list)
+    sweep_start: List[float] = field(default_factory=list)
+    sweep_stop: List[float] = field(default_factory=list)
+    perturb_amp: float = 0.0
+    perturb_seed: int = 0
+    chunk_steps: int = 16          # fused steps per engine dispatch
+    # run-service knobs (ensemble/queue): a running job whose heartbeat
+    # mtime is older than queue_stale_s is presumed orphaned and may be
+    # reclaimed by another worker
+    queue_stale_s: float = 300.0
+
+
+@dataclass
 class Params:
     """Full runtime configuration (one object per simulation)."""
     ndim: int = 3               # compile-time in the reference (bin/Makefile:7)
@@ -343,6 +369,7 @@ class Params:
     cooling: CoolingParams = field(default_factory=CoolingParams)
     rt: RtParams = field(default_factory=RtParams)
     units: UnitsParams = field(default_factory=UnitsParams)
+    ensemble: EnsembleParams = field(default_factory=EnsembleParams)
     lightcone: LightconeParams = field(
         default_factory=LightconeParams)
     clumpfind: ClumpfindParams = field(
@@ -368,6 +395,7 @@ _GROUP_MAP = {
     "cooling_params": "cooling",
     "rt_params": "rt",
     "units_params": "units",
+    "ensemble_params": "ensemble",
     "lightcone_params": "lightcone",
     "clumpfind_params": "clumpfind",
 }

@@ -604,8 +604,17 @@ def run_namelist(path: str, ndim: int = 3, dtype=jnp.float32,
     """Build-and-evolve from a namelist.  With ``max_attempts > 1`` or
     ``&RUN_PARAMS auto_resume``/``nrestart=-1`` the run is supervised:
     an interrupted attempt resumes from the newest manifest-valid
-    checkpoint with exponential backoff between attempts."""
+    checkpoint with exponential backoff between attempts.
+
+    ``&ENSEMBLE_PARAMS nmember > 1`` dispatches to the batched
+    ensemble engine instead (one compiled program advances every
+    member) and returns the :class:`~ramses_tpu.ensemble.batch.
+    EnsembleEngine` in place of a :class:`Simulation`."""
     params = load_params(path, ndim=ndim)
+    if params.ensemble.nmember > 1:
+        from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
+        spec = EnsembleSpec.from_params(params)
+        return EnsembleEngine(spec, dtype=dtype).run(verbose=verbose)
     supervised = (max_attempts > 1 or params.run.auto_resume
                   or params.run.nrestart == -1)
     if supervised:

@@ -1,0 +1,13 @@
+"""Batched many-scenario engine + run-service front-end (ROADMAP item
+3): ``batch`` vmaps the fused uniform step chains over a leading member
+axis with frozen-config sub-batch grouping; ``queue``/``service`` are
+the file-backed submit/claim/complete layer that turns the CLI into a
+system absorbing many runs (``python -m ramses_tpu --serve <dir>``)."""
+
+from ramses_tpu.ensemble.batch import (EnsembleEngine, EnsembleSpec,
+                                       apply_override, build_member)
+from ramses_tpu.ensemble import queue
+from ramses_tpu.ensemble.service import serve, submit_namelist
+
+__all__ = ["EnsembleEngine", "EnsembleSpec", "apply_override",
+           "build_member", "queue", "serve", "submit_namelist"]

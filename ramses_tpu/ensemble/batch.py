@@ -1,0 +1,513 @@
+"""Batched ensemble engine: one compiled program, a fleet of runs.
+
+ROADMAP item 3 (and JANC, arXiv:2504.13750, as the existence proof):
+production scale for this framework is *many* simulations, so the
+fused uniform step chains (``grid/uniform.run_steps``/``run_steps_cool``,
+``mhd/uniform.run_steps``, ``rhd/uniform.run_steps``) are vmapped over a
+leading member axis.  :class:`EnsembleSpec` expands one base namelist
+into N members by sweeping parameters; anything *traced* (region
+densities/pressures, IC perturbation seeds, cooling table data) batches
+freely inside one compiled program, while sweeps that touch a *static*
+config field (EOS gamma, the Riemann solver, a CoolingSpec knob) change
+the frozen dataclass that IS the jit cache key — those members are
+grouped into sub-batches by frozen-config hash so each distinct config
+compiles exactly once (``platform.enable_compile_cache`` makes even that
+cold-start O(load) for a known namelist).
+
+Per-member time is carried as a batched ``t[B]`` array and completion is
+the per-step ``t < tend`` mask already inside every ``run_steps`` scan —
+under vmap it becomes a per-member ``lax.select``, so finished members
+idle cheaply until their sub-batch drains.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.config import Params
+
+_INDEXED = re.compile(r"^(?P<name>\w+)\[(?P<idx>\d+)\]$")
+
+#: round-off slack shared with the drivers' "reached tend" checks
+_TEND_EPS = 1e-12
+
+
+def apply_override(params: Params, key: str, value: Any) -> None:
+    """Set a dotted sweep path (``"hydro.gamma"``, ``"init.p_region[1]"``)
+    on a :class:`Params` in place.  Unknown groups/fields raise — a
+    silently ignored sweep would make every member identical."""
+    group, _, fname = key.partition(".")
+    if not fname:
+        raise ValueError(f"sweep key '{key}' is not of the form "
+                         "'group.field' or 'group.field[i]'")
+    sub = getattr(params, group)
+    m = _INDEXED.match(fname)
+    if m:
+        lst = list(getattr(sub, m.group("name")))
+        lst[int(m.group("idx"))] = value
+        setattr(sub, m.group("name"), lst)
+    else:
+        cur = getattr(sub, fname)          # AttributeError when unknown
+        if isinstance(cur, bool):
+            value = bool(value)
+        elif isinstance(cur, int) and not isinstance(value, bool):
+            value = int(value)
+        elif isinstance(cur, float):
+            value = float(value)
+        setattr(sub, fname, value)
+
+
+def solver_from_params(params: Params) -> str:
+    """Solver-family auto-detect shared with ``__main__``: MHD when any
+    region seeds a magnetic field, hydro otherwise (rhd is explicit)."""
+    init = params.init
+    return ("mhd" if any(init.A_region) or any(init.B_region)
+            or any(init.C_region) else "hydro")
+
+
+@dataclass
+class EnsembleSpec:
+    """One base namelist + per-member parameter sweeps.
+
+    ``sweeps`` maps dotted parameter paths to per-member value lists
+    (every list must have length ``nmember``).  ``perturb_amp > 0``
+    additionally multiplies each member's IC density by
+    ``1 + amp * U[-1, 1)`` drawn from ``default_rng(perturb_seed + k)``
+    — a traced-only sweep that never splits the jit cache.
+    """
+    base: Params
+    nmember: int
+    sweeps: Dict[str, List[Any]] = field(default_factory=dict)
+    perturb_amp: float = 0.0
+    perturb_seed: int = 0
+    solver: str = ""               # "" -> auto (hydro/mhd)
+
+    def __post_init__(self):
+        if self.nmember < 1:
+            raise ValueError(f"nmember must be >= 1 (got {self.nmember})")
+        if not self.solver:
+            self.solver = solver_from_params(self.base)
+        for key, vals in self.sweeps.items():
+            if len(vals) != self.nmember:
+                raise ValueError(
+                    f"sweep '{key}' has {len(vals)} values for "
+                    f"{self.nmember} members")
+
+    @classmethod
+    def from_params(cls, params: Params,
+                    sweeps: Optional[Dict[str, Sequence[Any]]] = None,
+                    nmember: Optional[int] = None,
+                    solver: str = "") -> "EnsembleSpec":
+        """Build from ``&ENSEMBLE_PARAMS`` (plus optional explicit
+        sweeps, e.g. from a queue job record).  Namelist ``sweep_name``
+        rows ramp linearly ``sweep_start -> sweep_stop`` across the
+        members; explicit ``sweeps`` win on key collision."""
+        e = params.ensemble
+        sweeps = {k: list(v) for k, v in (sweeps or {}).items()}
+        nm = int(nmember or 0) or int(e.nmember) or \
+            (max(len(v) for v in sweeps.values()) if sweeps else 1)
+        for i, name in enumerate(e.sweep_name):
+            if name in sweeps:
+                continue
+            lo = float(e.sweep_start[i]) if i < len(e.sweep_start) else 0.0
+            hi = float(e.sweep_stop[i]) if i < len(e.sweep_stop) else lo
+            sweeps[name] = [lo + (hi - lo) * (k / (nm - 1) if nm > 1
+                                              else 0.0)
+                            for k in range(nm)]
+        return cls(base=params, nmember=nm, sweeps=sweeps,
+                   perturb_amp=float(e.perturb_amp),
+                   perturb_seed=int(e.perturb_seed), solver=solver)
+
+    def member_params(self, k: int) -> Params:
+        """Member k's full Params (a deep copy with its sweeps applied)."""
+        if not 0 <= k < self.nmember:
+            raise IndexError(k)
+        p = copy.deepcopy(self.base)
+        for key, vals in self.sweeps.items():
+            apply_override(p, key, vals[k])
+        return p
+
+    def fingerprint(self) -> str:
+        """Stable id of the expansion (checkpoint compatibility check)."""
+        blob = json.dumps({"nmember": self.nmember, "solver": self.solver,
+                           "sweeps": {k: [repr(v) for v in vs]
+                                      for k, vs in sorted(self.sweeps.items())},
+                           "perturb": [self.perturb_amp, self.perturb_seed]},
+                          sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _uniform_shape(p: Params, cubic: bool) -> Tuple[Tuple[int, ...], float]:
+    n = 2 ** p.amr.levelmin
+    base = [p.amr.nx, p.amr.ny, p.amr.nz][:p.ndim]
+    if cubic and any(b != 1 for b in base):
+        raise NotImplementedError(
+            f"{'mhd/rhd'} ensembles require nx=ny=nz=1 (got {base})")
+    shape = tuple(b * n for b in base)
+    return shape, p.amr.boxlen / n
+
+
+def _check_uniform_only(p: Params, solver: str) -> None:
+    if p.amr.levelmax > p.amr.levelmin:
+        raise NotImplementedError(
+            "ensemble engine covers the uniform fused step chains only "
+            f"(levelmin={p.amr.levelmin} < levelmax={p.amr.levelmax}); "
+            "run AMR namelists solo")
+    r = p.run
+    if r.poisson or r.pic or r.cosmo or r.rt:
+        raise NotImplementedError(
+            "ensemble engine: pure (M/R)HD uniform runs only — "
+            "poisson/pic/cosmo/rt namelists run solo")
+    if solver == "hydro" and p.run.patch:
+        # patch hooks are process-global state; per-member patches
+        # cannot coexist inside one batch
+        raise NotImplementedError("ensemble engine does not support "
+                                  "&RUN_PARAMS patch plug-ins")
+
+
+def _perturb(u0: np.ndarray, spec: EnsembleSpec, k: int) -> np.ndarray:
+    if spec.perturb_amp <= 0.0:
+        return u0
+    rng = np.random.default_rng(spec.perturb_seed + k)
+    u0 = np.array(u0, copy=True)
+    u0[0] = u0[0] * (1.0 + spec.perturb_amp
+                     * (2.0 * rng.random(u0[0].shape) - 1.0))
+    return u0
+
+
+def build_member(spec: EnsembleSpec, k: int, dtype=jnp.float64):
+    """(grid, state, tend, params) for member k — the single source of
+    truth for ICs, shared by the engine and by bitwise solo-run tests.
+
+    ``state`` is a tuple of device arrays: ``(u,)`` for hydro/rhd,
+    ``(u, bf)`` for MHD.  ``grid`` is the frozen static dataclass that
+    doubles as the jit cache key (and the sub-batch group key)."""
+    from ramses_tpu.grid import boundary as bmod
+
+    p = spec.member_params(k)
+    _check_uniform_only(p, spec.solver)
+    tend = float(p.output.tout[-1] if p.output.tout else p.output.tend)
+    if spec.solver == "hydro":
+        from ramses_tpu.grid.uniform import UniformGrid
+        from ramses_tpu.hydro.core import HydroStatic
+        from ramses_tpu.init.regions import condinit
+        cfg = HydroStatic.from_params(p)
+        shape, dx = _uniform_shape(p, cubic=False)
+        grid = UniformGrid(cfg=cfg, shape=shape, dx=dx,
+                           bc=bmod.BoundarySpec.from_params(p))
+        u0 = _perturb(np.asarray(condinit(shape, dx, p, cfg)), spec, k)
+        return grid, (jnp.asarray(u0, dtype),), tend, p
+    if spec.solver == "mhd":
+        from ramses_tpu.mhd.driver import mhd_condinit
+        from ramses_tpu.mhd.core import MhdStatic
+        from ramses_tpu.mhd import uniform as mu
+        cfg = MhdStatic.from_params(p)
+        shape, dx = _uniform_shape(p, cubic=True)
+        spec_bc = bmod.BoundarySpec.from_params(p)
+        bc_kinds = tuple((f[0].kind, f[1].kind) for f in spec_bc.faces)
+        for lo, hi in bc_kinds:
+            for kk in (lo, hi):
+                if kk not in (bmod.PERIODIC, bmod.OUTFLOW):
+                    raise NotImplementedError(
+                        "mhd ensembles: periodic/outflow only")
+        grid = mu.MhdGrid(cfg=cfg, shape=shape, dx=dx, bc_kinds=bc_kinds)
+        u0, bf0 = mhd_condinit(shape, dx, p, cfg)
+        u0 = _perturb(np.asarray(u0), spec, k)
+        return grid, (jnp.asarray(u0, dtype),
+                      jnp.asarray(bf0, dtype)), tend, p
+    if spec.solver == "rhd":
+        from ramses_tpu.rhd.driver import rhd_condinit
+        from ramses_tpu.rhd.core import RhdStatic
+        from ramses_tpu.rhd import uniform as ru
+        cfg = RhdStatic.from_params(p)
+        shape, dx = _uniform_shape(p, cubic=True)
+        spec_bc = bmod.BoundarySpec.from_params(p)
+        bc_kinds = tuple((f[0].kind, f[1].kind) for f in spec_bc.faces)
+        for lo, hi in bc_kinds:
+            for kk in (lo, hi):
+                if kk not in (bmod.PERIODIC, bmod.OUTFLOW):
+                    raise NotImplementedError(
+                        "rhd ensembles: periodic/outflow only")
+        grid = ru.RhdGrid(cfg=cfg, shape=shape, dx=dx, bc_kinds=bc_kinds)
+        u0 = _perturb(np.asarray(rhd_condinit(shape, dx, p, cfg)), spec, k)
+        return grid, (jnp.asarray(u0, dtype),), tend, p
+    raise ValueError(f"unknown solver '{spec.solver}'")
+
+
+def member_cooling(p: Params):
+    """(tables, cspec) for a member's &COOLING_PARAMS, or (None, None).
+    Table *data* is traced (J21 sweeps batch freely); ``cspec`` is the
+    frozen static part that splits the sub-batch grouping."""
+    if not p.cooling.cooling:
+        return None, None
+    from ramses_tpu.hydro.cooling import CoolingSpec, build_tables
+    from ramses_tpu.units import units as units_fn
+    cspec = CoolingSpec.from_params(p, units_fn(p, cosmo=None, aexp=1.0))
+    c = p.cooling
+    tables = build_tables(aexp=1.0, J21=float(c.J21),
+                          a_spec=float(c.a_spec),
+                          z_reion=float(c.z_reion),
+                          haardt_madau=bool(c.haardt_madau))
+    return tables, cspec
+
+
+@dataclass
+class SubBatch:
+    """One frozen-config group: members that share a jit cache key."""
+    grid: Any
+    cspec: Any                       # cooling static part (hydro only)
+    members: List[int]               # member indices, batch order
+    state: Tuple[Any, ...]           # each [B, ...]
+    tables: Any                      # stacked cooling tables or None
+    t: Any                           # [B] device
+    tend: np.ndarray                 # [B] host
+    nstep: np.ndarray                # [B] host, real steps done
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+
+class EnsembleEngine:
+    """Advance every member of an :class:`EnsembleSpec` to its tend.
+
+    Members are grouped by ``(grid, cspec)`` — the frozen static
+    dataclasses that are the jit cache keys — so each distinct config
+    compiles once and a traced-only sweep compiles exactly once total.
+    The drive loop dispatches fused ``chunk_steps``-step windows per
+    group until all members complete (per-member ``tend`` or
+    ``&RUN_PARAMS nstepmax``).
+    """
+
+    def __init__(self, spec: EnsembleSpec, dtype=jnp.float64,
+                 telemetry=None):
+        from ramses_tpu.telemetry import make_telemetry
+        self.spec = spec
+        self.params = spec.base
+        self.dtype = dtype
+        tdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        by_key: Dict[Any, Dict[str, list]] = {}
+        for k in range(spec.nmember):
+            grid, state, tend, p = build_member(spec, k, dtype=dtype)
+            tables, cspec = (member_cooling(p) if spec.solver == "hydro"
+                             else (None, None))
+            g = by_key.setdefault((grid, cspec), dict(
+                grid=grid, cspec=cspec, members=[], states=[],
+                tables=[], tend=[]))
+            g["members"].append(k)
+            g["states"].append(state)
+            g["tables"].append(tables)
+            g["tend"].append(tend)
+        self.groups: List[SubBatch] = []
+        for g in by_key.values():
+            ncomp = len(g["states"][0])
+            state = tuple(jnp.stack([s[c] for s in g["states"]])
+                          for c in range(ncomp))
+            tables = (jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *g["tables"])
+                if g["tables"][0] is not None else None)
+            b = len(g["members"])
+            self.groups.append(SubBatch(
+                grid=g["grid"], cspec=g["cspec"], members=g["members"],
+                state=state, tables=tables, t=jnp.zeros(b, tdt),
+                tend=np.asarray(g["tend"], np.float64),
+                nstep=np.zeros(b, np.int64)))
+        self.wall_s = 0.0
+        self.cell_updates = 0
+        self._iout = 0
+        self.telemetry = (telemetry if telemetry is not None
+                          else make_telemetry(spec.base,
+                                              run_info=self.run_info()))
+
+    # ------------------------------------------------------------------
+    # status surface (duck-typed like the solo sims, for the supervisor,
+    # telemetry close() and OpsGuard-style callers)
+    @property
+    def nmember(self) -> int:
+        return self.spec.nmember
+
+    @property
+    def t(self) -> float:
+        """Least-advanced member time (monotone; tend when all done)."""
+        return float(min(float(np.asarray(g.t).min())
+                         for g in self.groups))
+
+    @property
+    def nstep(self) -> int:
+        """Largest member step count (monotone checkpoint ordinal)."""
+        return int(max(int(g.nstep.max()) for g in self.groups))
+
+    def run_info(self) -> Dict[str, Any]:
+        return {"driver": f"ensemble-{self.spec.solver}"
+                if hasattr(self, "spec") else "ensemble",
+                "nmember": self.spec.nmember,
+                "ngroup": len(getattr(self, "groups", [])),
+                "sweeps": sorted(self.spec.sweeps)}
+
+    def _member_pos(self, k: int) -> Tuple[SubBatch, int]:
+        for g in self.groups:
+            if k in g.members:
+                return g, g.members.index(k)
+        raise IndexError(k)
+
+    def member_state(self, k: int) -> Dict[str, Any]:
+        """Member k's current state: ``u`` (+ ``bf`` for MHD), t, nstep."""
+        g, i = self._member_pos(k)
+        out = {"u": g.state[0][i], "t": float(np.asarray(g.t)[i]),
+               "nstep": int(g.nstep[i])}
+        if len(g.state) > 1:
+            out["bf"] = g.state[1][i]
+        return out
+
+    def _group_done(self, g: SubBatch, nstepmax: int) -> np.ndarray:
+        t = np.asarray(g.t, np.float64)
+        reached = t >= g.tend * (1.0 - _TEND_EPS) - 1e-300
+        return reached | (g.nstep >= nstepmax)
+
+    def run_complete(self, params=None, tend=None) -> bool:
+        """Every member individually reached its tend or the step
+        budget (the supervisor's completion hook)."""
+        nmax = int(self.params.run.nstepmax)
+        return all(bool(self._group_done(g, nmax).all())
+                   for g in self.groups)
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, g: SubBatch, nsteps: int, eff_tend):
+        """One fused window for one sub-batch; returns per-member ndone."""
+        tdt = g.t.dtype
+        tend = jnp.asarray(eff_tend, tdt)
+        if self.spec.solver == "hydro" and g.tables is not None:
+            from ramses_tpu.grid.uniform import run_steps_cool_batch
+            u, t, ndone = run_steps_cool_batch(
+                g.grid, g.state[0], g.t, tend, nsteps, g.tables, g.cspec)
+            g.state = (u,)
+        elif self.spec.solver == "hydro":
+            from ramses_tpu.grid.uniform import run_steps_batch
+            u, t, ndone = run_steps_batch(
+                g.grid, g.state[0], g.t, tend, nsteps)
+            g.state = (u,)
+        elif self.spec.solver == "mhd":
+            from ramses_tpu.mhd.uniform import run_steps_batch
+            u, bf, t, ndone = run_steps_batch(
+                g.grid, g.state[0], g.state[1], g.t, tend, nsteps)
+            g.state = (u, bf)
+        else:
+            from ramses_tpu.rhd.uniform import run_steps_batch
+            u, t, ndone = run_steps_batch(
+                g.grid, g.state[0], g.t, tend, nsteps)
+            g.state = (u,)
+        g.t = t
+        return np.asarray(ndone, np.int64)
+
+    def run(self, chunk: Optional[int] = None,
+            nstepmax: Optional[int] = None, verbose: bool = False,
+            on_chunk: Optional[Callable[["EnsembleEngine"], None]] = None):
+        """Drive every sub-batch until all members complete.
+
+        One host round-trip per group per chunk (the ``ndone`` fetch);
+        ``on_chunk`` (service heartbeats) runs after each sweep over
+        the groups."""
+        chunk = int(chunk or self.params.ensemble.chunk_steps or 16)
+        nmax = int(nstepmax if nstepmax is not None
+                   else self.params.run.nstepmax)
+        while not self.run_complete():
+            t0 = time.perf_counter()
+            stepped = 0
+            for g in self.groups:
+                done = self._group_done(g, nmax)
+                if done.all():
+                    continue
+                # members at tend idle via the in-scan mask; members at
+                # the step budget are frozen by clamping their
+                # effective tend below their current t
+                rem = nmax - int(g.nstep[~done].max()) if (~done).any() \
+                    else 0
+                n = max(1, min(chunk, rem))
+                eff_tend = np.where(g.nstep >= nmax, -1.0, g.tend)
+                ndone = self._dispatch(g, n, eff_tend)
+                g.nstep = g.nstep + ndone
+                stepped += int(ndone.sum())
+                self.cell_updates += int(ndone.sum()) * g.grid.ncell
+            self.wall_s += time.perf_counter() - t0
+            self.telemetry.record_event(
+                "ensemble_chunk", nmember=self.nmember,
+                ngroup=len(self.groups), steps=stepped,
+                t_min=self.t, nstep_max=self.nstep,
+                wall_s=round(self.wall_s, 6))
+            if verbose:
+                print(f"ensemble: {self.nmember} members "
+                      f"{len(self.groups)} groups t_min={self.t:.5e} "
+                      f"steps+={stepped}")
+            if on_chunk is not None:
+                on_chunk(self)
+            if stepped == 0:
+                # every active member was clamped to a no-op window —
+                # cannot happen unless tend/nstepmax are inconsistent;
+                # bail rather than spin
+                break
+        return self
+
+    # ------------------------------------------------------------------
+    # manifest-valid checkpoints (resilience/checkpoint) so a supervised
+    # ensemble job resumes exactly like a solo run
+    def save(self, base_dir: str, iout: Optional[int] = None) -> str:
+        from ramses_tpu.resilience.checkpoint import finalize_checkpoint
+        self._iout = int(iout if iout is not None else self._iout + 1)
+        final = os.path.join(base_dir, f"output_{self._iout:05d}")
+        stage = final + ".tmp"
+        os.makedirs(stage, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {}
+        for gi, g in enumerate(self.groups):
+            for ci, comp in enumerate(g.state):
+                arrays[f"g{gi}_s{ci}"] = np.asarray(comp)
+            arrays[f"g{gi}_t"] = np.asarray(g.t)
+            arrays[f"g{gi}_nstep"] = g.nstep
+        np.savez(os.path.join(stage, "ensemble_state.npz"), **arrays)
+        with open(os.path.join(stage, "ensemble.json"), "w") as f:
+            json.dump({"fingerprint": self.spec.fingerprint(),
+                       "nmember": self.nmember,
+                       "solver": self.spec.solver,
+                       "groups": [g.members for g in self.groups],
+                       "iout": self._iout}, f, indent=1)
+        meta = {"kind": "ensemble", "iout": self._iout,
+                "nstep": self.nstep, "t": self.t,
+                "nmember": self.nmember}
+        return finalize_checkpoint(stage, final, meta)
+
+    @classmethod
+    def from_checkpoint(cls, spec: EnsembleSpec, outdir: str,
+                        dtype=jnp.float64, telemetry=None
+                        ) -> "EnsembleEngine":
+        """Rebuild from an ensemble checkpoint dir (manifest-validated
+        by the caller/supervisor); the spec must expand to the same
+        members the checkpoint was written from."""
+        with open(os.path.join(outdir, "ensemble.json")) as f:
+            meta = json.load(f)
+        eng = cls(spec, dtype=dtype, telemetry=telemetry)
+        if meta["fingerprint"] != spec.fingerprint():
+            raise ValueError(
+                f"checkpoint {outdir} was written by a different "
+                f"ensemble spec (fingerprint {meta['fingerprint']} != "
+                f"{spec.fingerprint()})")
+        if meta["groups"] != [g.members for g in eng.groups]:
+            raise ValueError(f"checkpoint {outdir}: sub-batch grouping "
+                             "changed; cannot restore")
+        data = np.load(os.path.join(outdir, "ensemble_state.npz"))
+        for gi, g in enumerate(eng.groups):
+            g.state = tuple(jnp.asarray(data[f"g{gi}_s{ci}"], dtype)
+                            for ci in range(len(g.state)))
+            g.t = jnp.asarray(data[f"g{gi}_t"])
+            g.nstep = np.asarray(data[f"g{gi}_nstep"], np.int64)
+        eng._iout = int(meta.get("iout", 0))
+        return eng

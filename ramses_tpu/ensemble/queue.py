@@ -1,0 +1,230 @@
+"""File-backed submit/claim/complete job queue for the run service.
+
+The queue is a directory with one JSON record per job, and a job's
+lifecycle IS its location: ``queued/`` -> ``running/`` -> ``done/`` or
+``failed/``.  Every transition is a single ``os.rename`` on the same
+filesystem, so claiming is atomic — two workers racing for one job see
+exactly one rename succeed and one ``FileNotFoundError`` (the AMT
+task-queue scheduling shape, arXiv:2412.15518, reduced to POSIX).
+
+Liveness is the running record's mtime: a worker touches its claimed
+record (``heartbeat``) between fused windows, and any caller may
+``reclaim_stale`` records whose mtime is older than the staleness
+timeout — bumping the attempt count and renaming the job back into
+``queued/`` (or into ``failed/`` once ``max_attempts`` is exhausted).
+Results (telemetry JSONL + checkpoints) land under ``results/<job>/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+STATES = ("queued", "running", "done", "failed")
+
+
+@dataclass
+class Job:
+    """A claimed (or inspected) job: its id, current record path and
+    parsed record dict."""
+    id: str
+    path: str
+    record: Dict[str, Any]
+
+    @property
+    def state(self) -> str:
+        return os.path.basename(os.path.dirname(self.path))
+
+
+def _dirs(queue_dir: str) -> Dict[str, str]:
+    return {s: os.path.join(queue_dir, s) for s in STATES}
+
+
+def init_queue(queue_dir: str) -> str:
+    for d in _dirs(queue_dir).values():
+        os.makedirs(d, exist_ok=True)
+    os.makedirs(os.path.join(queue_dir, "results"), exist_ok=True)
+    return queue_dir
+
+
+def results_dir(queue_dir: str, job_id: str) -> str:
+    return os.path.join(queue_dir, "results", job_id)
+
+
+def _write_record(path: str, record: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def submit(queue_dir: str, namelist: str,
+           sweeps: Optional[Dict[str, List[Any]]] = None,
+           solver: str = "", ndim: int = 3, dtype: str = "float32",
+           job_id: str = "", meta: Optional[Dict[str, Any]] = None
+           ) -> str:
+    """Enqueue a run: ``namelist`` is the full namelist *text* (the
+    record is self-contained — workers need no shared checkout), plus
+    optional explicit per-member ``sweeps``.  Returns the job id."""
+    init_queue(queue_dir)
+    if not job_id:
+        job_id = f"job-{time.time_ns():020d}-{os.getpid()}"
+    path = os.path.join(queue_dir, "queued", job_id + ".json")
+    if os.path.exists(path):
+        raise FileExistsError(f"job id '{job_id}' already queued")
+    _write_record(path, {
+        "id": job_id, "namelist": namelist,
+        "sweeps": dict(sweeps or {}), "solver": solver,
+        "ndim": int(ndim), "dtype": dtype,
+        "submitted_unix": time.time(), "attempts": 0,
+        "meta": dict(meta or {})})
+    return job_id
+
+
+def claim(queue_dir: str, worker: str = "",
+          ) -> Optional[Job]:
+    """Atomically claim the oldest queued job (rename into
+    ``running/``), bump its attempt count and stamp the claim time.
+    Returns None when the queue is empty; racing workers each get a
+    distinct job or None."""
+    dirs = _dirs(queue_dir)
+    worker = worker or f"{os.uname().nodename}:{os.getpid()}"
+    try:
+        names = sorted(n for n in os.listdir(dirs["queued"])
+                       if n.endswith(".json"))
+    except FileNotFoundError:
+        return None
+    for name in names:
+        src = os.path.join(dirs["queued"], name)
+        dst = os.path.join(dirs["running"], name)
+        try:
+            os.rename(src, dst)        # the atomic claim
+        except OSError:
+            continue                   # another worker won this one
+        with open(dst) as f:
+            record = json.load(f)
+        record["attempts"] = int(record.get("attempts", 0)) + 1
+        record["worker"] = worker
+        record["claimed_unix"] = time.time()
+        _write_record(dst, record)
+        return Job(id=record["id"], path=dst, record=record)
+    return None
+
+
+def heartbeat(job: Job) -> None:
+    """Refresh the running record's mtime — the worker liveness signal
+    the staleness reclaim keys on."""
+    os.utime(job.path)
+
+
+def complete(job: Job, result: Optional[Dict[str, Any]] = None) -> str:
+    """running -> done, folding ``result`` (artifact paths, final t/
+    nstep) into the record."""
+    return _finish(job, "done", result=result)
+
+
+def fail(job: Job, error: str = "",
+         result: Optional[Dict[str, Any]] = None) -> str:
+    """running -> failed with the error recorded."""
+    return _finish(job, "failed", result=result, error=error)
+
+
+def requeue(job: Job, error: str = "") -> str:
+    """running -> queued (a failed attempt with attempts remaining);
+    the attempt count stays — :func:`claim` bumps it on the next
+    worker."""
+    if error:
+        job.record["error"] = error
+    _write_record(job.path, job.record)
+    dst = os.path.join(os.path.dirname(os.path.dirname(job.path)),
+                       "queued", os.path.basename(job.path))
+    os.rename(job.path, dst)
+    job.path = dst
+    return dst
+
+
+def _finish(job: Job, state: str, result=None, error: str = "") -> str:
+    job.record["finished_unix"] = time.time()
+    if result:
+        job.record["result"] = result
+    if error:
+        job.record["error"] = error
+    _write_record(job.path, job.record)
+    dst = os.path.join(os.path.dirname(os.path.dirname(job.path)),
+                       state, os.path.basename(job.path))
+    os.rename(job.path, dst)
+    job.path = dst
+    return dst
+
+
+def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
+                  max_attempts: int = 3, log=print) -> int:
+    """Requeue running jobs whose heartbeat mtime is older than
+    ``stale_s`` (a dead/preempted worker); jobs already at
+    ``max_attempts`` go to ``failed/`` instead.  Returns the number of
+    records moved.  Safe to call concurrently — the rename either
+    succeeds for exactly one caller or raises and is skipped."""
+    dirs = _dirs(queue_dir)
+    now = time.time()
+    moved = 0
+    try:
+        names = sorted(n for n in os.listdir(dirs["running"])
+                       if n.endswith(".json"))
+    except FileNotFoundError:
+        return 0
+    for name in names:
+        path = os.path.join(dirs["running"], name)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue                   # finished/reclaimed under us
+        if age < stale_s:
+            continue
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        attempts = int(record.get("attempts", 0))
+        state = "queued" if attempts < max_attempts else "failed"
+        if state == "failed":
+            record["error"] = (f"stale after {attempts} attempts "
+                               f"(no heartbeat for {age:.0f}s)")
+        record["reclaimed_unix"] = now
+        dst = os.path.join(dirs[state], name)
+        try:
+            _write_record(path, record)
+            os.rename(path, dst)
+        except OSError:
+            continue
+        moved += 1
+        if log is not None:
+            log(f"queue: reclaimed {record.get('id', name)} -> {state} "
+                f"(heartbeat {age:.0f}s old, attempt {attempts})")
+    return moved
+
+
+def job_status(queue_dir: str, job_id: str) -> Optional[Job]:
+    """Find a job in any state dir (None when unknown)."""
+    for state, d in _dirs(queue_dir).items():
+        path = os.path.join(d, job_id + ".json")
+        if os.path.isfile(path):
+            with open(path) as f:
+                return Job(id=job_id, path=path, record=json.load(f))
+    return None
+
+
+def queue_counts(queue_dir: str) -> Dict[str, int]:
+    out = {}
+    for state, d in _dirs(queue_dir).items():
+        try:
+            out[state] = len([n for n in os.listdir(d)
+                              if n.endswith(".json")])
+        except FileNotFoundError:
+            out[state] = 0
+    return out

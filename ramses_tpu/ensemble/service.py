@@ -1,0 +1,163 @@
+"""Run service: drain the job queue under supervised execution.
+
+``serve(queue_dir)`` is the worker loop: reclaim stale records, claim a
+job, run it through the batched :class:`~ramses_tpu.ensemble.batch.
+EnsembleEngine` under ``resilience/supervisor.supervise`` (auto-resume
+from the newest manifest-valid ensemble checkpoint in the job's results
+dir), and publish telemetry JSONL + checkpoints as the result artifact.
+A single-member job is just an ensemble of one — every job gets the
+same artifact shape.  The engine covers the uniform fused step chains
+(hydro incl. cooling, MHD, RHD); AMR/gravity namelists must run solo
+via ``python -m ramses_tpu run.nml``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from ramses_tpu.ensemble import queue as jq
+
+
+def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
+            verbose: bool = False, log=print) -> Dict[str, Any]:
+    """Execute one claimed job; returns the result dict recorded on
+    ``done``.  Raises on failure (caller moves the record)."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.config import params_from_string
+    from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
+    from ramses_tpu.resilience import supervisor as rsup
+
+    rec = job.record
+    rdir = jq.results_dir(queue_dir, job.id)
+    os.makedirs(rdir, exist_ok=True)
+    nml_path = os.path.join(rdir, "run.nml")
+    with open(nml_path, "w") as f:
+        f.write(rec["namelist"])
+    params = params_from_string(rec["namelist"],
+                                ndim=int(rec.get("ndim", 3)))
+    params.output.output_dir = rdir
+    if not params.output.telemetry:
+        params.output.telemetry = os.path.join(rdir, "telemetry.jsonl")
+    # a re-claimed job (stale worker) must continue from the dead
+    # worker's last checkpoint, so supervise() attempt 1 resolves the
+    # newest manifest-valid dir instead of starting fresh
+    params.run.auto_resume = True
+    dtype = getattr(jnp, rec.get("dtype") or "float32")
+    spec = EnsembleSpec.from_params(params, sweeps=rec.get("sweeps"),
+                                    solver=rec.get("solver", ""))
+
+    def build(restart):
+        if restart:
+            return EnsembleEngine.from_checkpoint(spec, restart,
+                                                  dtype=dtype)
+        return EnsembleEngine(spec, dtype=dtype)
+
+    def drive(eng):
+        from ramses_tpu.resilience.checkpoint import rotate_checkpoints
+
+        def beat(e):
+            # worker liveness + resumability advance together: every
+            # fused window refreshes the claim mtime and lands a
+            # manifest-valid checkpoint (keep the newest two)
+            jq.heartbeat(job)
+            e.save(rdir)
+            rotate_checkpoints(rdir, keep=2)
+        eng.run(verbose=verbose, on_chunk=beat)
+
+    eng = rsup.supervise(build, drive, params, base_dir=rdir,
+                         max_attempts=max_attempts, log=log)
+    snap = eng.save(rdir)
+    eng.telemetry.record_event("ensemble_done", nmember=eng.nmember,
+                               ngroup=len(eng.groups), t_min=eng.t,
+                               nstep_max=eng.nstep, snapshot=snap)
+    eng.telemetry.close(eng, print_timers=False)
+    if not eng.run_complete():
+        raise RuntimeError(
+            f"job {job.id}: incomplete after {max_attempts} attempts "
+            f"(t_min={eng.t:.6g} nstep_max={eng.nstep})")
+    return {"results_dir": rdir, "snapshot": snap,
+            "telemetry": params.output.telemetry,
+            "nmember": eng.nmember, "ngroup": len(eng.groups),
+            "t_min": eng.t, "nstep_max": eng.nstep,
+            "cell_updates": eng.cell_updates}
+
+
+def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
+          idle_exit: bool = False, poll_s: float = 1.0,
+          stale_s: Optional[float] = None, max_attempts: int = 2,
+          verbose: bool = False, log=print) -> Dict[str, int]:
+    """Worker loop: claim and run jobs until the queue is drained
+    (``idle_exit``) or ``max_jobs`` jobs have been processed
+    (0 = unbounded).  Returns done/failed counts for this worker."""
+    jq.init_queue(queue_dir)
+    counts = {"done": 0, "failed": 0, "requeued": 0}
+    while True:
+        # default staleness from the first job's namelist is unknowable
+        # before claiming — use the CLI/default value for the sweep
+        jq.reclaim_stale(queue_dir, stale_s=stale_s or 300.0,
+                         max_attempts=max_attempts, log=log)
+        job = jq.claim(queue_dir, worker=worker)
+        if job is None:
+            if idle_exit:
+                return counts
+            time.sleep(poll_s)
+            continue
+        log(f"serve: claimed {job.id} "
+            f"(attempt {job.record['attempts']}/{max_attempts})")
+        try:
+            result = run_job(queue_dir, job, max_attempts=max_attempts,
+                             verbose=verbose, log=log)
+        except Exception as e:   # noqa: BLE001 — worker boundary
+            log(f"serve: {job.id} failed: {e!r}")
+            err = "".join(traceback.format_exception_only(type(e), e))
+            if int(job.record.get("attempts", 0)) < max_attempts:
+                # hand it back for another worker/attempt; a requeue is
+                # not a processed job (max_jobs counts final outcomes)
+                counts["requeued"] += 1
+                jq.requeue(job, error=err.strip())
+            else:
+                counts["failed"] += 1
+                jq.fail(job, error=err.strip())
+        else:
+            counts["done"] += 1
+            jq.complete(job, result=result)
+            log(f"serve: {job.id} done -> {result['snapshot']}")
+        if max_jobs and counts["done"] + counts["failed"] >= max_jobs:
+            return counts
+
+
+def submit_namelist(queue_dir: str, namelist_path: str,
+                    sweeps: Optional[Dict[str, Any]] = None,
+                    solver: str = "", ndim: int = 3,
+                    dtype: str = "float32") -> str:
+    """CLI submit helper: inline the namelist file into the job record
+    so workers need no shared checkout."""
+    with open(namelist_path) as f:
+        text = f.read()
+    return jq.submit(queue_dir, text, sweeps=sweeps, solver=solver,
+                     ndim=ndim, dtype=dtype,
+                     meta={"namelist_path": os.path.abspath(
+                         namelist_path)})
+
+
+def parse_sweep_args(items) -> Dict[str, list]:
+    """``--sweep key=v1,v2,...`` CLI rows into a sweeps dict (values
+    parsed as JSON scalars when possible, else kept as strings)."""
+    sweeps: Dict[str, list] = {}
+    for item in items or ():
+        key, _, vals = item.partition("=")
+        if not vals:
+            raise ValueError(f"--sweep '{item}': expected key=v1,v2,...")
+        parsed = []
+        for v in vals.split(","):
+            try:
+                parsed.append(json.loads(v))
+            except json.JSONDecodeError:
+                parsed.append(v)
+        sweeps[key.strip()] = parsed
+    return sweeps

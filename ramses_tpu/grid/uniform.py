@@ -194,6 +194,36 @@ def run_steps_cool(grid: UniformGrid, u, t, tend, nsteps: int,
     return u, t, ndone
 
 
+@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+def run_steps_batch(grid: UniformGrid, u, t, tend, nsteps: int,
+                    dt_scale: float = 1.0):
+    """:func:`run_steps` vmapped over a leading ensemble axis.
+
+    ``u`` is ``[B, nvar, *sp]``, ``t``/``tend`` are ``[B]`` — one
+    compiled program advances every member; the per-step
+    ``active = t < tend`` masking inside :func:`run_steps` becomes a
+    per-member ``lax.select`` under vmap, so members that reach their
+    own ``tend`` idle cheaply until the batch drains.  Returns
+    ``(u, t, ndone)`` with ``ndone[B]`` counting each member's real
+    steps.  The batch shares one jit cache entry per ``grid`` — the
+    frozen static dataclass is the cache key (ensemble/batch groups
+    members by it)."""
+    def solo(u_, t_, tend_):
+        return run_steps(grid, u_, t_, tend_, nsteps, dt_scale=dt_scale)
+    return jax.vmap(solo)(u, t, tend)
+
+
+@partial(jax.jit, static_argnames=("grid", "cspec", "nsteps"))
+def run_steps_cool_batch(grid: UniformGrid, u, t, tend, nsteps: int,
+                         tables, cspec):
+    """:func:`run_steps_cool` over a leading ensemble axis; ``tables``
+    is stacked per-member too (cooling-constant sweeps are traced table
+    data, not jit keys — only ``cspec`` splits the cache)."""
+    def solo(u_, t_, tend_, tb_):
+        return run_steps_cool(grid, u_, t_, tend_, nsteps, tb_, cspec)
+    return jax.vmap(solo)(u, t, tend, tables)
+
+
 def totals(u, cfg: HydroStatic, dx: float):
     """Conservation audit (mass, momentum, energy) — ``check_cons``
     (``hydro/courant_fine.f90:161``)."""

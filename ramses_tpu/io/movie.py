@@ -76,13 +76,24 @@ def smooth2d(m: np.ndarray, sigma_px: float) -> np.ndarray:
                               mode="valid"), 1, out)
 
 
+#: .map layout revision appended to the header record.  Version 1
+#: frames pin the shape-record convention (first int = fastest-varying
+#: extent, data written ``arr.T.ravel()``); frames without the tag
+#: (version 0, 5-double header) predate the pin — a non-square
+#: version-0 frame is orientation-ambiguous (see docs/io.md).
+MAP_FORMAT_VERSION = 1
+
+
 def write_frame(path: str, data, t: float = 0.0,
                 bounds: Sequence[float] = (0, 1, 0, 1)) -> None:
     """Binary frame file (``output_frame`` map layout): record [t, xmin,
-    xmax, ymin, ymax], record [nw, nh], record float32 data."""
+    xmax, ymin, ymax, version], record [nw, nh], record float32 data.
+    The trailing version double is ours; the reference's 5-double
+    header readers (``utils/py/map2img.py`` reads by index) skip it."""
     arr = np.asarray(data, dtype=np.float32)
     with open(path, "wb") as f:
-        frt.write_record(f, np.asarray([t, *bounds], dtype=np.float64))
+        frt.write_record(f, np.asarray(
+            [t, *bounds, float(MAP_FORMAT_VERSION)], dtype=np.float64))
         # the reference layout is Fortran column-major: the first int
         # is the FASTEST-varying extent (utils/py/map2img.py reads
         # reshape(ny, nx)); arr.T.ravel() puts axis 0 fastest, so the
@@ -93,11 +104,24 @@ def write_frame(path: str, data, t: float = 0.0,
 
 
 def read_frame(path: str):
+    """Parse a ``.map`` frame.  ``version`` is 0 for pre-tag frames
+    (whose non-square maps are orientation-ambiguous — the writer's
+    shape convention was pinned with the tag); the data record length
+    is checked against nw*nh so a truncated or shape-corrupt frame
+    fails loudly instead of reshaping garbage."""
     with open(path, "rb") as f:
         head = frt.read_reals(f)
+        version = int(head[5]) if len(head) > 5 else 0
         nw, nh = frt.read_ints(f)
-        data = frt.read_array(f, np.float32).reshape(nh, nw).T
-    return dict(t=head[0], bounds=tuple(head[1:5]), data=data)
+        data = frt.read_array(f, np.float32)
+        if data.size != int(nw) * int(nh):
+            raise ValueError(
+                f"{path}: data record holds {data.size} floats but the "
+                f"shape record says nw*nh = {int(nw) * int(nh)} "
+                f"({int(nw)}x{int(nh)}) — truncated or corrupt frame")
+        data = data.reshape(nh, nw).T
+    return dict(t=head[0], bounds=tuple(head[1:5]), data=data,
+                version=version)
 
 
 class Camera:

@@ -5,9 +5,14 @@ The reference bounds checkpoint write concurrency with the
 evolved a dedicated I/O-server process family (``pario/io_loop.f90``).
 The TPU-native equivalent: every host writes exactly the shard rows it
 already holds (``jax.Array.addressable_shards`` — no cross-host gather,
-no device→single-host funnel), one file set per host, with an optional
-``io_group_size`` semaphore bounding how many hosts stream to the
-filesystem at once.  Restore reads whichever file sets exist and
+no device→single-host funnel), one file set per host.  An optional
+``io_group_size`` bounds write concurrency on BOTH axes: within a
+process it is a semaphore over the ``split_hosts`` writer threads, and
+across processes the hosts write in ``io_group_size`` staggered waves
+(wave = ``process_index % io_group_size``) with a global device barrier
+between waves — so at most ``ceil(process_count / io_group_size)``
+hosts stream to the filesystem at once, the IOGROUPSIZE contract.
+Restore reads whichever file sets exist and
 re-places rows onto the CURRENT mesh, so a dump from N hosts restores
 onto any device count — the same any-count contract as the
 reference-format snapshot path (``io/snapshot.py``), which remains the
@@ -67,13 +72,38 @@ def _level_arrays(sim) -> Dict[str, object]:
     return arrs
 
 
+def _host_wave(me: int, group: int) -> int:
+    """The wave in which process ``me`` writes its host files: waves
+    are keyed on ``process_index % io_group_size``, so wave ``w`` holds
+    every ``ceil(nproc/group)``-th process — bounded filesystem fan-in
+    per wave, ``group`` waves total."""
+    return int(me) % max(1, int(group))
+
+
+def _barrier(tag: str) -> None:
+    """Cross-host barrier between write waves (no-op single-process)."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
 def dump_pario(sim, iout: int, base_dir: str = ".",
                io_group_size: Optional[int] = None,
                split_hosts: Optional[int] = None) -> str:
     """Write a per-host sharded checkpoint of ``sim`` (AmrSim or
     ShardedAmrSim).  Each process writes only its addressable shards
-    — one writer thread per host file, bounded by ``io_group_size``
-    concurrent writers (the IOGROUPSIZE ring; None = all at once).
+    — one writer thread per host file.
+
+    ``io_group_size`` bounds write concurrency (None = all at once) on
+    both axes: a per-process semaphore over the ``split_hosts`` writer
+    threads, and — on a multi-process run — cross-host staggering into
+    ``io_group_size`` waves (wave = ``process_index % io_group_size``)
+    with a global barrier between waves, so at most
+    ``ceil(process_count/io_group_size)`` hosts hit the filesystem
+    simultaneously.  Every process walks the same wave schedule, which
+    makes the barrier a collective.
 
     ``split_hosts``: partition this process's shards into that many
     host files written CONCURRENTLY — on a real pod every process is
@@ -164,14 +194,28 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
             except Exception as e:          # surface on the main thread
                 errs.append(e)
 
-    threads = [threading.Thread(target=write, args=(g,))
-               for g in range(ngrp)]
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join()
-    if errs:
-        raise errs[0]
+    def write_all():
+        threads = [threading.Thread(target=write, args=(g,))
+                   for g in range(ngrp)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errs:
+            raise errs[0]
+
+    group = int(io_group_size or 0)
+    if group > 0 and nproc > 1:
+        # cross-host wave staggering: wave w writes while the others
+        # wait at the barrier; min(group, nproc) waves covers every
+        # residue class that actually occurs
+        mine = _host_wave(me, group)
+        for w in range(min(group, nproc)):
+            if mine == w:
+                write_all()
+            _barrier(f"pario_{iout:05d}_wave_{w}")
+    else:
+        write_all()
     if atomic:
         out = ckpt.finalize_checkpoint(out, final, meta={
             "kind": "pario", "iout": int(iout),

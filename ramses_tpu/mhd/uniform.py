@@ -391,6 +391,19 @@ def run_steps(grid: MhdGrid, u, bf, t, tend, nsteps: int,
     return u, bf, t, ndone
 
 
+@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+def run_steps_batch(grid: MhdGrid, u, bf, t, tend, nsteps: int,
+                    dt_scale: float = 1.0):
+    """:func:`run_steps` vmapped over a leading ensemble axis
+    (``u[B, nvar, *sp]``, ``bf[B, 3, *sp]``, ``t/tend[B]``) — cf. the
+    hydro ``grid/uniform.run_steps_batch``.  Per-member completion is
+    the in-scan ``t < tend`` mask; returns per-member ``ndone``."""
+    def solo(u_, bf_, t_, tend_):
+        return run_steps(grid, u_, bf_, t_, tend_, nsteps,
+                         dt_scale=dt_scale)
+    return jax.vmap(solo)(u, bf, t, tend)
+
+
 def totals(u, cfg: MhdStatic, dx: float):
     vol = dx ** cfg.ndim
     return {"mass": jnp.sum(u[0]) * vol,

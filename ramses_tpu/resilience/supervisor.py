@@ -40,7 +40,15 @@ def _sim_nstep(sim) -> int:
 
 
 def run_complete(sim, params, tend: Optional[float] = None) -> bool:
-    """Did the run reach its configured end (tend or nstepmax)?"""
+    """Did the run reach its configured end (tend or nstepmax)?
+
+    A sim may own the answer: when it defines a ``run_complete``
+    method that wins (the ensemble engine does — "complete" there
+    means every *member* reached its own tend/budget, which the
+    scalar t/nstep probes below cannot express)."""
+    own = getattr(sim, "run_complete", None)
+    if callable(own):
+        return bool(own(params, tend=tend))
     run = getattr(params, "run", None)
     nmax = getattr(run, "nstepmax", None)
     if nmax is not None and int(nmax) > 0 \

@@ -148,6 +148,18 @@ def run_steps(grid: RhdGrid, u, t, tend, nsteps: int,
     return u, t, ndone
 
 
+@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+def run_steps_batch(grid: RhdGrid, u, t, tend, nsteps: int,
+                    dt_scale: float = 1.0):
+    """:func:`run_steps` vmapped over a leading ensemble axis
+    (``u[B, nvar, *sp]``, ``t/tend[B]``) — cf. the hydro
+    ``grid/uniform.run_steps_batch``.  Per-member completion is the
+    in-scan ``t < tend`` mask; returns per-member ``ndone``."""
+    def solo(u_, t_, tend_):
+        return run_steps(grid, u_, t_, tend_, nsteps, dt_scale=dt_scale)
+    return jax.vmap(solo)(u, t, tend)
+
+
 def lorentz_refine_flags(u, cfg: RhdStatic, err: float = 0.1):
     """Lorentz-factor gradient refinement criterion (the rhd
     hydro_flag analogue)."""

@@ -48,6 +48,44 @@ def test_frame_roundtrip(tmp_path):
     assert np.allclose(fr["data"], data)
 
 
+def test_frame_version_tag(tmp_path):
+    """New frames carry the .map layout version in the header; legacy
+    5-double headers read back as version 0 (their non-square frames
+    are orientation-ambiguous — the shape convention predates the tag)."""
+    from ramses_tpu.io import fortran as frt
+    from ramses_tpu.io.movie import MAP_FORMAT_VERSION
+
+    p = str(tmp_path / "v1.map")
+    write_frame(p, np.arange(12.0).reshape(3, 4))
+    assert read_frame(p)["version"] == MAP_FORMAT_VERSION == 1
+
+    legacy = str(tmp_path / "v0.map")
+    arr = np.arange(12.0).reshape(3, 4).astype(np.float32)
+    with open(legacy, "wb") as f:
+        frt.write_record(f, np.asarray([2.5, 0, 1, 0, 1],
+                                       dtype=np.float64))
+        frt.write_record(f, np.asarray(arr.shape, dtype=np.int32))
+        frt.write_record(f, arr.T.ravel())
+    fr = read_frame(legacy)
+    assert fr["version"] == 0 and fr["t"] == 2.5
+    assert np.allclose(fr["data"], arr)
+
+
+def test_frame_shape_sanity_check(tmp_path):
+    """A frame whose data record disagrees with its shape record fails
+    loudly instead of reshaping garbage."""
+    from ramses_tpu.io import fortran as frt
+
+    bad = str(tmp_path / "bad.map")
+    with open(bad, "wb") as f:
+        frt.write_record(f, np.asarray([0.0, 0, 1, 0, 1, 1.0],
+                                       dtype=np.float64))
+        frt.write_record(f, np.asarray([3, 4], dtype=np.int32))
+        frt.write_record(f, np.zeros(7, dtype=np.float32))  # != 3*4
+    with pytest.raises(ValueError, match="nw\\*nh"):
+        read_frame(bad)
+
+
 def test_project_kinds():
     f = jnp.asarray(np.arange(27.0).reshape(3, 3, 3))
     assert np.allclose(np.asarray(project(f, 0, "sum")),

@@ -148,6 +148,45 @@ def test_pario_layout_roundtrip(tmp_path):
         assert np.array_equal(a, b), l
 
 
+def test_pario_cross_host_waves(tmp_path, monkeypatch):
+    """On a multi-process run io_group_size staggers HOSTS into waves
+    (wave = process_index % group) with a barrier between them — this
+    process's host files land strictly inside its own wave window."""
+    import jax
+
+    import ramses_tpu.io.pario as pario
+
+    events = []
+    monkeypatch.setattr(pario, "_barrier",
+                        lambda tag: events.append(("barrier", tag)))
+    orig = np.savez
+
+    def recording_savez(path, *a, **k):
+        events.append(("write", os.path.basename(str(path))))
+        return orig(path, *a, **k)
+
+    monkeypatch.setattr(np, "savez", recording_savez)
+    # pretend to be process 1 of 4 (dump_pario reads both lazily)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    sim = AmrSim(params_from_string(NML, ndim=2), dtype=jnp.float32)
+    out = dump_pario(sim, 7, str(tmp_path), split_hosts=2,
+                     io_group_size=2)
+    b0 = events.index(("barrier", "pario_00007_wave_0"))
+    b1 = events.index(("barrier", "pario_00007_wave_1"))
+    writes = [i for i, (kind, name) in enumerate(events)
+              if kind == "write" and name.startswith("host_")]
+    assert len(writes) == 2            # split_hosts=2 files this host
+    # process 1 is in wave 1: every write sits between the two barriers
+    assert all(b0 < i < b1 for i in writes)
+    # a non-zero process writes no manifest, and multi-process dumps
+    # are in place (no atomic rename possible across hosts)
+    assert not os.path.exists(os.path.join(out, "manifest.npz"))
+    assert out.endswith("pario_00007")
+    # the wave schedule covers every residue class once
+    assert [pario._host_wave(p, 2) for p in range(4)] == [0, 1, 0, 1]
+
+
 def test_pario_io_group_throttle(tmp_path, monkeypatch):
     """io_group_size=1 serializes the writers (the IOGROUPSIZE token
     ring); the files still land and restore."""
