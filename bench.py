@@ -166,6 +166,10 @@ def bench_ensemble(params, dtype, jnp, hb=lambda *a, **k: None):
     nsteps = int(os.environ.get("BENCH_ENS_STEPS", "8"))
     batches = tuple(int(b) for b in os.environ.get(
         "BENCH_ENS_BATCHES", "1,8,32").split(","))
+    # BENCH_ENS_POISON=J NaN-poisons member J before the warm window —
+    # the chaos hook proving a bad sweep point degrades the sub-bench
+    # to a quarantine count instead of killing the whole capture
+    poison = os.environ.get("BENCH_ENS_POISON", "")
     params.amr.levelmin = params.amr.levelmax = lvl
     params.ensemble.nmember = max(batches)
     # small IC perturbations make every member's data distinct without
@@ -175,10 +179,14 @@ def bench_ensemble(params, dtype, jnp, hb=lambda *a, **k: None):
     hb("spec")
     per_batch = {}
     grid = None
+    quarantined_max = 0
     for b in batches:
         members = [build_member(spec, k, dtype=dtype) for k in range(b)]
         grid = members[0][0]
         u = jnp.stack([m[1][0] for m in members])
+        if poison != "" and int(poison) < b:
+            u = u.at[(int(poison),) + (0,) * (u.ndim - 1)].set(
+                float("nan"))
         t = jnp.zeros((b,), jnp.float32)
         tend = jnp.full((b,), 1e9, jnp.float32)
         # warm with the SAME (grid, nsteps) so the timed window holds
@@ -190,13 +198,25 @@ def bench_ensemble(params, dtype, jnp, hb=lambda *a, **k: None):
         u2, t2, nd = run_steps_batch(grid, u1, t1, tend, nsteps)
         float(jnp.sum(u2[:, 0]))
         wall = time.perf_counter() - t0
-        steps = int(np.min(np.asarray(nd)))
-        updates = grid.ncell * steps * b
+        # a poisoned member freezes (NaN time fails the in-scan
+        # t < tend mask) — report it as quarantined and take the
+        # throughput numbers over the healthy members only, so one bad
+        # sweep point degrades the report instead of erroring it
+        finite = np.isfinite(np.asarray(t2, np.float64))
+        nq = int((~finite).sum())
+        quarantined_max = max(quarantined_max, nq)
+        if nq:
+            hb("quarantine")
+        b_eff = int(finite.sum())
+        nd_arr = np.asarray(nd)
+        steps = int(nd_arr[finite].min()) if b_eff else 0
+        updates = grid.ncell * steps * b_eff
         per_batch[str(b)] = {
-            "scenarios_per_sec": b / wall,
+            "scenarios_per_sec": b_eff / wall,
             "cell_updates_per_sec": updates / wall,
             "mus_per_cell_update": 1e6 * wall / max(updates, 1),
             "steps_per_member": steps, "wall_s": wall,
+            "quarantined": nq,
         }
         hb(f"timed_b{b}")
     one = per_batch.get("1", {}).get("cell_updates_per_sec")
@@ -212,6 +232,7 @@ def bench_ensemble(params, dtype, jnp, hb=lambda *a, **k: None):
         "cell_updates_per_sec": big["cell_updates_per_sec"],
         "scenarios_per_sec": big["scenarios_per_sec"],
         "n": grid.ncell if grid else 0,
+        "quarantined": quarantined_max,
         "per_batch": per_batch,
         "tunnel_rtt_s": measure_rtt(jnp),
     }

@@ -161,7 +161,12 @@ def main(argv=None) -> int:
         snap = eng.save(params.output.output_dir)
         print(f"ensemble: {eng.nmember} members "
               f"{len(eng.groups)} compile groups t_min={eng.t:.5e} "
-              f"nstep_max={eng.nstep} -> {snap}")
+              f"nstep_max={eng.nstep} "
+              f"quarantined={eng.quarantined_count} -> {snap}")
+        for k, info in sorted(eng.quarantined.items()):
+            print(f"ensemble: member {k} quarantined: "
+                  f"{info.get('reason')} at nstep={info.get('nstep')} "
+                  f"t={info.get('t')}")
         eng.telemetry.close(eng)
         return 0
 

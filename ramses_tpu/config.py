@@ -345,6 +345,15 @@ class EnsembleParams:
     perturb_amp: float = 0.0
     perturb_seed: int = 0
     chunk_steps: int = 16          # fused steps per engine dispatch
+    # member isolation ladder (resilience/stepguard.BatchGuard): a
+    # non-finite member is rolled back to its pre-window state and
+    # re-advanced at halved dt (LLF escalation from the second retry);
+    # after max_member_retries failures it is quarantined so the rest
+    # of the batch keeps running.  member_quarantine arms the guard
+    # even with zero retries (trip -> quarantine directly).  Both off
+    # by default: the engine retains no state and adds no fetches.
+    max_member_retries: int = 0
+    member_quarantine: bool = False
     # run-service knobs (ensemble/queue): a running job whose heartbeat
     # mtime is older than queue_stale_s is presumed orphaned and may be
     # reclaimed by another worker

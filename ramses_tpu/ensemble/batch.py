@@ -273,6 +273,9 @@ class SubBatch:
     t: Any                           # [B] device
     tend: np.ndarray                 # [B] host
     nstep: np.ndarray                # [B] host, real steps done
+    t_host: np.ndarray               # [B] host mirror of t (refreshed
+    #                                  by the per-dispatch fetch)
+    quarantined: np.ndarray          # [B] host bool (evicted members)
 
     @property
     def size(self) -> int:
@@ -322,13 +325,23 @@ class EnsembleEngine:
                 grid=g["grid"], cspec=g["cspec"], members=g["members"],
                 state=state, tables=tables, t=jnp.zeros(b, tdt),
                 tend=np.asarray(g["tend"], np.float64),
-                nstep=np.zeros(b, np.int64)))
+                nstep=np.zeros(b, np.int64),
+                t_host=np.zeros(b, np.float64),
+                quarantined=np.zeros(b, bool)))
         self.wall_s = 0.0
         self.cell_updates = 0
         self._iout = 0
+        #: member isolation ladder state: {member: {reason, nstep, t,
+        #: dump}} for members evicted by the batched step-guard
+        self.quarantined: Dict[int, Dict[str, Any]] = {}
         self.telemetry = (telemetry if telemetry is not None
                           else make_telemetry(spec.base,
                                               run_info=self.run_info()))
+        from ramses_tpu.resilience.faultinject import FaultInjector
+        from ramses_tpu.resilience.stepguard import BatchGuard
+        self._bguard = BatchGuard.from_params(spec.base,
+                                              telemetry=self.telemetry)
+        self._fault = FaultInjector.from_params(spec.base)
 
     # ------------------------------------------------------------------
     # status surface (duck-typed like the solo sims, for the supervisor,
@@ -339,14 +352,24 @@ class EnsembleEngine:
 
     @property
     def t(self) -> float:
-        """Least-advanced member time (monotone; tend when all done)."""
-        return float(min(float(np.asarray(g.t).min())
-                         for g in self.groups))
+        """Least-advanced *healthy* member time (monotone; tend when
+        all done).  Host-cached — no device fetch."""
+        vals = [float(g.t_host[~g.quarantined].min())
+                for g in self.groups if (~g.quarantined).any()]
+        if not vals:                   # everything quarantined
+            vals = [float(g.t_host.min()) for g in self.groups]
+        return float(min(vals))
 
     @property
     def nstep(self) -> int:
         """Largest member step count (monotone checkpoint ordinal)."""
         return int(max(int(g.nstep.max()) for g in self.groups))
+
+    @property
+    def quarantined_count(self) -> int:
+        """Members evicted by the member isolation ladder (telemetry
+        folds this into step/chunk records)."""
+        return len(self.quarantined)
 
     def run_info(self) -> Dict[str, Any]:
         return {"driver": f"ensemble-{self.spec.solver}"
@@ -365,15 +388,18 @@ class EnsembleEngine:
         """Member k's current state: ``u`` (+ ``bf`` for MHD), t, nstep."""
         g, i = self._member_pos(k)
         out = {"u": g.state[0][i], "t": float(np.asarray(g.t)[i]),
-               "nstep": int(g.nstep[i])}
+               "nstep": int(g.nstep[i]),
+               "quarantined": bool(g.quarantined[i])}
         if len(g.state) > 1:
             out["bf"] = g.state[1][i]
         return out
 
     def _group_done(self, g: SubBatch, nstepmax: int) -> np.ndarray:
-        t = np.asarray(g.t, np.float64)
-        reached = t >= g.tend * (1.0 - _TEND_EPS) - 1e-300
-        return reached | (g.nstep >= nstepmax)
+        """Per-member completion from host-cached time: reached tend,
+        hit the step budget, or quarantined (evicted members count as
+        terminally done so the batch — and the job — can drain)."""
+        reached = g.t_host >= g.tend * (1.0 - _TEND_EPS) - 1e-300
+        return reached | (g.nstep >= nstepmax) | g.quarantined
 
     def run_complete(self, params=None, tend=None) -> bool:
         """Every member individually reached its tend or the step
@@ -383,32 +409,56 @@ class EnsembleEngine:
                    for g in self.groups)
 
     # ------------------------------------------------------------------
-    def _dispatch(self, g: SubBatch, nsteps: int, eff_tend):
-        """One fused window for one sub-batch; returns per-member ndone."""
+    def _dispatch(self, g: SubBatch, nsteps: int, eff_tend,
+                  dt_scale: float = 1.0, summarize: bool = False):
+        """One fused window for one sub-batch.
+
+        Returns ``(ndone[B], summ)`` with ``summ`` the per-member guard
+        summary ``[B, 3]`` (None unless ``summarize``).  Exactly ONE
+        host<->device fetch per call — ``jax.device_get`` on the
+        ``(ndone, t[, summary])`` tuple — so arming the batched guard
+        widens the existing fetch instead of adding one, and the
+        zero-overhead pin can count ``jax.device_get`` calls honestly.
+        ``g.t_host`` is refreshed from the same fetch."""
         tdt = g.t.dtype
         tend = jnp.asarray(eff_tend, tdt)
+        summ = None
         if self.spec.solver == "hydro" and g.tables is not None:
             from ramses_tpu.grid.uniform import run_steps_cool_batch
-            u, t, ndone = run_steps_cool_batch(
-                g.grid, g.state[0], g.t, tend, nsteps, g.tables, g.cspec)
+            out = run_steps_cool_batch(
+                g.grid, g.state[0], g.t, tend, nsteps, g.tables,
+                g.cspec, dt_scale=dt_scale, summarize=summarize)
+            u, t, ndone = out[:3]
             g.state = (u,)
         elif self.spec.solver == "hydro":
             from ramses_tpu.grid.uniform import run_steps_batch
-            u, t, ndone = run_steps_batch(
-                g.grid, g.state[0], g.t, tend, nsteps)
+            out = run_steps_batch(
+                g.grid, g.state[0], g.t, tend, nsteps,
+                dt_scale=dt_scale, summarize=summarize)
+            u, t, ndone = out[:3]
             g.state = (u,)
         elif self.spec.solver == "mhd":
             from ramses_tpu.mhd.uniform import run_steps_batch
-            u, bf, t, ndone = run_steps_batch(
-                g.grid, g.state[0], g.state[1], g.t, tend, nsteps)
+            out = run_steps_batch(
+                g.grid, g.state[0], g.state[1], g.t, tend, nsteps,
+                dt_scale=dt_scale, summarize=summarize)
+            u, bf, t, ndone = out[:4]
             g.state = (u, bf)
         else:
             from ramses_tpu.rhd.uniform import run_steps_batch
-            u, t, ndone = run_steps_batch(
-                g.grid, g.state[0], g.t, tend, nsteps)
+            out = run_steps_batch(
+                g.grid, g.state[0], g.t, tend, nsteps,
+                dt_scale=dt_scale, summarize=summarize)
+            u, t, ndone = out[:3]
             g.state = (u,)
         g.t = t
-        return np.asarray(ndone, np.int64)
+        if summarize:
+            ndone_h, t_h, summ = jax.device_get((ndone, t, out[-1]))
+            summ = np.asarray(summ, np.float64)
+        else:
+            ndone_h, t_h = jax.device_get((ndone, t))
+        g.t_host = np.asarray(t_h, np.float64)
+        return np.asarray(ndone_h, np.int64), summ
 
     def run(self, chunk: Optional[int] = None,
             nstepmax: Optional[int] = None, verbose: bool = False,
@@ -421,7 +471,13 @@ class EnsembleEngine:
         chunk = int(chunk or self.params.ensemble.chunk_steps or 16)
         nmax = int(nstepmax if nstepmax is not None
                    else self.params.run.nstepmax)
+        guard = self._bguard
         while not self.run_complete():
+            if self._fault is not None:
+                # top of loop: the previous sweep's on_chunk beat has
+                # already checkpointed, so a sigterm@K resume restarts
+                # at nstep >= K and strict arming prevents a re-fire
+                self._fault.maybe_signal(self.nstep)
             t0 = time.perf_counter()
             stepped = 0
             for g in self.groups:
@@ -429,13 +485,30 @@ class EnsembleEngine:
                 if done.all():
                     continue
                 # members at tend idle via the in-scan mask; members at
-                # the step budget are frozen by clamping their
-                # effective tend below their current t
+                # the step budget (or quarantined) are frozen by
+                # clamping their effective tend below their current t
                 rem = nmax - int(g.nstep[~done].max()) if (~done).any() \
                     else 0
                 n = max(1, min(chunk, rem))
-                eff_tend = np.where(g.nstep >= nmax, -1.0, g.tend)
-                ndone = self._dispatch(g, n, eff_tend)
+                if self._fault is not None:
+                    n = self._fault.clamp_window_batch(
+                        n, self.nstep,
+                        lambda j, _g=g: int(_g.nstep[_g.members.index(j)])
+                        if j in _g.members else self.nstep)
+                eff_tend = np.where((g.nstep >= nmax) | g.quarantined,
+                                    -1.0, g.tend)
+                # the guard's retained pre-window state: plain refs
+                # (run_steps_batch does not donate its inputs)
+                prev = ((g.state, g.t, g.nstep.copy(),
+                         g.t_host.copy()) if guard is not None else None)
+                if self._fault is not None:
+                    self._fault.maybe_nan_batch(g)
+                ndone, summ = self._dispatch(
+                    g, n, eff_tend, summarize=guard is not None)
+                if guard is not None:
+                    bad = guard.screen(g.t_host, summ, active=~done)
+                    if bad.any():
+                        ndone = self._recover(g, bad, prev, ndone)
                 g.nstep = g.nstep + ndone
                 stepped += int(ndone.sum())
                 self.cell_updates += int(ndone.sum()) * g.grid.ncell
@@ -444,11 +517,13 @@ class EnsembleEngine:
                 "ensemble_chunk", nmember=self.nmember,
                 ngroup=len(self.groups), steps=stepped,
                 t_min=self.t, nstep_max=self.nstep,
+                quarantined=self.quarantined_count,
                 wall_s=round(self.wall_s, 6))
             if verbose:
                 print(f"ensemble: {self.nmember} members "
                       f"{len(self.groups)} groups t_min={self.t:.5e} "
-                      f"steps+={stepped}")
+                      f"steps+={stepped} "
+                      f"quarantined={self.quarantined_count}")
             if on_chunk is not None:
                 on_chunk(self)
             if stepped == 0:
@@ -457,6 +532,146 @@ class EnsembleEngine:
                 # bail rather than spin
                 break
         return self
+
+    # ------------------------------------------------------------------
+    # member isolation ladder: trip -> masked rollback -> halved-dt
+    # retry -> LLF escalation regroup -> quarantine
+    def _restore_members(self, g: SubBatch, mask: np.ndarray, prev):
+        """Masked select of the retained pre-window state into the
+        tripped lanes only — healthy members keep their advanced
+        arrays bitwise untouched."""
+        prev_state, prev_t, _prev_nstep, prev_t_host = prev
+        m = jnp.asarray(mask)
+        g.state = tuple(
+            jnp.where(m.reshape((-1,) + (1,) * (cur.ndim - 1)), ps, cur)
+            for ps, cur in zip(prev_state, g.state))
+        g.t = jnp.where(m, prev_t, g.t)
+        g.t_host = np.where(mask, prev_t_host, g.t_host)
+
+    def _retry_masked(self, g: SubBatch, still: np.ndarray,
+                      dt_scale: float):
+        """Re-advance only the tripped lanes one step at reduced dt;
+        everyone else idles via the effective-tend clamp (their state
+        passes through the in-scan select bitwise unchanged)."""
+        eff = np.where(still, g.tend, -1.0)
+        ndone, summ = self._dispatch(g, 1, eff, dt_scale=dt_scale,
+                                     summarize=True)
+        ok = ~self._bguard.screen(g.t_host, summ)
+        return ndone, ok
+
+    def _retry_escalated(self, g: SubBatch, still: np.ndarray,
+                         dt_scale: float):
+        """LLF escalation as a *regroup*: the Riemann knob is a field
+        of the frozen static config (a jit cache key), so the tripped
+        members are gathered into an escalation sub-batch whose grid
+        carries ``riemann='llf'``, advanced one step, and scattered
+        back — never a traced branch."""
+        import dataclasses as _dc
+        idx = np.nonzero(still)[0]
+        jidx = jnp.asarray(idx)
+        esc = SubBatch(
+            grid=_dc.replace(g.grid, cfg=_dc.replace(g.grid.cfg,
+                                                     riemann="llf")),
+            cspec=g.cspec,
+            members=[g.members[i] for i in idx],
+            state=tuple(c[jidx] for c in g.state),
+            tables=(jax.tree_util.tree_map(lambda x: x[jidx], g.tables)
+                    if g.tables is not None else None),
+            t=g.t[jidx], tend=g.tend[idx],
+            nstep=g.nstep[idx].copy(), t_host=g.t_host[idx].copy(),
+            quarantined=np.zeros(len(idx), bool))
+        nd_sub, summ = self._dispatch(esc, 1, esc.tend,
+                                      dt_scale=dt_scale, summarize=True)
+        ok_sub = ~self._bguard.screen(esc.t_host, summ)
+        g.state = tuple(c.at[jidx].set(sc)
+                        for c, sc in zip(g.state, esc.state))
+        g.t = g.t.at[jidx].set(esc.t)
+        g.t_host[idx] = esc.t_host
+        ndone = np.zeros(g.size, np.int64)
+        ndone[idx] = nd_sub
+        ok = np.ones(g.size, bool)
+        ok[idx] = ok_sub
+        return ndone, ok
+
+    def _recover(self, g: SubBatch, bad: np.ndarray, prev,
+                 ndone: np.ndarray) -> np.ndarray:
+        """Run the member isolation ladder for the tripped lanes of
+        one window; returns the corrected per-member ndone (tripped
+        lanes contribute only their recovered retry steps)."""
+        sg = self._bguard
+        _ps, _pt, prev_nstep, prev_t_host = prev
+        ndone = np.array(ndone, np.int64)
+        ndone[bad] = 0
+        sg.record_trip([g.members[i] for i in np.nonzero(bad)[0]],
+                       prev_nstep[bad], prev_t_host[bad])
+        self._restore_members(g, bad, prev)
+        still = bad.copy()
+        riemann = getattr(g.grid.cfg, "riemann", None)
+        can_llf = riemann is not None and riemann != "llf"
+        for attempt in range(1, sg.max_retries + 1):
+            scale = 0.5 ** attempt
+            escalated = attempt >= 2 and can_llf
+            sg.record_rollback(
+                [g.members[i] for i in np.nonzero(still)[0]],
+                attempt, scale, escalated)
+            if escalated:
+                nd_r, ok = self._retry_escalated(g, still, scale)
+            else:
+                nd_r, ok = self._retry_masked(g, still, scale)
+            rec = still & ok
+            if rec.any():
+                ndone[rec] += nd_r[rec]
+                sg.record_recovered(
+                    [g.members[i] for i in np.nonzero(rec)[0]], attempt)
+            still &= ~ok
+            if not still.any():
+                return ndone
+            self._restore_members(g, still, prev)
+        for i in np.nonzero(still)[0]:
+            self._quarantine_member(g, int(i), int(prev_nstep[i]),
+                                    float(prev_t_host[i]))
+        return ndone
+
+    def _quarantine_member(self, g: SubBatch, i: int, nstep0: int,
+                           t0: float):
+        """Evict lane ``i`` of group ``g``: emergency-dump its last
+        clean state (already restored by the ladder), record the
+        census entry, and freeze the lane so the batch drains without
+        it.  The census rides every subsequent checkpoint manifest."""
+        k = int(g.members[i])
+        dump = ""
+        try:
+            dump = self._dump_member(g, i, k, nstep0, t0)
+        except Exception as e:  # noqa: BLE001 — dump is best-effort
+            print(f" batch guard: member {k} emergency dump failed: "
+                  f"{e!r}")
+        info = {"reason": "nonfinite_state", "nstep": nstep0,
+                "t": t0, "dump": dump}
+        self.quarantined[k] = info
+        g.quarantined[i] = True
+        self._bguard.record_quarantine(k, info)
+
+    def _dump_member(self, g: SubBatch, i: int, k: int, nstep0: int,
+                     t0: float) -> str:
+        """Manifest-valid single-member emergency dump
+        (``quarantine_mNNN/`` beside the ensemble checkpoints; the
+        ``output_`` prefix is avoided so auto-resume never selects
+        it)."""
+        from ramses_tpu.resilience.checkpoint import finalize_checkpoint
+        base = str(self.params.output.output_dir or ".")
+        os.makedirs(base, exist_ok=True)
+        final = os.path.join(base, f"quarantine_m{k:03d}")
+        stage = final + ".tmp"
+        os.makedirs(stage, exist_ok=True)
+        arrays = {f"s{ci}": np.asarray(comp[i])
+                  for ci, comp in enumerate(g.state)}
+        np.savez(os.path.join(stage, "member_state.npz"),
+                 t=np.float64(t0), nstep=np.int64(nstep0), **arrays)
+        return finalize_checkpoint(
+            stage, final, meta={"kind": "quarantine_member",
+                                "member": k,
+                                "reason": "nonfinite_state",
+                                "nstep": nstep0, "t": t0})
 
     # ------------------------------------------------------------------
     # manifest-valid checkpoints (resilience/checkpoint) so a supervised
@@ -474,15 +689,22 @@ class EnsembleEngine:
             arrays[f"g{gi}_t"] = np.asarray(g.t)
             arrays[f"g{gi}_nstep"] = g.nstep
         np.savez(os.path.join(stage, "ensemble_state.npz"), **arrays)
+        census = {str(k): v for k, v in sorted(self.quarantined.items())}
         with open(os.path.join(stage, "ensemble.json"), "w") as f:
             json.dump({"fingerprint": self.spec.fingerprint(),
                        "nmember": self.nmember,
                        "solver": self.spec.solver,
                        "groups": [g.members for g in self.groups],
+                       "quarantined": census,
                        "iout": self._iout}, f, indent=1)
         meta = {"kind": "ensemble", "iout": self._iout,
                 "nstep": self.nstep, "t": self.t,
                 "nmember": self.nmember}
+        if census:
+            # per-member quarantine census in the manifest meta: the
+            # durable record (read_quarantine_census) of which members
+            # were evicted, with reason/nstep/t
+            meta["quarantined"] = census
         return finalize_checkpoint(stage, final, meta)
 
     @classmethod
@@ -507,7 +729,16 @@ class EnsembleEngine:
         for gi, g in enumerate(eng.groups):
             g.state = tuple(jnp.asarray(data[f"g{gi}_s{ci}"], dtype)
                             for ci in range(len(g.state)))
-            g.t = jnp.asarray(data[f"g{gi}_t"])
+            # cast to the engine's time dtype (g.t was initialised to
+            # it): a checkpoint written under a different x64 mode must
+            # not leak its dtype into the scan carry
+            g.t = jnp.asarray(data[f"g{gi}_t"], g.t.dtype)
+            g.t_host = np.asarray(data[f"g{gi}_t"], np.float64)
             g.nstep = np.asarray(data[f"g{gi}_nstep"], np.int64)
+        eng.quarantined = {int(k): dict(v) for k, v in
+                           (meta.get("quarantined") or {}).items()}
+        for k in eng.quarantined:
+            g, i = eng._member_pos(k)
+            g.quarantined[i] = True
         eng._iout = int(meta.get("iout", 0))
         return eng

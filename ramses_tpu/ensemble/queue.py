@@ -122,6 +122,28 @@ def heartbeat(job: Job) -> None:
     os.utime(job.path)
 
 
+def _log_failure(record: Dict[str, Any], error: str,
+                 stage: str) -> None:
+    """Append one attempt's failure to the record's ``failure_log``.
+    The log rides the record file through every requeue/reclaim, so a
+    job that bounced across three workers arrives in ``failed/`` with
+    the full history instead of only the last error."""
+    record.setdefault("failure_log", []).append({
+        "error": str(error), "stage": stage,
+        "attempt": int(record.get("attempts", 0)),
+        "worker": record.get("worker", ""),
+        "time_unix": time.time()})
+    record["error"] = str(error)
+
+
+def _emit(telemetry, kind: str, **fields) -> None:
+    if telemetry is not None:
+        try:
+            telemetry.record_event(kind, **fields)
+        except Exception:
+            pass
+
+
 def complete(job: Job, result: Optional[Dict[str, Any]] = None) -> str:
     """running -> done, folding ``result`` (artifact paths, final t/
     nstep) into the record."""
@@ -129,17 +151,26 @@ def complete(job: Job, result: Optional[Dict[str, Any]] = None) -> str:
 
 
 def fail(job: Job, error: str = "",
-         result: Optional[Dict[str, Any]] = None) -> str:
-    """running -> failed with the error recorded."""
+         result: Optional[Dict[str, Any]] = None,
+         telemetry=None) -> str:
+    """running -> failed with the error appended to the accumulated
+    ``failure_log`` (and recorded as the headline ``error``)."""
+    if error:
+        _log_failure(job.record, error, "fail")
+    _emit(telemetry, "queue_fail", job=job.id,
+          attempts=int(job.record.get("attempts", 0)), error=error)
     return _finish(job, "failed", result=result, error=error)
 
 
-def requeue(job: Job, error: str = "") -> str:
+def requeue(job: Job, error: str = "", telemetry=None) -> str:
     """running -> queued (a failed attempt with attempts remaining);
     the attempt count stays — :func:`claim` bumps it on the next
-    worker."""
+    worker.  The attempt's error is appended to ``failure_log``, which
+    survives the requeue because it lives in the record file."""
     if error:
-        job.record["error"] = error
+        _log_failure(job.record, error, "requeue")
+    _emit(telemetry, "queue_requeue", job=job.id,
+          attempts=int(job.record.get("attempts", 0)), error=error)
     _write_record(job.path, job.record)
     dst = os.path.join(os.path.dirname(os.path.dirname(job.path)),
                        "queued", os.path.basename(job.path))
@@ -163,7 +194,8 @@ def _finish(job: Job, state: str, result=None, error: str = "") -> str:
 
 
 def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
-                  max_attempts: int = 3, log=print) -> int:
+                  max_attempts: int = 3, log=print,
+                  telemetry=None) -> int:
     """Requeue running jobs whose heartbeat mtime is older than
     ``stale_s`` (a dead/preempted worker); jobs already at
     ``max_attempts`` go to ``failed/`` instead.  Returns the number of
@@ -192,9 +224,11 @@ def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
             continue
         attempts = int(record.get("attempts", 0))
         state = "queued" if attempts < max_attempts else "failed"
-        if state == "failed":
-            record["error"] = (f"stale after {attempts} attempts "
-                               f"(no heartbeat for {age:.0f}s)")
+        _log_failure(record, f"stale worker (no heartbeat for "
+                     f"{age:.0f}s, attempt {attempts})", "stale")
+        if state == "queued":
+            # the stale note is bookkeeping, not the job's verdict
+            record.pop("error", None)
         record["reclaimed_unix"] = now
         dst = os.path.join(dirs[state], name)
         try:
@@ -203,6 +237,8 @@ def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
         except OSError:
             continue
         moved += 1
+        _emit(telemetry, "queue_reclaim", job=record.get("id", name),
+              attempts=attempts, to=state, heartbeat_age_s=round(age, 1))
         if log is not None:
             log(f"queue: reclaimed {record.get('id', name)} -> {state} "
                 f"(heartbeat {age:.0f}s old, attempt {attempts})")

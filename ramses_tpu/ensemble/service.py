@@ -74,37 +74,72 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
     snap = eng.save(rdir)
     eng.telemetry.record_event("ensemble_done", nmember=eng.nmember,
                                ngroup=len(eng.groups), t_min=eng.t,
-                               nstep_max=eng.nstep, snapshot=snap)
+                               nstep_max=eng.nstep, snapshot=snap,
+                               quarantined=eng.quarantined_count)
     eng.telemetry.close(eng, print_timers=False)
     if not eng.run_complete():
         raise RuntimeError(
             f"job {job.id}: incomplete after {max_attempts} attempts "
             f"(t_min={eng.t:.6g} nstep_max={eng.nstep})")
-    return {"results_dir": rdir, "snapshot": snap,
-            "telemetry": params.output.telemetry,
-            "nmember": eng.nmember, "ngroup": len(eng.groups),
-            "t_min": eng.t, "nstep_max": eng.nstep,
-            "cell_updates": eng.cell_updates}
+    result = {"results_dir": rdir, "snapshot": snap,
+              "telemetry": params.output.telemetry,
+              "nmember": eng.nmember, "ngroup": len(eng.groups),
+              "t_min": eng.t, "nstep_max": eng.nstep,
+              "cell_updates": eng.cell_updates}
+    if eng.quarantined:
+        # partial completion: quarantined members are a property of the
+        # job's *result*, not a worker failure — the job lands in
+        # done/ with the census attached and never burns another queue
+        # attempt on behalf of its healthy members
+        result["partial"] = True
+        result["failed_members"] = [
+            {"member": int(k), **info}
+            for k, info in sorted(eng.quarantined.items())]
+        log(f"serve: {job.id} partial completion — "
+            f"{eng.quarantined_count}/{eng.nmember} members "
+            f"quarantined")
+    return result
+
+
+def _counts_line(queue_dir: str) -> str:
+    c = jq.queue_counts(queue_dir)
+    return (f"queued={c['queued']} running={c['running']} "
+            f"done={c['done']} failed={c['failed']}")
 
 
 def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
           idle_exit: bool = False, poll_s: float = 1.0,
           stale_s: Optional[float] = None, max_attempts: int = 2,
-          verbose: bool = False, log=print) -> Dict[str, int]:
+          verbose: bool = False, log=print, beat_s: float = 30.0,
+          telemetry=None) -> Dict[str, int]:
     """Worker loop: claim and run jobs until the queue is drained
     (``idle_exit``) or ``max_jobs`` jobs have been processed
-    (0 = unbounded).  Returns done/failed counts for this worker."""
+    (0 = unbounded).  Returns done/failed counts for this worker.
+
+    While idle-polling, a ``queue_counts()`` heartbeat line is printed
+    every ``beat_s`` seconds so a stuck fleet is visible from any
+    worker's log; ``telemetry`` (optional) receives the queue
+    lifecycle events (requeue/fail/reclaim)."""
     jq.init_queue(queue_dir)
     counts = {"done": 0, "failed": 0, "requeued": 0}
+    last_beat = 0.0
     while True:
         # default staleness from the first job's namelist is unknowable
         # before claiming — use the CLI/default value for the sweep
         jq.reclaim_stale(queue_dir, stale_s=stale_s or 300.0,
-                         max_attempts=max_attempts, log=log)
+                         max_attempts=max_attempts, log=log,
+                         telemetry=telemetry)
         job = jq.claim(queue_dir, worker=worker)
         if job is None:
             if idle_exit:
+                if log is not None:
+                    log(f"serve: idle, exiting — "
+                        f"{_counts_line(queue_dir)}")
                 return counts
+            now = time.monotonic()
+            if log is not None and now - last_beat >= beat_s:
+                log(f"serve: idle — {_counts_line(queue_dir)}")
+                last_beat = now
             time.sleep(poll_s)
             continue
         log(f"serve: claimed {job.id} "
@@ -119,10 +154,10 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
                 # hand it back for another worker/attempt; a requeue is
                 # not a processed job (max_jobs counts final outcomes)
                 counts["requeued"] += 1
-                jq.requeue(job, error=err.strip())
+                jq.requeue(job, error=err.strip(), telemetry=telemetry)
             else:
                 counts["failed"] += 1
-                jq.fail(job, error=err.strip())
+                jq.fail(job, error=err.strip(), telemetry=telemetry)
         else:
             counts["done"] += 1
             jq.complete(job, result=result)

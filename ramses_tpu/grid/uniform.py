@@ -168,17 +168,18 @@ def _run_steps_pallas(grid: UniformGrid, u, t, tend, nsteps: int,
     return u, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "cspec", "nsteps"))
+@partial(jax.jit, static_argnames=("grid", "cspec", "nsteps", "dt_scale"))
 def run_steps_cool(grid: UniformGrid, u, t, tend, nsteps: int,
-                   tables, cspec):
+                   tables, cspec, dt_scale: float = 1.0):
     """:func:`run_steps` with the cooling source applied after each hydro
     step (the ``cooling_fine`` call that follows ``godunov_fine`` in
-    ``amr/amr_step.f90:448-474``)."""
+    ``amr/amr_step.f90:448-474``).  ``dt_scale < 1`` is the redo-step
+    retry knob, as on :func:`run_steps`."""
     from ramses_tpu.hydro.cooling import cooling_step
 
     def body(carry, _):
         u, t, ndone = carry
-        dt = cfl_dt(grid, u)
+        dt = cfl_dt(grid, u) * dt_scale
         dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
         active = t < tend
         dt_eff = jnp.where(active, dt, 0.0)
@@ -194,9 +195,31 @@ def run_steps_cool(grid: UniformGrid, u, t, tend, nsteps: int,
     return u, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+def batch_summary(u, ndim: int, dx: float, ienergy: int, bf=None):
+    """Per-member conserved/finiteness summary ``[B, 3]`` for the
+    batched guard (resilience/stepguard.BatchGuard): columns are
+    (all-finite flag, mass total, energy total).  A NaN that lands on
+    the *last* step of a fused window leaves the member's ``t`` finite,
+    so the guard needs a state-derived channel too; computed on device
+    so arming the guard only widens the existing per-dispatch fetch
+    instead of adding one."""
+    axes = tuple(range(1, u.ndim))
+    finite = jnp.all(jnp.isfinite(u), axis=axes)
+    if bf is not None:
+        finite &= jnp.all(jnp.isfinite(bf),
+                          axis=tuple(range(1, bf.ndim)))
+    vol = dx ** ndim
+    sp = tuple(range(1, u.ndim - 1))     # spatial axes of u[:, ivar]
+    mass = jnp.sum(u[:, 0], axis=sp)
+    energy = jnp.sum(u[:, ienergy], axis=sp)
+    return jnp.stack([finite.astype(u.dtype),
+                      mass * vol, energy * vol], axis=-1)
+
+
+@partial(jax.jit,
+         static_argnames=("grid", "nsteps", "dt_scale", "summarize"))
 def run_steps_batch(grid: UniformGrid, u, t, tend, nsteps: int,
-                    dt_scale: float = 1.0):
+                    dt_scale: float = 1.0, summarize: bool = False):
     """:func:`run_steps` vmapped over a leading ensemble axis.
 
     ``u`` is ``[B, nvar, *sp]``, ``t``/``tend`` are ``[B]`` — one
@@ -205,23 +228,37 @@ def run_steps_batch(grid: UniformGrid, u, t, tend, nsteps: int,
     per-member ``lax.select`` under vmap, so members that reach their
     own ``tend`` idle cheaply until the batch drains.  Returns
     ``(u, t, ndone)`` with ``ndone[B]`` counting each member's real
-    steps.  The batch shares one jit cache entry per ``grid`` — the
-    frozen static dataclass is the cache key (ensemble/batch groups
-    members by it)."""
+    steps.  ``summarize=True`` (batched step-guard armed) additionally
+    returns the :func:`batch_summary` ``[B, 3]``.  The batch shares
+    one jit cache entry per ``grid`` — the frozen static dataclass is
+    the cache key (ensemble/batch groups members by it)."""
     def solo(u_, t_, tend_):
         return run_steps(grid, u_, t_, tend_, nsteps, dt_scale=dt_scale)
-    return jax.vmap(solo)(u, t, tend)
+    u, t, ndone = jax.vmap(solo)(u, t, tend)
+    if summarize:
+        cfg = grid.cfg
+        return u, t, ndone, batch_summary(u, cfg.ndim, grid.dx,
+                                          cfg.ndim + 1)
+    return u, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "cspec", "nsteps"))
+@partial(jax.jit, static_argnames=("grid", "cspec", "nsteps",
+                                   "dt_scale", "summarize"))
 def run_steps_cool_batch(grid: UniformGrid, u, t, tend, nsteps: int,
-                         tables, cspec):
+                         tables, cspec, dt_scale: float = 1.0,
+                         summarize: bool = False):
     """:func:`run_steps_cool` over a leading ensemble axis; ``tables``
     is stacked per-member too (cooling-constant sweeps are traced table
     data, not jit keys — only ``cspec`` splits the cache)."""
     def solo(u_, t_, tend_, tb_):
-        return run_steps_cool(grid, u_, t_, tend_, nsteps, tb_, cspec)
-    return jax.vmap(solo)(u, t, tend, tables)
+        return run_steps_cool(grid, u_, t_, tend_, nsteps, tb_, cspec,
+                              dt_scale=dt_scale)
+    u, t, ndone = jax.vmap(solo)(u, t, tend, tables)
+    if summarize:
+        cfg = grid.cfg
+        return u, t, ndone, batch_summary(u, cfg.ndim, grid.dx,
+                                          cfg.ndim + 1)
+    return u, t, ndone
 
 
 def totals(u, cfg: HydroStatic, dx: float):

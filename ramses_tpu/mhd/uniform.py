@@ -391,17 +391,24 @@ def run_steps(grid: MhdGrid, u, bf, t, tend, nsteps: int,
     return u, bf, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+@partial(jax.jit,
+         static_argnames=("grid", "nsteps", "dt_scale", "summarize"))
 def run_steps_batch(grid: MhdGrid, u, bf, t, tend, nsteps: int,
-                    dt_scale: float = 1.0):
+                    dt_scale: float = 1.0, summarize: bool = False):
     """:func:`run_steps` vmapped over a leading ensemble axis
     (``u[B, nvar, *sp]``, ``bf[B, 3, *sp]``, ``t/tend[B]``) — cf. the
     hydro ``grid/uniform.run_steps_batch``.  Per-member completion is
-    the in-scan ``t < tend`` mask; returns per-member ``ndone``."""
+    the in-scan ``t < tend`` mask; returns per-member ``ndone``, plus
+    the per-member guard summary ``[B, 3]`` when ``summarize``."""
     def solo(u_, bf_, t_, tend_):
         return run_steps(grid, u_, bf_, t_, tend_, nsteps,
                          dt_scale=dt_scale)
-    return jax.vmap(solo)(u, bf, t, tend)
+    u, bf, t, ndone = jax.vmap(solo)(u, bf, t, tend)
+    if summarize:
+        from ramses_tpu.grid.uniform import batch_summary
+        return u, bf, t, ndone, batch_summary(
+            u, grid.cfg.ndim, grid.dx, IP, bf=bf)
+    return u, bf, t, ndone
 
 
 def totals(u, cfg: MhdStatic, dx: float):

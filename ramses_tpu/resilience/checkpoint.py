@@ -124,6 +124,17 @@ def read_manifest_meta(outdir: str) -> Dict[str, Any]:
         return {}
 
 
+def read_quarantine_census(outdir: str) -> Dict[int, Dict[str, Any]]:
+    """Per-member quarantine census from an ensemble checkpoint's
+    manifest meta: ``{member: {reason, nstep, t, dump}}`` ({} when the
+    checkpoint predates member isolation or nothing is quarantined).
+    Written by ``EnsembleEngine.save`` whenever the batched step-guard
+    evicted members — the durable record of *which* members' results
+    in this checkpoint are last-clean-state rather than completed."""
+    census = read_manifest_meta(outdir).get("quarantined") or {}
+    return {int(k): dict(v) for k, v in census.items()}
+
+
 def scan_checkpoints(base_dir: str, log: Optional[Callable] = None,
                      prefix: str = "output_"
                      ) -> List[Tuple[str, Dict[str, Any]]]:

@@ -5,6 +5,9 @@ Spec syntax (``&RUN_PARAMS fault_inject='...'`` or env
 
   ``nan@K``            poison one cell of the state with NaN just
                        before the coarse step that starts at nstep K
+  ``nan@K:member=J``   same, but targeted at ensemble member J — the
+                       batched engine clamps its fused windows so the
+                       fault lands exactly at member J's step K
   ``sigterm@K``        deliver SIGTERM to this process at the guard
                        check when nstep >= K
   ``truncate:NAME``    after the next checkpoint finalize, truncate
@@ -26,27 +29,38 @@ ENV_VAR = "RAMSES_FAULT_INJECT"
 
 
 def _parse(spec: str):
+    """(faults, member_of): ``faults`` keeps the historic 2-tuple shape
+    (kind, arg); member targeting rides in the parallel ``member_of``
+    dict keyed by fault index."""
     faults = []
+    member_of = {}
     for part in str(spec or "").split(","):
         part = part.strip()
         if not part:
             continue
         if part.startswith("nan@"):
-            faults.append(("nan", int(part[4:])))
+            body, _, opt = part[4:].partition(":")
+            if opt:
+                if not opt.startswith("member="):
+                    raise ValueError(
+                        f"unknown fault_inject option {opt!r} "
+                        f"in {part!r} (expected member=J)")
+                member_of[len(faults)] = int(opt[len("member="):])
+            faults.append(("nan", int(body)))
         elif part.startswith("sigterm@"):
             faults.append(("sigterm", int(part[8:])))
         elif part.startswith("truncate:"):
             faults.append(("truncate", part[len("truncate:"):]))
         else:
             raise ValueError(f"unknown fault_inject spec {part!r}")
-    return faults
+    return faults, member_of
 
 
 class FaultInjector:
     """Holds the parsed fault list and per-fault armed/fired state."""
 
     def __init__(self, spec: str):
-        self.faults = _parse(spec)
+        self.faults, self.member_of = _parse(spec)
         self._armed = {}          # idx -> bool (saw nstep < K)
         self._fired = set()
 
@@ -82,8 +96,9 @@ class FaultInjector:
                             getattr(getattr(sim, "state", None),
                                     "nstep", 0)))
         for i, (kind, _arg) in enumerate(self.faults):
-            if kind != "nan" or not self._should_fire(i, kind, nstep):
-                continue
+            if kind != "nan" or i in self.member_of \
+                    or not self._should_fire(i, kind, nstep):
+                continue               # member-targeted: batched engine
             import numpy as np
             u = getattr(sim, "u", None)
             if u is None and getattr(sim, "state", None) is not None:
@@ -120,6 +135,50 @@ class FaultInjector:
                 n = min(n, int(k) - nstep)
         return max(1, int(n))
 
+    def clamp_window_batch(self, n: int, nstep_global: int,
+                           member_nstep) -> int:
+        """:meth:`clamp_window` for the batched engine: member-targeted
+        faults clamp against *that member's* step count
+        (``member_nstep(j)``; members of a group can lag each other
+        after a retry), untargeted faults against the engine-global
+        ``nstep_global`` — so ``nan@K:member=J`` lands exactly at
+        member J's step K inside a fused window."""
+        for i, (kind, k) in enumerate(self.faults):
+            if kind not in ("nan", "sigterm") or i in self._fired:
+                continue
+            if self._armed.get(i) is False:
+                continue
+            j = self.member_of.get(i)
+            ns = int(nstep_global if j is None else member_nstep(j))
+            if ns < int(k):
+                n = min(n, int(k) - ns)
+        return max(1, int(n))
+
+    def maybe_nan_batch(self, group) -> list:
+        """Poison one cell of each armed member-targeted fault whose
+        member lives in this sub-batch (duck-typed: ``group`` has
+        ``members``/``state``/``nstep``).  Returns the member indices
+        poisoned.  Must run *after* the engine retained its pre-window
+        state, so rollback restores the clean arrays."""
+        import numpy as np
+        poisoned = []
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "nan":
+                continue
+            j = self.member_of.get(i)
+            if j is None or j not in group.members:
+                continue
+            pos = group.members.index(j)
+            if not self._should_fire(i, kind, int(group.nstep[pos])):
+                continue
+            u = group.state[0]
+            idx = (pos,) + (0,) * (u.ndim - 1)
+            group.state = (u.at[idx].set(np.nan),) + group.state[1:]
+            print(f" fault-inject: NaN poisoned member {j} at "
+                  f"nstep={int(group.nstep[pos])}")
+            poisoned.append(j)
+        return poisoned
+
     def maybe_signal(self, nstep: int) -> bool:
         """SIGTERM this process when armed (OpsGuard handles it)."""
         for i, (kind, _arg) in enumerate(self.faults):
@@ -144,7 +203,8 @@ def post_dump(outdir: str):
     spec = os.environ.get(ENV_VAR, "")
     if "truncate:" not in spec:
         return
-    for kind, name in _parse(spec):
+    faults, _members = _parse(spec)
+    for kind, name in faults:
         if kind != "truncate" or name in _truncate_fired:
             continue
         for root, _dirs, files in os.walk(outdir):

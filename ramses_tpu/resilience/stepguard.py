@@ -103,3 +103,112 @@ class StepGuard:
                    emergency_dump=outdir or "")
         print(" step guard: retry ladder exhausted"
               + (f"; emergency dump -> {outdir}" if outdir else ""))
+
+
+class BatchGuard:
+    """Member-granular :class:`StepGuard` for the batched ensemble
+    engine (ensemble/batch.EnsembleEngine).
+
+    The engine already fetches per-member ``(ndone[B], t[B])`` once per
+    fused window; arming the guard only *widens* that single fetch with
+    the on-device conserved/finiteness summary
+    (``grid.uniform.batch_summary``), so the zero-device-fetch-when-off
+    contract of :class:`StepGuard` carries over: ``screen()`` touches
+    only already-host arrays.  Policy: a tripped member is restored
+    from the retained pre-window state by masked select and re-advanced
+    at halved dt (LLF escalation via an escalation sub-batch regroup
+    from the second retry); after ``max_member_retries`` failures the
+    member is quarantined — last clean state emergency-dumped, census
+    recorded in the ensemble checkpoint manifest — and the batch
+    continues without it.
+    """
+
+    def __init__(self, max_retries: int = 2, telemetry=None):
+        self.max_retries = int(max_retries)
+        self.telemetry = telemetry
+        self.trips = 0          # member-windows that screened bad
+        self.rollbacks = 0      # member retry attempts taken
+        self.recovered = 0      # members saved by the ladder
+        self.quarantined = 0    # members evicted
+
+    @classmethod
+    def from_params(cls, params, telemetry=None
+                    ) -> Optional["BatchGuard"]:
+        """A guard when ``&ENSEMBLE_PARAMS max_member_retries > 0`` or
+        ``member_quarantine=.true.`` (quarantine-only mode: a trip
+        evicts directly, no retries), else None — the engine then
+        retains no state and adds no fetches."""
+        e = getattr(params, "ensemble", None)
+        n = int(getattr(e, "max_member_retries", 0) or 0)
+        q = bool(getattr(e, "member_quarantine", False))
+        if n <= 0 and not q:
+            return None
+        return cls(max_retries=max(0, n), telemetry=telemetry)
+
+    @staticmethod
+    def screen(t_host, summ=None, active=None):
+        """bool[B] of tripped members, from *host* arrays only.
+
+        A member trips when its time is non-finite (the in-scan NaN
+        freeze) or its summary shows a non-finite state (finite-flag
+        column 0, conserved totals columns 1+ — catches a NaN landing
+        on the window's last step, where ``t`` is still finite).
+        ``active`` (bool[B]) restricts screening to members that were
+        actually advanced this window."""
+        import numpy as np
+        t_host = np.asarray(t_host, np.float64)
+        bad = ~np.isfinite(t_host)
+        if summ is not None:
+            s = np.asarray(summ, np.float64)
+            bad |= ~np.all(np.isfinite(s), axis=-1)
+            bad |= s[..., 0] < 0.5
+        if active is not None:
+            bad &= np.asarray(active, bool)
+        return bad
+
+    # ---- telemetry (member-level fault/quarantine events) ------------
+
+    def _emit(self, kind: str, **fields):
+        tel = self.telemetry
+        if tel is not None:
+            try:
+                tel.record_event(kind, **fields)
+            except Exception:
+                pass
+
+    def record_trip(self, members, nsteps, ts,
+                    reason: str = "nonfinite"):
+        for m, n, t in zip(members, nsteps, ts):
+            self.trips += 1
+            self._emit("fault", member=int(m), reason=reason,
+                       nstep=int(n), t=float(t))
+        print(f" batch guard: non-finite members {list(members)}; "
+              "rolling back")
+
+    def record_rollback(self, members, attempt: int, dt_scale: float,
+                        escalated: bool):
+        for m in members:
+            self.rollbacks += 1
+            self._emit("member_rollback", member=int(m),
+                       attempt=int(attempt), dt_scale=float(dt_scale),
+                       escalated=bool(escalated))
+        extra = ", riemann->llf regroup" if escalated else ""
+        print(f" batch guard: retry {attempt}/{self.max_retries} for "
+              f"members {list(members)} at dt_scale={dt_scale}{extra}")
+
+    def record_recovered(self, members, attempt: int):
+        for m in members:
+            self.recovered += 1
+            self._emit("member_recovered", member=int(m),
+                       attempt=int(attempt))
+        print(f" batch guard: members {list(members)} recovered on "
+              f"retry {attempt}")
+
+    def record_quarantine(self, member: int, info):
+        self.quarantined += 1
+        self._emit("quarantine", member=int(member), **dict(info))
+        print(f" batch guard: member {int(member)} quarantined "
+              f"({info.get('reason', '?')} at nstep={info.get('nstep')}"
+              f", t={info.get('t')})"
+              + (f"; dump -> {info['dump']}" if info.get("dump")
+                 else ""))

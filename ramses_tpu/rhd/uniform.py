@@ -148,16 +148,24 @@ def run_steps(grid: RhdGrid, u, t, tend, nsteps: int,
     return u, t, ndone
 
 
-@partial(jax.jit, static_argnames=("grid", "nsteps", "dt_scale"))
+@partial(jax.jit,
+         static_argnames=("grid", "nsteps", "dt_scale", "summarize"))
 def run_steps_batch(grid: RhdGrid, u, t, tend, nsteps: int,
-                    dt_scale: float = 1.0):
+                    dt_scale: float = 1.0, summarize: bool = False):
     """:func:`run_steps` vmapped over a leading ensemble axis
     (``u[B, nvar, *sp]``, ``t/tend[B]``) — cf. the hydro
     ``grid/uniform.run_steps_batch``.  Per-member completion is the
-    in-scan ``t < tend`` mask; returns per-member ``ndone``."""
+    in-scan ``t < tend`` mask; returns per-member ``ndone``, plus the
+    per-member guard summary ``[B, 3]`` when ``summarize`` (columns:
+    finite flag, D total, tau total)."""
     def solo(u_, t_, tend_):
         return run_steps(grid, u_, t_, tend_, nsteps, dt_scale=dt_scale)
-    return jax.vmap(solo)(u, t, tend)
+    u, t, ndone = jax.vmap(solo)(u, t, tend)
+    if summarize:
+        from ramses_tpu.grid.uniform import batch_summary
+        return u, t, ndone, batch_summary(u, grid.cfg.ndim, grid.dx,
+                                          grid.cfg.ndim + 1)
+    return u, t, ndone
 
 
 def lorentz_refine_flags(u, cfg: RhdStatic, err: float = 0.1):

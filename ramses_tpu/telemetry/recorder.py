@@ -378,6 +378,11 @@ class Telemetry:
                 "imbalance": float(bs.imbalance),
                 "nreb": int(getattr(sim, "_rebalance_count", 0)),
             }
+        nq = getattr(sim, "quarantined_count", None)
+        if nq:
+            # member isolation ladder (ensemble engines): evicted
+            # members surface in step records, not just fault events
+            rec["quarantined"] = int(nq)
         if state_current and (self._nstep_rec - 1) % self.cons_every == 0:
             cons = self._cons_sample(sim)
             if cons is not None:

@@ -154,6 +154,7 @@ def test_fully_refined_matches_uniform(ndim):
     assert np.max(err) < 1e-11
 
 
+@pytest.mark.slow
 def test_conservation_2d_sedov_amr():
     """Mass & energy conserved to machine precision through refinement,
     subcycling, and flux correction (periodic box)."""
@@ -325,6 +326,7 @@ tend=0.05
                               yc=0.5 * ny, lx=0.5 * ext / boxlen)
         return params_from_string(nml, ndim=2)
 
+    @pytest.mark.slow
     def test_matches_equivalent_cubic_run(self):
         # nx=ny=2, boxlen=0.5, lmin=4  ==  nx=ny=1, boxlen=1, lmin=5:
         # identical cells (dx=1/32 on [0,1]^2), identical physics

@@ -65,6 +65,7 @@ def test_explicit_comm_builds_schedules():
 
 
 @pytest.mark.smoke
+@pytest.mark.slow
 def test_explicit_comm_matches_gspmd():
     """Same tree, same dt sequence: the explicit ppermute schedule and
     the compiler-inserted collectives integrate the same physics."""

@@ -178,6 +178,7 @@ def test_stellar_imf_and_lifetime():
     assert tl[0] > tl[1] > tl[2]          # massive stars die first
 
 
+@pytest.mark.slow
 def test_sink_cloud_accretion():
     """Cloud sampling (create_cloud_from_sink): the draw spreads over
     the cloud's cells instead of one host cell, mass+momentum stay

@@ -115,6 +115,7 @@ def test_degenerate_amr_matches_uniform_pm():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_refined_run_momentum_and_stability():
     """Particles through a refined hierarchy: bounded momentum drift."""
     p = _params(3, 5, ndim=2,

@@ -118,6 +118,7 @@ def _cmp_state(sim_a, sim_b, rtol, atol):
                                    err_msg=f"lvl {l}")
 
 
+@pytest.mark.slow
 def test_forced_layout_single_device_invariance():
     """A forced Hilbert relayout is a pure row permutation: the evolved
     run must match the identity-layout run to roundoff, and the screen
@@ -238,6 +239,7 @@ def _skew_groups(lb, lmin=5, lmax=8):
     return {k: dict(v) for k, v in g.items()}
 
 
+@pytest.mark.slow
 def test_skewed_tree_sharded_rebalances_and_matches_single_device():
     """The acceptance scenario: refinement piled into one corner octant
     on the 8-device mesh.  The natural (threshold) rebalance must fire,

@@ -115,6 +115,7 @@ def test_tracer_no_flux_no_motion():
     assert np.allclose(np.asarray(x2), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_tracer_namelist_dump_restart(tmp_path):
     """&RUN_PARAMS tracer=.true.: Poisson-seeded jittered tracers
     advect, serialize as massless FAM_GAS_TRACER particle rows, and a

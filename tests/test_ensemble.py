@@ -16,6 +16,9 @@ Pins the tentpole contracts:
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -445,6 +448,334 @@ def test_driver_dispatches_ensemble(tmp_path):
                        verbose=False)
     assert isinstance(eng, EnsembleEngine)
     assert eng.run_complete() and eng.nmember == 2 and eng.nstep == 4
+
+
+# ---------------------------------------------------------------------
+# member isolation ladder (batched step-guard -> retry -> quarantine)
+# ---------------------------------------------------------------------
+class _CapTel:
+    """Minimal telemetry stand-in capturing record_event calls."""
+
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+def _armed_params(nstepmax=6, retries=2, quarantine=False, fault=""):
+    p = _hydro_params(nstepmax=nstepmax)
+    p.ensemble.max_member_retries = retries
+    p.ensemble.member_quarantine = quarantine
+    if fault:
+        p.run.fault_inject = fault
+    return p
+
+
+def test_member_fault_recovery_bitwise():
+    """The acceptance pin: ``nan@3:member=1`` in a 4-member batch is
+    recovered by the masked retry and the OTHER members finish bitwise
+    identical to a fault-free run.  (The pending fault clamps the
+    faulty run's fused windows to (3, 3); the clean twin runs chunk=3
+    so the healthy members see the identical window sequence.)"""
+    kw = dict(nmember=4,
+              sweeps={"init.p_region[1]": [0.08, 0.1, 0.12, 0.14]},
+              perturb_amp=0.01)
+    clean = EnsembleEngine(EnsembleSpec(base=_armed_params(), **kw),
+                           dtype=jnp.float64,
+                           telemetry=_CapTel()).run(chunk=3)
+    tel = _CapTel()
+    faulty = EnsembleEngine(
+        EnsembleSpec(base=_armed_params(fault="nan@3:member=1"), **kw),
+        dtype=jnp.float64, telemetry=tel).run(chunk=4)
+    assert faulty.run_complete() and not faulty.quarantined
+    for k in (0, 2, 3):
+        a, b = faulty.member_state(k), clean.member_state(k)
+        assert np.asarray(a["u"]).tobytes() == \
+            np.asarray(b["u"]).tobytes(), k
+        assert a["t"] == b["t"] and a["nstep"] == 6
+    # member 1 took the ladder: tripped exactly at its step 3 (the
+    # fused-window clamp), recovered at halved dt, and still completed
+    m1 = faulty.member_state(1)
+    assert m1["nstep"] == 6 and np.isfinite(np.asarray(m1["u"])).all()
+    faults = [f for k, f in tel.events if k == "fault"]
+    assert faults == [{"member": 1, "reason": "nonfinite",
+                       "nstep": 3, "t": faults[0]["t"]}]
+    assert "member_rollback" in tel.kinds()
+    rec = [f for k, f in tel.events if k == "member_recovered"]
+    assert rec and rec[0]["member"] == 1 and rec[0]["attempt"] == 1
+    g = faulty._bguard
+    assert (g.trips, g.rollbacks, g.recovered, g.quarantined) == \
+        (1, 1, 1, 0)
+    # the chunk records carry the (zero) quarantine count
+    chunks = [f for k, f in tel.events if k == "ensemble_chunk"]
+    assert chunks and all(c["quarantined"] == 0 for c in chunks)
+
+
+def test_member_quarantine_census_and_checkpoint(tmp_path):
+    """Quarantine-only mode: a poisoned member is evicted with a
+    manifest-valid emergency dump of its last clean state, the census
+    rides the ensemble checkpoint manifest, and a restore keeps both
+    the census and the run-complete verdict."""
+    from ramses_tpu.resilience.checkpoint import (latest_valid_checkpoint,
+                                                  read_quarantine_census,
+                                                  validate_checkpoint)
+    tel = _CapTel()
+    p = _armed_params(retries=0, quarantine=True,
+                      fault="nan@3:member=1")
+    p.output.output_dir = str(tmp_path)
+    spec = EnsembleSpec(base=p, nmember=4, perturb_amp=0.01)
+    eng = EnsembleEngine(spec, dtype=jnp.float64,
+                         telemetry=tel).run(chunk=4)
+    assert eng.run_complete()
+    assert list(eng.quarantined) == [1]
+    info = eng.quarantined[1]
+    assert info["reason"] == "nonfinite_state" and info["nstep"] == 3
+    assert eng.member_state(1)["quarantined"] is True
+    assert eng.member_state(1)["nstep"] == 3       # frozen at eviction
+    assert all(eng.member_state(k)["nstep"] == 6 for k in (0, 2, 3))
+    q = [f for k, f in tel.events if k == "quarantine"]
+    assert q and q[0]["member"] == 1
+    # the emergency dump is manifest-valid and holds finite state
+    ok, why = validate_checkpoint(info["dump"])
+    assert ok, why
+    dump = np.load(os.path.join(info["dump"], "member_state.npz"))
+    assert np.isfinite(dump["s0"]).all() and int(dump["nstep"]) == 3
+    # census rides the checkpoint manifest; the quarantine dump is NOT
+    # a resume candidate (no output_ prefix)
+    snap = eng.save(str(tmp_path))
+    assert latest_valid_checkpoint(str(tmp_path), log=None) == snap
+    census = read_quarantine_census(snap)
+    assert census[1]["reason"] == "nonfinite_state"
+    assert census[1]["nstep"] == 3
+    r = EnsembleEngine.from_checkpoint(spec, snap, dtype=jnp.float64)
+    assert r.quarantined[1]["nstep"] == 3
+    assert r.member_state(1)["quarantined"] is True
+    assert r.run_complete()
+
+
+def test_member_retry_llf_escalation(monkeypatch):
+    """When the halved-dt retry fails too, attempt 2 regroups the
+    tripped member into an LLF escalation sub-batch (the Riemann knob
+    is a jit cache key, never a traced branch) — the parent group's
+    config stays untouched."""
+    from ramses_tpu.resilience.stepguard import BatchGuard
+
+    tel = _CapTel()
+    spec = EnsembleSpec(
+        base=_armed_params(retries=2, fault="nan@3:member=1"),
+        nmember=2, perturb_amp=0.01)
+    eng = EnsembleEngine(spec, dtype=jnp.float64, telemetry=tel)
+    real = BatchGuard.screen
+    forced = {"done": False}
+
+    def fake(t_host, summ=None, active=None):
+        bad = real(t_host, summ, active)
+        # retry-ladder checks pass active=None (the main-window check
+        # passes active=~done): fail the FIRST retry so the ladder
+        # reaches the attempt-2 escalation
+        if active is None and not forced["done"]:
+            forced["done"] = True
+            return np.ones_like(bad)
+        return bad
+
+    monkeypatch.setattr(BatchGuard, "screen", staticmethod(fake))
+    eng.run(chunk=4)
+    assert eng.run_complete() and not eng.quarantined
+    rb = [f for k, f in tel.events if k == "member_rollback"]
+    assert [(r["attempt"], r["escalated"]) for r in rb] == \
+        [(1, False), (2, True)]
+    rec = [f for k, f in tel.events if k == "member_recovered"]
+    assert rec == [{"member": 1, "attempt": 2}]
+    assert eng.groups[0].grid.cfg.riemann == "hllc"
+    assert np.isfinite(np.asarray(eng.member_state(1)["u"])).all()
+
+
+def test_batched_zero_overhead_device_get_pin(monkeypatch):
+    """Arming the batched guard must not add host<->device fetches:
+    the per-member summary is folded into the single per-dispatch
+    ``jax.device_get`` tuple fetch (one per fused window — windows
+    (4, 2) for nstepmax=6, chunk=4)."""
+    counts = {}
+    for name, p in (("off", _hydro_params()),
+                    ("armed", _armed_params(retries=2))):
+        kw = dict(nmember=2, perturb_amp=0.01)
+        # warm the compile caches so the counted run is pure dispatch
+        EnsembleEngine(EnsembleSpec(base=p, **kw), dtype=jnp.float64,
+                       telemetry=_CapTel()).run(chunk=4)
+        eng = EnsembleEngine(EnsembleSpec(base=p, **kw),
+                             dtype=jnp.float64, telemetry=_CapTel())
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counted(x, _c=calls, _r=real):
+            _c["n"] += 1
+            return _r(x)
+
+        with monkeypatch.context() as m:
+            m.setattr(jax, "device_get", counted)
+            eng.run(chunk=4)
+        assert eng.run_complete()
+        counts[name] = calls["n"]
+    assert counts["off"] == counts["armed"] == 2, counts
+
+
+def test_bench_ensemble_poison_degrades_to_quarantine_count(
+        monkeypatch):
+    """BENCH_ENS_POISON=J: one poisoned member degrades the ensemble
+    sub-bench to a quarantined count (healthy-member throughput)
+    instead of erroring the capture."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    monkeypatch.setenv("BENCH_ENS_LEVEL", "4")
+    monkeypatch.setenv("BENCH_ENS_STEPS", "2")
+    monkeypatch.setenv("BENCH_ENS_BATCHES", "1,4")
+    monkeypatch.setenv("BENCH_ENS_POISON", "1")
+    marks = []
+    p = _hydro_params(nstepmax=8)
+    d = bench.bench_ensemble(p, jnp.float32, jnp,
+                             hb=lambda *a, **k: marks.append(a))
+    assert d["quarantined"] == 1
+    assert d["per_batch"]["4"]["quarantined"] == 1
+    assert d["per_batch"]["1"]["quarantined"] == 0   # member 1 absent
+    assert d["per_batch"]["4"]["scenarios_per_sec"] > 0
+    assert any(a and a[0] == "quarantine" for a in marks)
+
+
+# ---------------------------------------------------------------------
+# queue failure log + serve heartbeat / partial completion
+# ---------------------------------------------------------------------
+def test_queue_failure_log_accumulates_across_requeues(tmp_path):
+    tel = _CapTel()
+    q = str(tmp_path / "q")
+    jq.submit(q, "&RUN_PARAMS\n/", job_id="job-log")
+    job = jq.claim(q, worker="w1")
+    jq.requeue(job, error="first boom", telemetry=tel)
+    job = jq.claim(q, worker="w2")
+    old = time.time() - 3600
+    os.utime(job.path, (old, old))
+    assert jq.reclaim_stale(q, stale_s=300.0, max_attempts=3,
+                            log=None, telemetry=tel) == 1
+    j = jq.job_status(q, "job-log")
+    assert j.state == "queued"
+    assert "error" not in j.record     # stale note is not the verdict
+    job = jq.claim(q, worker="w3")
+    jq.fail(job, error="final boom", telemetry=tel)
+    j = jq.job_status(q, "job-log")
+    flog = j.record["failure_log"]
+    assert [e["stage"] for e in flog] == ["requeue", "stale", "fail"]
+    assert [e["attempt"] for e in flog] == [1, 2, 3]
+    assert [e["worker"] for e in flog] == ["w1", "w2", "w3"]
+    assert flog[0]["error"] == "first boom"
+    assert "no heartbeat" in flog[1]["error"]
+    assert j.record["error"] == "final boom"
+    assert tel.kinds() == ["queue_requeue", "queue_reclaim",
+                           "queue_fail"]
+    reclaim = tel.events[1][1]
+    assert reclaim["to"] == "queued"
+    assert reclaim["heartbeat_age_s"] >= 300.0
+
+
+def test_serve_idle_prints_queue_counts(tmp_path):
+    logs = []
+    counts = serve(str(tmp_path / "q"), idle_exit=True,
+                   log=logs.append)
+    assert counts == {"done": 0, "failed": 0, "requeued": 0}
+    assert any("serve: idle, exiting — queued=0 running=0 done=0 "
+               "failed=0" in m for m in logs)
+
+
+#: SERVICE_NML with a member-targeted NaN fault + quarantine-only mode:
+#: member 1 is evicted at its step 3 while member 0 completes
+POISON_NML = (SERVICE_NML
+              .replace("&RUN_PARAMS",
+                       "&RUN_PARAMS\nfault_inject='nan@3:member=1'")
+              .replace("chunk_steps=2",
+                       "chunk_steps=2\nmember_quarantine=.true."))
+
+
+def test_partial_completion_never_requeues(tmp_path):
+    """A quarantined member is a property of the job's RESULT, not a
+    worker failure: the job lands in done/ with ``failed_members`` on
+    the FIRST attempt — the queue never burns an attempt on it."""
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, POISON_NML, ndim=2, dtype="float64")
+    counts = serve(q, worker="t", idle_exit=True, max_attempts=2,
+                   log=lambda *a: None)
+    assert counts == {"done": 1, "failed": 0, "requeued": 0}
+    job = jq.job_status(q, jid)
+    assert job.state == "done" and job.record["attempts"] == 1
+    assert "failure_log" not in job.record
+    res = job.record["result"]
+    assert res["partial"] is True
+    assert [m["member"] for m in res["failed_members"]] == [1]
+    assert res["failed_members"][0]["nstep"] == 3
+    kinds = [json.loads(line).get("kind")
+             for line in open(res["telemetry"])]
+    assert "fault" in kinds and "quarantine" in kinds
+    assert "ensemble_done" in kinds
+
+
+def test_sigterm_mid_ensemble_serve_resume_bitwise(tmp_path):
+    """satellite: SIGTERM@K mid-ensemble under ``--serve`` with
+    auto-resume.  The killed worker's job is reclaimed, attempt 2
+    resumes from the beat checkpoint, and the final state — healthy
+    member AND the quarantined member's census — is bitwise identical
+    to an uninterrupted serve of the same job."""
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, POISON_NML, ndim=2, dtype="float64")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, RAMSES_FAULT_INJECT="sigterm@2",
+               JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (root, os.environ.get("PYTHONPATH", ""))
+                   if p))
+    r = subprocess.run(
+        [sys.executable, "-m", "ramses_tpu", "--serve", q,
+         "--idle-exit", "--max-attempts", "2"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=str(tmp_path))
+    assert r.returncode == -signal.SIGTERM, \
+        (r.returncode, r.stdout[-2000:], r.stderr[-2000:])
+    job = jq.job_status(q, jid)
+    assert job.state == "running"      # died mid-claim, no handover
+    old = time.time() - 3600
+    os.utime(job.path, (old, old))
+    logs = []
+    counts = serve(q, worker="resumer", idle_exit=True, max_attempts=2,
+                   log=logs.append)
+    assert counts == {"done": 1, "failed": 0, "requeued": 0}
+    assert any("auto-resume from" in m or "resuming from" in m
+               for m in logs), \
+        "attempt 2 must resume from the dead worker's beat checkpoint"
+    job = jq.job_status(q, jid)
+    assert job.state == "done" and job.record["attempts"] == 2
+    assert [e["stage"] for e in job.record["failure_log"]] == ["stale"]
+    res = job.record["result"]
+
+    # uninterrupted twin of the same job (fresh queue, no env fault)
+    q2 = str(tmp_path / "q2")
+    jid2 = jq.submit(q2, POISON_NML, ndim=2, dtype="float64")
+    counts2 = serve(q2, worker="twin", idle_exit=True, max_attempts=2,
+                    log=lambda *a: None)
+    assert counts2 == {"done": 1, "failed": 0, "requeued": 0}
+    res2 = jq.job_status(q2, jid2).record["result"]
+    a = np.load(os.path.join(res["snapshot"], "ensemble_state.npz"))
+    b = np.load(os.path.join(res2["snapshot"], "ensemble_state.npz"))
+    # both lanes bitwise — the healthy member's full history AND the
+    # quarantined member's restored last-clean state
+    assert a["g0_s0"].tobytes() == b["g0_s0"].tobytes()
+    assert a["g0_t"].tobytes() == b["g0_t"].tobytes()
+    assert np.array_equal(a["g0_nstep"], b["g0_nstep"])
+    fm = [{k: v for k, v in m.items() if k != "dump"}
+          for m in res["failed_members"]]
+    fm2 = [{k: v for k, v in m.items() if k != "dump"}
+           for m in res2["failed_members"]]
+    assert fm == fm2 and fm[0]["member"] == 1 and fm[0]["nstep"] == 3
 
 
 def test_shipped_ensemble_namelist_through_cli(tmp_path, monkeypatch):

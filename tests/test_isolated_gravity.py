@@ -60,6 +60,7 @@ def test_isolated_vs_periodic_differ():
     assert abs(d_per - d_iso) > 1e-3 * abs(d_iso)
 
 
+@pytest.mark.slow
 def test_amr_isolated_gravity_blob():
     """Open-box AMR run: blob force points inward at ~-M/r^2, and the
     hierarchy steps stay finite (the old periodic-only raise is gone)."""

@@ -94,6 +94,7 @@ def test_mc_tracer_amr_uniform_advection():
         assert np.allclose(rho, 1.0, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_mc_tracer_sedov_follows_gas_mass():
     """Tracer radial distribution tracks the gas mass distribution on
     the refined blast (replaces the velocity-tracer stand-in)."""

@@ -362,6 +362,7 @@ def test_roe_upwind_consistency_and_conservation():
             assert l1 < 0.02, (riemann, l1)
 
 
+@pytest.mark.slow
 def test_riemann2d_bank_orszag_tang():
     """Every 2D corner solver of the reference bank
     (riemann2d=llf|roe|upwind|hll|hlla|hlld, mhd/umuscl.f90:1946-2000)

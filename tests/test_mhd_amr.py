@@ -156,6 +156,7 @@ def test_ot_divb_machine_zero_across_regrids():
     assert sim.max_divb() < 1e-11
 
 
+@pytest.mark.slow
 def test_ot_amr_conservation():
     """Mass/energy conserved across coarse-fine interfaces (masked
     fluxes + fine corrections, the hydro scheme applied to MHD)."""
@@ -291,6 +292,7 @@ def _pm_params(extra_init, ndim=2):
     return params_from_string(txt, ndim=ndim)
 
 
+@pytest.mark.slow
 def test_mhd_amr_particles_match_hydro_amr():
     """With a vanishing field and uniform gas the MHD hierarchy's PM
     layer must reproduce the hydro hierarchy's particle trajectories:

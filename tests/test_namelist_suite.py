@@ -67,7 +67,14 @@ def _shrunk_copy(name: str, tmp_path) -> str:
     return dst
 
 
-@pytest.mark.parametrize("name", sorted(CONFIGS))
+# the longest-running configs ride the nightly tier only
+_SLOW_NMLS = {"collapse_iso.nml", "tube_mhd.nml"}
+
+
+@pytest.mark.parametrize("name", [
+    pytest.param(n, marks=pytest.mark.slow) if n in _SLOW_NMLS else n
+    for n in sorted(CONFIGS)
+])
 def test_namelist_runs_through_cli(name, tmp_path, monkeypatch):
     from ramses_tpu.__main__ import main
 

@@ -27,6 +27,7 @@ def _sim(lmin=4, lmax=5):
     return AmrSim(p, dtype=jnp.float64)
 
 
+@pytest.mark.slow
 def test_sigusr1_snapshot(tmp_path):
     """SIGUSR1 mid-run produces a valid restartable snapshot."""
     sim = _sim()

@@ -30,6 +30,7 @@ NML = "\n".join([
 ])
 
 
+@pytest.mark.slow
 def test_pario_roundtrip_any_device_count(tmp_path):
     import jax
     devices = jax.devices()

@@ -161,6 +161,44 @@ def test_fault_spec_parse_arming_and_window_clamp():
     assert inj2.clamp_window(5, 16) == 16   # past the target
 
 
+def test_member_targeted_fault_parse_clamp_and_poison():
+    """``nan@K:member=J``: the faults list keeps its historic 2-tuple
+    shape (member targeting rides the parallel ``member_of`` dict), the
+    solo drivers skip targeted faults, and the batched clamp/poison key
+    on member J's OWN step count."""
+    inj = finj.FaultInjector("nan@5:member=2,sigterm@9")
+    assert inj.faults == [("nan", 5), ("sigterm", 9)]
+    assert inj.member_of == {0: 2}
+    with pytest.raises(ValueError, match="member"):
+        finj.FaultInjector("nan@3:lane=1")
+    # a solo sim never fires a member-targeted fault
+    sim = types.SimpleNamespace(nstep=0, u=jnp.zeros((4, 4)))
+    assert inj.maybe_nan(sim) is False
+    assert np.isfinite(np.asarray(sim.u)).all()
+    # member faults clamp against THAT member's step count, untargeted
+    # faults against the engine-global one
+    assert inj.clamp_window_batch(16, 0, lambda j: {2: 3}[j]) == 2
+    assert inj.clamp_window_batch(16, 7, lambda j: {2: 5}[j]) == 2
+    assert inj.clamp_window_batch(16, 9, lambda j: {2: 7}[j]) == 16
+
+    # batched poison lands in member J's LANE, exactly at its step K
+    inj2 = finj.FaultInjector("nan@5:member=2")
+    grp = types.SimpleNamespace(members=[4, 2],
+                                state=(jnp.ones((2, 3, 4, 4)),),
+                                nstep=np.array([7, 3]))
+    assert inj2.maybe_nan_batch(grp) == []    # arms at nstep 3 < 5
+    grp.nstep = np.array([9, 5])
+    assert inj2.maybe_nan_batch(grp) == [2]
+    u = np.asarray(grp.state[0])
+    assert np.isnan(u[1, 0, 0, 0]) and np.isfinite(u[0]).all()
+    assert inj2.maybe_nan_batch(grp) == []    # exactly-once
+    # strict arming: a resume first observed at nstep >= K never fires
+    inj3 = finj.FaultInjector("nan@5:member=2")
+    grp.nstep = np.array([9, 6])
+    assert inj3.maybe_nan_batch(grp) == []
+    assert inj3.clamp_window_batch(16, 0, lambda j: 1) == 16
+
+
 # ---------------------------------------------------------------------
 # atomic checkpoints
 # ---------------------------------------------------------------------

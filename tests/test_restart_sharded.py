@@ -33,6 +33,7 @@ def source_sim():
     return sim
 
 
+@pytest.mark.slow
 def test_dump8_restore1(tmp_path, source_sim):
     sim = source_sim
     out = sim.dump(1, str(tmp_path), ncpu=8)

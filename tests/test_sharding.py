@@ -51,6 +51,7 @@ def test_mesh_shape():
 
 
 @pytest.mark.smoke
+@pytest.mark.slow
 def test_sharded_amr_matches_single_device():
     """Decomposition invariance for the AMR path: identical aggregates
     from the 8-device sharded run and the single-device run."""
