@@ -35,16 +35,21 @@ The parent ALWAYS prints the JSON line and exits 0.
 Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
-BENCH_ONLY=uniform|amr|mg|amr_poisson|ensemble, BENCH_SUB_TIMEOUT,
-BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH, BENCH_ENS_LEVEL,
-BENCH_ENS_STEPS, BENCH_ENS_BATCHES.
+BENCH_ONLY=<comma list of uniform|amr|mg|amr_poisson|ensemble>,
+BENCH_SUB_TIMEOUT, BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH,
+BENCH_ENS_LEVEL, BENCH_ENS_STEPS, BENCH_ENS_BATCHES,
+BENCH_HANG_SUB=<sub> (deliberately wedge that child before its jax
+import — the hang-isolation test hook).
 
 Each child writes a phase-marker heartbeat sidecar
-(BENCH_HEARTBEAT_<sub>.jsonl, format: ramses_tpu/telemetry/heartbeat.py);
-on a timeout the parent folds the child's last phase into the error
-object as ``phase_at_timeout`` — a hang in backend init, warmup, or the
-timed window each read differently instead of as four identical
-"sub-bench timed out" errors.
+(BENCH_HEARTBEAT_<sub>.jsonl, format: ramses_tpu/telemetry/heartbeat.py)
+plus an atomic result sidecar (BENCH_RESULT_<sub>.json) once its
+measurement finishes; on a timeout the parent folds the child's last
+phase into the error object as ``phase_at_timeout`` with
+``classification: "hang"`` (also set when a child exits with the
+watchdog's hang status 87), or recovers the completed result from the
+sidecar when only the exit hung.  A per-pending-sub budget reserve
+means one hung sub can never exhaust the global budget for the rest.
 """
 
 import json
@@ -62,6 +67,33 @@ MARKER = "##BENCH_SUB##"
 
 def _hb_path(name):
     return os.path.join(HERE, f"BENCH_HEARTBEAT_{name}.jsonl")
+
+
+def _result_path(name):
+    return os.path.join(HERE, f"BENCH_RESULT_{name}.json")
+
+
+def _write_result(name, d):
+    """Atomic sidecar copy of the sub's result dict: the parent reads
+    it back when the child was deadline-killed (or its captured stdout
+    truncated) AFTER the measurement finished — the healthy value
+    still lands in the driver JSON instead of a timeout error."""
+    path = _result_path(name)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _read_result(name):
+    try:
+        with open(_result_path(name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def _read_phases(path):
@@ -482,6 +514,14 @@ def run_sub_inproc(name):
     hb = _load_heartbeat_mod().Heartbeat.from_env()
     hb.mark("start", sub=name)
 
+    if os.environ.get("BENCH_HANG_SUB", "") == name:
+        # deliberate-hang hook (CI/tests): wedge BEFORE the jax import
+        # so the parent's deadline-kill + hang-classification path is
+        # exercised in seconds, not a backend-init timeout
+        hb.mark("deliberate_hang")
+        while True:
+            time.sleep(0.5)
+
     import jax
     import jax.numpy as jnp
     hb.mark("import jax")
@@ -509,6 +549,7 @@ def run_sub_inproc(name):
     hb.mark("done")
     d["_device"] = str(jax.devices()[0].platform)
     d["_dtype"] = str(dtype.__name__)
+    _write_result(name, d)
     print(MARKER + json.dumps(d), flush=True)
 
 
@@ -560,11 +601,14 @@ def _backend_ish(msg):
         "Socket closed", "Connection reset"))
 
 
-def run_sub(name, deadline, weight=None):
+def run_sub(name, deadline, weight=None, reserve=0.0):
     """Parent side: launch the sub-bench subprocess with a timeout
     bounded by BOTH the per-sub ceiling and this sub's share of the
     remaining global budget; retry on backend-init failures/timeouts
-    only while budget remains.  Returns the sub dict (or error)."""
+    only while budget remains.  ``reserve`` (seconds) is held back for
+    the subs still pending after this one, so one hung sub burns its
+    own share of the budget, never the whole remainder.  Returns the
+    sub dict (or error)."""
     ceiling = float(os.environ.get("BENCH_SUB_TIMEOUT",
                                    SUB_TIMEOUTS.get(name, 600)))
     if weight is None:
@@ -591,12 +635,17 @@ def run_sub(name, deadline, weight=None):
             return last or {"error": "skipped: global bench budget "
                                      "exhausted", "attempt": attempt}
         timeout = min(ceiling, max(45.0, weight * remaining))
-        try:
-            # stale sidecar from a previous attempt/run must not
-            # masquerade as this child's last phase
-            os.path.exists(hb_path) and os.remove(hb_path)
-        except OSError:
-            pass
+        if reserve > 0.0:
+            # hold back >=45s for each still-pending sub (never raising
+            # the per-sub ceiling)
+            timeout = min(timeout, max(45.0, remaining - reserve))
+        for stale in (hb_path, _result_path(name)):
+            try:
+                # stale sidecars from a previous attempt/run must not
+                # masquerade as this child's phase trail or result
+                os.path.exists(stale) and os.remove(stale)
+            except OSError:
+                pass
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--sub", name],
@@ -605,14 +654,28 @@ def run_sub(name, deadline, weight=None):
             for line in reversed(r.stdout.splitlines()):
                 if line.startswith(MARKER):
                     return json.loads(line[len(MARKER):])
+            got = _read_result(name)
+            if got is not None:
+                return got        # stdout lost, sidecar survived
             tail = (r.stderr or r.stdout or "")[-2000:]
             last = {"error": f"sub-bench exited rc={r.returncode} "
                              f"without result", "tail": tail,
                     "attempt": attempt, **_hb_diag()}
+            if r.returncode == 87:
+                # the watchdog's HANG_EXIT_CODE, as a literal — the
+                # parent never imports ramses_tpu
+                last["classification"] = "hang"
+                return last
             if not _backend_ish(tail):
                 return last
         except subprocess.TimeoutExpired:
+            got = _read_result(name)
+            if got is not None:
+                # the measurement finished; the child hung afterwards
+                got["late"] = True
+                return got
             last = {"error": f"sub-bench timed out after {timeout:.0f}s",
+                    "classification": "hang",
                     "attempt": attempt, **_hb_diag()}
         except Exception:
             last = {"error": traceback.format_exc()[-2000:],
@@ -630,11 +693,13 @@ def run_sub(name, deadline, weight=None):
 
 def main():
     only = os.environ.get("BENCH_ONLY", "")
-    if only not in ("",) + SUBS:
+    wanted = (tuple(s.strip() for s in only.split(",") if s.strip())
+              if only else SUBS)
+    bad = [s for s in wanted if s not in SUBS]
+    if bad:
         raise SystemExit(
-            f"BENCH_ONLY={only!r}: expected "
-            f"uniform|amr|mg|amr_poisson|ensemble")
-    wanted = SUBS if only == "" else (only,)
+            f"BENCH_ONLY={only!r}: unknown sub(s) {bad}; expected a "
+            f"comma list of uniform|amr|mg|amr_poisson|ensemble")
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "900"))
     deadline = time.monotonic() + budget
     partial_path = os.environ.get(
@@ -656,9 +721,10 @@ def main():
                        "sub": {}}, f)
     except OSError:
         pass
-    for name in wanted:
+    for i, name in enumerate(wanted):
         sub[name] = run_sub(name, deadline,
-                            weight=0.95 if len(wanted) == 1 else None)
+                            weight=0.95 if len(wanted) == 1 else None,
+                            reserve=45.0 * (len(wanted) - 1 - i))
         device = device or sub[name].pop("_device", None)
         dtype_name = dtype_name or sub[name].pop("_dtype", None)
         sub[name].pop("_device", None)

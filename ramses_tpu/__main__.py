@@ -306,4 +306,13 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from ramses_tpu.resilience.watchdog import (HANG_EXIT_CODE,
+                                                HangDetected)
+    try:
+        sys.exit(main())
+    except HangDetected as e:
+        # hang budget exhausted: exit with the dedicated status so a
+        # parent (batch system, bench subprocess capture) classifies
+        # hang vs crash without parsing logs
+        print(f"ramses_tpu: unrecoverable hang: {e}", file=sys.stderr)
+        sys.exit(HANG_EXIT_CODE)

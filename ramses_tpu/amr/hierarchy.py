@@ -19,6 +19,7 @@ standard regrid-interval relaxation).
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from functools import partial
 from typing import Dict, NamedTuple, Optional
 
@@ -553,6 +554,8 @@ class AmrSim:
         self._sguard = StepGuard.from_params(params,
                                              telemetry=self.telemetry)
         self._fault = FaultInjector.from_params(params)
+        from ramses_tpu.resilience.watchdog import Watchdog
+        self._wd = Watchdog.from_params(params, telemetry=self.telemetry)
         self._guard_snap = None
         # cosmology: supercomoving conformal-time integration
         # (``amr/update_time.f90``; aexp/hexp from the Friedmann tables)
@@ -1817,7 +1820,11 @@ class AmrSim:
                 if self._fault is not None:
                     self._fault.maybe_nan(self)
                 if not instrumented:
-                    n = self.step_chunk(chunk, tend)
+                    with self._step_guard():
+                        if self._fault is not None:
+                            self._fault.maybe_hang(self.nstep)
+                        n = self.step_chunk(chunk, tend)
+                    self._wd_note()
                     if sguard is not None \
                             and not sguard.ok(self.t, self.dt_old):
                         self._recover_step(tend)
@@ -1826,7 +1833,12 @@ class AmrSim:
                         break
                     continue
                 t0 = time.perf_counter()
-                n, (ts, dts) = self.step_chunk(chunk, tend, trace=True)
+                with self._step_guard():
+                    if self._fault is not None:
+                        self._fault.maybe_hang(self.nstep)
+                    n, (ts, dts) = self.step_chunk(chunk, tend,
+                                                   trace=True)
+                self._wd_note()
                 if sguard is not None \
                         and not sguard.ok(self.t, self.dt_old):
                     # rolled-back window: its poisoned records are
@@ -1847,7 +1859,11 @@ class AmrSim:
             if self._fault is not None:
                 self._fault.maybe_nan(self)
             t0 = time.perf_counter() if instrumented else 0.0
-            self.step_coarse(dt)
+            with self._step_guard():
+                if self._fault is not None:
+                    self._fault.maybe_hang(self.nstep)
+                self.step_coarse(dt)
+            self._wd_note()
             # trip detection BEFORE the telemetry record and before the
             # next iteration's regrid rebuilds the tree on a poisoned
             # state (which would make the capture unrestorable): the
@@ -1861,6 +1877,16 @@ class AmrSim:
                         self, dt=dt, wall_s=time.perf_counter() - t0)
                 if verbose:
                     print(telemetry_screen.step_line(self, dt=dt))
+
+    def _step_guard(self):
+        """Watchdog deadline guard for one fused window / coarse step
+        (nullcontext when the watchdog is off — zero added fetches)."""
+        return (self._wd.guard("step") if self._wd is not None
+                else nullcontext())
+
+    def _wd_note(self):
+        if self._wd is not None:
+            self._wd.note(nstep=self.nstep, t=self.t)
 
     # ------------------------------------------------------------------
     # diagnostics

@@ -68,6 +68,15 @@ class RunParams:
     auto_resume: bool = False
     max_step_retries: int = 0
     fault_inject: str = ""
+    # hang watchdog (resilience/watchdog.py): wall-clock budgets for
+    # the first (compiling) fused window, every later window, and
+    # checkpoint writes.  0 disables (zero-overhead off); on expiry a
+    # structured 'hang' event + emergency hang_NNNNN dump land and the
+    # supervisor resumes immediately from the newest checkpoint.
+    # RAMSES_{COMPILE,STEP,IO}_DEADLINE_S env vars override.
+    compile_deadline_s: float = 0.0
+    step_deadline_s: float = 0.0
+    io_deadline_s: float = 0.0
 
 
 @dataclass
@@ -139,6 +148,9 @@ class OutputParams:
     # keep only the newest N manifest-valid checkpoints (0 = keep all);
     # rotation never touches pre-atomic output dirs without manifests
     checkpoint_keep: int = 0
+    # also write each particle output as a Gadget SnapFormat=1 file
+    # (io/gadget.py write_gadget — the reference's savegadget flag)
+    savegadget: bool = False
 
 
 @dataclass
@@ -358,6 +370,13 @@ class EnsembleParams:
     # mtime is older than queue_stale_s is presumed orphaned and may be
     # reclaimed by another worker
     queue_stale_s: float = 300.0
+    # hang watchdog for the batched engine (resilience/watchdog.py):
+    # same semantics as the &RUN_PARAMS deadlines, but guarding the
+    # engine's per-chunk dispatch fetch; a hang escaping run_job makes
+    # the serve loop requeue the job with stage="hang"
+    compile_deadline_s: float = 0.0
+    step_deadline_s: float = 0.0
+    io_deadline_s: float = 0.0
 
 
 @dataclass

@@ -8,6 +8,7 @@ wall-clock/output bookkeeping; device advances in fused multi-step chunks.
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -245,6 +246,10 @@ class Simulation:
         self._sguard = StepGuard.from_params(params,
                                              telemetry=self.telemetry)
         self._fault = FaultInjector.from_params(params)
+        # hang watchdog (&RUN_PARAMS *_deadline_s): None when every
+        # deadline is unset — evolve() then skips the guard entirely
+        from ramses_tpu.resilience.watchdog import Watchdog
+        self._wd = Watchdog.from_params(params, telemetry=self.telemetry)
 
     @property
     def nstep(self) -> int:
@@ -321,38 +326,49 @@ class Simulation:
                     self._fault.maybe_nan(self)
                 t0 = time.perf_counter()
                 hist = None
-                if (self.pspec.enabled or self.gspec.enabled
-                        or self.cosmo is not None):
-                    u, st.p, st.f, t, dt_old, ndone = run_steps_pm(
-                        self.grid, self.gspec, self.pspec, st.u, st.p,
-                        st.f, jnp.asarray(st.t, tdtype),
-                        jnp.asarray(tout, tdtype),
-                        jnp.asarray(st.dt_old, tdtype), n,
-                        cosmo=self.cosmo)
-                    st.dt_old = float(dt_old)
-                elif self.cool_tables is not None:
-                    from ramses_tpu.grid.uniform import run_steps_cool
-                    u, t, ndone = run_steps_cool(
-                        self.grid, st.u, jnp.asarray(st.t, tdtype),
-                        jnp.asarray(tout, tdtype), n,
-                        self.cool_tables, self.cool_spec)
-                elif telem.enabled:
-                    # instrumented run: the scan additionally stacks
-                    # per-step (t, dt) so the event log gets one record
-                    # per coarse step from this single summary fetch —
-                    # the chunk stays one device program
-                    u, t, ndone, hist = run_steps(
-                        self.grid, st.u, jnp.asarray(st.t, tdtype),
-                        jnp.asarray(tout, tdtype), n, trace=True)
-                else:
-                    u, t, ndone = run_steps(self.grid, st.u,
-                                            jnp.asarray(st.t, tdtype),
-                                            jnp.asarray(tout, tdtype), n)
-                u.block_until_ready()
+                # the whole dispatch + blocking fetch runs under the
+                # step deadline (first window: compile deadline) —
+                # nullcontext when the watchdog is off keeps this path
+                # fetch-identical to the unguarded one
+                with (self._wd.guard("step") if self._wd is not None
+                        else nullcontext()):
+                    if self._fault is not None:
+                        self._fault.maybe_hang(int(st.nstep))
+                    if (self.pspec.enabled or self.gspec.enabled
+                            or self.cosmo is not None):
+                        u, st.p, st.f, t, dt_old, ndone = run_steps_pm(
+                            self.grid, self.gspec, self.pspec, st.u,
+                            st.p, st.f, jnp.asarray(st.t, tdtype),
+                            jnp.asarray(tout, tdtype),
+                            jnp.asarray(st.dt_old, tdtype), n,
+                            cosmo=self.cosmo)
+                        st.dt_old = float(dt_old)
+                    elif self.cool_tables is not None:
+                        from ramses_tpu.grid.uniform import run_steps_cool
+                        u, t, ndone = run_steps_cool(
+                            self.grid, st.u, jnp.asarray(st.t, tdtype),
+                            jnp.asarray(tout, tdtype), n,
+                            self.cool_tables, self.cool_spec)
+                    elif telem.enabled:
+                        # instrumented run: the scan additionally stacks
+                        # per-step (t, dt) so the event log gets one
+                        # record per coarse step from this single
+                        # summary fetch — the chunk stays one device
+                        # program
+                        u, t, ndone, hist = run_steps(
+                            self.grid, st.u, jnp.asarray(st.t, tdtype),
+                            jnp.asarray(tout, tdtype), n, trace=True)
+                    else:
+                        u, t, ndone = run_steps(
+                            self.grid, st.u, jnp.asarray(st.t, tdtype),
+                            jnp.asarray(tout, tdtype), n)
+                    u.block_until_ready()
+                    ndone = int(ndone)
                 wall = time.perf_counter() - t0
                 self.wall_s += wall
-                ndone = int(ndone)
                 st.u, st.t, st.nstep = u, float(t), st.nstep + ndone
+                if self._wd is not None:
+                    self._wd.note(nstep=st.nstep, t=st.t)
                 self.cell_updates += ndone * self.grid.ncell
                 if prev is not None and not self._sguard.ok(st.t):
                     # non-finite window: roll back and redo at halved
@@ -475,11 +491,13 @@ class Simulation:
                 scale = 0.5 ** attempt
                 sg.record_rollback(self, attempt, scale, escalated)
                 tw0 = time.perf_counter()
-                u, t, ndone = run_steps(
-                    self.grid, u0, jnp.asarray(t0, tdtype),
-                    jnp.asarray(tout, tdtype), 1, dt_scale=scale)
-                u.block_until_ready()
-                tf = float(t)
+                with (self._wd.guard("step") if self._wd is not None
+                        else nullcontext()):
+                    u, t, ndone = run_steps(
+                        self.grid, u0, jnp.asarray(t0, tdtype),
+                        jnp.asarray(tout, tdtype), 1, dt_scale=scale)
+                    u.block_until_ready()
+                    tf = float(t)
                 if StepGuard.ok(tf):
                     st.u, st.t, st.nstep = u, tf, nstep0 + int(ndone)
                     self.cell_updates += int(ndone) * self.grid.ncell
@@ -516,24 +534,42 @@ class Simulation:
         import os
 
         from ramses_tpu.io import snapshot as snapmod
-        iout = iout if iout is not None else self.state.iout
-        snap = snapmod.snapshot_from_uniform(self, iout)
-        base = base_dir or self.params.output.output_dir
-        extra = None
-        if self.turb is not None:
-            # the OU spectral state + RNG key ride in every snapshot
-            # (``turb/write_turb_fields.f90``) so a driven-turbulence
-            # restart continues the SAME forcing realization instead of
-            # silently re-seeding; staged alongside the file set so it
-            # lands under the checkpoint manifest, not after the rename
-            extra = os.path.join(base, f"output_{iout:05d}.extras.tmp")
-            os.makedirs(extra, exist_ok=True)
-            self.turb.save(os.path.join(extra, "turb_fields.npz"))
-        return snapmod.dump_all(
-            snap, iout, base, namelist_path=namelist_path,
-            extra_dir=extra,
-            keep_last=int(getattr(self.params.output,
-                                  "checkpoint_keep", 0)))
+        with (self._wd.guard("io") if self._wd is not None
+                else nullcontext()):
+            iout = iout if iout is not None else self.state.iout
+            snap = snapmod.snapshot_from_uniform(self, iout)
+            base = base_dir or self.params.output.output_dir
+            extra = None
+            if self.turb is not None:
+                # the OU spectral state + RNG key ride in every snapshot
+                # (``turb/write_turb_fields.f90``) so a driven-turbulence
+                # restart continues the SAME forcing realization instead
+                # of silently re-seeding; staged alongside the file set
+                # so it lands under the checkpoint manifest, not after
+                # the rename
+                extra = os.path.join(base,
+                                     f"output_{iout:05d}.extras.tmp")
+                os.makedirs(extra, exist_ok=True)
+                self.turb.save(os.path.join(extra, "turb_fields.npz"))
+            if getattr(self.params.output, "savegadget", False) \
+                    and self.state.p is not None:
+                # &OUTPUT_PARAMS savegadget: each particle output also
+                # lands as a Gadget SnapFormat=1 file, staged into the
+                # extras dir so it rides the checkpoint manifest
+                from ramses_tpu.io.gadget import dump_gadget_particles
+                if extra is None:
+                    extra = os.path.join(
+                        base, f"output_{iout:05d}.extras.tmp")
+                    os.makedirs(extra, exist_ok=True)
+                dump_gadget_particles(
+                    os.path.join(extra, f"gadget_{iout:05d}.dat"),
+                    self.state.p, boxlen=self.params.amr.boxlen,
+                    time=self.state.t)
+            return snapmod.dump_all(
+                snap, iout, base, namelist_path=namelist_path,
+                extra_dir=extra,
+                keep_last=int(getattr(self.params.output,
+                                      "checkpoint_keep", 0)))
 
     @classmethod
     def from_snapshot(cls, params: Params, outdir: str,

@@ -28,6 +28,7 @@ import json
 import os
 import re
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -342,6 +343,10 @@ class EnsembleEngine:
         self._bguard = BatchGuard.from_params(spec.base,
                                               telemetry=self.telemetry)
         self._fault = FaultInjector.from_params(spec.base)
+        # hang watchdog: &ENSEMBLE_PARAMS *_deadline_s (None when off)
+        from ramses_tpu.resilience.watchdog import Watchdog
+        self._wd = Watchdog.from_params(spec.base, scope="ensemble",
+                                        telemetry=self.telemetry)
 
     # ------------------------------------------------------------------
     # status surface (duck-typed like the solo sims, for the supervisor,
@@ -503,8 +508,14 @@ class EnsembleEngine:
                          g.t_host.copy()) if guard is not None else None)
                 if self._fault is not None:
                     self._fault.maybe_nan_batch(g)
-                ndone, summ = self._dispatch(
-                    g, n, eff_tend, summarize=guard is not None)
+                with (self._wd.guard("step") if self._wd is not None
+                        else nullcontext()):
+                    if self._fault is not None:
+                        self._fault.maybe_hang_batch(g, self.nstep)
+                    ndone, summ = self._dispatch(
+                        g, n, eff_tend, summarize=guard is not None)
+                if self._wd is not None:
+                    self._wd.note(nstep=self.nstep, t=self.t)
                 if guard is not None:
                     bad = guard.screen(g.t_host, summ, active=~done)
                     if bad.any():

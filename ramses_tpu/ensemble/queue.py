@@ -152,25 +152,32 @@ def complete(job: Job, result: Optional[Dict[str, Any]] = None) -> str:
 
 def fail(job: Job, error: str = "",
          result: Optional[Dict[str, Any]] = None,
-         telemetry=None) -> str:
+         telemetry=None, stage: str = "fail") -> str:
     """running -> failed with the error appended to the accumulated
-    ``failure_log`` (and recorded as the headline ``error``)."""
+    ``failure_log`` (and recorded as the headline ``error``).
+    ``stage`` labels the log entry — the serve loop passes ``"hang"``
+    for deadline-killed jobs so the classification survives in the
+    record."""
     if error:
-        _log_failure(job.record, error, "fail")
+        _log_failure(job.record, error, stage)
     _emit(telemetry, "queue_fail", job=job.id,
-          attempts=int(job.record.get("attempts", 0)), error=error)
+          attempts=int(job.record.get("attempts", 0)), error=error,
+          stage=stage)
     return _finish(job, "failed", result=result, error=error)
 
 
-def requeue(job: Job, error: str = "", telemetry=None) -> str:
+def requeue(job: Job, error: str = "", telemetry=None,
+            stage: str = "requeue") -> str:
     """running -> queued (a failed attempt with attempts remaining);
     the attempt count stays — :func:`claim` bumps it on the next
     worker.  The attempt's error is appended to ``failure_log``, which
-    survives the requeue because it lives in the record file."""
+    survives the requeue because it lives in the record file.
+    ``stage`` labels the entry (``"hang"`` for kill-and-requeue)."""
     if error:
-        _log_failure(job.record, error, "requeue")
+        _log_failure(job.record, error, stage)
     _emit(telemetry, "queue_requeue", job=job.id,
-          attempts=int(job.record.get("attempts", 0)), error=error)
+          attempts=int(job.record.get("attempts", 0)), error=error,
+          stage=stage)
     _write_record(job.path, job.record)
     dst = os.path.join(os.path.dirname(os.path.dirname(job.path)),
                        "queued", os.path.basename(job.path))

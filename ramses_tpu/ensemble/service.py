@@ -20,6 +20,7 @@ import traceback
 from typing import Any, Dict, Optional
 
 from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.resilience.watchdog import HangDetected
 
 
 def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
@@ -69,8 +70,12 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
             rotate_checkpoints(rdir, keep=2)
         eng.run(verbose=verbose, on_chunk=beat)
 
+    # hang_retries=0: a deadline-expired chunk escapes immediately so
+    # the serve loop can kill-and-requeue with stage="hang" instead of
+    # retrying inside a worker the queue already believes is live
     eng = rsup.supervise(build, drive, params, base_dir=rdir,
-                         max_attempts=max_attempts, log=log)
+                         max_attempts=max_attempts, log=log,
+                         hang_retries=0)
     snap = eng.save(rdir)
     eng.telemetry.record_event("ensemble_done", nmember=eng.nmember,
                                ngroup=len(eng.groups), t_min=eng.t,
@@ -147,6 +152,21 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
         try:
             result = run_job(queue_dir, job, max_attempts=max_attempts,
                              verbose=verbose, log=log)
+        except HangDetected as e:
+            # serve-loop liveness: a deadline-expired chunk comes back
+            # HERE (run_job runs with hang_retries=0) — the wedged job
+            # is killed-and-requeued with stage="hang" immediately
+            # instead of zombifying this worker until stale-reclaim
+            log(f"serve: {job.id} hang: {e!r}")
+            err = "".join(traceback.format_exception_only(type(e), e))
+            if int(job.record.get("attempts", 0)) < max_attempts:
+                counts["requeued"] += 1
+                jq.requeue(job, error=err.strip(), telemetry=telemetry,
+                           stage="hang")
+            else:
+                counts["failed"] += 1
+                jq.fail(job, error=err.strip(), telemetry=telemetry,
+                        stage="hang")
         except Exception as e:   # noqa: BLE001 — worker boundary
             log(f"serve: {job.id} failed: {e!r}")
             err = "".join(traceback.format_exception_only(type(e), e))

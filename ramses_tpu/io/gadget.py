@@ -59,6 +59,35 @@ def read_gadget(path: str):
     return hdr, pos.astype(np.float64), vel.astype(np.float64), ids
 
 
+def dump_gadget_particles(path: str, p, boxlen: float = 1.0,
+                          time: float = 0.0) -> str:
+    """Write a sim ParticleSet's *active* lanes as a SnapFormat=1 file
+    (the reference's ``savegadget`` flag: each particle output also
+    lands as a Gadget snapshot for external tooling).  Positions/
+    velocities stay in code units; ndim<3 pads zero columns; the
+    header carries one shared mass (type-1 slot, mean of the active
+    masses — the format's per-particle MASS block is not written)."""
+    act = np.asarray(p.active, dtype=bool)
+    x = np.asarray(p.x, dtype=np.float64)[act]
+    v = np.asarray(p.v, dtype=np.float64)[act]
+    ids = np.asarray(p.idp)[act].astype(np.uint32)
+    m = np.asarray(p.m, dtype=np.float64)[act]
+    n = int(act.sum())
+    if x.ndim == 1:
+        x = x[:, None]
+        v = v[:, None]
+    if x.shape[1] < 3:
+        pad = np.zeros((n, 3 - x.shape[1]))
+        x = np.concatenate([x, pad], axis=1)
+        v = np.concatenate([v, pad], axis=1)
+    hdr = GadgetHeader(
+        npart=(0, n, 0, 0, 0, 0),
+        mass=(0.0, float(m.mean()) if n else 0.0, 0.0, 0.0, 0.0, 0.0),
+        time=float(time), boxsize=float(boxlen))
+    write_gadget(path, hdr, x, v, ids)
+    return path
+
+
 def write_gadget(path: str, hdr: GadgetHeader, pos: np.ndarray,
                  vel: np.ndarray, ids: np.ndarray):
     """SnapFormat=1 writer (tests + IC tooling)."""

@@ -41,25 +41,93 @@ from typing import Dict, Optional
 import numpy as np
 
 
-def _unpersisted_state(sim) -> list:
+def _unpersisted_state(sim, nproc: int = 1) -> list:
     """Names of populated state layers pario does NOT checkpoint.
 
-    The fat-checkpoint path rides GAS state only (u + MHD faces); a
-    dump of a run carrying any of these loses that state on restore —
-    the reference-format snapshot path (io/snapshot.py) persists them.
+    Single-process dumps ride particles/sinks/tracers/turb state on the
+    manifest (see :func:`_extra_state_payload`), so only radiation is
+    lost there.  Multi-process dumps stay gas-only for those layers —
+    the particle arrays are sharded device state and the manifest is a
+    process-0 artifact — so a dump of a run carrying any of these loses
+    that state on restore; the reference-format snapshot path
+    (io/snapshot.py) persists them.
     """
     out = []
-    p = getattr(sim, "p", None)
-    if p is not None and int(np.sum(np.asarray(p.active))) > 0:
-        out.append("particles")
-    if getattr(sim, "sinks", None) is not None:
-        out.append("sinks")
-    tx = getattr(sim, "tracer_x", None)
-    if tx is not None and len(tx) > 0:
-        out.append("tracers")
+    if int(nproc) > 1:
+        p = getattr(sim, "p", None)
+        if p is not None and int(np.sum(np.asarray(p.active))) > 0:
+            out.append("particles")
+        if getattr(sim, "sinks", None) is not None:
+            out.append("sinks")
+        tx = getattr(sim, "tracer_x", None)
+        if tx is not None and len(tx) > 0:
+            out.append("tracers")
     if getattr(sim, "rt_amr", None) is not None:
         out.append("radiation")
     return out
+
+
+def _extra_state_payload(sim) -> Dict[str, np.ndarray]:
+    """Non-gas state riding the single-process manifest: full padded
+    particle lanes (so a restore keeps the exact lane layout and
+    headroom — bitwise-identical PM restarts), host sink/tracer
+    arrays, and the driven-turbulence OU field + RNG key."""
+    out: Dict[str, np.ndarray] = {}
+    p = getattr(sim, "p", None)
+    if p is not None:
+        for f in ("x", "v", "m", "active", "idp", "family",
+                  "tp", "zp", "flags"):
+            out[f"part_{f}"] = np.asarray(getattr(p, f))
+    s = getattr(sim, "sinks", None)
+    if s is not None:
+        for f in ("x", "v", "m", "tform", "idp"):
+            out[f"sink_{f}"] = np.asarray(getattr(s, f))
+        out["sink_next_id"] = np.asarray(int(s.next_id))
+    tx = getattr(sim, "tracer_x", None)
+    if tx is not None:
+        out["tracer_x"] = np.asarray(tx)
+        tid = getattr(sim, "tracer_id", None)
+        if tid is not None:
+            out["tracer_id"] = np.asarray(tid)
+    tb = getattr(sim, "turb", None)
+    if tb is not None:
+        out["turb_fhat"] = np.asarray(tb.fhat)
+        out["turb_key"] = np.asarray(tb.key)
+    return out
+
+
+def _restore_extra_state(sim, man, params) -> None:
+    """Re-attach the :func:`_extra_state_payload` layers from a loaded
+    manifest onto a freshly-built sim."""
+    import jax.numpy as jnp
+
+    if "part_x" in man.files:
+        from ramses_tpu.pm.particles import ParticleSet
+        sim.p = ParticleSet(
+            **{f: jnp.asarray(man[f"part_{f}"])
+               for f in ("x", "v", "m", "active", "idp", "family",
+                         "tp", "zp", "flags")})
+        run = getattr(params, "run", None)
+        if bool(getattr(run, "pic", False)):
+            sim.pic = True
+    if "sink_x" in man.files:
+        from ramses_tpu.pm.sinks import SinkSet
+        sim.sinks = SinkSet(
+            x=np.asarray(man["sink_x"]), v=np.asarray(man["sink_v"]),
+            m=np.asarray(man["sink_m"]),
+            tform=np.asarray(man["sink_tform"]),
+            idp=np.asarray(man["sink_idp"]),
+            next_id=int(man["sink_next_id"]))
+    if "tracer_x" in man.files:
+        sim.tracer_x = np.asarray(man["tracer_x"])
+        if "tracer_id" in man.files:
+            sim.tracer_id = np.asarray(man["tracer_id"])
+    if "turb_fhat" in man.files and getattr(sim, "turb", None) \
+            is not None:
+        # OU spectral field + RNG key: the restored forcing continues
+        # the dumped realization instead of re-seeding
+        sim.turb.fhat = jnp.asarray(man["turb_fhat"])
+        sim.turb.key = jnp.asarray(man["turb_key"])
 
 
 def _level_arrays(sim) -> Dict[str, object]:
@@ -134,13 +202,13 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
     arrs = _level_arrays(sim)
     me = jax.process_index()
 
-    lost = _unpersisted_state(sim)
+    lost = _unpersisted_state(sim, nproc=nproc)
     if lost:
         warnings.warn(
             f"dump_pario: run carries {'/'.join(lost)} state that the "
-            "pario fat-checkpoint does NOT persist (gas only); a "
-            "restore re-creates it from ICs.  Use sim.dump() "
-            "(reference-format snapshots) for full-state checkpoints.",
+            "pario fat-checkpoint does NOT persist here; a restore "
+            "re-creates it from ICs.  Use sim.dump() (reference-format "
+            "snapshots) for full-state checkpoints.",
             stacklevel=2)
 
     # manifest: host tree + run meta (process 0 writes it)
@@ -155,6 +223,11 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
             tree_payload[f"octrow{l}"] = np.asarray(lay.oct_row,
                                                     np.int64)
         dtc = getattr(sim, "_dt_cache", None)
+        # single-process: non-gas layers (particles/sinks/tracers/turb)
+        # ride the manifest — multi-process particle state is sharded
+        # across hosts and stays on the snapshot path (see
+        # _unpersisted_state)
+        extra = _extra_state_payload(sim) if nproc == 1 else {}
         np.savez(os.path.join(out, "manifest.npz"),
                  levels=np.asarray(sim.levels()),
                  ndim=sim.cfg.ndim, root=np.asarray(sim.tree.root),
@@ -162,7 +235,7 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
                  t=float(sim.t), nstep=int(sim.nstep),
                  dt_old=float(getattr(sim, "dt_old", 0.0)),
                  dtnew=float(dtc) if dtc is not None else 0.0,
-                 nproc=nproc, **tree_payload)
+                 nproc=nproc, **tree_payload, **extra)
 
     # partition this process's shards into host groups (by device)
     ngrp = max(1, int(split_hosts or 1))
@@ -290,13 +363,14 @@ def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
             n = min(len(dbuf), len(buf))
             buf[:n] = dbuf[:n]
             tgt[l] = sim._place(jnp.asarray(buf, buf.dtype), "cells")
-    lost = _unpersisted_state(sim)
+    _restore_extra_state(sim, man, params)
+    dump_nproc = int(man["nproc"]) if "nproc" in man.files else 1
+    lost = _unpersisted_state(sim, nproc=dump_nproc)
     if lost:
         warnings.warn(
             f"restore_pario: restored run carries {'/'.join(lost)} "
-            "state that was NOT in the checkpoint (pario persists gas "
-            "only) — those layers are fresh from ICs, not the dumped "
-            "run.", stacklevel=2)
+            "state that was NOT in the checkpoint — those layers are "
+            "fresh from ICs, not the dumped run.", stacklevel=2)
     sim.t = float(man["t"])
     sim.nstep = int(man["nstep"])
     sim.dt_old = float(man["dt_old"])

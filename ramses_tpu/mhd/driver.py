@@ -10,6 +10,7 @@ Region ICs follow ``mhd/init_flow_fine.f90:475-596``: square regions set
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -125,6 +126,8 @@ class MhdSimulation:
         self._sguard = StepGuard.from_params(params,
                                              telemetry=self.telemetry)
         self._fault = FaultInjector.from_params(params)
+        from ramses_tpu.resilience.watchdog import Watchdog
+        self._wd = Watchdog.from_params(params, telemetry=self.telemetry)
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
@@ -153,15 +156,22 @@ class MhdSimulation:
                 self._fault.maybe_nan(self)
             t0 = time.perf_counter()
             t_before = self.t
-            u, bf, t, ndone = mu.run_steps(
-                self.grid, self.u, self.bf,
-                jnp.asarray(self.t, tdtype), jnp.asarray(tend, tdtype), n)
-            u.block_until_ready()
+            with (self._wd.guard("step") if self._wd is not None
+                    else nullcontext()):
+                if self._fault is not None:
+                    self._fault.maybe_hang(self.nstep)
+                u, bf, t, ndone = mu.run_steps(
+                    self.grid, self.u, self.bf,
+                    jnp.asarray(self.t, tdtype),
+                    jnp.asarray(tend, tdtype), n)
+                u.block_until_ready()
+                ndone = int(ndone)
             wall = time.perf_counter() - t0
             self.wall_s += wall
-            ndone = int(ndone)
             self.u, self.bf, self.t = u, bf, float(t)
             self.nstep += ndone
+            if self._wd is not None:
+                self._wd.note(nstep=self.nstep, t=self.t)
             self.cell_updates += ndone * self.grid.ncell
             if prev is not None and not self._sguard.ok(self.t):
                 ndone = self._retry_window(prev, tend, tdtype)

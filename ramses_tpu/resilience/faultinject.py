@@ -10,6 +10,13 @@ Spec syntax (``&RUN_PARAMS fault_inject='...'`` or env
                        fault lands exactly at member J's step K
   ``sigterm@K``        deliver SIGTERM to this process at the guard
                        check when nstep >= K
+  ``hang@K``           block the host thread inside the deadline-
+                       guarded window that starts at nstep K — the
+                       watchdog (resilience/watchdog.py) must detect
+                       and classify it within ``step_deadline_s``
+  ``hang@K:member=J``  same, triggered by ensemble member J reaching
+                       its step K (the batched engine clamps windows
+                       so the hang lands exactly there)
   ``truncate:NAME``    after the next checkpoint finalize, truncate
                        the file whose basename contains NAME (breaks
                        its manifest hash — validation must catch it)
@@ -38,15 +45,16 @@ def _parse(spec: str):
         part = part.strip()
         if not part:
             continue
-        if part.startswith("nan@"):
-            body, _, opt = part[4:].partition(":")
+        if part.startswith("nan@") or part.startswith("hang@"):
+            kind, _, rest = part.partition("@")
+            body, _, opt = rest.partition(":")
             if opt:
                 if not opt.startswith("member="):
                     raise ValueError(
                         f"unknown fault_inject option {opt!r} "
                         f"in {part!r} (expected member=J)")
                 member_of[len(faults)] = int(opt[len("member="):])
-            faults.append(("nan", int(body)))
+            faults.append((kind, int(body)))
         elif part.startswith("sigterm@"):
             faults.append(("sigterm", int(part[8:])))
         elif part.startswith("truncate:"):
@@ -127,7 +135,8 @@ class FaultInjector:
         """
         nstep = int(nstep)
         for i, (kind, k) in enumerate(self.faults):
-            if kind not in ("nan", "sigterm") or i in self._fired:
+            if kind not in ("nan", "sigterm", "hang") \
+                    or i in self._fired or self._hang_done(i):
                 continue
             if self._armed.get(i) is False:
                 continue               # resumed past K: will never fire
@@ -144,7 +153,8 @@ class FaultInjector:
         ``nstep_global`` — so ``nan@K:member=J`` lands exactly at
         member J's step K inside a fused window."""
         for i, (kind, k) in enumerate(self.faults):
-            if kind not in ("nan", "sigterm") or i in self._fired:
+            if kind not in ("nan", "sigterm", "hang") \
+                    or i in self._fired or self._hang_done(i):
                 continue
             if self._armed.get(i) is False:
                 continue
@@ -179,6 +189,75 @@ class FaultInjector:
             poisoned.append(j)
         return poisoned
 
+    def _hang_key(self, idx: int):
+        kind, k = self.faults[idx]
+        return (kind, int(k), self.member_of.get(idx))
+
+    def _hang_done(self, idx: int) -> bool:
+        """Hang faults fire once per PROCESS, not once per injector:
+        the hang-policy resume (supervisor) or re-claim (serve loop)
+        rebuilds the sim — and with it a fresh injector — inside the
+        same process, usually from a checkpoint *before* K; without
+        process-wide state the resumed run would re-arm and hang
+        forever inside the bounded retry budget."""
+        if self.faults[idx][0] != "hang":
+            return False
+        return self._hang_key(idx) in _hang_fired
+
+    def _hang_now(self, nstep: int, member=None):
+        """Block the host thread: sleep until the watchdog's SIGALRM
+        soft interrupt raises HangDetected out of the sleep, capped
+        (RAMSES_HANG_INJECT_CAP_S, default 60s) so a misconfigured run
+        without a watchdog still terminates."""
+        import time
+        cap = float(os.environ.get("RAMSES_HANG_INJECT_CAP_S", "60"))
+        tag = f" member {member}" if member is not None else ""
+        print(f" fault-inject: hanging{tag} at nstep={int(nstep)} "
+              f"(cap {cap:g}s)", flush=True)
+        end = time.monotonic() + cap
+        while True:
+            left = end - time.monotonic()
+            if left <= 0.0:
+                print(" fault-inject: hang cap expired with no "
+                      "watchdog; continuing", flush=True)
+                return
+            time.sleep(min(0.5, left))
+
+    def maybe_hang(self, nstep: int) -> bool:
+        """Injected hang for the solo drivers (untargeted ``hang@K``):
+        call INSIDE the watchdog-guarded window so the deadline is
+        what ends it."""
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "hang" or i in self.member_of \
+                    or self._hang_done(i) \
+                    or not self._should_fire(i, kind, int(nstep)):
+                continue
+            _hang_fired.add(self._hang_key(i))
+            self._hang_now(nstep)
+            return True
+        return False
+
+    def maybe_hang_batch(self, group, nstep_global: int) -> bool:
+        """Injected hang for the batched engine: member-targeted
+        faults trigger off that member's own step count, untargeted
+        ones off the engine-global ``nstep_global``."""
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "hang" or self._hang_done(i):
+                continue
+            j = self.member_of.get(i)
+            if j is None:
+                ns = int(nstep_global)
+            elif j in group.members:
+                ns = int(group.nstep[group.members.index(j)])
+            else:
+                continue
+            if not self._should_fire(i, kind, ns):
+                continue
+            _hang_fired.add(self._hang_key(i))
+            self._hang_now(ns, member=j)
+            return True
+        return False
+
     def maybe_signal(self, nstep: int) -> bool:
         """SIGTERM this process when armed (OpsGuard handles it)."""
         for i, (kind, _arg) in enumerate(self.faults):
@@ -189,6 +268,18 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGTERM)
             return True
         return False
+
+
+# ---- process-wide fired state ---------------------------------------
+
+# hang faults already delivered in this process (see _hang_done)
+_hang_fired = set()
+
+
+def reset_fired():
+    """Forget process-wide fired state (test isolation)."""
+    _hang_fired.clear()
+    _truncate_fired.clear()
 
 
 # ---- post-dump truncation (module-level: dump may run on the

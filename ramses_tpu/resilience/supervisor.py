@@ -8,6 +8,17 @@ OpsGuard stop path flushes queued dumps) resumes from the last good
 output instead of failing the allocation.  Backoff between attempts is
 exponential and capped; :func:`backoff_delay` is shared with bench.py
 so both supervisors pace retries identically.
+
+Failures are *classified*: a :class:`HangDetected` from the watchdog
+(resilience/watchdog.py) is a hang, a :class:`StepRetryExhausted` from
+the step-guard ladder is a NaN, anything else is a crash.  Hangs get a
+hang-specific policy — immediate resume from the newest checkpoint
+with NO backoff and NO dt-halving (the state is stale, not numerically
+suspect) under a separate bounded ``hang_retries`` budget that never
+consumes regular crash attempts; once that budget is spent the hang
+re-raises so a process-level parent (serve loop, bench, batch system)
+can apply ITS hang policy (requeue with ``stage="hang"``, exit-code
+classification).
 """
 
 from __future__ import annotations
@@ -17,6 +28,19 @@ from typing import Callable, Optional
 
 from ramses_tpu.resilience.checkpoint import (latest_valid_checkpoint,
                                               resolve_restart_dir)
+from ramses_tpu.resilience.stepguard import StepRetryExhausted
+from ramses_tpu.resilience.watchdog import HangDetected
+
+
+def classify(err: Optional[BaseException]) -> str:
+    """Supervisor fault taxonomy: hang vs nan vs crash (vs none)."""
+    if err is None:
+        return "none"
+    if isinstance(err, HangDetected):
+        return "hang"
+    if isinstance(err, StepRetryExhausted):
+        return "nan"
+    return "crash"
 
 
 def backoff_delay(attempt: int, base: float = 1.0,
@@ -65,10 +89,20 @@ def run_complete(sim, params, tend: Optional[float] = None) -> bool:
     return _sim_t(sim) >= float(end) * (1.0 - 1e-12) - 1e-300
 
 
+def _close_tel(tel, sim):
+    """Close an attempt's telemetry so the resumed one appends
+    cleanly."""
+    if tel is not None:
+        try:
+            tel.close(sim, print_timers=False)
+        except Exception:
+            pass
+
+
 def supervise(build: Callable, drive: Callable, params,
               base_dir: str = ".", max_attempts: int = 3,
               backoff_s: float = 1.0, tend: Optional[float] = None,
-              log: Callable = print):
+              log: Callable = print, hang_retries: int = 2):
     """Run ``drive(build(restart_dir))`` until complete or attempts
     are exhausted.
 
@@ -76,12 +110,23 @@ def supervise(build: Callable, drive: Callable, params,
     restart_dir is None, else restored from that checkpoint);
     ``drive(sim)`` evolves it and returns normally on a clean stop
     (including an OpsGuard-handled SIGTERM).  Returns the final sim.
+
+    ``hang_retries`` bounds hang-classified resumes separately from
+    ``max_attempts`` (see module docstring); ``hang_retries=0`` makes
+    a hang escape on first detection — the serve loop uses that to
+    kill-and-requeue rather than retry in-worker.
     """
     max_attempts = max(1, int(max_attempts))
+    hang_retries = max(0, int(hang_retries))
     last_err = None
     sim = None
-    for attempt in range(1, max_attempts + 1):
-        if attempt == 1:
+    attempt = 0
+    hang_used = 0
+    nbuild = 0
+    while attempt < max_attempts:
+        attempt += 1
+        nbuild += 1
+        if nbuild == 1:
             restart = resolve_restart_dir(params, base_dir=base_dir,
                                           log=log)
         else:
@@ -94,7 +139,10 @@ def supervise(build: Callable, drive: Callable, params,
                     "found no valid checkpoint; restarting fresh")
         sim = build(restart)
         tel = getattr(sim, "telemetry", None)
-        if restart is not None and tel is not None:
+        if tel is not None and (restart is not None or nbuild > 1):
+            # any rebuild appends — even a fresh restart after a failed
+            # attempt must not truncate the earlier attempts' fault
+            # events (hang/rollback/...) out of the JSONL log
             try:
                 tel.mark_resumed(restart, attempt)
             except AttributeError:
@@ -104,18 +152,30 @@ def supervise(build: Callable, drive: Callable, params,
             last_err = None
         except Exception as e:   # noqa: BLE001 — supervisor boundary
             last_err = e
-            log(f"resilience: attempt {attempt} failed: {e!r}")
+            log(f"resilience: attempt {attempt} failed "
+                f"(classified {classify(e)}): {e!r}")
         if last_err is None and run_complete(sim, params, tend=tend):
             return sim
+        if classify(last_err) == "hang":
+            # hang policy: immediate resume (no backoff, no
+            # dt-halving — the ladder never saw a trip), bounded by
+            # its own budget, never converted into a crash attempt
+            _close_tel(tel, sim)
+            if hang_used >= hang_retries:
+                log(f"resilience: hang budget exhausted "
+                    f"({hang_used}/{hang_retries}); re-raising for "
+                    "process-level classification")
+                raise last_err
+            hang_used += 1
+            attempt -= 1
+            log(f"resilience: hang retry {hang_used}/{hang_retries}: "
+                "immediate resume from newest checkpoint")
+            continue
         if attempt == max_attempts:
             break
         # Interrupted (stop flag / SIGTERM / crash): close this
         # attempt's telemetry so the resumed one appends cleanly.
-        if tel is not None:
-            try:
-                tel.close(sim, print_timers=False)
-            except Exception:
-                pass
+        _close_tel(tel, sim)
         delay = backoff_delay(attempt, base=backoff_s)
         log(f"resilience: run incomplete at nstep={_sim_nstep(sim)} "
             f"t={_sim_t(sim):.6g}; retrying in {delay:.1f}s")

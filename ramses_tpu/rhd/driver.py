@@ -4,6 +4,7 @@ shock tubes and blast waves, ``rhd/test_suite/``)."""
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from typing import Optional
 
 import jax
@@ -99,6 +100,8 @@ class RhdSimulation:
         self._sguard = StepGuard.from_params(params,
                                              telemetry=self.telemetry)
         self._fault = FaultInjector.from_params(params)
+        from ramses_tpu.resilience.watchdog import Watchdog
+        self._wd = Watchdog.from_params(params, telemetry=self.telemetry)
 
     def mus_per_cell_update(self) -> float:
         return 1e6 * self.wall_s / max(self.cell_updates, 1)
@@ -127,15 +130,21 @@ class RhdSimulation:
                 self._fault.maybe_nan(self)
             t0 = time.perf_counter()
             t_before = self.t
-            u, t, ndone = ru.run_steps(
-                self.grid, self.u, jnp.asarray(self.t, tdtype),
-                jnp.asarray(tend, tdtype), n)
-            u.block_until_ready()
+            with (self._wd.guard("step") if self._wd is not None
+                    else nullcontext()):
+                if self._fault is not None:
+                    self._fault.maybe_hang(self.nstep)
+                u, t, ndone = ru.run_steps(
+                    self.grid, self.u, jnp.asarray(self.t, tdtype),
+                    jnp.asarray(tend, tdtype), n)
+                u.block_until_ready()
+                ndone = int(ndone)
             wall = time.perf_counter() - t0
             self.wall_s += wall
-            ndone = int(ndone)
             self.u, self.t = u, float(t)
             self.nstep += ndone
+            if self._wd is not None:
+                self._wd.note(nstep=self.nstep, t=self.t)
             self.cell_updates += ndone * self.grid.ncell
             if prev is not None and not self._sguard.ok(self.t):
                 ndone = self._retry_window(prev, tend, tdtype)

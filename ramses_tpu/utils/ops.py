@@ -105,8 +105,14 @@ class OpsGuard:
         self._stop_requested = True
 
     def _dump(self) -> Optional[str]:
+        from contextlib import nullcontext
+
+        # io_deadline_s: snapshot writes run under the sim's watchdog
+        # (a wedged filesystem hangs a run as surely as a wedged device)
+        wd = getattr(self.sim, "_wd", None)
         try:
-            out = self.sim.dump(self._iout, self.base_dir)
+            with (wd.guard("io") if wd is not None else nullcontext()):
+                out = self.sim.dump(self._iout, self.base_dir)
             self._iout += 1
             return out
         except Exception as e:          # keep the run alive on IO issues

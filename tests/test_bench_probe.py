@@ -52,7 +52,7 @@ def test_dead_tunnel_tops_both_jsons(monkeypatch, tmp_path, capsys):
     monkeypatch.setattr(bench, "tunnel_probe", lambda *a, **k: dead)
     monkeypatch.setattr(
         bench, "run_sub",
-        lambda name, deadline, weight=None:
+        lambda name, deadline, weight=None, reserve=0.0:
             {"error": "sub-bench timed out after 45s", "attempt": 2})
     partial = tmp_path / "BENCH_PARTIAL.json"
     monkeypatch.setenv("BENCH_PARTIAL_PATH", str(partial))

@@ -1,0 +1,57 @@
+"""``docs/params.md`` freshness: the generated namelist-parameter
+reference must match what ``tools/gen_params_doc.py`` renders from the
+current ``config.py`` — a config change without a doc regen fails here
+(and in the CI ``--check`` step) instead of rotting silently."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_gen():
+    spec = importlib.util.spec_from_file_location(
+        "gen_params_doc", os.path.join(REPO, "tools",
+                                       "gen_params_doc.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_params_doc_fresh():
+    gen = _load_gen()
+    with open(os.path.join(REPO, "docs", "params.md")) as f:
+        cur = f.read()
+    assert cur == gen.render(), (
+        "docs/params.md is stale — run `python tools/gen_params_doc.py`")
+
+
+def test_params_doc_covers_every_group_and_key():
+    """Structural pin: one section per _GROUP_MAP group, one row per
+    dataclass field — including keys added this PR."""
+    import dataclasses
+
+    from ramses_tpu import config as cfg
+
+    gen = _load_gen()
+    text = gen.render()
+    p = cfg.Params()
+    for gname, attr in cfg._GROUP_MAP.items():
+        assert f"## &{gname.upper()}" in text, gname
+        for fld in dataclasses.fields(type(getattr(p, attr))):
+            assert f"| `{fld.name}` |" in text, (gname, fld.name)
+    for key in ("compile_deadline_s", "step_deadline_s",
+                "io_deadline_s", "savegadget"):
+        assert f"| `{key}` |" in text, key
+
+
+def test_params_doc_check_mode(tmp_path, capsys, monkeypatch):
+    """--check exits 0 on fresh, 1 on stale."""
+    gen = _load_gen()
+    doc = tmp_path / "params.md"
+    doc.write_text(gen.render())
+    monkeypatch.setattr(gen, "DOC_PATH", str(doc))
+    assert gen.main(["--check"]) == 0
+    doc.write_text("stale\n")
+    assert gen.main(["--check"]) == 1

@@ -1,0 +1,461 @@
+"""Hang watchdog (ramses_tpu/resilience/watchdog.py).
+
+Pins the hang pillar of the resilience layer:
+
+  * deadline expiry raises :class:`HangDetected` in the main thread,
+    records a ``hang`` telemetry event, and writes a manifest-valid
+    ``hang_NNNNN/`` diagnostics dump that is NEVER an auto-resume
+    candidate;
+  * ``Watchdog.from_params`` is ``None`` with every deadline unset
+    (the zero-overhead off switch) and the env overrides arm it;
+  * ``hang@K[:member=J]`` fault injection parses, clamps fused
+    windows, and fires exactly once per PROCESS (so the hang-policy
+    resume completes instead of re-hanging forever);
+  * arming the watchdog adds zero host<->device fetches (same
+    device_get-counting pin as the step guard);
+  * a supervised ``hang@K`` run resumes immediately — no backoff, its
+    own retry budget — and reproduces an uninterrupted run within
+    round-off (same contract as the SIGTERM test in
+    tests/test_resilience.py).
+"""
+
+import json
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.config import params_from_string
+from ramses_tpu.resilience import checkpoint as ckpt
+from ramses_tpu.resilience import faultinject as finj
+from ramses_tpu.resilience import supervisor as rsup
+from ramses_tpu.resilience import watchdog as wdog
+
+pytestmark = pytest.mark.smoke
+
+UNI2D = """
+&RUN_PARAMS
+hydro=.true.
+nstepmax={nstep}
+ncontrol=1
+{run_extra}
+/
+&AMR_PARAMS
+levelmin=4
+levelmax=4
+boxlen=1.0
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='point'
+x_center=0.5,0.5
+y_center=0.5,0.5
+length_x=10.0,1.0
+length_y=10.0,1.0
+exp_region=10.0,10.0
+d_region=1.0,0.0
+p_region=1e-5,0.1
+/
+&OUTPUT_PARAMS
+noutput=1
+tout=1.0
+{out_extra}
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+/
+"""
+
+AMR2D = """
+&RUN_PARAMS
+hydro=.true.
+nstepmax={nstep}
+ncontrol=1
+{run_extra}
+/
+&AMR_PARAMS
+levelmin=4
+levelmax=5
+boxlen=1.0
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='point'
+x_center=0.5,0.5
+y_center=0.5,0.5
+length_x=10.0,1.0
+length_y=10.0,1.0
+exp_region=10.0,10.0
+d_region=1.0,0.0
+p_region=1e-5,0.1
+/
+&OUTPUT_PARAMS
+tend=1.0
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.8
+/
+&REFINE_PARAMS
+err_grad_p=0.1
+/
+"""
+
+
+@pytest.fixture(autouse=True)
+def _watchdog_hygiene():
+    """Process-wide state the watchdog/injector touch: the shared
+    SIGALRM handler and the once-per-process hang-fired set."""
+    yield
+    wdog._uninstall_handler()
+    finj.reset_fired()
+
+
+def _uni_params(nstep=6, run_extra="", out_extra=""):
+    return params_from_string(
+        UNI2D.format(nstep=nstep, run_extra=run_extra,
+                     out_extra=out_extra), ndim=2)
+
+
+def _uni_sim(nstep=6, run_extra="", out_extra="", dtype=jnp.float64):
+    from ramses_tpu.driver import Simulation
+    return Simulation(_uni_params(nstep, run_extra, out_extra),
+                      dtype=dtype)
+
+
+class _FakeTel:
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+# ---------------------------------------------------------------------
+# construction: the zero-overhead off switch
+# ---------------------------------------------------------------------
+def test_config_keys_parse_and_from_params_off_by_default(monkeypatch):
+    for key in ("RAMSES_COMPILE_DEADLINE_S", "RAMSES_STEP_DEADLINE_S",
+                "RAMSES_IO_DEADLINE_S"):
+        monkeypatch.delenv(key, raising=False)
+    p = _uni_params()
+    assert wdog.Watchdog.from_params(p) is None, \
+        "no deadlines set must mean NO watchdog (zero-overhead off)"
+
+    p2 = _uni_params(run_extra=("compile_deadline_s=600.0\n"
+                                "step_deadline_s=120.0\n"
+                                "io_deadline_s=300.0"))
+    assert p2.run.compile_deadline_s == 600.0
+    assert p2.run.step_deadline_s == 120.0
+    assert p2.run.io_deadline_s == 300.0
+    wd = wdog.Watchdog.from_params(p2)
+    assert wd is not None
+    assert wd.deadlines == {"compile": 600.0, "step": 120.0,
+                            "io": 300.0}
+
+    # env overrides arm an unconfigured run and win over the namelist
+    monkeypatch.setenv("RAMSES_STEP_DEADLINE_S", "7.5")
+    wd2 = wdog.Watchdog.from_params(p)
+    assert wd2 is not None and wd2.deadlines["step"] == 7.5
+    assert wdog.Watchdog.from_params(p2).deadlines["step"] == 7.5
+
+    # the ensemble scope reads &ENSEMBLE_PARAMS, not &RUN_PARAMS
+    monkeypatch.delenv("RAMSES_STEP_DEADLINE_S")
+    ens = types.SimpleNamespace(
+        run=None, output=None,
+        ensemble=types.SimpleNamespace(compile_deadline_s=0.0,
+                                       step_deadline_s=30.0,
+                                       io_deadline_s=0.0))
+    assert wdog.Watchdog.from_params(p2, scope="ensemble") is None
+    wd3 = wdog.Watchdog.from_params(ens, scope="ensemble")
+    assert wd3 is not None and wd3.deadlines["step"] == 30.0
+
+
+def test_unarmed_guard_spawns_no_monitor_thread():
+    wd = wdog.Watchdog(io_deadline_s=5.0, hard_exit=False)
+    before = threading.active_count()
+    with wd.guard("step"):                 # step deadline unset
+        assert threading.active_count() == before, \
+            "a phase with no deadline must not start a monitor thread"
+    assert wd.hangs == 0
+
+
+# ---------------------------------------------------------------------
+# expiry: HangDetected + telemetry + manifest-valid hang dump
+# ---------------------------------------------------------------------
+def test_guard_expiry_raises_dumps_and_never_resumes_from_it(tmp_path):
+    tel = _FakeTel()
+    wd = wdog.Watchdog(step_deadline_s=0.3, telemetry=tel,
+                       base_dir=str(tmp_path), hard_exit=False)
+    wd.note(nstep=3, t=0.125)
+    with pytest.raises(wdog.HangDetected) as ei:
+        with wd.guard("step"):
+            time.sleep(30.0)               # wedged fetch stand-in
+    assert ei.value.phase == "step"
+    assert ei.value.deadline_s == 0.3
+    assert ei.value.nstep == 3
+    assert wd.hangs == 1
+
+    kinds = [k for k, _ in tel.events]
+    assert kinds == ["hang"]
+    ev = tel.events[0][1]
+    assert ev["phase"] == "step" and ev["nstep"] == 3
+
+    # the diagnostics dump is manifest-valid but NEVER a resume
+    # candidate: the scanner only ranks output_NNNNN directories
+    dump = os.path.join(str(tmp_path), "hang_00001")
+    assert os.path.isdir(dump)
+    ok, reason = ckpt.validate_checkpoint(dump)
+    assert ok, reason
+    with open(os.path.join(dump, "hang.json")) as f:
+        payload = json.load(f)
+    assert payload["phase"] == "step" and payload["nstep"] == 3
+    assert ckpt.latest_valid_checkpoint(
+        str(tmp_path), log=lambda *_: None) is None
+
+
+def test_fast_completion_never_trips():
+    wd = wdog.Watchdog(step_deadline_s=5.0, hard_exit=False)
+    for _ in range(3):
+        with wd.guard("step"):
+            pass
+    with wd.guard("io"):                   # io deadline unset: off
+        pass
+    time.sleep(0.05)                       # let monitors drain
+    assert wd.hangs == 0
+
+
+def test_first_step_window_runs_under_compile_budget(tmp_path):
+    wd = wdog.Watchdog(compile_deadline_s=60.0, step_deadline_s=0.2,
+                       base_dir=str(tmp_path), hard_exit=False)
+    assert wd._effective("step") == ("compile", 60.0)
+    with wd.guard("step"):                 # compiling window: generous
+        time.sleep(0.4)                    # > step deadline, no trip
+    assert wd.hangs == 0
+    # warmed: later windows run under the tight step budget
+    assert wd._effective("step") == ("step", 0.2)
+    with pytest.raises(wdog.HangDetected) as ei:
+        with wd.guard("step"):
+            time.sleep(30.0)
+    assert ei.value.phase == "step"
+    # with no compile budget the first window is a plain step window
+    wd2 = wdog.Watchdog(step_deadline_s=9.0, hard_exit=False)
+    assert wd2._effective("step") == ("step", 9.0)
+
+
+# ---------------------------------------------------------------------
+# hang fault injection
+# ---------------------------------------------------------------------
+def test_hang_fault_parse_and_window_clamp():
+    inj = finj.FaultInjector("hang@5")
+    assert inj.faults == [("hang", 5)]
+    assert inj.member_of == {}
+    inj2 = finj.FaultInjector("hang@3:member=1,nan@7")
+    assert inj2.faults == [("hang", 3), ("nan", 7)]
+    assert inj2.member_of == {0: 1}
+    with pytest.raises(ValueError, match="member"):
+        finj.FaultInjector("hang@3:lane=1")
+    # pending hangs clamp fused windows to land exactly on step K
+    assert inj.clamp_window(0, 16) == 5
+    assert inj.clamp_window(3, 16) == 2
+    # strict arming: first observed at nstep >= K never fires
+    assert finj.FaultInjector("hang@5").maybe_hang(7) is False
+
+
+def test_hang_fires_once_per_process(monkeypatch):
+    monkeypatch.setenv("RAMSES_HANG_INJECT_CAP_S", "0")
+    inj = finj.FaultInjector("hang@5")
+    assert inj.maybe_hang(0) is False      # arms below K
+    assert inj.maybe_hang(5) is True
+    assert inj.maybe_hang(5) is False      # exactly-once per injector
+    # a FRESH injector (the hang-policy resume rebuilds the sim inside
+    # the same process) must NOT re-fire, or the bounded retry budget
+    # would hang forever
+    fresh = finj.FaultInjector("hang@5")
+    assert fresh.maybe_hang(0) is False
+    assert fresh.maybe_hang(5) is False
+    # ...and once fired, the clamp stops carving windows around K
+    assert fresh.clamp_window(0, 16) == 16
+    finj.reset_fired()                     # test isolation hook
+    again = finj.FaultInjector("hang@5")
+    assert again.maybe_hang(0) is False
+    assert again.maybe_hang(5) is True
+
+
+def test_member_targeted_hang_batched_only(monkeypatch):
+    monkeypatch.setenv("RAMSES_HANG_INJECT_CAP_S", "0")
+    inj = finj.FaultInjector("hang@2:member=1")
+    # the solo drivers never fire a member-targeted hang
+    assert inj.maybe_hang(0) is False
+    assert inj.maybe_hang(2) is False
+    # the batched engine keys on that member's OWN step count
+    grp = types.SimpleNamespace(members=[0, 1],
+                                nstep=np.array([5, 0]))
+    assert inj.maybe_hang_batch(grp, nstep_global=5) is False  # arms
+    grp.nstep = np.array([7, 2])
+    assert inj.maybe_hang_batch(grp, nstep_global=7) is True
+    assert inj.maybe_hang_batch(grp, nstep_global=7) is False
+    # a group without the member never triggers
+    inj2 = finj.FaultInjector("hang@2:member=9")
+    other = types.SimpleNamespace(members=[0, 1],
+                                  nstep=np.array([0, 0]))
+    assert inj2.maybe_hang_batch(other, nstep_global=0) is False
+    other.nstep = np.array([4, 4])
+    assert inj2.maybe_hang_batch(other, nstep_global=4) is False
+    # clamping against member J's own (lagging) step count
+    inj3 = finj.FaultInjector("hang@5:member=2")
+    assert inj3.clamp_window_batch(16, 9, lambda j: {2: 3}[j]) == 2
+
+
+# ---------------------------------------------------------------------
+# supervisor classification + hang policy
+# ---------------------------------------------------------------------
+def test_classify_taxonomy():
+    from ramses_tpu.resilience.stepguard import StepRetryExhausted
+    assert rsup.classify(None) == "none"
+    assert rsup.classify(wdog.HangDetected("step", 5.0)) == "hang"
+    assert rsup.classify(StepRetryExhausted("nan ladder")) == "nan"
+    assert rsup.classify(RuntimeError("boom")) == "crash"
+
+
+def test_hang_policy_immediate_resume_no_backoff(tmp_path, monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(rsup.time, "sleep", lambda s: sleeps.append(s))
+    p = _uni_params(nstep=5)
+    calls = {"n": 0}
+
+    def build(restart):
+        assert restart is None             # no checkpoints on disk
+        return types.SimpleNamespace(nstep=0, t=0.0, telemetry=None)
+
+    def drive(sim):
+        calls["n"] += 1
+        raise wdog.HangDetected("step", 2.0, nstep=3)
+
+    with pytest.raises(wdog.HangDetected):
+        rsup.supervise(build, drive, p, base_dir=str(tmp_path),
+                       max_attempts=3, hang_retries=2,
+                       log=lambda *_: None)
+    # hang retries ride their OWN budget (2 resumes + the initial
+    # attempt), never consume the 3 crash attempts, and never back off
+    assert calls["n"] == 3
+    assert sleeps == []
+
+    # hang_retries=0 (the serve loop's setting): first hang escapes
+    calls["n"] = 0
+    with pytest.raises(wdog.HangDetected):
+        rsup.supervise(build, drive, p, base_dir=str(tmp_path),
+                       max_attempts=3, hang_retries=0,
+                       log=lambda *_: None)
+    assert calls["n"] == 1
+
+
+def test_queue_requeue_and_fail_carry_hang_stage(tmp_path):
+    from ramses_tpu.ensemble import queue as jq
+    q = jq.init_queue(str(tmp_path / "q"))
+    jq.submit(q, "&RUN_PARAMS\n/", job_id="job-hang")
+    job = jq.claim(q, worker="w1")
+    jq.requeue(job, error="phase 'step' exceeded 2s deadline",
+               stage="hang")
+    job2 = jq.claim(q, worker="w2")
+    assert [e["stage"] for e in job2.record["failure_log"]] == ["hang"]
+    jq.fail(job2, error="hung again", stage="hang")
+    rec = jq.job_status(q, "job-hang").record
+    assert [e["stage"] for e in rec["failure_log"]] == ["hang", "hang"]
+
+
+# ---------------------------------------------------------------------
+# zero overhead when off AND when armed (device_get pin)
+# ---------------------------------------------------------------------
+@pytest.mark.parametrize("armed", [False, True])
+def test_watchdog_adds_zero_device_fetches(tmp_path, monkeypatch,
+                                           armed):
+    from ramses_tpu.amr.hierarchy import AmrSim
+    extra = ("compile_deadline_s=600.0\nstep_deadline_s=600.0"
+             if armed else "")
+    p = params_from_string(AMR2D.format(nstep=16, run_extra=extra),
+                           ndim=2)
+    sim = AmrSim(p)
+    assert (sim._wd is not None) is armed, \
+        "the watchdog must be OFF (None) unless a deadline is set"
+    sim.regrid_interval = 0
+    sim.evolve(1e9, nstepmax=4)            # warm the fused chunk
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    sim.evolve(1e9, nstepmax=sim.nstep + 8)
+    assert calls["n"] == 0, \
+        "the watchdog must never add host<->device fetches"
+
+
+# ---------------------------------------------------------------------
+# supervised hang-resume reproduces an uninterrupted run
+# ---------------------------------------------------------------------
+def test_hang_resume_matches_uninterrupted_run(tmp_path, monkeypatch):
+    """Same contract as the SIGTERM test in tests/test_resilience.py:
+    an injected ``hang@4`` trips the step deadline, the supervisor
+    classifies it as a hang and immediately resumes from the newest
+    checkpoint, and the finished run matches a clean one within
+    round-off."""
+    from ramses_tpu.driver import Simulation
+    monkeypatch.setenv("RAMSES_HANG_INJECT_CAP_S", "30")
+
+    ref = _uni_sim(nstep=8, dtype=jnp.float64)
+    ref.evolve()
+    assert ref.nstep == 8
+
+    outdir = str(tmp_path / "run")
+    os.makedirs(outdir)
+    # a mid-run checkpoint for the hang policy to resume from (the
+    # fused windows land exactly on step 4 thanks to the injector's
+    # window clamp — here we dump that state explicitly)
+    pre = _uni_sim(nstep=4, dtype=jnp.float64)
+    pre.evolve()
+    assert pre.nstep == 4
+    # emergency-range output number (like an OpsGuard stop dump):
+    # restore then re-derives the next scheduled iout from t instead
+    # of skipping past the output table
+    pre.dump(900, outdir)
+
+    p = _uni_params(
+        nstep=8,
+        run_extra=("fault_inject='hang@4'\n"
+                   "compile_deadline_s=120.0\nstep_deadline_s=2.0"),
+        out_extra=f"output_dir='{outdir}'")
+
+    def build(restart):
+        return (Simulation.from_snapshot(p, restart, dtype=jnp.float64)
+                if restart else Simulation(p, dtype=jnp.float64))
+
+    logs = []
+    sim = rsup.supervise(build, lambda s: s.evolve(), p,
+                         base_dir=outdir, max_attempts=3,
+                         hang_retries=2,
+                         log=lambda m: logs.append(str(m)))
+    assert any("classified hang" in m for m in logs), \
+        "the deadline expiry must be classified as a hang, not a crash"
+    assert any("hang retry" in m for m in logs)
+    assert any("resuming from" in m for m in logs), \
+        "the hang policy resumes from the newest valid checkpoint"
+    assert sim.nstep == 8
+    np.testing.assert_allclose(
+        np.asarray(sim.state.u), np.asarray(ref.state.u),
+        rtol=1e-9, atol=1e-12)
+    assert abs(sim.t - ref.t) <= 1e-12 * max(abs(ref.t), 1.0)
+    # the expiry left a hang diagnostics dump that the resume scanner
+    # ignored (it resumed from output_00900, not hang_00001)
+    assert os.path.isdir(os.path.join(outdir, "hang_00001"))

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Generate ``docs/params.md`` from ``ramses_tpu/config.py``.
+
+Every namelist group the runtime parses (``_GROUP_MAP``) becomes one
+section: a table of every field with its default (rendered in namelist
+syntax) and its semantics, harvested mechanically from the dataclass
+source — the comment block directly above a field plus any trailing
+comment on its line.  Because the tables are derived from the config
+module itself, the doc cannot drift from the code: ``--check`` re-
+renders and fails when ``docs/params.md`` is stale (wired into CI and
+``tests/test_params_doc.py``).
+
+Usage:
+    python tools/gen_params_doc.py           # rewrite docs/params.md
+    python tools/gen_params_doc.py --check   # exit 1 when stale
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CONFIG_PY = os.path.join(REPO, "ramses_tpu", "config.py")
+DOC_PATH = os.path.join(REPO, "docs", "params.md")
+
+HEADER = """\
+# Namelist parameters (generated)
+
+Every namelist key the runtime parses, with defaults and semantics —
+generated from `ramses_tpu/config.py` by `tools/gen_params_doc.py`.
+**Do not edit by hand**: rerun the generator after changing a config
+dataclass; CI and `tests/test_params_doc.py` fail when this file is
+stale.  For the curated per-group prose see
+[runtime_parameters.md](runtime_parameters.md) and
+[namelists.md](namelists.md).
+
+Defaults are rendered in namelist syntax (`.true.`/`.false.`, quoted
+strings).  Long per-level/per-region list defaults are abbreviated as
+`v,... (Nx)`.  `ndim`/`nvar`/`nener`/`npassive` are load-time
+arguments (`--ndim` on the CLI), not namelist keys.
+"""
+
+
+def _field_comments(src: str):
+    """Map (class_name, field_name) -> semantics string harvested from
+    the source: contiguous ``#`` lines directly above the field plus a
+    trailing comment on the field's own (possibly wrapped) statement."""
+    lines = src.splitlines()
+    tree = ast.parse(src)
+    out = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in cls.body:
+            if not isinstance(node, ast.AnnAssign) \
+                    or not isinstance(node.target, ast.Name):
+                continue
+            name = node.target.id
+            # comment block above (stop at code or blank line)
+            block = []
+            i = node.lineno - 2
+            while i >= 0:
+                s = lines[i].strip()
+                if s.startswith("#"):
+                    block.insert(0, s.lstrip("#").strip())
+                    i -= 1
+                else:
+                    break
+            # trailing comments on the statement's own lines
+            trail = []
+            end = getattr(node, "end_lineno", node.lineno)
+            for j in range(node.lineno - 1, end):
+                m = re.search(r"#\s?(.*)$", lines[j])
+                if m:
+                    trail.append(m.group(1).strip())
+            text = " ".join(block + trail)
+            out[(cls.name, name)] = re.sub(r"\s+", " ", text).strip()
+    return out
+
+
+def _render_default(v) -> str:
+    if isinstance(v, bool):
+        return ".true." if v else ".false."
+    if isinstance(v, str):
+        return f"`'{v}'`"
+    if isinstance(v, float):
+        return f"`{v!r}`"
+    if isinstance(v, int):
+        return f"`{v}`"
+    if isinstance(v, list):
+        if not v:
+            return "—"
+        if len(v) > 3 and len({repr(x) for x in v}) == 1:
+            inner = _render_default(v[0]).strip("`")
+            return f"`{inner},...` ({len(v)}x)"
+        return "`" + ",".join(
+            _render_default(x).strip("`") for x in v) + "`"
+    return f"`{v!r}`"
+
+
+def _md_escape(s: str) -> str:
+    return s.replace("|", "\\|")
+
+
+def render() -> str:
+    from ramses_tpu import config as cfg
+
+    with open(CONFIG_PY) as f:
+        src = f.read()
+    comments = _field_comments(src)
+    p = cfg.Params()
+    out = io.StringIO()
+    out.write(HEADER)
+    for gname, attr in cfg._GROUP_MAP.items():
+        sub = getattr(p, attr)
+        cls = type(sub)
+        out.write(f"\n## &{gname.upper()} — `params.{attr}`\n\n")
+        doc = (cls.__doc__ or "").strip()
+        if doc:
+            out.write(re.sub(r"\s+", " ", doc) + "\n\n")
+        out.write("| parameter | default | semantics |\n")
+        out.write("|---|---|---|\n")
+        for fld in dataclasses.fields(cls):
+            default = _render_default(getattr(sub, fld.name))
+            sem = comments.get((cls.__name__, fld.name), "")
+            out.write(f"| `{fld.name}` | {default} "
+                      f"| {_md_escape(sem)} |\n")
+    out.write(
+        "\n## Raw groups\n\n"
+        "Groups not in the table above stay verbatim in `params.raw` "
+        "and are parsed by their owning subsystem (`&SF_PARAMS`, "
+        "`&FEEDBACK_PARAMS`, `&SINK_PARAMS`, `&STELLAR_PARAMS`, "
+        "`&MOVIE_PARAMS`, `&TURB_PARAMS`) — see "
+        "[namelists.md](namelists.md).\n")
+    return out.getvalue()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    text = render()
+    if "--check" in argv:
+        try:
+            with open(DOC_PATH) as f:
+                cur = f.read()
+        except FileNotFoundError:
+            cur = ""
+        if cur != text:
+            print("docs/params.md is STALE — rerun "
+                  "`python tools/gen_params_doc.py`", file=sys.stderr)
+            return 1
+        print("docs/params.md is up to date")
+        return 0
+    with open(DOC_PATH, "w") as f:
+        f.write(text)
+    print(f"wrote {os.path.relpath(DOC_PATH, REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
