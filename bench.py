@@ -35,7 +35,10 @@ The parent ALWAYS prints the JSON line and exits 0.
 Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
-BENCH_ONLY=<comma list of uniform|amr|mg|amr_poisson|ensemble>,
+BENCH_ONLY=<comma list of uniform|amr|mg|amr_poisson|ensemble|
+profile_amr — the last runs tools/profile_amr.py's per-kernel probes
+with incremental partial capture; also auto-escalated after a
+hang-classified amr sub>,
 BENCH_SUB_TIMEOUT, BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH,
 BENCH_ENS_LEVEL, BENCH_ENS_STEPS, BENCH_ENS_BATCHES,
 BENCH_HANG_SUB=<sub> (deliberately wedge that child before its jax
@@ -498,15 +501,20 @@ def bench_mg(dtype, jnp, hb=lambda *a, **k: None):
     }
 
 
-SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
+# the default protocol; profile_amr (the per-kernel breakdown of
+# tools/profile_amr.py) is opt-in via BENCH_ONLY or the amr-hang
+# escalation below — too slow for every protocol run
+DEFAULT_SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
+SUBS = DEFAULT_SUBS + ("profile_amr",)
 # ceilings per sub; the GLOBAL budget (BENCH_TOTAL_BUDGET) always wins —
 # four rounds of rc=124 driver kills came from these summing past the
 # driver's wall clock whenever the tunnel hung
 SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500,
-                "ensemble": 300}
+                "ensemble": 300, "profile_amr": 700}
 # share of the REMAINING budget each sub may claim at launch
 SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35,
-               "amr_poisson": 0.95, "ensemble": 0.95}
+               "amr_poisson": 0.95, "ensemble": 0.95,
+               "profile_amr": 0.95}
 
 
 def run_sub_inproc(name):
@@ -544,6 +552,16 @@ def run_sub_inproc(name):
     elif name == "ensemble":
         d = bench_ensemble(load_params(nml, ndim=3), dtype, jnp,
                            hb=hb.mark)
+    elif name == "profile_amr":
+        # per-kernel breakdown (tools/profile_amr.py): its probes emit
+        # incrementally into the result sidecar with completed=False,
+        # so a deadline-killed child still leaves a classified partial
+        # capture with the phase timings gathered so far
+        from tools.profile_amr import collect
+        os.environ.setdefault("PROF_PROBE_DEADLINE_S", "120")
+        d = collect(hb=hb.mark,
+                    emit=lambda r: _write_result(name, dict(r)))
+        d["tunnel_rtt_s"] = measure_rtt(jnp)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
     hb.mark("done")
@@ -655,12 +673,16 @@ def run_sub(name, deadline, weight=None, reserve=0.0):
                 if line.startswith(MARKER):
                     return json.loads(line[len(MARKER):])
             got = _read_result(name)
-            if got is not None:
+            if got is not None and got.get("completed") is not False:
                 return got        # stdout lost, sidecar survived
             tail = (r.stderr or r.stdout or "")[-2000:]
             last = {"error": f"sub-bench exited rc={r.returncode} "
                              f"without result", "tail": tail,
                     "attempt": attempt, **_hb_diag()}
+            if got is not None:
+                # incremental sidecar (profile_amr): keep the partial
+                # phase timings alongside the diagnosis
+                last["partial"] = got
             if r.returncode == 87:
                 # the watchdog's HANG_EXIT_CODE, as a literal — the
                 # parent never imports ramses_tpu
@@ -670,6 +692,14 @@ def run_sub(name, deadline, weight=None, reserve=0.0):
                 return last
         except subprocess.TimeoutExpired:
             got = _read_result(name)
+            if got is not None and got.get("completed") is False:
+                # incremental sidecar: the child was killed mid-capture
+                # — classify as hang but KEEP the partial phase timings
+                got.update({"error": f"sub-bench timed out after "
+                                     f"{timeout:.0f}s",
+                            "classification": "hang",
+                            "attempt": attempt, **_hb_diag()})
+                return got
             if got is not None:
                 # the measurement finished; the child hung afterwards
                 got["late"] = True
@@ -694,12 +724,13 @@ def run_sub(name, deadline, weight=None, reserve=0.0):
 def main():
     only = os.environ.get("BENCH_ONLY", "")
     wanted = (tuple(s.strip() for s in only.split(",") if s.strip())
-              if only else SUBS)
+              if only else DEFAULT_SUBS)
     bad = [s for s in wanted if s not in SUBS]
     if bad:
         raise SystemExit(
             f"BENCH_ONLY={only!r}: unknown sub(s) {bad}; expected a "
-            f"comma list of uniform|amr|mg|amr_poisson|ensemble")
+            f"comma list of "
+            f"uniform|amr|mg|amr_poisson|ensemble|profile_amr")
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "900"))
     deadline = time.monotonic() + budget
     partial_path = os.environ.get(
@@ -731,6 +762,23 @@ def main():
         sub[name].pop("_dtype", None)
         # incremental emission: whatever has completed is ALWAYS on
         # record, even if the driver kills this process mid-protocol
+        try:
+            with open(partial_path, "w") as f:
+                json.dump({"budget_s": budget, "tunnel": tunnel,
+                           "device": device, "dtype": dtype_name,
+                           "sub": sub}, f)
+        except OSError:
+            pass
+
+    # amr-hang escalation: a hang-classified amr capture alone says
+    # nothing about WHERE the step wedged — run the per-kernel
+    # breakdown (incremental sidecar) so even a degraded tunnel leaves
+    # classified partial phase timings on record
+    if (sub.get("amr", {}).get("classification") == "hang"
+            and "profile_amr" not in wanted
+            and deadline - time.monotonic() > 60.0):
+        sub["profile_amr"] = run_sub("profile_amr", deadline, weight=0.95)
+        sub["profile_amr"]["escalated_from"] = "amr hang"
         try:
             with open(partial_path, "w") as f:
                 json.dump({"budget_s": budget, "tunnel": tunnel,

@@ -97,6 +97,12 @@ class FusedSpec(NamedTuple):
     # None) for COMPLETE levels on a multi-chip mesh; empty tuple =
     # global-view dense sweep everywhere (the single-device default)
     slab: tuple = ()
+    # per-level bool: partial level runs the gather-fused blocked tile
+    # sweep (Morton-aligned oct tiles, amr/maps.build_block_maps)
+    # instead of the 6^d stencil gather; empty tuple = never
+    blocked: tuple = ()
+    # octs per tile side = 2**block_shift for the blocked levels
+    block_shift: int = 2
 
 
 def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
@@ -164,6 +170,22 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
                 u[l], u[l - 1], unew[l - 1], d, dtl, dx(l), cfg,
                 spec.comm[i])
             corr = None
+        elif spec.blocked and spec.blocked[i]:
+            # gather-fused blocked tile path: the compact Morton-tile
+            # batch replaces the ~(3^d)x-duplicated stencil gather
+            interp = K.interp_cells(u[l - 1], d["b_interp_cell"],
+                                    d["b_interp_nb"], d["b_interp_sgn"],
+                                    cfg, itype=spec.itype)
+            out = K.tile_sweep(
+                u[l], interp, d["tile_src"], d["tile_vsgn"], d["tile_ok"],
+                d["cell_tile"], d["cell_slot"], d["oct_tile"],
+                d["oct_slot"], dtl, dx(l), cfg, spec.block_shift,
+                ret_flux=spec.want_flux)
+            # pad cell rows index the kernels' appended zero column
+            # (maps.py), so du/phi pad rows are exactly 0 — no masking
+            du, corr = out[0], out[1]
+            if spec.want_flux:
+                phi[l] = phi[l] + out[2]
         else:
             interp = K.interp_cells(u[l - 1], d["interp_cell"],
                                     d["interp_nb"], d["interp_sgn"], cfg,
@@ -288,6 +310,20 @@ def _fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
                                           fls, shp,
                                           spec.bspec, cfg,
                                           dx=spec.boxlen / (1 << l))
+        elif spec.blocked and spec.blocked[i]:
+            # flags reuse the blocked shared gather (tile batch)
+            if l == spec.lmin:
+                interp = jnp.zeros((d["b_interp_cell"].shape[0],
+                                    cfg.nvar), u[l].dtype)
+            else:
+                interp = K.interp_cells(u[l - 1], d["b_interp_cell"],
+                                        d["b_interp_nb"],
+                                        d["b_interp_sgn"],
+                                        cfg, itype=itype)
+            fl = K.tile_refine_flags(u[l], interp, d["tile_src"],
+                                     d["tile_vsgn"], d["cell_tile"],
+                                     d["cell_slot"], eg, fls, cfg,
+                                     spec.block_shift)
         else:
             if l == spec.lmin:
                 interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
@@ -469,6 +505,10 @@ class AmrSim:
 
     _needs_mig_log = False
     ndev = 1          # device count of the row sharding (sharded subclass)
+    # gather-fused blocked tile sweep on partial levels: solver families
+    # with their own partial-level gather (MHD faces) and row-sharded
+    # sims (GSPMD owns the gather) opt out
+    _oct_blocked = True
     # solver families whose state layout differs from the hydro
     # [rho, mom, E, ...] convention opt out of the shared SF/sink passes
     _pm_physics = True
@@ -880,6 +920,21 @@ class AmrSim:
         self._force_rebalance = False
         self.balance_stats = stats
 
+    def _block_level_ok(self, l: int) -> bool:
+        """Gate: is a PARTIAL level eligible for the gather-fused blocked
+        tile sweep?  Load-balance layouts permute oct rows (breaking the
+        Morton-contiguous tile property) and explicit comm schedules own
+        their own gather, so both keep the 6^d stencil path."""
+        if not self._oct_blocked:
+            return False
+        if not bool(getattr(self.params.amr, "oct_blocking", True)):
+            return False
+        if getattr(self, "_comm_specs", {}):
+            return False
+        if any(self.layouts.get(j) is not None for j in (l - 1, l, l + 1)):
+            return False
+        return True
+
     def _rebuild_maps(self, old_tree: Optional[Octree] = None,
                       old_maps: Optional[dict] = None,
                       old_dev: Optional[dict] = None):
@@ -889,10 +944,13 @@ class AmrSim:
         from ramses_tpu.parallel import balance
         prev_maps = old_maps or {}
         prev_dev = old_dev or {}
+        prev_blocks = getattr(self, "blocks", {})
         prev_lay = getattr(self, "_built_lay", {})
         self._spec = None
         self.maps: Dict[int, mapmod.LevelMaps] = {}
         self.dev: Dict[int, dict] = {}
+        self.blocks: Dict[int, mapmod.BlockMaps] = {}
+        self.block_stats = {"blocks_total": 0, "blocks_rebuilt": 0}
         self._built_lay = {}
         for l in range(self.lmin, self.lmax + 1):
             if not self.tree.has(l):
@@ -905,6 +963,12 @@ class AmrSim:
                     and prev_lay.get(l) == self._built_lay[l]):
                 self.maps[l] = prev_maps[l]
                 self.dev[l] = prev_dev[l]
+                if l in prev_blocks:
+                    # unchanged (l-1, l, l+1) oct sets: every per-block
+                    # map is still valid — zero blocks rebuilt
+                    self.blocks[l] = prev_blocks[l]
+                    self.block_stats["blocks_total"] += \
+                        prev_blocks[l].ntile
                 continue
             if (l in prev_maps and prev_maps[l].complete
                     and self._keys_same(old_tree, l)):
@@ -974,6 +1038,35 @@ class AmrSim:
                 son_oct=self._place(jnp.asarray(m.son_oct), "rep"),
                 valid_cell=self._place(jnp.asarray(valid_cell), "cells"),
             )
+            if self._block_level_ok(l):
+                b = mapmod.build_block_maps(
+                    self.tree, l, self.bc_kinds,
+                    shift=int(getattr(self.params.amr,
+                                      "oct_block_shift", 2)),
+                    noct_pad=m.noct_pad, prev=prev_blocks.get(l))
+                self.blocks[l] = b
+                self.block_stats["blocks_total"] += b.ntile
+                self.block_stats["blocks_rebuilt"] += b.blocks_rebuilt
+                self.dev[l].update(
+                    tile_src=self._place(jnp.asarray(b.tile_src), "rep"),
+                    tile_vsgn=(self._place(jnp.asarray(b.tile_vsgn),
+                                           "rep")
+                               if b.tile_vsgn is not None else None),
+                    tile_ok=self._place(jnp.asarray(b.tile_ok), "rep"),
+                    cell_tile=self._place(jnp.asarray(b.cell_tile),
+                                          "cells"),
+                    cell_slot=self._place(jnp.asarray(b.cell_slot),
+                                          "cells"),
+                    oct_tile=self._place(jnp.asarray(b.oct_tile), "octs"),
+                    oct_slot=self._place(jnp.asarray(b.oct_slot), "octs"),
+                    b_interp_cell=self._place(
+                        jnp.asarray(b.interp_cell), "rep"),
+                    b_interp_nb=self._place(jnp.asarray(b.interp_nb),
+                                            "rep"),
+                    b_interp_sgn=self._place(
+                        jnp.asarray(b.interp_sgn, dtype=self.dtype),
+                        "rep"),
+                )
             if self.gravity:
                 g = mapmod.build_gravity_maps(self.tree, l, self.bc_kinds,
                                               noct_pad=m.noct_pad)
@@ -1153,6 +1246,13 @@ class AmrSim:
                                               lay_range))
         if unchanged:
             self.tree = oldtree
+            if getattr(self, "blocks", None):
+                # steady-state regrid: tree untouched, every per-block
+                # map stays live — zero blocks rebuilt
+                self.block_stats = {
+                    "blocks_total": sum(b.ntile
+                                        for b in self.blocks.values()),
+                    "blocks_rebuilt": 0}
             return
         with self.timers.section("regrid: maps"):
             self._rebuild_maps(oldtree, old_maps, old_dev)
@@ -1246,9 +1346,10 @@ class AmrSim:
                 self.fg.pop(l, None)
                 self.poisson_iters.pop(l, None)
                 self._rho_dev.pop(l, None)
-        self._restrict_all()
-        self._dt_cache = None          # u changed: stale CFL dt
         self.timers.stop()
+        with self.timers.section("regrid: upload"):
+            self._restrict_all()
+        self._dt_cache = None          # u changed: stale CFL dt
 
     def _restrict_all(self):
         """Restriction sweep fine→coarse so non-leaf cells hold son means."""
@@ -1293,6 +1394,12 @@ class AmrSim:
                          else None for l in lv)
             if any(s is not None for s in slab):
                 self._spec = self._spec._replace(slab=slab)
+            blocked = tuple(l in self.blocks for l in lv)
+            if any(blocked):
+                self._spec = self._spec._replace(
+                    blocked=blocked,
+                    block_shift=int(getattr(self.params.amr,
+                                            "oct_block_shift", 2)))
         return self._spec
 
     def _slab_spec(self, l: int):
@@ -1776,6 +1883,22 @@ class AmrSim:
         instrumented = telem.enabled or verbose
         if telem.enabled and not telem.run_info:
             telem.run_info.update(sim_run_info(self))
+            import os as _os
+
+            from ramses_tpu.telemetry import hlo as _hlo
+            if _os.environ.get("RAMSES_TELEMETRY_HLO", "1") != "0":
+                # static gather-traffic inventory of the fused coarse
+                # step for this tree: a lowering (trace, no compile),
+                # recorded once per run for offline trend tracking
+                try:
+                    txt = _hlo.lower_fused_step(self)
+                    inv = _hlo.gather_inventory(txt)
+                    telem.run_info["hlo_gather_elems"] = \
+                        sum(n for n, _ in inv)
+                    telem.run_info["hlo_gather_ops"] = len(inv)
+                except Exception as e:  # pragma: no cover - best effort
+                    telem.run_info["hlo_gather_elems"] = None
+                    telem.run_info["hlo_gather_error"] = repr(e)
         sguard = self._sguard
         while self.t < tend * (1 - 1e-12) and self.nstep < nstepmax:
             if guard is not None:

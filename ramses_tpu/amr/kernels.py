@@ -254,6 +254,181 @@ def level_sweep(u_flat, interp_vals, stencil_src, vsgn, ok_ref, gloc,
     return du_flat, corr, phi
 
 
+# ---------------------------------------------------------------------------
+# Blocked Morton tile sweep (gather-fused oct path)
+# ---------------------------------------------------------------------------
+
+_NG = 2                                   # tile halo width (MUSCL stencil)
+
+
+def _gather_utile(u_flat, interp_vals, tile_src, tile_vsgn,
+                  cfg: HydroStatic, td: int):
+    """Compact blocked gather: [nvar, td..., ntile] from flat cells +
+    interps — the gather-fused replacement for :func:`_gather_uloc`'s
+    ~(3^d)x-duplicated per-oct stencil batch.  Each Morton-aligned tile
+    holds its interior cells once plus a 2-cell halo, so HBM gather
+    traffic scales with tile volume instead of stencil volume."""
+    trash = jnp.zeros((1, cfg.nvar), u_flat.dtype)
+    src = jnp.concatenate([u_flat, interp_vals, trash], axis=0)
+    srcT = src.T                                       # [nvar, nrows]
+    ut = srcT[:, tile_src]                             # [nvar, ntile, td^d]
+    if tile_vsgn is not None:
+        for d in range(cfg.ndim):
+            flip = ((tile_vsgn >> d) & 1).astype(u_flat.dtype)
+            ut = ut.at[1 + d].multiply(1.0 - 2.0 * flip)
+    ntile = ut.shape[1]
+    ut = jnp.swapaxes(ut, 1, 2)                        # [nvar, td^d, ntile]
+    return ut.reshape((cfg.nvar,) + (td,) * cfg.ndim + (ntile,))
+
+
+def _face_planes(fl, d, ndim: int, c: int):
+    """Per-oct-face flux planes of masked flux ``fl`` [nvar, td..., ntile]
+    along d: [nvar, c//2+1, c...(transverse, increasing-dim order),
+    ntile] — positions _NG + 2k, transverse interior."""
+    idx = [slice(None)]
+    for dd in range(ndim):
+        idx.append(slice(_NG, _NG + c + 1, 2) if dd == d
+                   else slice(_NG, _NG + c))
+    return jnp.moveaxis(fl[tuple(idx)], 1 + d, 1)
+
+
+def _mass_planes(f0, d, ndim: int, c: int):
+    """All c+1 per-cell-face planes of the mass flux ``f0``
+    [td..., ntile] along d: [c+1, c...(transverse), ntile]."""
+    idx = []
+    for dd in range(ndim):
+        idx.append(slice(_NG, _NG + c + 1) if dd == d
+                   else slice(_NG, _NG + c))
+    return jnp.moveaxis(f0[tuple(idx)], d, 0)
+
+
+def _corr_from_planes(planes, d, ndim: int, c: int):
+    """Per-oct boundary-flux sums from face planes: (lo, hi), each
+    [nvar, (c//2)^ndim, ntile] flattened in global dim order — the same
+    [nvar, 2, 2, ...] transverse reduction as :func:`level_sweep`."""
+    o = c // 2
+    nvar, ntile = planes.shape[0], planes.shape[-1]
+    shape = [nvar, o + 1] + [o, 2] * (ndim - 1) + [ntile]
+    g = planes.reshape(shape)
+    cell_axes = [3 + 2 * i for i in range(ndim - 1)]
+    g = jnp.moveaxis(g, cell_axes, tuple(range(1, ndim)))
+    red = tuple(range(1, 1 + ndim - 1))
+    s = g.sum(axis=red) if ndim > 1 else g
+    # s: [nvar, o+1 (planes along d), o transverse dims..., ntile];
+    # restore global dim order before flattening to oct slots
+    def _oct_rows(x):
+        x = jnp.moveaxis(x, 1, 1 + d)
+        return x.reshape(nvar, o ** ndim, ntile)
+    lo = jax.lax.slice_in_dim(s, 0, o, axis=1)
+    hi = jax.lax.slice_in_dim(s, 1, o + 1, axis=1)
+    return _oct_rows(lo), _oct_rows(hi)
+
+
+@partial(jax.jit, static_argnames=("cfg", "dx", "shift", "ret_flux"))
+def tile_sweep(u_flat, interp_vals, tile_src, tile_vsgn, tile_ok,
+               cell_tile, cell_slot, oct_tile, oct_slot,
+               dt, dx: float, cfg: HydroStatic, shift: int,
+               ret_flux: bool = False):
+    """Full godfine1 for one blocked partial level — the gather-fused
+    replacement for :func:`level_sweep` (same return convention:
+    du_flat [ncell, nvar], corr [noct, ndim, 2, nvar] [, phi
+    [ncell, ndim, 2]]).  The 6^d-duplicated stencil batch is never
+    materialized: the sweep runs on the compact [nvar, td..., ntile]
+    tile batch (Pallas kernel on TPU, trailing-batch XLA fallback
+    elsewhere), and du/corr/phi are reordered back to flat rows with
+    small per-cell/per-oct gathers."""
+    ndim, nvar = cfg.ndim, cfg.nvar
+    c = 1 << (shift + 1)
+    td = c + 2 * _NG
+    ut = _gather_utile(u_flat, interp_vals, tile_src, tile_vsgn, cfg, td)
+    ntile = ut.shape[-1]
+    okl = tile_ok.T.reshape((td,) * ndim + (ntile,))
+
+    from ramses_tpu.hydro import pallas_oct
+    if pallas_oct.tile_available(cfg, ntile, u_flat.dtype):
+        out_k = pallas_oct.tile_sweep(ut, okl.astype(ut.dtype), dt, cfg,
+                                      dx, shift, want_flux=ret_flux)
+        du_t, corrp = out_k[0], out_k[1]
+        planes = [corrp[:, d] for d in range(ndim)]
+        mass = ([out_k[2][d] for d in range(ndim)] if ret_flux else None)
+    else:
+        bcfg = dreplace(cfg, trailing_batch=True)
+        flux, tmp = _unsplit_fn(cfg)(ut, None, dt, (dx,) * ndim, bcfg)
+        fluxes = []
+        tmps = []
+        for d in range(ndim):
+            keep = ~(okl | jnp.roll(okl, 1, axis=d))
+            fluxes.append(flux[d] * keep[None].astype(flux.dtype))
+            if tmp is not None:
+                tmps.append(tmp[d] * keep[None].astype(flux.dtype))
+        un_blk = muscl.apply_fluxes(ut, jnp.stack(fluxes), bcfg)
+        if tmp is not None and (cfg.pressure_fix or cfg.nener):
+            un_blk = muscl.dual_energy_fix(ut, un_blk, jnp.stack(tmps),
+                                           dt, (dx,) * ndim, bcfg)
+        interior = (slice(None),) + (slice(_NG, _NG + c),) * ndim
+        du_t = un_blk[interior] - ut[interior]
+        planes = [_face_planes(fluxes[d], d, ndim, c) for d in range(ndim)]
+        mass = ([_mass_planes(fluxes[d][0], d, ndim, c)
+                 for d in range(ndim)] if ret_flux else None)
+
+    # interior update → flat rows.  Pad cell rows carry slot c^d /
+    # tile 0 (maps.py), which flattens one past the interior batch —
+    # an appended zero column — so they come out exactly 0 with no
+    # masking on the real-row dataflow.
+    flat_idx = cell_slot * ntile + cell_tile
+    du_src = jnp.concatenate(
+        [du_t.reshape((nvar, c ** ndim * ntile)),
+         jnp.zeros((nvar, 1), du_t.dtype)], axis=1)
+    du_flat = du_src[:, flat_idx].T                    # [ncell_pad, nvar]
+
+    # boundary fluxes → per-oct corr rows
+    corr = []
+    for d in range(ndim):
+        lo, hi = _corr_from_planes(planes[d], d, ndim, c)
+        lo_g = lo[:, oct_slot, oct_tile]
+        hi_g = hi[:, oct_slot, oct_tile]
+        corr.append(jnp.stack([lo_g, hi_g], axis=-1))  # [nvar, noct, 2]
+    corr = jnp.stack(corr, axis=-2)                    # [nvar, noct, nd, 2]
+    corr = jnp.moveaxis(corr, 0, -1)                   # [noct, nd, 2, nvar]
+    if not ret_flux:
+        return du_flat, corr
+
+    # per-cell (low, high) face mass flux
+    def _cell_rows(x, d):
+        x = jnp.moveaxis(x, 0, d)                      # [c..., ntile]
+        xf = jnp.concatenate([x.reshape(c ** ndim * ntile),
+                              jnp.zeros((1,), x.dtype)])
+        return xf[flat_idx]
+    phis = []
+    for d in range(ndim):
+        phis.append(jnp.stack([_cell_rows(mass[d][:c], d),
+                               _cell_rows(mass[d][1:c + 1], d)], axis=-1))
+    phi = jnp.stack(phis, axis=-2)                     # [ncell, ndim, 2]
+    return du_flat, corr, phi
+
+
+@partial(jax.jit, static_argnames=("cfg", "err_grad", "floors", "shift"))
+def tile_refine_flags(u_flat, interp_vals, tile_src, tile_vsgn,
+                      cell_tile, cell_slot,
+                      err_grad: Tuple[float, float, float],
+                      floors: Tuple[float, float, float],
+                      cfg: HydroStatic, shift: int):
+    """Blocked-gather variant of :func:`refine_flags`: evaluates the same
+    gradient criteria on the compact tile batch (the shared gather of
+    the blocked sweep) and reorders to flat-cell rows [noct_pad, 2^d]."""
+    nd = cfg.ndim
+    c = 1 << (shift + 1)
+    td = c + 2 * _NG
+    ut = _gather_utile(u_flat, interp_vals, tile_src, tile_vsgn, cfg, td)
+    ntile = ut.shape[-1]
+    ok = _flags_fn(cfg)(ut, err_grad, floors, spatial0=0, cfg=cfg)
+    interior = (slice(_NG, _NG + c),) * nd
+    okc = jnp.concatenate([ok[interior].reshape(c ** nd * ntile),
+                           jnp.zeros((1,), ok.dtype)])
+    rows = okc[cell_slot * ntile + cell_tile]          # [ncell_pad]
+    return rows.reshape(len(cell_slot) // 2 ** nd, 2 ** nd)
+
+
 def dense_interior_update(up, okp, dt, dx: float, shape: Tuple[int, ...],
                           cfg: HydroStatic, ret_flux: bool = False):
     """Padded-halo interior update shared by the global-view dense sweep

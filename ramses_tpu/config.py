@@ -98,6 +98,12 @@ class AmrParams:
     # regrid time when max/mean device cost exceeds the threshold
     load_balance: bool = False
     load_balance_threshold: float = 1.1
+    # gather-fused blocked tile sweep on partial levels: octs grouped
+    # into Morton-aligned tiles of 2^oct_block_shift octs per side so
+    # the stencil gather is one compact tile batch instead of a
+    # ~(3^ndim)x duplicated per-oct batch (single-device hydro/rhd)
+    oct_blocking: bool = True
+    oct_block_shift: int = 2
     cost_weight_hydro: float = 1.0
     cost_weight_mhd: float = 2.0
     cost_weight_rt: float = 1.5

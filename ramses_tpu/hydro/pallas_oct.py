@@ -41,6 +41,10 @@ from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.hydro.pallas_muscl import (DISABLED, _hllc_flux, _llf_flux,
                                            _slopes)
 
+# jax renamed TPUCompilerParams → CompilerParams between releases
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 
 # Test hook: force the kernel branch on any backend, run it in Pallas
 # interpreter mode — lets CI drive level_sweep's REAL pallas branch (not
@@ -215,6 +219,189 @@ def oct_sweep(uloc, ok, dt, cfg: HydroStatic, dx: float,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
     )(uloc, ok, dt2)
+
+
+# ---------------------------------------------------------------------------
+# Blocked Morton tile kernel (gather-fused oct path)
+# ---------------------------------------------------------------------------
+
+_NG = 2                                   # tile halo width (MUSCL stencil)
+
+
+def tile_available(cfg: HydroStatic, ntile_pad: int, dtype) -> bool:
+    """Availability gate for the blocked tile kernel — same physics scope
+    as :func:`available`; tile counts are power-of-2 bucketed (>=8)."""
+    if DISABLED:
+        return False
+    if not FORCE_INTERPRET and (jax.default_backend() != "tpu"
+                                or jax.device_count() != 1):
+        return False
+    if getattr(cfg, "physics", "hydro") != "hydro":
+        return False
+    if cfg.ndim != 3 or cfg.nener != 0 or cfg.npassive != 0:
+        return False
+    if cfg.pressure_fix or cfg.scheme != "muscl":
+        return False
+    if cfg.slope_type not in (1, 2, 8):
+        return False
+    if cfg.riemann not in ("llf", "hllc"):
+        return False
+    if dtype not in (jnp.float32, jnp.dtype("float32")):
+        return False
+    return ntile_pad % 8 == 0
+
+
+def _tile_nt(ntile_pad: int, td: int) -> int:
+    """Lane-tile size: keep slots*lanes near the 6^3 kernel's proven
+    VMEM budget (216 slots x 512 lanes)."""
+    cap = max(8, (216 * 512) // td ** 3)
+    nt = 8
+    while nt * 2 <= cap and ntile_pad % (nt * 2) == 0:
+        nt *= 2
+    return nt
+
+
+def _make_tile_kernel(cfg: HydroStatic, dx: float, c: int,
+                      want_flux: bool = False):
+    """Tile-kernel body; refs: u [5,td,td,td,NT], ok [td,td,td,NT],
+    dt [1,1] SMEM → du [5,c,c,c,NT] (interior update), corrp
+    [5,3,c//2+1,c,c,NT] (dt/dx-scaled per-oct-face flux planes,
+    transverse interior, in increasing-dim order) [, phip
+    [3,c+1,c,c,NT] (dt/dx-scaled per-cell-face mass-flux planes)].
+    Physics body identical to :func:`_make_kernel`; only the geometry
+    (interior core, plane outputs) differs."""
+    st = cfg.slope_type
+    theta = float(getattr(cfg, "slope_theta", 1.5))
+    solver = _llf_flux if cfg.riemann == "llf" else _hllc_flux
+    o = c // 2
+    core = (slice(_NG, _NG + c),) * 3
+
+    def kernel(u_ref, ok_ref, dt_ref, du_ref, corrp_ref, *phi_ref):
+        dt = dt_ref[0, 0]
+        # ---- ctoprim ----
+        r = jnp.maximum(u_ref[0], cfg.smallr)
+        ir = 1.0 / r
+        v = [u_ref[1] * ir, u_ref[2] * ir, u_ref[3] * ir]
+        ek = 0.5 * (v[0] * v[0] + v[1] * v[1] + v[2] * v[2])
+        eint = jnp.maximum(u_ref[4] * ir - ek, cfg.smalle)
+        p = (cfg.gamma - 1.0) * r * eint
+        q = (r, v[0], v[1], v[2], p)
+        # ---- uslope ----
+        dq = []
+        for d in range(3):
+            qm1 = tuple(jnp.roll(cc, 1, axis=d) for cc in q)
+            qp1 = tuple(jnp.roll(cc, -1, axis=d) for cc in q)
+            dq.append(tuple(_slopes(a, b, cc, st, theta)
+                            for a, b, cc in zip(qm1, q, qp1)))
+        # ---- trace3d source terms ----
+        divv = dq[0][1] + dq[1][2] + dq[2][3]
+        adv = lambda comp: (v[0] * dq[0][comp] + v[1] * dq[1][comp]
+                            + v[2] * dq[2][comp])
+        sr0 = -adv(0) - divv * r
+        sp0 = -adv(4) - divv * cfg.gamma * p
+        sv0 = [-adv(1 + j) - dq[j][4] * ir for j in range(3)]
+        dtdx2 = 0.5 * dt / dx
+        okf = ok_ref[:]
+        scale = dt / dx
+
+        du = [None] * 5
+        for d in range(3):
+            def face_state(sgn):
+                rho = r + sgn * 0.5 * dq[d][0] + sr0 * dtdx2
+                rho = jnp.where(rho < cfg.smallr, r, rho)
+                vs = [v[j] + sgn * 0.5 * dq[d][1 + j] + sv0[j] * dtdx2
+                      for j in range(3)]
+                pp = p + sgn * 0.5 * dq[d][4] + sp0 * dtdx2
+                return (rho, vs[0], vs[1], vs[2], pp)
+            qm = face_state(+1.0)
+            qp = face_state(-1.0)
+            ql5 = tuple(jnp.roll(cc, 1, axis=d) for cc in qm)
+            qr5 = qp
+            ql5 = (jnp.maximum(ql5[0], cfg.smallr), ql5[1], ql5[2], ql5[3],
+                   jnp.maximum(ql5[4], ql5[0] * cfg.smallp))
+            qr5 = (jnp.maximum(qr5[0], cfg.smallr), qr5[1], qr5[2], qr5[3],
+                   jnp.maximum(qr5[4], qr5[0] * cfg.smallp))
+            flux = solver(ql5, qr5, d, cfg)
+            keepf = (1.0 - okf) * (1.0 - jnp.roll(okf, 1, axis=d))
+            flux = tuple(f * keepf for f in flux)
+            # per-oct-face flux planes at positions _NG + 2k, transverse
+            # interior — the 2x2 per-oct sums happen outside the kernel
+            for k in range(o + 1):
+                ix = tuple(_NG + 2 * k if dd == d else slice(_NG, _NG + c)
+                           for dd in range(3))
+                for cv in range(5):
+                    corrp_ref[cv, d, k] = (flux[cv] * scale)[ix]
+            for cv in range(5):
+                contrib = (flux[cv] - jnp.roll(flux[cv], -1, axis=d)) * scale
+                du[cv] = contrib if du[cv] is None else du[cv] + contrib
+            if want_flux:
+                # all c+1 cell-face mass-flux planes along d
+                for j in range(c + 1):
+                    ix = tuple(_NG + j if dd == d else slice(_NG, _NG + c)
+                               for dd in range(3))
+                    phi_ref[0][d, j] = (flux[0] * scale)[ix]
+        for cv in range(5):
+            du_ref[cv] = du[cv][core]
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("cfg", "dx", "shift", "interpret",
+                                   "want_flux"))
+def tile_sweep(ut, ok, dt, cfg: HydroStatic, dx: float, shift: int,
+               interpret: bool = False, want_flux: bool = False):
+    """Fused partial-level sweep on a compact blocked tile batch.
+
+    ut: [5, td, td, td, N] (td = 2**(shift+1)+4, N = padded tile count);
+    ok: [td, td, td, N] refined-cell mask in the state dtype (0/1).
+    Returns (du [5, c, c, c, N], corrp [5, 3, c//2+1, c, c, N]) with
+    fluxes already ×dt/dx, plus, with ``want_flux``, phip
+    [3, c+1, c, c, N].  Per-oct/per-cell reordering happens in the
+    caller (:func:`ramses_tpu.amr.kernels.tile_sweep`).
+    """
+    c = 1 << (shift + 1)
+    td = c + 2 * _NG
+    o = c // 2
+    n = ut.shape[-1]
+    nt = _tile_nt(n, td)
+    dt2 = jnp.asarray(dt, ut.dtype).reshape(1, 1)
+    kern = _make_tile_kernel(cfg, dx, c, want_flux)
+    interpret = interpret or FORCE_INTERPRET
+    out_specs = [
+        pl.BlockSpec((5, c, c, c, nt), lambda i: (0, 0, 0, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((5, 3, o + 1, c, c, nt),
+                     lambda i: (0, 0, 0, 0, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((5, c, c, c, n), ut.dtype),
+        jax.ShapeDtypeStruct((5, 3, o + 1, c, c, n), ut.dtype),
+    ]
+    if want_flux:
+        out_specs.append(
+            pl.BlockSpec((3, c + 1, c, c, nt),
+                         lambda i: (0, 0, 0, 0, i),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((3, c + 1, c, c, n), ut.dtype))
+    return pl.pallas_call(
+        kern,
+        grid=(n // nt,),
+        in_specs=[
+            pl.BlockSpec((5, td, td, td, nt), lambda i: (0, 0, 0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, td, td, nt), lambda i: (0, 0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        interpret=interpret,
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(ut, ok, dt2)

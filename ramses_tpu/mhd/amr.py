@@ -664,6 +664,7 @@ class MhdAmrSim(AmrSim):
     _needs_mig_log = True
     _pm_physics = False      # MHD state layout carries cell-centred B
     _noncubic_ok = False     # dense CT path assumes one root cube
+    _oct_blocked = False     # CT partial sweep gathers staggered faces
 
     def __init__(self, params: Params, dtype=jnp.float32, **kw):
         from ramses_tpu import patch

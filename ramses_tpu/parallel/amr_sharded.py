@@ -65,6 +65,10 @@ from ramses_tpu.parallel.mesh import oct_mesh
 class ShardedAmrSim(AmrSim):
     """AmrSim with per-level state sharded over an ``oct`` mesh axis."""
 
+    # row-sharded partial levels keep the 6^d stencil gather so GSPMD
+    # (or the explicit comm schedule) can partition it
+    _oct_blocked = False
+
     def __init__(self, params: Params,
                  devices: Optional[Sequence[jax.Device]] = None,
                  dtype=jnp.float32, particles=None, init_tree=None,

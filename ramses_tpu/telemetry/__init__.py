@@ -6,6 +6,7 @@ when &OUTPUT_PARAMS leaves telemetry off — the zero-overhead-off
 contract) and the :mod:`~ramses_tpu.telemetry.screen` formatting.
 """
 
+from ramses_tpu.telemetry import hlo                       # noqa: F401
 from ramses_tpu.telemetry.recorder import (                # noqa: F401
     NULL,
     REQUIRED_STEP_KEYS,

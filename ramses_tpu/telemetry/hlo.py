@@ -1,0 +1,65 @@
+"""Static HLO gather-traffic inventory.
+
+The AMR per-cell gap is gather-bound: every partial-level sweep starts
+from index gathers out of the flat cell batches, and the gathered
+RESULT element count of the lowered program is a backend-independent
+proxy for that HBM traffic — countable on the CPU test backend, stable
+across XLA versions (it is read from the *lowered* StableHLO, before
+the partitioner or fusion touch it).  The blocked Morton-tile path
+exists to shrink exactly this number, so the regression test pins it
+(tests/test_hlo_inventory.py) and the telemetry run header records it
+(``hlo_gather_elems``) for offline trend tracking.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+# `stablehlo.gather ... -> tensor<AxBx...xf32>` (also matches the
+# `"stablehlo.gather"(...)` generic-syntax form and dynamic_gather)
+_GATHER_RE = re.compile(
+    r"stablehlo\.(?:dynamic_)?gather\"?.*->\s*tensor<([0-9x]+)x?[a-z]")
+
+
+def gather_inventory(text: str) -> List[Tuple[int, str]]:
+    """All gather ops in lowered StableHLO/HLO ``text`` as
+    ``(result_elems, op_line)`` pairs, largest first."""
+    out = []
+    for line in text.splitlines():
+        m = _GATHER_RE.search(line)
+        if not m:
+            continue
+        dims = [int(d) for d in m.group(1).split("x") if d]
+        n = 1
+        for d in dims:
+            n *= d
+        out.append((n, line.strip()[:200]))
+    out.sort(key=lambda t: -t[0])
+    return out
+
+
+def count_gather_elems(text: str) -> int:
+    """Total gathered RESULT elements across every gather op in lowered
+    ``text``."""
+    return sum(n for n, _ in gather_inventory(text))
+
+
+def lower_fused_step(sim, dt: float = 1e-6) -> str:
+    """Lowered (pre-optimization) StableHLO text of one fused AMR coarse
+    step for ``sim``'s current tree — the program whose gather traffic
+    the inventory counts."""
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr import hierarchy as H
+
+    spec = sim._fused_spec()
+    return H._fused_coarse_step.lower(
+        sim.u, sim.dev, sim.fg if sim.gravity else {},
+        jnp.asarray(float(sim.dt_old or dt), sim.dtype), spec,
+        sim._cool_bundle()).as_text()
+
+
+def fused_step_gather_elems(sim) -> int:
+    """``count_gather_elems`` of the sim's fused coarse step."""
+    return count_gather_elems(lower_fused_step(sim))

@@ -64,4 +64,6 @@ def test_dead_tunnel_tops_both_jsons(monkeypatch, tmp_path, capsys):
     assert out["value"] is None
     part = json.loads(partial.read_text())
     assert part["tunnel"] == dead
-    assert set(part["sub"]) == set(bench.SUBS)
+    # the default protocol runs DEFAULT_SUBS; profile_amr is opt-in
+    # (BENCH_ONLY=profile_amr) or auto-escalated on an amr hang
+    assert set(part["sub"]) == set(bench.DEFAULT_SUBS)

@@ -1,0 +1,95 @@
+"""Static HLO gather-traffic inventory (telemetry/hlo.py) and the
+blocked-sweep traffic regression gate.
+
+The AMR per-cell gap is gather-bound, so the gathered RESULT element
+count of the *lowered* fused coarse step is the number this PR-chain
+optimizes.  It is backend-independent (counted from StableHLO before
+XLA optimizes anything), deterministic for a fixed tree, and countable
+on the CPU test backend — which makes it pinnable: the blocked Morton
+tile path must gather at least 2x fewer elements than the per-oct
+stencil path on the same tree.
+
+Measured on this suite's Sedov tree (lmin=5, lmax=7, 3D):
+
+* init tree (tile occupancy ~0.31, the worst case for blocking):
+  5,580,160 -> 2,789,760 elements = 2.0x
+* evolved to t=0.02 (occupancy ~0.6): 160.0M -> 44.2M = 3.6x
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.config import params_from_string
+from ramses_tpu.telemetry import hlo
+
+from tests.test_oct_blocking import SEDOV3D
+
+_SYNTH = """
+  %9 = "stablehlo.gather"(%2, %8) <{dimension_numbers = #stablehlo.gather<offset_dims = [0]>}> : (tensor<100x5xf32>, tensor<7x1xi32>) -> tensor<5x7xf32>
+  %12 = stablehlo.add %9, %9 : tensor<5x7xf32>
+  %20 = "stablehlo.dynamic_gather"(%2, %8, %13) : (tensor<100x5xf32>, tensor<3x1xi32>, tensor<2xi32>) -> tensor<3x5xf64>
+"""
+
+
+def test_gather_inventory_parses_stablehlo():
+    inv = hlo.gather_inventory(_SYNTH)
+    assert [n for n, _ in inv] == [35, 15]       # largest first
+    assert hlo.count_gather_elems(_SYNTH) == 50
+    assert hlo.count_gather_elems("no gathers here") == 0
+
+
+def test_run_header_records_gather_inventory(tmp_path):
+    """Telemetry satellite: the JSONL run header carries the static
+    gather inventory of the fused step, and regrid sub-phase timers
+    flow into the per-step phase wallclock."""
+    nml = SEDOV3D.replace("&RUN_PARAMS", "&RUN_PARAMS\nnstepmax=2") \
+        .replace("/\n&INIT_PARAMS",
+                 f"/\n&OUTPUT_PARAMS\ntelemetry='{tmp_path}/run.jsonl'\n"
+                 "telemetry_interval=1\n/\n&INIT_PARAMS")
+    p = params_from_string(
+        nml.format(lmin=4, lmax=5, blk=".true.", riemann="llf"), ndim=2)
+    sim = AmrSim(p, dtype=jnp.float64)
+    sim.evolve(1e9, nstepmax=2)
+    sim.telemetry.close(sim, print_timers=False)
+    with open(tmp_path / "run.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    hdr = recs[0]
+    assert hdr["kind"] == "run_header"
+    n = hdr["run_info"]["hlo_gather_elems"]
+    assert isinstance(n, int) and n > 0, hdr["run_info"]
+    assert hdr["run_info"]["hlo_gather_ops"] > 0
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert any("regrid: flag" in r.get("phases_s", {}) for r in steps)
+
+
+@pytest.mark.slow
+def test_blocked_sweep_halves_gather_traffic():
+    """Regression gate: on the lmin=5/lmax=7 Sedov init tree the
+    blocked fused step must gather >= 2x fewer elements than the
+    per-oct stencil path, and stay under an absolute ceiling."""
+    totals, invs = {}, {}
+    for blk in (".false.", ".true."):
+        p = params_from_string(
+            SEDOV3D.format(lmin=5, lmax=7, blk=blk, riemann="llf"),
+            ndim=3)
+        sim = AmrSim(p, dtype=jnp.float32)
+        invs[blk] = hlo.gather_inventory(hlo.lower_fused_step(sim))
+        totals[blk] = sum(n for n, _ in invs[blk])
+        if blk == ".true.":
+            assert sim.blocks, "no blocked levels"
+    # the 6^d-duplicated stencil batch is the largest gather class of
+    # the per-oct program; blocking must remove that class entirely,
+    # not just shrink the total
+    off_max = invs[".false."][0][0]
+    on_sizes = {n for n, _ in invs[".true."]}
+    assert invs[".true."][0][0] < off_max
+    assert off_max not in on_sizes
+    off, on = totals[".false."], totals[".true."]
+    assert off >= 2 * on, totals            # the headline: >= 2x fewer
+    assert on <= 3_000_000, totals          # measured 2,789,760
+    assert off >= 5_000_000, totals         # comparison stays meaningful

@@ -1,0 +1,255 @@
+"""Gather-fused blocked oct sweep (amr/maps.py BlockMaps +
+amr/kernels.py tile_sweep + the hierarchy wiring).
+
+The oracle is the same invariance trick the rest of the AMR suite
+uses: the blocked Morton-tile decomposition is a *layout* change, so
+``oct_blocking=.true.`` must reproduce the per-oct stencil path
+bitwise — same conserved state, same refinement flags, same trees —
+on every configuration it is eligible for.  Map-level tests
+cross-check the gathered tile values against the tree geometry
+directly, and the incremental-rebuild contract (unchanged tiles are
+never rebuilt) is pinned on real regrids.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from ramses_tpu.amr import maps as mapmod
+from ramses_tpu.amr.hierarchy import AmrSim
+from ramses_tpu.amr.tree import cell_offsets
+from ramses_tpu.config import params_from_dict, params_from_string
+
+SEDOV3D = """
+&RUN_PARAMS
+hydro=.true.
+/
+&AMR_PARAMS
+levelmin={lmin}
+levelmax={lmax}
+boxlen=1.0
+oct_blocking={blk}
+/
+&INIT_PARAMS
+nregion=2
+region_type(1)='square'
+region_type(2)='point'
+x_center=0.5,0.5
+y_center=0.5,0.5
+z_center=0.5,0.5
+length_x=10.0,1.0
+length_y=10.0,1.0
+length_z=10.0,1.0
+d_region=1.0,0.0
+p_region=1e-5,0.1
+/
+&HYDRO_PARAMS
+gamma=1.4
+courant_factor=0.7
+slope_type=1
+riemann='{riemann}'
+/
+&REFINE_PARAMS
+err_grad_p=0.1
+/
+"""
+
+
+def _sedov(blk, lmin=4, lmax=5, ndim=3, dtype=None, riemann="llf"):
+    p = params_from_string(
+        SEDOV3D.format(lmin=lmin, lmax=lmax, blk=blk, riemann=riemann),
+        ndim=ndim)
+    return AmrSim(p, dtype=dtype or jnp.float64)
+
+
+def _check_maps(sim):
+    """Cross-check BlockMaps against the tree: every gathered slot must
+    resolve to the cell its Morton key names, an interp row for its
+    missing-father key, or the zero trash row."""
+    from ramses_tpu.amr import keys as kmod
+    nd = sim.tree.ndim
+    for l, b in sim.blocks.items():
+        lev = sim.tree.levels[l]
+        # fabricate a cell field = its own BC-mapped Morton key; interp
+        # rows get a distinct marker family, trash row a third
+        u = np.full((b.ncell_pad, 1), -1.0)
+        co = cell_offsets(nd)
+        gc = (2 * lev.og[:, None, :] + co[None, :, :]).reshape(-1, nd)
+        u[:len(gc), 0] = kmod.encode(gc, nd).astype(float)
+        iv = np.full((b.ni_pad, 1), -2.0)
+        iv[:b.ni, 0] = -1000.0 - np.arange(b.ni)
+        src = np.concatenate([u, iv, [[-3.0]]], axis=0)
+        got = src[np.asarray(b.tile_src), 0][:b.ntile]
+        ck = b.slot_ckey
+        exists = (sim.tree.lookup_keys(l, (ck >> nd).reshape(-1)) >= 0) \
+            .reshape(ck.shape)
+        assert np.array_equal(got[exists], ck[exists].astype(float)), \
+            f"level {l}: existing-cell slots"
+        missing = got[~exists]
+        assert ((missing <= -1000.0) | (missing == -3.0)).all(), \
+            f"level {l}: missing slots must be interp or trash"
+        if b.ni:
+            # an interp slot's row index must equal the rank of its key
+            rows = (-(missing + 1000.0)).astype(int)
+            onrow = missing <= -1000.0
+            uniq = np.unique(ck[~exists][onrow])
+            assert np.array_equal(
+                rows[onrow], np.searchsorted(uniq, ck[~exists][onrow])), \
+                f"level {l}: interp row ranks"
+        # scatter maps invert the layout: flat cell order <-> tile slots
+        nreal = lev.noct * (1 << nd)
+        flat = np.arange(b.ntile_pad * (1 << (nd * (b.shift + 1)))) \
+            .reshape(b.ntile_pad, -1)
+        vals = flat[np.asarray(b.cell_tile)[:nreal],
+                    np.asarray(b.cell_slot)[:nreal]]
+        assert len(np.unique(vals)) == nreal, f"level {l}: cell scatter"
+
+
+def test_block_maps_consistency():
+    sim = _sedov(".true.")
+    assert sim.blocks, "no blocked levels built"
+    _check_maps(sim)
+
+
+def test_unchanged_regrid_rebuilds_zero_blocks():
+    """Steady-state regrid contract: tree untouched => every per-block
+    map is reused, zero rebuilt."""
+    sim = _sedov(".true.")
+    assert sim.block_stats["blocks_total"] > 0
+    sim.regrid()
+    assert sim.block_stats["blocks_total"] > 0
+    assert sim.block_stats["blocks_rebuilt"] == 0, sim.block_stats
+
+
+def test_incremental_rebuild_matches_fresh():
+    """After a real regrid, the prev-reusing build must equal a fresh
+    build field-for-field."""
+    sim = _sedov(".true.")
+    for _ in range(2):
+        sim.step_coarse(sim.coarse_dt())
+    sim.regrid()
+    shift = int(sim.params.amr.oct_block_shift)
+    for l, b in sim.blocks.items():
+        fresh = mapmod.build_block_maps(
+            sim.tree, l, sim.bc_kinds, shift=shift,
+            noct_pad=sim.maps[l].noct_pad)
+        assert fresh.blocks_rebuilt == fresh.ntile
+        for f in ("tile_src", "tile_ok", "interp_cell", "interp_nb",
+                  "interp_sgn", "cell_tile", "cell_slot", "oct_tile",
+                  "oct_slot", "tile_key", "slot_ckey"):
+            a, c = getattr(b, f), getattr(fresh, f)
+            assert np.array_equal(np.asarray(a), np.asarray(c)), (l, f)
+        if b.tile_vsgn is not None:
+            assert np.array_equal(b.tile_vsgn, fresh.tile_vsgn), l
+
+
+def _parity(lmin, lmax, ndim, dtype=None, riemann="llf", nstep=2,
+            with_regrid=True):
+    sims = {}
+    for blk in (".true.", ".false."):
+        s = _sedov(blk, lmin=lmin, lmax=lmax, ndim=ndim, dtype=dtype,
+                   riemann=riemann)
+        if blk == ".true.":
+            assert s.blocks, "no blocked levels built"
+        else:
+            assert not s.blocks
+        for _ in range(nstep):
+            s.step_coarse(s.coarse_dt())
+        if with_regrid:
+            s.regrid()
+            s.step_coarse(s.coarse_dt())
+        sims[blk] = s
+    sa, sb = sims[".true."], sims[".false."]
+    assert sorted(sa.levels()) == sorted(sb.levels())
+    for l in sa.levels():
+        # identical trees (flags parity, incl. tile_refine_flags)
+        assert np.array_equal(np.asarray(sa.tree.levels[l].keys),
+                              np.asarray(sb.tree.levels[l].keys)), l
+        # FULL padded arrays: pad rows must stay bitwise too (the
+        # sharded-vs-single suite compares them)
+        ua, ub = np.asarray(sa.u[l]), np.asarray(sb.u[l])
+        assert np.array_equal(ua, ub), \
+            f"level {l}: maxdiff={np.abs(ua - ub).max()}"
+
+
+def test_blocked_parity_3d_sedov():
+    """Blocked vs per-oct stencil path: bitwise-identical state and
+    trees through steps + a regrid (XLA tile fallback on CPU)."""
+    _parity(4, 5, 3)
+
+
+def test_blocked_parity_2d_sedov():
+    _parity(4, 6, 2)
+
+
+@pytest.mark.slow
+def test_blocked_parity_3d_hllc_two_level_span():
+    _parity(4, 6, 3, riemann="hllc")
+
+
+@pytest.mark.slow
+def test_blocked_parity_gravity():
+    """Self-gravity run: want_flux path (phi mass-flux planes) must also
+    be bitwise under blocking."""
+    def blob(blk):
+        groups = {
+            "run_params": {"hydro": True, "poisson": True},
+            "amr_params": {"levelmin": 4, "levelmax": 5, "boxlen": 1.0,
+                           "oct_blocking": blk},
+            "init_params": {"nregion": 2,
+                            "region_type": ["square", "square"],
+                            "x_center": [0.5, 0.5],
+                            "y_center": [0.5, 0.5],
+                            "z_center": [0.5, 0.5],
+                            "length_x": [10.0, 0.25],
+                            "length_y": [10.0, 0.25],
+                            "length_z": [10.0, 0.25],
+                            "exp_region": [10.0, 2.0],
+                            "d_region": [1.0, 50.0],
+                            "p_region": [10.0, 10.0]},
+            "hydro_params": {"gamma": 1.4, "courant_factor": 0.5,
+                             "riemann": "hllc"},
+            "refine_params": {"err_grad_d": 0.2},
+        }
+        return AmrSim(params_from_dict(groups, ndim=3),
+                      dtype=jnp.float64)
+
+    sa, sb = blob(True), blob(False)
+    assert sa.blocks and not sb.blocks
+    for s in (sa, sb):
+        for _ in range(2):
+            s.step_coarse(s.coarse_dt())
+    for l in sa.levels():
+        nreal = sa.tree.levels[l].noct * 8
+        assert np.array_equal(np.asarray(sa.u[l])[:nreal],
+                              np.asarray(sb.u[l])[:nreal]), l
+
+
+@pytest.mark.slow
+def test_blocked_parity_pallas_interpret(monkeypatch):
+    """The real Pallas tile kernel (interpret mode) vs the per-oct
+    reference path: bitwise-identical f32 state.  Both sims run under
+    FORCE_INTERPRET so the only difference is blocked vs stencil."""
+    from ramses_tpu.hydro import pallas_oct
+    monkeypatch.setattr(pallas_oct, "FORCE_INTERPRET", True)
+    jax.clear_caches()                  # force a fresh branch choice
+    try:
+        sims = {}
+        for blk in (".true.", ".false."):
+            s = _sedov(blk, dtype=jnp.float32)
+            if blk == ".true.":
+                for l, b in s.blocks.items():
+                    assert pallas_oct.tile_available(
+                        s.cfg, b.ntile_pad, jnp.float32), (l, b.ntile_pad)
+            for _ in range(2):
+                s.step_coarse(s.coarse_dt())
+            sims[blk] = s
+        sa, sb = sims[".true."], sims[".false."]
+        for l in sa.levels():
+            nreal = sa.tree.levels[l].noct * 8
+            assert np.array_equal(np.asarray(sa.u[l])[:nreal],
+                                  np.asarray(sb.u[l])[:nreal]), l
+    finally:
+        jax.clear_caches()              # do not leak into other tests
