@@ -185,7 +185,8 @@ def main(argv=None) -> int:
                     else params.output.tend)
             sim = launch(
                 lambda restart: (
-                    RhdAmrSim.from_snapshot(params, restart, dtype=dtype)
+                    RhdAmrSim.from_checkpoint_dir(params, restart,
+                                                  dtype=dtype)
                     if restart else RhdAmrSim(params, dtype=dtype)),
                 drive_amr(tend), tend=tend)
             print(f"rhd-amr t={sim.t:.5e} nstep={sim.nstep} "
@@ -217,7 +218,8 @@ def main(argv=None) -> int:
                     else params.output.tend)
             sim = launch(
                 lambda restart: (
-                    MhdAmrSim.from_snapshot(params, restart, dtype=dtype)
+                    MhdAmrSim.from_checkpoint_dir(params, restart,
+                                                  dtype=dtype)
                     if restart else MhdAmrSim(params, dtype=dtype)),
                 drive_amr(tend), tend=tend)
             print(f"mhd-amr t={sim.t:.5e} nstep={sim.nstep} "
@@ -246,7 +248,8 @@ def main(argv=None) -> int:
 
         def build(restart):
             if restart:
-                return AmrSim.from_snapshot(params, restart, dtype=dtype)
+                return AmrSim.from_checkpoint_dir(params, restart,
+                                                  dtype=dtype)
             particles = None
             dense = None
             if (params.run.cosmo and params.init.initfile

@@ -2054,9 +2054,16 @@ class AmrSim:
         ``dumper``: optional :class:`~ramses_tpu.io.async_writer.
         AsyncDumper` — the host-resident snapshot is assembled
         synchronously, the file writing happens on its background
-        thread (the ``pario`` offload, SURVEY.md §2.10)."""
+        thread (the ``pario`` offload, SURVEY.md §2.10).
+
+        ``&OUTPUT_PARAMS pario=.true.`` routes to the elastic sharded
+        checkpoint instead (``pario_NNNNN/`` shard dirs, two-phase
+        global commit, mesh-shape-elastic restore)."""
         import os
         import shutil
+
+        if bool(getattr(self.params.output, "pario", False)):
+            return self.dump_pario(iout, base_dir)
 
         from ramses_tpu.io import snapshot as snapmod
         snap = snapmod.snapshot_from_amr(self, iout)
@@ -2086,6 +2093,38 @@ class AmrSim:
                                    ncpu=ncpu, extra_dir=extra,
                                    keep_last=keep)
         return out
+
+    def dump_pario(self, iout: int = 1, base_dir: str = ".",
+                   io_group_size: Optional[int] = None,
+                   split_hosts: Optional[int] = None) -> str:
+        """Elastic sharded checkpoint (:mod:`ramses_tpu.io.pario`
+        format 2): every process stages its own validated shard dir,
+        process 0 seals the set under the watchdogged two-phase
+        commit.  Defaults come from ``&OUTPUT_PARAMS io_group_size`` /
+        ``pario_split_hosts``; ``checkpoint_keep`` rotation covers
+        pario and snapshot checkpoints alike."""
+        import os
+
+        import jax
+
+        from ramses_tpu.io.pario import dump_pario as _dp
+        out = self.params.output
+        if io_group_size is None:
+            g = int(getattr(out, "io_group_size", 0))
+            io_group_size = g if g > 0 else None
+        if split_hosts is None:
+            s = int(getattr(out, "pario_split_hosts", 0))
+            split_hosts = s if s > 0 else None
+        path = _dp(self, iout, base_dir,
+                   io_group_size=io_group_size,
+                   split_hosts=split_hosts)
+        keep = int(getattr(out, "checkpoint_keep", 0))
+        if keep > 0 and jax.process_index() == 0 \
+                and not path.endswith(".tmp"):
+            from ramses_tpu.resilience import rotate_checkpoints
+            rotate_checkpoints(os.path.dirname(os.path.abspath(path))
+                               or ".", keep, protect=path)
+        return path
 
     def _clumpfind_pass(self, out: str, iout: int):
         """In-run PHEW chain at output time (``clumpfind=.true.``,
@@ -2236,3 +2275,40 @@ class AmrSim:
             to_cons=lambda q: prim_out_to_cons(q, cfg),
             place_level=_place_u_rows)
         return sim
+
+    @classmethod
+    def from_checkpoint_dir(cls, params: Params, outdir: str,
+                            dtype=jnp.float32, log=print,
+                            **kw) -> "AmrSim":
+        """Restore from any checkpoint directory: ``pario_NNNNN``
+        elastic sharded dumps go through the mesh-shape-elastic
+        reader, everything else through :meth:`from_snapshot`.  A
+        pario checkpoint whose surviving shards cannot cover the
+        hierarchy is quarantined shard-by-shard and the restore falls
+        back to the next-oldest globally-valid checkpoint — the same
+        degrade-don't-die contract ``resolve_restart_dir`` applies to
+        whole-checkpoint rot."""
+        import os
+
+        from ramses_tpu.io import pario as pariomod
+        from ramses_tpu.resilience import latest_valid_checkpoint
+        cur = outdir
+        seen = set()
+        while True:
+            seen.add(os.path.abspath(cur))
+            name = os.path.basename(os.path.normpath(cur))
+            if not name.startswith("pario_"):
+                return cls.from_snapshot(params, cur, dtype=dtype)
+            try:
+                return pariomod.restore_pario(cls, params, cur,
+                                              dtype=dtype, log=log,
+                                              **kw)
+            except pariomod.CorruptShardError as e:
+                if log is not None:
+                    log(f"resilience: {e}; falling back to the "
+                        "next-oldest valid checkpoint")
+                base = os.path.dirname(os.path.abspath(cur)) or "."
+                nxt = latest_valid_checkpoint(base, log=log)
+                if nxt is None or os.path.abspath(nxt) in seen:
+                    raise
+                cur = nxt

@@ -77,6 +77,11 @@ class RunParams:
     compile_deadline_s: float = 0.0
     step_deadline_s: float = 0.0
     io_deadline_s: float = 0.0
+    # mesh-shape-elastic restore (io/pario.py format 2): a sharded
+    # checkpoint restores onto the CURRENT process/device mesh (write
+    # on 8, restore on 4 or 1, and vice versa).  .false. refuses a
+    # restore whose saved process count differs from the current run.
+    elastic_restore: bool = True
 
 
 @dataclass
@@ -157,6 +162,18 @@ class OutputParams:
     # also write each particle output as a Gadget SnapFormat=1 file
     # (io/gadget.py write_gadget — the reference's savegadget flag)
     savegadget: bool = False
+    # elastic sharded checkpoints (io/pario.py format 2): .true. makes
+    # dump() write pario_NNNNN/ shard dirs under the two-phase global
+    # commit instead of reference-format output_NNNNN/ snapshots
+    pario: bool = False
+    # writer concurrency bound for pario dumps — the reference's
+    # IOGROUPSIZE ring: per-process semaphore over the writer threads
+    # AND cross-host wave stagger (0 = unbounded, all hosts at once)
+    io_group_size: int = 0
+    # split each process's pario payload into this many shard dirs
+    # written concurrently (0/1 = one shard per process; >1 exercises
+    # the per-shard decomposition on a single-host test mesh)
+    pario_split_hosts: int = 0
 
 
 @dataclass

@@ -48,6 +48,12 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
     # worker's last checkpoint, so supervise() attempt 1 resolves the
     # newest manifest-valid dir instead of starting fresh
     params.run.auto_resume = True
+    # checkpoints can rot between beats (torn shard, truncated file on
+    # a dying node): quarantine them NOW so the auto-resume scan below
+    # never loops over a dir that validates at scan time but fails at
+    # restore time
+    from ramses_tpu.resilience import scrub_checkpoints
+    scrub_checkpoints(rdir, log=log)
     dtype = getattr(jnp, rec.get("dtype") or "float32")
     spec = EnsembleSpec.from_params(params, sweeps=rec.get("sweeps"),
                                     solver=rec.get("solver", ""))

@@ -1,56 +1,99 @@
-"""Per-host concurrent sharded checkpoints — the ``pario`` role.
+"""Elastic per-host sharded checkpoints — the ``pario`` role.
 
 The reference bounds checkpoint write concurrency with the
 ``IOGROUPSIZE`` token ring (``amr/output_amr.f90:256-260,395-400``) and
 evolved a dedicated I/O-server process family (``pario/io_loop.f90``).
 The TPU-native equivalent: every host writes exactly the shard rows it
 already holds (``jax.Array.addressable_shards`` — no cross-host gather,
-no device→single-host funnel), one file set per host.  An optional
-``io_group_size`` bounds write concurrency on BOTH axes: within a
-process it is a semaphore over the ``split_hosts`` writer threads, and
-across processes the hosts write in ``io_group_size`` staggered waves
-(wave = ``process_index % io_group_size``) with a global device barrier
-between waves — so at most ``ceil(process_count / io_group_size)``
-hosts stream to the filesystem at once, the IOGROUPSIZE contract.
-Restore reads whichever file sets exist and
-re-places rows onto the CURRENT mesh, so a dump from N hosts restores
-onto any device count — the same any-count contract as the
-reference-format snapshot path (``io/snapshot.py``), which remains the
-interoperable format; this one is the fast fat-checkpoint path.
+no device→single-host funnel), one validated shard directory per
+writer.  An optional ``io_group_size`` bounds write concurrency on
+BOTH axes: within a process it is a semaphore over the ``split_hosts``
+writer threads, and across processes the hosts write in
+``io_group_size`` staggered waves (wave = ``process_index %
+io_group_size``) with a global device barrier between waves — so at
+most ``ceil(process_count / io_group_size)`` hosts stream to the
+filesystem at once, the IOGROUPSIZE contract.
 
-Layout of ``pario_NNNNN/``:
-  manifest.npz       — tree (per-level oct coords), t/nstep/meta,
-                       per-level row counts, the writer list
-  host_HHHHH.npz     — this host's row blocks: for each level, the
-                       global [row0, row1) interval per shard and the
-                       raw rows (uncompressed: zlib would serialize
-                       the concurrent writers on CPU time)
+Format 2 (``pario_NNNNN/``) — elastic and pod-true:
+
+  manifest.json        global manifest (resilience/checkpoint.py):
+                       top-level file hashes + a ``shards`` table
+                       sealing every shard manifest's SHA-256
+  tree.npz             process-0 payload: per-level oct coords, run
+                       scalars (t/nstep/dt), load-balance layout
+                       permutations, host-replicated sink/tracer/turb
+                       state
+  shard_SSSSS/         one per writer (shard = process*split + group)
+    manifest.json      schema-1 manifest over the shard payload, meta
+                       carrying row intervals, oct/particle counts and
+                       the Hilbert-order key range per array
+    data.npz           this writer's row blocks — gas levels AND
+                       particle lanes ({name}_r{i}/{name}_d{i}/
+                       {name}_n keys, uncompressed: zlib would
+                       serialize the concurrent writers on CPU time)
+
+Two-phase commit: every writer stages its shard dirs inside
+``pario_NNNNN.tmp/`` (payload → shard manifest → validate →
+``os.replace``), then all hosts meet at a deadline-watchdogged barrier
+(``Watchdog.guard("io")``) and process 0 seals the set — validating
+every shard, writing the GLOBAL manifest, and renaming the staging dir
+into place.  A host that dies or hangs mid-dump leaves only the
+``.tmp`` staging dir, whose name never matches the checkpoint
+scanner's all-digits suffix — it can NEVER scan as a valid checkpoint
+— and the surviving hosts' guarded barrier raises ``HangDetected``,
+aborts the commit, and falls through so the pod is not wedged.
+
+Restore is mesh-shape-elastic: the reader validates each shard
+(full-hash), assembles the global hierarchy from every valid shard —
+or any subset whose row intervals still cover each level — and places
+the rows onto the CURRENT process/device mesh, so a dump from 8
+devices restores onto 4 or 1 and vice versa.  A corrupt shard is
+quarantined (``shard_X.quarantined``), which invalidates the global
+manifest, so ``resolve_restart_dir`` falls back to the next-oldest
+globally-valid checkpoint exactly as it does for whole-checkpoint rot.
+Format-1 dumps (``manifest.npz`` + ``host_*.npz``) remain readable.
 
 On a single-host CPU mesh the "hosts" degenerate to one process; the
-writer pool still exercises the per-shard decomposition and the
-restore-side reassembly, which is what the mesh-level contract needs.
+writer pool still exercises the per-shard decomposition, the commit
+protocol, and the restore-side reassembly, which is what the
+mesh-level contract needs.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
 import threading
 import warnings
 from typing import Dict, Optional
 
 import numpy as np
 
+#: elastic sharded layout (shard dirs + global manifest.json); format 1
+#: is the legacy manifest.npz + host_*.npz layout, still restorable
+PARIO_FORMAT = 2
+
+_PART_FIELDS = ("x", "v", "m", "active", "idp", "family", "tp", "zp",
+                "flags")
+
+
+class CorruptShardError(RuntimeError):
+    """A pario checkpoint failed restore-side validation (torn shard
+    payload, torn tree payload, or a surviving-shard subset that no
+    longer covers the hierarchy).  ``AmrSim.from_checkpoint_dir``
+    catches this and falls back to the next-oldest globally-valid
+    checkpoint."""
+
 
 def _unpersisted_state(sim, nproc: int = 1) -> list:
-    """Names of populated state layers pario does NOT checkpoint.
+    """Names of populated state layers a pario dump does NOT persist.
 
-    Single-process dumps ride particles/sinks/tracers/turb state on the
-    manifest (see :func:`_extra_state_payload`), so only radiation is
-    lost there.  Multi-process dumps stay gas-only for those layers —
-    the particle arrays are sharded device state and the manifest is a
-    process-0 artifact — so a dump of a run carrying any of these loses
-    that state on restore; the reference-format snapshot path
-    (io/snapshot.py) persists them.
+    Format 2 persists particles/sinks/tracers/turb on every process
+    count (``nproc=1`` semantics), so only radiation is lost there.
+    The ``nproc > 1`` branch describes legacy FORMAT-1 multi-process
+    dumps, which stayed gas-only — the v1 restore path still uses it
+    to warn about what an old dump never carried.
     """
     out = []
     if int(nproc) > 1:
@@ -67,17 +110,12 @@ def _unpersisted_state(sim, nproc: int = 1) -> list:
     return out
 
 
-def _extra_state_payload(sim) -> Dict[str, np.ndarray]:
-    """Non-gas state riding the single-process manifest: full padded
-    particle lanes (so a restore keeps the exact lane layout and
-    headroom — bitwise-identical PM restarts), host sink/tracer
-    arrays, and the driven-turbulence OU field + RNG key."""
+def _host_state_payload(sim) -> Dict[str, np.ndarray]:
+    """Host-replicated non-gas state riding ``tree.npz`` (process 0
+    writes it): sink census, tracer positions/ids, and the
+    driven-turbulence OU field + RNG key.  Particle lanes are sharded
+    device state and ride the shard payloads instead."""
     out: Dict[str, np.ndarray] = {}
-    p = getattr(sim, "p", None)
-    if p is not None:
-        for f in ("x", "v", "m", "active", "idp", "family",
-                  "tp", "zp", "flags"):
-            out[f"part_{f}"] = np.asarray(getattr(p, f))
     s = getattr(sim, "sinks", None)
     if s is not None:
         for f in ("x", "v", "m", "tform", "idp"):
@@ -96,20 +134,11 @@ def _extra_state_payload(sim) -> Dict[str, np.ndarray]:
     return out
 
 
-def _restore_extra_state(sim, man, params) -> None:
-    """Re-attach the :func:`_extra_state_payload` layers from a loaded
-    manifest onto a freshly-built sim."""
+def _restore_host_state(sim, man) -> None:
+    """Re-attach the :func:`_host_state_payload` layers from a loaded
+    npz mapping onto a freshly-built sim."""
     import jax.numpy as jnp
 
-    if "part_x" in man.files:
-        from ramses_tpu.pm.particles import ParticleSet
-        sim.p = ParticleSet(
-            **{f: jnp.asarray(man[f"part_{f}"])
-               for f in ("x", "v", "m", "active", "idp", "family",
-                         "tp", "zp", "flags")})
-        run = getattr(params, "run", None)
-        if bool(getattr(run, "pic", False)):
-            sim.pic = True
     if "sink_x" in man.files:
         from ramses_tpu.pm.sinks import SinkSet
         sim.sinks = SinkSet(
@@ -130,6 +159,23 @@ def _restore_extra_state(sim, man, params) -> None:
         sim.turb.key = jnp.asarray(man["turb_key"])
 
 
+def _attach_particles(sim, lanes: Dict[str, np.ndarray],
+                      params) -> None:
+    """Rebuild the ParticleSet from reassembled full padded lanes (so
+    a restore keeps the exact lane layout and headroom —
+    bitwise-identical PM restarts)."""
+    import jax.numpy as jnp
+
+    if "x" not in lanes:
+        return
+    from ramses_tpu.pm.particles import ParticleSet
+    sim.p = ParticleSet(**{f: jnp.asarray(lanes[f])
+                           for f in _PART_FIELDS})
+    run = getattr(params, "run", None)
+    if bool(getattr(run, "pic", False)):
+        sim.pic = True
+
+
 def _level_arrays(sim) -> Dict[str, object]:
     """Name → sharded device array for everything that must ride the
     checkpoint (solver family decides: hydro u; MHD adds faces)."""
@@ -140,16 +186,25 @@ def _level_arrays(sim) -> Dict[str, object]:
     return arrs
 
 
+def _particle_arrays(sim) -> Dict[str, object]:
+    """Name → particle lane array (full padded lanes; sharded or
+    replicated placement decides the shard row intervals)."""
+    p = getattr(sim, "p", None)
+    if p is None:
+        return {}
+    return {f"part_{f}": getattr(p, f) for f in _PART_FIELDS}
+
+
 def _host_wave(me: int, group: int) -> int:
-    """The wave in which process ``me`` writes its host files: waves
-    are keyed on ``process_index % io_group_size``, so wave ``w`` holds
+    """The wave in which process ``me`` writes its shards: waves are
+    keyed on ``process_index % io_group_size``, so wave ``w`` holds
     every ``ceil(nproc/group)``-th process — bounded filesystem fan-in
     per wave, ``group`` waves total."""
     return int(me) % max(1, int(group))
 
 
 def _barrier(tag: str) -> None:
-    """Cross-host barrier between write waves (no-op single-process)."""
+    """Cross-host barrier (no-op single-process)."""
     import jax
     if jax.process_count() <= 1:
         return
@@ -157,118 +212,271 @@ def _barrier(tag: str) -> None:
     multihost_utils.sync_global_devices(tag)
 
 
+def _shard_blocks(arrs: Dict[str, object], ngrp: int):
+    """Partition this process's addressable shards of every array into
+    ``ngrp`` writer groups.  Returns per-group ``{key: array}`` payload
+    dicts (the ``{name}_r{i}/_d{i}/_n`` block scheme) and per-group
+    row-interval metadata ``{name: [[r0, nrows], ...]}``.  Replicated
+    arrays (every device holds the full rows) are deduplicated to one
+    block — all writers would stage identical bytes."""
+    blocks = [dict() for _ in range(ngrp)]
+    counts = [dict() for _ in range(ngrp)]
+    rows = [dict() for _ in range(ngrp)]
+    for name, a in arrs.items():
+        if hasattr(a, "addressable_shards"):
+            shards = list(a.addressable_shards)
+            seen = set()
+            parts = []
+            for s in shards:
+                r0 = int(s.index[0].start or 0) if s.index else 0
+                if r0 in seen:
+                    continue            # replicated placement
+                seen.add(r0)
+                parts.append((r0, s.data))
+        else:
+            parts = [(0, a)]
+        for k, (r0, data) in enumerate(parts):
+            g = k * ngrp // max(len(parts), 1)
+            i = counts[g].get(name, 0)
+            counts[g][name] = i + 1
+            d = np.asarray(data)
+            blocks[g][f"{name}_r{i}"] = np.asarray([r0], dtype=np.int64)
+            blocks[g][f"{name}_d{i}"] = d
+            rows[g].setdefault(name, []).append([int(r0), int(len(d))])
+    for g in range(ngrp):
+        for name, n in counts[g].items():
+            blocks[g][f"{name}_n"] = np.asarray([n], dtype=np.int64)
+    return blocks, rows
+
+
+def _shard_meta(sim, sidx: int, me: int, rows: Dict[str, list],
+                iout: int) -> Dict[str, object]:
+    """Per-shard manifest meta: row intervals, oct/particle counts and
+    the Hilbert-order key range per array — everything the elastic
+    reader and the offline scrubber need without opening the payload."""
+    ttd = 2 ** int(sim.cfg.ndim)
+    octs = {}
+    npart = 0
+    key_range = {}
+    for name, ivs in rows.items():
+        lo = min(r0 for r0, _n in ivs)
+        hi = max(r0 + n for r0, n in ivs)
+        key_range[name] = [int(lo), int(hi)]
+        tot = sum(n for _r0, n in ivs)
+        if name.startswith("u"):
+            octs[name[1:]] = int(tot // ttd)
+        elif name == "part_x":
+            npart = int(tot)
+    return {"kind": "pario_shard", "format": PARIO_FORMAT,
+            "shard": int(sidx), "process": int(me), "iout": int(iout),
+            "nstep": int(sim.nstep), "rows": rows, "octs": octs,
+            "npart": npart, "key_range": key_range}
+
+
+def _commit_pario(stage: str, final: str, meta: Dict[str, object],
+                  nshard: int, telemetry=None, log=print
+                  ) -> Optional[str]:
+    """Phase 2, process 0 only: validate the full shard set, seal the
+    global manifest, atomically rename the staging dir into place.
+    Returns the final path, or None when the commit must be aborted
+    (missing/torn shard) — an aborted commit leaves only the ``.tmp``
+    staging dir, which no scanner ever selects."""
+    from ramses_tpu.resilience import checkpoint as ckpt
+
+    def abort(reason: str) -> None:
+        if log is not None:
+            log(f"pario: commit of {os.path.basename(final)} aborted: "
+                f"{reason}")
+        if telemetry is not None:
+            telemetry.record_event("io_degraded", reason="commit_abort",
+                                   detail=reason, path=stage)
+
+    expected = {f"shard_{i:05d}" for i in range(int(nshard))}
+    present = {n for n in os.listdir(stage)
+               if n.startswith("shard_")
+               and os.path.isdir(os.path.join(stage, n))}
+    # shard dirs beyond the expected set are leftovers of a dead dump
+    # attempt on a larger mesh — an elastic resume writes fewer shards
+    for extra in sorted(present - expected):
+        shutil.rmtree(os.path.join(stage, extra), ignore_errors=True)
+    missing = sorted(expected - present)
+    if missing:
+        abort(f"missing {missing[0]} ({len(missing)} of {nshard})")
+        return None
+    rows_total: Dict[str, int] = {}
+    npart = 0
+    for name in sorted(expected):
+        sdir = os.path.join(stage, name)
+        # size-only validation: each writer already full-hash-validated
+        # its own staged bytes in phase 1; re-hashing every shard here
+        # would serialize the whole dump through process 0's CPU
+        ok, reason = ckpt.validate_checkpoint(sdir, verify_hash=False)
+        if not ok:
+            abort(f"{name}: {reason}")
+            return None
+        smeta = ckpt.read_manifest_meta(sdir)
+        for nm, ivs in (smeta.get("rows") or {}).items():
+            for r0, n in ivs:
+                rows_total[nm] = max(rows_total.get(nm, 0),
+                                     int(r0) + int(n))
+        npart = max(npart, int(smeta.get("npart", 0) or 0))
+    meta = dict(meta, nshard=int(nshard), rows_total=rows_total)
+    ckpt.write_global_manifest(stage, meta=meta)
+    if os.path.isdir(final):
+        shutil.rmtree(final)
+    os.replace(stage, final)
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(final)),
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass                          # e.g. non-fsyncable mount
+    return final
+
+
 def dump_pario(sim, iout: int, base_dir: str = ".",
                io_group_size: Optional[int] = None,
                split_hosts: Optional[int] = None) -> str:
-    """Write a per-host sharded checkpoint of ``sim`` (AmrSim or
-    ShardedAmrSim).  Each process writes only its addressable shards
-    — one writer thread per host file.
+    """Write an elastic sharded checkpoint of ``sim`` (AmrSim or
+    ShardedAmrSim) under the two-phase commit protocol.  Returns the
+    committed ``pario_NNNNN`` path, or the ``.tmp`` staging path when
+    the commit was aborted (hung barrier, missing shard, injected
+    death on another host) — the staging path never scans as a
+    checkpoint, so an aborted dump degrades to "no new checkpoint",
+    never to a torn one.
 
     ``io_group_size`` bounds write concurrency (None = all at once) on
     both axes: a per-process semaphore over the ``split_hosts`` writer
     threads, and — on a multi-process run — cross-host staggering into
-    ``io_group_size`` waves (wave = ``process_index % io_group_size``)
-    with a global barrier between waves, so at most
-    ``ceil(process_count/io_group_size)`` hosts hit the filesystem
-    simultaneously.  Every process walks the same wave schedule, which
-    makes the barrier a collective.
+    ``io_group_size`` waves with a global barrier between waves.
+    Every process walks the same wave schedule, which makes the
+    barrier a collective.
 
     ``split_hosts``: partition this process's shards into that many
-    host files written CONCURRENTLY — on a real pod every process is
+    shard dirs written CONCURRENTLY — on a real pod every process is
     one writer already; on a single-host test mesh this exercises the
-    same per-host decomposition and writer concurrency.
-
-    Single-process runs get the atomic-checkpoint treatment (stage to
-    ``pario_NNNNN.tmp/`` + ``manifest.json`` + rename); multi-process
-    runs write in place because the rename would race the other hosts'
-    writers — there the npz manifest from process 0 remains the only
-    completeness signal."""
+    same per-shard decomposition and commit protocol."""
     import jax
 
     from ramses_tpu.resilience import checkpoint as ckpt
+    from ramses_tpu.resilience.watchdog import HangDetected
 
     final = os.path.join(base_dir, f"pario_{iout:05d}")
+    stage = final + ".tmp"
     nproc = jax.process_count()
-    atomic = nproc == 1
-    if atomic:
-        out = final + ".tmp"
-        if os.path.isdir(out):
-            import shutil
-            shutil.rmtree(out)
-        os.makedirs(out)
-    else:
-        out = final
-        os.makedirs(out, exist_ok=True)
-    arrs = _level_arrays(sim)
     me = jax.process_index()
+    nstep = int(sim.nstep)
+    tel = getattr(sim, "telemetry", None)
+    inj = getattr(sim, "_fault", None)
+    wd = getattr(sim, "_wd", None)
 
-    lost = _unpersisted_state(sim, nproc=nproc)
-    if lost:
-        warnings.warn(
-            f"dump_pario: run carries {'/'.join(lost)} state that the "
-            "pario fat-checkpoint does NOT persist here; a restore "
-            "re-creates it from ICs.  Use sim.dump() (reference-format "
-            "snapshots) for full-state checkpoints.",
-            stacklevel=2)
+    # stale staging left by a dump that died mid-commit.  Dumps are
+    # collective and serialized in the run loop, so ANY pario_*.tmp for
+    # a different iout is a dead attempt — clean it, it is observable
+    # I/O degradation.  For OUR OWN stage the marker disambiguates: it
+    # records which nstep staged it — a DIFFERENT nstep means a dead
+    # attempt, the SAME nstep means concurrent writers of this very
+    # dump (keep it; a deterministic resume that replays the exact
+    # dump also lands here, and the writers below replace their own
+    # shard dirs in place).
+    marker = os.path.join(stage, f".staged_nstep_{nstep}")
+    if me == 0:
+        stale = [os.path.join(base_dir, n)
+                 for n in sorted(os.listdir(base_dir or "."))
+                 if n.startswith("pario_") and n.endswith(".tmp")
+                 and os.path.join(base_dir, n) != stage]
+        if os.path.isdir(stage) and not os.path.exists(marker):
+            stale.append(stage)
+        for s in stale:
+            if tel is not None:
+                tel.record_event("io_degraded", reason="stale_stage",
+                                 path=s, iout=int(iout))
+            shutil.rmtree(s, ignore_errors=True)
+    _barrier(f"pario_{iout:05d}_stage")
+    os.makedirs(stage, exist_ok=True)
+    with open(marker, "w"):
+        pass
 
-    # manifest: host tree + run meta (process 0 writes it)
+    # structured telemetry for any layer the fat checkpoint still
+    # cannot persist (radiation) — the gas-only multi-process era is
+    # over, so this is an event, not a warning
+    lost = _unpersisted_state(sim, nproc=1)
+    if lost and tel is not None:
+        tel.record_event("io_degraded", reason="unpersisted",
+                         layers=lost, iout=int(iout), path=final)
+
+    # phase 0: process 0 stages the tree payload + run scalars +
+    # host-replicated extras (these now persist on EVERY process count)
     if me == 0:
         tree_payload = {}
         for l in sim.levels():
             tree_payload[f"og{l}"] = sim.tree.levels[l].og
-        # load-balance layouts: rows in the host files are in the dump
-        # sim's (possibly Hilbert-rebalanced) row order — persist the
-        # oct_row permutation so restore can return them to tree order
+        # load-balance layouts: rows in the shard payloads are in the
+        # dump sim's (possibly Hilbert-rebalanced) row order — persist
+        # the oct_row permutation so restore can return them to tree
+        # order before re-decomposing onto the current mesh
         for l, lay in getattr(sim, "layouts", {}).items():
             tree_payload[f"octrow{l}"] = np.asarray(lay.oct_row,
                                                     np.int64)
         dtc = getattr(sim, "_dt_cache", None)
-        # single-process: non-gas layers (particles/sinks/tracers/turb)
-        # ride the manifest — multi-process particle state is sharded
-        # across hosts and stays on the snapshot path (see
-        # _unpersisted_state)
-        extra = _extra_state_payload(sim) if nproc == 1 else {}
-        np.savez(os.path.join(out, "manifest.npz"),
+        np.savez(os.path.join(stage, "tree.npz"),
                  levels=np.asarray(sim.levels()),
                  ndim=sim.cfg.ndim, root=np.asarray(sim.tree.root),
                  levelmin=sim.lmin, levelmax=sim.lmax,
-                 t=float(sim.t), nstep=int(sim.nstep),
+                 t=float(sim.t), nstep=nstep,
                  dt_old=float(getattr(sim, "dt_old", 0.0)),
                  dtnew=float(dtc) if dtc is not None else 0.0,
-                 nproc=nproc, **tree_payload, **extra)
+                 nproc=nproc, **tree_payload,
+                 **_host_state_payload(sim))
 
-    # partition this process's shards into host groups (by device)
+    # phase 1: partition this process's shards into writer groups and
+    # stage each as a validated shard dir
     ngrp = max(1, int(split_hosts or 1))
-    grp_blocks = [dict() for _ in range(ngrp)]
-    grp_counts = [dict() for _ in range(ngrp)]
-    for name, a in arrs.items():
-        shards = list(a.addressable_shards)
-        for k, s in enumerate(shards):
-            g = k * ngrp // max(len(shards), 1)
-            i = grp_counts[g].get(name, 0)
-            grp_counts[g][name] = i + 1
-            r0 = s.index[0].start or 0
-            grp_blocks[g][f"{name}_r{i}"] = np.asarray([r0],
-                                                       dtype=np.int64)
-            grp_blocks[g][f"{name}_d{i}"] = np.asarray(s.data)
-    for g in range(ngrp):
-        for name, n in grp_counts[g].items():
-            grp_blocks[g][f"{name}_n"] = np.asarray([n], dtype=np.int64)
+    arrs = dict(_level_arrays(sim))
+    arrs.update(_particle_arrays(sim))
+    blocks, rows = _shard_blocks(arrs, ngrp)
 
-    sem = threading.Semaphore(io_group_size or max(nproc * ngrp, 1))
+    sem = threading.Semaphore(io_group_size or max(ngrp, 1))
     errs = []
 
-    def write(g):
+    def write_shard(g):
         with sem:
             try:
-                np.savez(os.path.join(out,
-                                      f"host_{me * ngrp + g:05d}.npz"),
-                         **grp_blocks[g])
-            except Exception as e:          # surface on the main thread
+                sidx = me * ngrp + g
+                sdir = os.path.join(stage, f"shard_{sidx:05d}")
+                part = sdir + ".partial"
+                if os.path.isdir(part):
+                    shutil.rmtree(part)
+                os.makedirs(part)
+                np.savez(os.path.join(part, "data.npz"), **blocks[g])
+                ckpt.write_manifest(
+                    part, meta=_shard_meta(sim, sidx, me, rows[g],
+                                           iout))
+                ok, reason = ckpt.validate_checkpoint(
+                    part, verify_hash=False)
+                if not ok:
+                    raise RuntimeError(
+                        f"pario: staged shard {sidx} failed "
+                        f"validation: {reason}")
+                if inj is not None:
+                    # torn@K:shard=J corrupts the payload AFTER the
+                    # manifest is staged — exactly the window where
+                    # only full-hash validation can convict the shard
+                    inj.maybe_torn(part, sidx, nstep)
+                if os.path.isdir(sdir):
+                    # dead same-nstep attempt staged this shard (a
+                    # deterministic resume replays the exact dump) —
+                    # rename over a non-empty dir would ENOTEMPTY
+                    shutil.rmtree(sdir)
+                os.replace(part, sdir)
+            except Exception as e:      # surface on the main thread
                 errs.append(e)
 
     def write_all():
-        threads = [threading.Thread(target=write, args=(g,))
+        threads = [threading.Thread(target=write_shard, args=(g,))
                    for g in range(ngrp)]
         for th in threads:
             th.start()
@@ -289,25 +497,123 @@ def dump_pario(sim, iout: int, base_dir: str = ".",
             _barrier(f"pario_{iout:05d}_wave_{w}")
     else:
         write_all()
-    if atomic:
-        out = ckpt.finalize_checkpoint(out, final, meta={
-            "kind": "pario", "iout": int(iout),
-            "nstep": int(sim.nstep), "t": float(sim.t)})
-    return out
+
+    if inj is not None:
+        # die@K:host=J: this process exits hard after staging its
+        # shards but before the commit barrier — the mid-commit host
+        # death the two-phase protocol must survive
+        inj.maybe_die(nstep, host=me)
+
+    # phase 2: deadline-watchdogged commit barrier + global seal.  A
+    # host that died above never reaches the barrier; the survivors'
+    # io deadline expires, HangDetected lands here, and the dump
+    # kills-and-falls-through with the commit aborted.
+    committed = None
+    meta = {"kind": "pario", "format": PARIO_FORMAT, "iout": int(iout),
+            "nstep": nstep, "t": float(sim.t), "nproc": int(nproc),
+            "ndev": int(getattr(sim, "ndev", 1))}
+    try:
+        if wd is not None:
+            with wd.guard("io"):
+                _barrier(f"pario_{iout:05d}_commit")
+                if me == 0:
+                    committed = _commit_pario(stage, final, meta,
+                                              nproc * ngrp,
+                                              telemetry=tel)
+                _barrier(f"pario_{iout:05d}_committed")
+        else:
+            _barrier(f"pario_{iout:05d}_commit")
+            if me == 0:
+                committed = _commit_pario(stage, final, meta,
+                                          nproc * ngrp, telemetry=tel)
+            _barrier(f"pario_{iout:05d}_committed")
+    except HangDetected as e:
+        if tel is not None:
+            tel.record_event("io_degraded", reason="commit_abort",
+                             detail=str(e), path=stage)
+        print(f" pario: commit barrier hung ({e}); abandoning "
+              f"checkpoint {iout}, run continues", flush=True)
+        return stage
+    if me != 0:
+        committed = final if os.path.isdir(final) else None
+    return committed if committed is not None else stage
 
 
 def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
-                  **kw):
+                  log=print, **kw):
     """Rebuild a sim of class ``cls`` from a ``pario_NNNNN`` directory
-    onto the CURRENT device count.  Reads every host file set present,
-    reassembles global row arrays, and places them level by level."""
-    import glob as globmod
+    onto the CURRENT process/device mesh (write on 8, restore on 4 or
+    1, and vice versa).  Format-2 restores validate every shard with
+    full hashes first: a corrupt shard is quarantined and — unless the
+    surviving shards still cover every level's rows —
+    :class:`CorruptShardError` is raised so the caller falls back to
+    the next-oldest globally-valid checkpoint."""
+    if not os.path.isfile(os.path.join(outdir, "manifest.json")) \
+            and os.path.isfile(os.path.join(outdir, "manifest.npz")):
+        return _restore_pario_v1(cls, params, outdir, dtype=dtype,
+                                 devices=devices, **kw)
 
+    import jax
     import jax.numpy as jnp
 
     from ramses_tpu.amr.tree import Octree
+    from ramses_tpu.parallel import balance
+    from ramses_tpu.resilience import checkpoint as ckpt
 
-    man = np.load(os.path.join(outdir, "manifest.npz"))
+    with open(os.path.join(outdir, "manifest.json")) as f:
+        gman = json.load(f)
+    meta = dict(gman.get("meta") or {})
+    shards = dict(gman.get("shards") or {})
+
+    run = getattr(params, "run", None)
+    if not bool(getattr(run, "elastic_restore", True)):
+        cur = int(jax.process_count())
+        dumped = int(meta.get("nproc", 1))
+        if cur != dumped:
+            raise RuntimeError(
+                f"pario: checkpoint written on {dumped} processes, "
+                f"current run has {cur} and elastic_restore=.false.")
+
+    # per-shard full-hash validation with quarantine-and-fall-back
+    ok_shards: Dict[str, dict] = {}
+    bad = []
+    for name, ent in sorted(shards.items()):
+        ok, reason = ckpt.validate_shard(outdir, name, ent,
+                                         verify_hash=True)
+        if ok:
+            ok_shards[name] = ent
+        else:
+            bad.append((name, reason))
+    if bad:
+        rows_total = {nm: int(v)
+                      for nm, v in (meta.get("rows_total") or
+                                    {}).items()}
+        covered = bool(rows_total)
+        for nm, tot in rows_total.items():
+            ivs = [iv for ent in ok_shards.values()
+                   for iv in (ent.get("rows") or {}).get(nm, [])]
+            if not balance.ranges_cover(ivs, tot)[0]:
+                covered = False
+                break
+        for name, reason in bad:
+            ckpt.quarantine_shard(outdir, name, reason, log=log)
+        if not covered:
+            raise CorruptShardError(
+                f"{os.path.basename(outdir)}: "
+                f"{'; '.join(f'{n}: {r}' for n, r in bad)} and the "
+                "surviving shards do not cover the hierarchy")
+        if log is not None:
+            log(f"pario: restoring {os.path.basename(outdir)} from "
+                f"{len(ok_shards)}/{len(shards)} shards (full row "
+                f"coverage; quarantined: "
+                f"{', '.join(n for n, _ in bad)})")
+
+    try:
+        man = np.load(os.path.join(outdir, "tree.npz"))
+    except Exception as e:              # torn top-level payload
+        raise CorruptShardError(
+            f"{os.path.basename(outdir)}: tree payload unreadable "
+            f"({e})") from e
     levels = [int(l) for l in man["levels"]]
     tree = Octree(int(man["ndim"]), int(man["levelmin"]),
                   int(man["levelmax"]),
@@ -318,16 +624,17 @@ def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
         kw["devices"] = devices
     sim = cls(params, dtype=dtype or jnp.float32, init_tree=tree, **kw)
 
-    # gather row blocks from every host file
+    # gather row blocks from every valid shard payload
     per_name: Dict[str, list] = {}
-    for f in sorted(globmod.glob(os.path.join(outdir, "host_*.npz"))):
-        z = np.load(f)
+    for name in sorted(ok_shards):
+        z = np.load(os.path.join(outdir, name, "data.npz"))
         names = {k[:-2] for k in z.files if k.endswith("_n")}
-        for name in names:
-            nsh = int(z[f"{name}_n"][0])
+        for nm in names:
+            nsh = int(z[f"{nm}_n"][0])
             for k in range(nsh):
-                per_name.setdefault(name, []).append(
-                    (int(z[f"{name}_r{k}"][0]), z[f"{name}_d{k}"]))
+                per_name.setdefault(nm, []).append(
+                    (int(z[f"{nm}_r{k}"][0]), z[f"{nm}_d{k}"]))
+
     ttd = 2 ** int(man["ndim"])
     for l in levels:
         orow = (np.asarray(man[f"octrow{l}"], np.int64)
@@ -363,6 +670,122 @@ def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
             n = min(len(dbuf), len(buf))
             buf[:n] = dbuf[:n]
             tgt[l] = sim._place(jnp.asarray(buf, buf.dtype), "cells")
+
+    # particle lanes: reassemble the full padded lane arrays from the
+    # shard row intervals, whatever mesh wrote them
+    lanes: Dict[str, np.ndarray] = {}
+    for f in _PART_FIELDS:
+        nm = f"part_{f}"
+        if nm not in per_name:
+            continue
+        ext = max(r0 + len(d) for r0, d in per_name[nm])
+        d0 = per_name[nm][0][1]
+        dbuf = np.zeros((ext,) + d0.shape[1:], d0.dtype)
+        for r0, d in per_name[nm]:
+            dbuf[r0:r0 + len(d)] = d
+        lanes[f] = dbuf
+    _attach_particles(sim, lanes, params)
+    _restore_host_state(sim, man)
+
+    lost = _unpersisted_state(sim, nproc=1)
+    if lost:
+        warnings.warn(
+            f"restore_pario: restored run carries {'/'.join(lost)} "
+            "state that was NOT in the checkpoint — those layers are "
+            "fresh from ICs, not the dumped run.", stacklevel=2)
+    sim.t = float(man["t"])
+    sim.nstep = int(man["nstep"])
+    sim.dt_old = float(man["dt_old"])
+    dtn = float(man["dtnew"]) if "dtnew" in man.files else 0.0
+    # pending next-step dt: restore takes the same next step a
+    # continuous run would (dt hysteresis rides the manifest)
+    sim._dt_cache = dtn if dtn > 0.0 else None
+    # mesh-shape elasticity, part 2: the rows were re-PLACED onto the
+    # current mesh above; when cost-weighted balancing is enabled, ask
+    # the next regrid to re-cut the Hilbert layouts against the
+    # current device count too (the dump's cuts were for its mesh)
+    if balance.enabled(sim):
+        sim.request_rebalance()
+    return sim
+
+
+# ---- legacy format 1 (manifest.npz + host_*.npz) ---------------------
+
+
+def _restore_extra_state(sim, man, params) -> None:
+    """Format-1 extras: particles rode the process-0 manifest (single
+    process only); sinks/tracers/turb likewise."""
+    import jax.numpy as jnp
+
+    if "part_x" in man.files:
+        from ramses_tpu.pm.particles import ParticleSet
+        sim.p = ParticleSet(
+            **{f: jnp.asarray(man[f"part_{f}"])
+               for f in _PART_FIELDS})
+        run = getattr(params, "run", None)
+        if bool(getattr(run, "pic", False)):
+            sim.pic = True
+    _restore_host_state(sim, man)
+
+
+def _restore_pario_v1(cls, params, outdir: str, dtype=None,
+                      devices=None, **kw):
+    """Reader for legacy format-1 dumps: ``manifest.npz`` carries the
+    tree + extras, ``host_*.npz`` the row blocks.  Kept so checkpoints
+    written before the elastic format remain restorable."""
+    import glob as globmod
+
+    import jax.numpy as jnp
+
+    from ramses_tpu.amr.tree import Octree
+
+    man = np.load(os.path.join(outdir, "manifest.npz"))
+    levels = [int(l) for l in man["levels"]]
+    tree = Octree(int(man["ndim"]), int(man["levelmin"]),
+                  int(man["levelmax"]),
+                  root=(man["root"] if "root" in man.files else None))
+    for l in levels:
+        tree.set_level(l, man[f"og{l}"])
+    if devices is not None:
+        kw["devices"] = devices
+    sim = cls(params, dtype=dtype or jnp.float32, init_tree=tree, **kw)
+
+    per_name: Dict[str, list] = {}
+    for f in sorted(globmod.glob(os.path.join(outdir, "host_*.npz"))):
+        z = np.load(f)
+        names = {k[:-2] for k in z.files if k.endswith("_n")}
+        for name in names:
+            nsh = int(z[f"{name}_n"][0])
+            for k in range(nsh):
+                per_name.setdefault(name, []).append(
+                    (int(z[f"{name}_r{k}"][0]), z[f"{name}_d{k}"]))
+    ttd = 2 ** int(man["ndim"])
+    for l in levels:
+        orow = (np.asarray(man[f"octrow{l}"], np.int64)
+                if f"octrow{l}" in man.files else None)
+        for prefix, target in (("u", "u"), ("bf", "bf")):
+            name = f"{prefix}{l}"
+            if name not in per_name:
+                continue
+            tgt = getattr(sim, target, None)
+            if tgt is None or l not in tgt:
+                continue
+            cur = np.asarray(tgt[l])
+            ext = max((r0 + len(data) for r0, data in per_name[name]),
+                      default=0)
+            if orow is not None:
+                ext = max(ext, (int(orow.max()) + 1) * ttd)
+            dbuf = np.zeros((ext,) + cur.shape[1:], cur.dtype)
+            for r0, data in per_name[name]:
+                dbuf[r0:r0 + len(data)] = data
+            if orow is not None:
+                idx = (orow[:, None] * ttd
+                       + np.arange(ttd)[None, :]).reshape(-1)
+                dbuf = dbuf[idx]
+            buf = np.zeros(cur.shape, cur.dtype)
+            n = min(len(dbuf), len(buf))
+            buf[:n] = dbuf[:n]
+            tgt[l] = sim._place(jnp.asarray(buf, buf.dtype), "cells")
     _restore_extra_state(sim, man, params)
     dump_nproc = int(man["nproc"]) if "nproc" in man.files else 1
     lost = _unpersisted_state(sim, nproc=dump_nproc)
@@ -375,7 +798,5 @@ def restore_pario(cls, params, outdir: str, dtype=None, devices=None,
     sim.nstep = int(man["nstep"])
     sim.dt_old = float(man["dt_old"])
     dtn = float(man["dtnew"]) if "dtnew" in man.files else 0.0
-    # pending next-step dt: restore takes the same next step a
-    # continuous run would (dt hysteresis rides the manifest)
     sim._dt_cache = dtn if dtn > 0.0 else None
     return sim

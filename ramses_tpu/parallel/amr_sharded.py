@@ -119,17 +119,11 @@ class ShardedAmrSim(AmrSim):
         return super().dump(iout, base_dir, namelist_path=namelist_path,
                             ncpu=self.ndev if ncpu is None else ncpu)
 
-    def dump_pario(self, iout: int = 1, base_dir: str = ".",
-                   io_group_size: Optional[int] = None,
-                   split_hosts: Optional[int] = None) -> str:
-        """Per-host concurrent sharded checkpoint (io/pario.py): every
-        host writes only its addressable shard rows, ``io_group_size``
-        bounding concurrent writers — the IOGROUPSIZE ring.  Restores
-        onto any device count via :func:`ramses_tpu.io.pario.
-        restore_pario`."""
-        from ramses_tpu.io.pario import dump_pario as _dp
-        return _dp(self, iout, base_dir, io_group_size=io_group_size,
-                   split_hosts=split_hosts)
+    # dump_pario: inherited from AmrSim — every host writes only its
+    # addressable shard rows into its own validated shard dirs under
+    # the two-phase global commit (io/pario.py format 2), io_group_size
+    # bounding concurrent writers (the IOGROUPSIZE ring).  Restore onto
+    # ANY device count via AmrSim.from_checkpoint_dir.
 
     def _slab_spec(self, lvl: int):
         """Explicit slab decomposition for a complete level, or None

@@ -38,6 +38,7 @@ __all__ = [
     "make_layout", "compute_layouts", "measure", "enabled",
     "apply_layout_level", "apply_layout_gravity", "remap_son_oct",
     "remap_octs", "remap_cells", "layout_sig", "layouts_same",
+    "merge_ranges", "ranges_cover",
 ]
 
 
@@ -81,6 +82,41 @@ def layouts_same(a: Dict[int, LevelLayout], b: Dict[int, LevelLayout],
                  levels=None) -> bool:
     keys = (set(a) | set(b)) if levels is None else set(levels)
     return all(layout_sig(a.get(l)) == layout_sig(b.get(l)) for l in keys)
+
+
+def merge_ranges(ranges) -> list:
+    """Coalesce ``[start, length]`` (or ``(start, length)``) row
+    intervals into a sorted list of maximal disjoint ``[start, end)``
+    pairs.  Empty/zero-length intervals are dropped."""
+    ivs = sorted((int(r0), int(r0) + int(n)) for r0, n in ranges
+                 if int(n) > 0)
+    out: list = []
+    for lo, hi in ivs:
+        if out and lo <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return out
+
+
+def ranges_cover(ranges, total: int):
+    """Whether ``[start, length]`` intervals cover ``[0, total)`` with
+    no gap.  Returns ``(covered, first_gap)`` — ``first_gap`` is the
+    ``[lo, hi)`` of the first uncovered span (None when covered).
+    Elastic restore uses this to decide whether a surviving shard
+    subset still reconstructs every row of the saved hierarchy."""
+    total = int(total)
+    if total <= 0:
+        return True, None
+    merged = merge_ranges(ranges)
+    pos = 0
+    for lo, hi in merged:
+        if lo > pos:
+            return False, [pos, lo]
+        pos = max(pos, hi)
+        if pos >= total:
+            return True, None
+    return pos >= total, (None if pos >= total else [pos, total])
 
 
 # ---------------------------------------------------------------- cost model

@@ -27,13 +27,24 @@ Three pillars, each independently usable:
      dumping + aborting when the ladder is exhausted.  Zero overhead
      when off: no capture, no extra host↔device fetches.
 
-:mod:`.faultinject` makes all three deterministically testable
+A fourth pillar spans processes: **elastic sharded checkpoints**
+(:mod:`ramses_tpu.io.pario` format 2 + the ``shards`` manifest table
+here) — every process commits a validated ``shard_SSSSS/`` under a
+two-phase global commit, and the reader re-decomposes the saved
+hierarchy onto whatever mesh is CURRENT, quarantining corrupt shards
+(:func:`checkpoint.quarantine_shard`) so shard rot falls back to the
+next-oldest valid checkpoint like whole-checkpoint rot does.
+
+:mod:`.faultinject` makes all of it deterministically testable
 (``&RUN_PARAMS fault_inject`` / env ``RAMSES_FAULT_INJECT``: NaN at
-step k, SIGTERM at step k, truncate a checkpoint file).
+step k, SIGTERM at step k, truncate a checkpoint file, corrupt shard
+J's payload mid-commit, kill host J between shard staging and the
+global commit).
 """
 
 from ramses_tpu.resilience.checkpoint import (  # noqa: F401
-    finalize_checkpoint, latest_valid_checkpoint, resolve_restart_dir,
-    rotate_checkpoints, validate_checkpoint)
+    finalize_checkpoint, latest_valid_checkpoint, quarantine_shard,
+    resolve_restart_dir, rotate_checkpoints, scrub_checkpoints,
+    validate_checkpoint, validate_shard, write_global_manifest)
 from ramses_tpu.resilience.stepguard import (  # noqa: F401
     StepGuard, StepRetryExhausted)

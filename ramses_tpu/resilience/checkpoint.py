@@ -10,6 +10,18 @@ or nothing.  ``validate_checkpoint`` re-checks the manifest against
 the bytes on disk, so auto-resume (``resolve_restart_dir``) can skip
 bit-rotted or truncated checkpoints with a logged reason instead of
 crashing into them.
+
+Elastic sharded checkpoints (``io/pario.py`` format 2) add one level
+of hierarchy: each writer commits a ``shard_SSSSS/`` subdirectory
+carrying its own schema-1 manifest, and the GLOBAL manifest
+(:func:`write_global_manifest`) records every shard manifest's hash
+under a ``shards`` table — a two-phase commit where phase 1 is each
+shard validating its own bytes and phase 2 is one process sealing the
+set.  ``validate_checkpoint`` recurses through the shard table, so a
+checkpoint with a missing, torn, or quarantined shard never scans as
+valid; :func:`quarantine_shard` renames a corrupt shard aside (with a
+durable reason) so the scanner's fallback-to-next-oldest logic applies
+to shard-level rot exactly as it does to whole-checkpoint rot.
 """
 
 from __future__ import annotations
@@ -68,6 +80,59 @@ def write_manifest(stage_dir: str, meta: Optional[Dict[str, Any]] = None
     return mpath
 
 
+def write_global_manifest(stage_dir: str,
+                          meta: Optional[Dict[str, Any]] = None,
+                          shard_prefix: str = "shard_") -> str:
+    """Phase-2 manifest for an elastic sharded checkpoint: hash the
+    TOP-LEVEL files of ``stage_dir`` into the usual ``files`` table and
+    seal every committed ``shard_*/`` subdirectory into a ``shards``
+    table keyed on the shard's own (already fsynced) manifest hash —
+    the global manifest validates iff every shard manifest is the one
+    its writer staged.  Raises if any shard lacks a readable manifest:
+    the caller must never seal a checkpoint with an unvalidated shard.
+    """
+    files: Dict[str, Dict[str, Any]] = {}
+    shards: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(os.listdir(stage_dir)):
+        p = os.path.join(stage_dir, name)
+        if os.path.isdir(p):
+            if not name.startswith(shard_prefix):
+                continue
+            smpath = os.path.join(p, MANIFEST_NAME)
+            try:
+                with open(smpath) as f:
+                    smeta = dict(json.load(f).get("meta") or {})
+            except (OSError, json.JSONDecodeError) as e:
+                raise RuntimeError(
+                    f"write_global_manifest: shard {name} has no "
+                    f"readable manifest ({e}); commit refused")
+            ent: Dict[str, Any] = {
+                "manifest_size": os.path.getsize(smpath),
+                "manifest_sha256": _sha256(smpath)}
+            # summary columns the elastic reader needs without opening
+            # shard manifests: row intervals, oct/particle counts, the
+            # Hilbert-order key range
+            for k in ("shard", "process", "rows", "octs", "npart",
+                      "key_range"):
+                if k in smeta:
+                    ent[k] = smeta[k]
+            shards[name] = ent
+        elif name != MANIFEST_NAME:
+            files[name] = {"size": os.path.getsize(p),
+                           "sha256": _sha256(p)}
+            _fsync_path(p)
+    mpath = os.path.join(stage_dir, MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump({"schema": MANIFEST_SCHEMA,
+                   "meta": dict(meta or {}),
+                   "files": files,
+                   "shards": shards}, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(stage_dir)
+    return mpath
+
+
 def finalize_checkpoint(stage_dir: str, final_dir: str,
                         meta: Optional[Dict[str, Any]] = None) -> str:
     """Manifest the staged directory and atomically rename it into
@@ -112,7 +177,62 @@ def validate_checkpoint(outdir: str,
             return False, f"size mismatch on {rel}"
         if verify_hash and _sha256(p) != ent.get("sha256"):
             return False, f"checksum mismatch on {rel}"
+    shards = man.get("shards")
+    if isinstance(shards, dict):
+        for name, ent in shards.items():
+            ok, reason = validate_shard(outdir, name, ent,
+                                        verify_hash=verify_hash)
+            if not ok:
+                return False, reason
     return True, "ok"
+
+
+def validate_shard(outdir: str, name: str, ent: Dict[str, Any],
+                   verify_hash: bool = True) -> Tuple[bool, str]:
+    """(ok, reason) for one shard of an elastic checkpoint: the shard
+    dir exists, its manifest is byte-identical to what the global
+    commit sealed (always hash-checked — the manifest is tiny), and
+    the shard's own file table validates (sizes always; payload hashes
+    when ``verify_hash``)."""
+    sdir = os.path.join(outdir, name)
+    if not os.path.isdir(sdir):
+        return False, f"missing shard {name}"
+    smpath = os.path.join(sdir, MANIFEST_NAME)
+    if not os.path.isfile(smpath):
+        return False, f"shard {name} has no manifest"
+    if os.path.getsize(smpath) != int(ent.get("manifest_size", -1)) \
+            or _sha256(smpath) != ent.get("manifest_sha256"):
+        return False, f"shard {name} manifest mismatch"
+    ok, reason = validate_checkpoint(sdir, verify_hash=verify_hash)
+    if not ok:
+        return False, f"shard {name}: {reason}"
+    return True, "ok"
+
+
+def quarantine_shard(outdir: str, name: str, reason: str,
+                     log: Optional[Callable] = print) -> Optional[str]:
+    """Rename a corrupt ``shard_*`` dir to ``<name>.quarantined`` and
+    record the reason inside it.  The parent checkpoint then fails
+    validation (missing shard), so every scanner falls back to the
+    next-oldest globally-valid checkpoint — shard rot degrades to the
+    whole-checkpoint rot path.  Returns the quarantine path (None when
+    the shard is already gone)."""
+    src = os.path.join(outdir, name)
+    if not os.path.isdir(src):
+        return None
+    dst = src + ".quarantined"
+    if os.path.isdir(dst):
+        shutil.rmtree(dst, ignore_errors=True)
+    os.replace(src, dst)
+    try:
+        with open(os.path.join(dst, "quarantine.json"), "w") as f:
+            json.dump({"reason": reason, "shard": name}, f, indent=1)
+    except OSError:
+        pass
+    if log is not None:
+        log(f"resilience: quarantined {os.path.basename(outdir)}/"
+            f"{name}: {reason}")
+    return dst
 
 
 def read_manifest_meta(outdir: str) -> Dict[str, Any]:
@@ -135,21 +255,29 @@ def read_quarantine_census(outdir: str) -> Dict[int, Dict[str, Any]]:
     return {int(k): dict(v) for k, v in census.items()}
 
 
+CHECKPOINT_PREFIXES = ("output_", "pario_")
+
+
 def scan_checkpoints(base_dir: str, log: Optional[Callable] = None,
-                     prefix: str = "output_"
+                     prefix=CHECKPOINT_PREFIXES
                      ) -> List[Tuple[str, Dict[str, Any]]]:
     """Manifest-valid checkpoints under ``base_dir``, newest first by
     (nstep, t, iout) — so an emergency dump (high iout, current step)
     correctly outranks an older scheduled output.  Invalid candidates
-    are skipped with a logged reason."""
+    are skipped with a logged reason.  ``prefix`` may be one prefix or
+    a tuple; the default covers both snapshot (``output_``) and elastic
+    pario (``pario_``) checkpoints — a staged ``pario_NNNNN.tmp``
+    fails the all-digits suffix check, so a dump killed mid-commit is
+    never a candidate."""
+    prefixes = (prefix,) if isinstance(prefix, str) else tuple(prefix)
     try:
         names = sorted(os.listdir(base_dir))
     except OSError:
         return []
     found = []
     for name in names:
-        if not (name.startswith(prefix)
-                and name[len(prefix):].isdigit()):
+        if not any(name.startswith(p) and name[len(p):].isdigit()
+                   for p in prefixes):
             continue
         outdir = os.path.join(base_dir, name)
         if not os.path.isdir(outdir):
@@ -171,8 +299,9 @@ def scan_checkpoints(base_dir: str, log: Optional[Callable] = None,
 def latest_valid_checkpoint(base_dir: str,
                             log: Optional[Callable] = print
                             ) -> Optional[str]:
-    """Newest manifest-valid ``output_NNNNN`` under ``base_dir`` (by
-    stored nstep/t, not by directory number), or None."""
+    """Newest manifest-valid ``output_NNNNN``/``pario_NNNNN`` under
+    ``base_dir`` (by stored nstep/t, not by directory number), or
+    None."""
     found = scan_checkpoints(base_dir, log=log)
     return found[0][0] if found else None
 
@@ -191,6 +320,42 @@ def rotate_checkpoints(base_dir: str, keep: int,
         if prot and os.path.abspath(outdir) == prot:
             continue
         shutil.rmtree(outdir, ignore_errors=True)
+
+
+def scrub_checkpoints(base_dir: str,
+                      log: Optional[Callable] = print
+                      ) -> List[Tuple[str, str]]:
+    """Quarantine invalid checkpoints under ``base_dir`` by renaming
+    them to ``<name>.corrupt`` — used by the run service before a
+    resume so a checkpoint that rotted between beats cannot wedge the
+    auto-resume scan loop.  Only directories that CARRY a manifest and
+    fail validation are touched; pre-atomic science outputs (no
+    manifest) are never candidates.  Returns ``[(path, reason), ...]``
+    for everything moved."""
+    try:
+        names = sorted(os.listdir(base_dir))
+    except OSError:
+        return []
+    moved = []
+    for name in names:
+        if not any(name.startswith(p) and name[len(p):].isdigit()
+                   for p in CHECKPOINT_PREFIXES):
+            continue
+        outdir = os.path.join(base_dir, name)
+        if not os.path.isdir(outdir) or not os.path.isfile(
+                os.path.join(outdir, MANIFEST_NAME)):
+            continue
+        ok, reason = validate_checkpoint(outdir)
+        if ok:
+            continue
+        dst = outdir + ".corrupt"
+        if os.path.isdir(dst):
+            shutil.rmtree(dst, ignore_errors=True)
+        os.replace(outdir, dst)
+        if log is not None:
+            log(f"resilience: scrub quarantined {name}: {reason}")
+        moved.append((dst, reason))
+    return moved
 
 
 def resolve_restart_dir(params, base_dir: Optional[str] = None,

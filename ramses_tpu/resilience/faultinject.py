@@ -20,10 +20,27 @@ Spec syntax (``&RUN_PARAMS fault_inject='...'`` or env
   ``truncate:NAME``    after the next checkpoint finalize, truncate
                        the file whose basename contains NAME (breaks
                        its manifest hash — validation must catch it)
+  ``torn@K:shard=J``   during the first elastic pario dump at
+                       nstep >= K, corrupt shard J's payload bytes
+                       AFTER its shard manifest is staged (size
+                       preserved, so the cheap size-only commit scan
+                       passes and the checkpoint commits) — the
+                       restore-side full-hash validation must catch
+                       it, quarantine the shard, and fall back
+  ``die@K:host=J``     during the first elastic pario dump at
+                       nstep >= K, process J exits hard AFTER staging
+                       its shards but BEFORE the global commit
+                       barrier — the surviving hosts' watchdogged
+                       barrier must kill-and-fall-through, and the
+                       torn ``pario_NNNNN.tmp`` staging dir must
+                       never scan as a valid checkpoint
 
 Arming is strict: a fault fires only if the run is seen at
 ``nstep < K`` first, so a resumed run that restarts at nstep >= K does
-not re-fire the same fault — exactly-once per logical run.
+not re-fire the same fault — exactly-once per logical run.  ``torn``
+and ``die`` arm through the same per-step observations as ``nan``
+(the window clamp / guard checks the drivers already make), then fire
+inside the dump path.
 """
 
 from __future__ import annotations
@@ -34,26 +51,43 @@ from typing import Optional
 
 ENV_VAR = "RAMSES_FAULT_INJECT"
 
+# exit code of a die@K fault — distinct from HANG_EXIT_CODE (87) so a
+# supervising shell can tell an injected mid-commit death from a
+# watchdog kill
+DIE_EXIT_CODE = 3
+
+# each step-indexed kind's accepted ':key=' suffix (torn targets a
+# shard index, die a host/process index, nan/hang an ensemble member)
+_OPT_KEY = {"nan": "member", "hang": "member",
+            "torn": "shard", "die": "host"}
+
+# every step-indexed kind participates in strict arming and the fused
+# window clamp — a torn/die fault must not be skipped over by a fused
+# multi-step dispatch any more than a nan may be
+STEP_KINDS = ("nan", "sigterm", "hang", "torn", "die")
+
 
 def _parse(spec: str):
-    """(faults, member_of): ``faults`` keeps the historic 2-tuple shape
-    (kind, arg); member targeting rides in the parallel ``member_of``
-    dict keyed by fault index."""
+    """(faults, targets): ``faults`` keeps the historic 2-tuple shape
+    (kind, arg); targeting options (``member=J``/``shard=J``/
+    ``host=J``) ride in the parallel ``targets`` dict keyed by fault
+    index."""
     faults = []
-    member_of = {}
+    targets = {}
     for part in str(spec or "").split(","):
         part = part.strip()
         if not part:
             continue
-        if part.startswith("nan@") or part.startswith("hang@"):
-            kind, _, rest = part.partition("@")
+        kind, sep, rest = part.partition("@")
+        if sep and kind in _OPT_KEY:
             body, _, opt = rest.partition(":")
             if opt:
-                if not opt.startswith("member="):
+                want = _OPT_KEY[kind]
+                if not opt.startswith(want + "="):
                     raise ValueError(
                         f"unknown fault_inject option {opt!r} "
-                        f"in {part!r} (expected member=J)")
-                member_of[len(faults)] = int(opt[len("member="):])
+                        f"in {part!r} (expected {want}=J)")
+                targets[len(faults)] = int(opt[len(want) + 1:])
             faults.append((kind, int(body)))
         elif part.startswith("sigterm@"):
             faults.append(("sigterm", int(part[8:])))
@@ -61,14 +95,22 @@ def _parse(spec: str):
             faults.append(("truncate", part[len("truncate:"):]))
         else:
             raise ValueError(f"unknown fault_inject spec {part!r}")
-    return faults, member_of
+    return faults, targets
 
 
 class FaultInjector:
     """Holds the parsed fault list and per-fault armed/fired state."""
 
     def __init__(self, spec: str):
-        self.faults, self.member_of = _parse(spec)
+        self.faults, targets = _parse(spec)
+        # split the target dict by what the index means: ensemble
+        # member (nan/hang), shard (torn), host/process (die)
+        self.member_of = {i: t for i, t in targets.items()
+                          if self.faults[i][0] in ("nan", "hang")}
+        self.shard_of = {i: t for i, t in targets.items()
+                         if self.faults[i][0] == "torn"}
+        self.host_of = {i: t for i, t in targets.items()
+                        if self.faults[i][0] == "die"}
         self._armed = {}          # idx -> bool (saw nstep < K)
         self._fired = set()
 
@@ -127,15 +169,29 @@ class FaultInjector:
             return True
         return False
 
+    def observe(self, nstep: int) -> None:
+        """Strict-arming observation for the dump-path faults
+        (torn/die): they fire inside ``dump_pario``, far from any
+        per-step guard, so the window clamp — which every driver calls
+        with the current nstep — records 'seen at nstep < K' for them.
+        nan/sigterm/hang arming stays inside their own guard checks
+        (member-targeted faults must arm against the MEMBER's step)."""
+        for i, (kind, k) in enumerate(self.faults):
+            if kind in ("torn", "die") and i not in self._armed:
+                self._armed[i] = int(nstep) < int(k)
+
     def clamp_window(self, nstep: int, n: int) -> int:
         """Largest window size <= ``n`` that does not fuse past the
         next pending step-indexed fault target.  The uniform drivers
         run many coarse steps per device dispatch; without this clamp
-        a ``nan@K``/``sigterm@K`` could only land on chunk boundaries.
+        a ``nan@K``/``sigterm@K`` could only land on chunk boundaries
+        — and a ``torn@K``/``die@K`` could miss the dump that was
+        supposed to carry it.
         """
         nstep = int(nstep)
+        self.observe(nstep)
         for i, (kind, k) in enumerate(self.faults):
-            if kind not in ("nan", "sigterm", "hang") \
+            if kind not in STEP_KINDS \
                     or i in self._fired or self._hang_done(i):
                 continue
             if self._armed.get(i) is False:
@@ -152,8 +208,9 @@ class FaultInjector:
         after a retry), untargeted faults against the engine-global
         ``nstep_global`` — so ``nan@K:member=J`` lands exactly at
         member J's step K inside a fused window."""
+        self.observe(int(nstep_global))
         for i, (kind, k) in enumerate(self.faults):
-            if kind not in ("nan", "sigterm", "hang") \
+            if kind not in STEP_KINDS \
                     or i in self._fired or self._hang_done(i):
                 continue
             if self._armed.get(i) is False:
@@ -268,6 +325,65 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGTERM)
             return True
         return False
+
+    def maybe_torn(self, shard_dir: str, shard: int,
+                   nstep: int) -> bool:
+        """``torn@K:shard=J``: called by ``dump_pario`` after shard
+        ``shard``'s manifest is staged and validated, just before the
+        shard dir is committed.  Flips bytes in the middle of the
+        shard's largest payload file WITHOUT changing its size — the
+        commit-time size-only scan passes, so the torn shard ships
+        inside a globally-committed checkpoint and only full-hash
+        validation (restore / scrubber) can convict it."""
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "torn" or self.shard_of.get(i, 0) != int(shard):
+                continue
+            if not self._should_fire(i, kind, int(nstep)):
+                continue
+            target, tsize = None, -1
+            for fn in os.listdir(shard_dir):
+                p = os.path.join(shard_dir, fn)
+                if fn == "manifest.json" or not os.path.isfile(p):
+                    continue
+                if os.path.getsize(p) > tsize:
+                    target, tsize = p, os.path.getsize(p)
+            if target is None:
+                return False
+            with open(target, "r+b") as f:
+                f.seek(tsize // 2)
+                chunk = f.read(64)
+                f.seek(tsize // 2)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+                f.flush()
+                os.fsync(f.fileno())
+            print(f" fault-inject: tore shard {int(shard)} payload "
+                  f"{os.path.basename(target)} at nstep={int(nstep)}",
+                  flush=True)
+            return True
+        return False
+
+    def maybe_die(self, nstep: int, host: int) -> bool:
+        """``die@K:host=J``: called by ``dump_pario`` on process
+        ``host`` after its shards are staged but BEFORE the global
+        commit barrier — the injected mid-commit host death.  Exits
+        the process hard (``os._exit``: no atexit, no flushing, the
+        closest sane stand-in for a SIGKILL'd host)."""
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "die" or self.host_of.get(i, 0) != int(host):
+                continue
+            if not self._should_fire(i, kind, int(nstep)):
+                continue
+            print(f" fault-inject: host {int(host)} dying mid-commit "
+                  f"at nstep={int(nstep)}", flush=True)
+            _die(DIE_EXIT_CODE)
+            return True                    # only under a patched _die
+        return False
+
+
+def _die(code: int):
+    """Hard process exit for ``die@K`` (module-level so tests can
+    patch it into a raise instead of killing the test runner)."""
+    os._exit(code)
 
 
 # ---- process-wide fired state ---------------------------------------

@@ -1,7 +1,10 @@
 """Per-host concurrent sharded checkpoints (``io/pario.py`` — the
 pario/IOGROUPSIZE role, VERDICT-r04 Missing #1): every writer emits
-only the shard rows it holds, concurrently, and the file sets restore
-onto ANY device count bitwise."""
+only the shard rows it holds, concurrently, into its own validated
+shard dir; process 0 seals the set under the two-phase global commit;
+and the shard sets restore onto ANY device count bitwise.  Elastic
+fault paths (torn shards, die-mid-commit, degraded-mesh restore) live
+in test_elastic_checkpoint.py."""
 
 import glob
 import os
@@ -42,9 +45,11 @@ def test_pario_roundtrip_any_device_count(tmp_path):
 
     out = dump_pario(sim, 1, str(tmp_path), split_hosts=4,
                      io_group_size=2)
-    hosts = sorted(glob.glob(os.path.join(out, "host_*.npz")))
-    assert len(hosts) == 4                      # one file per "host"
-    assert os.path.exists(os.path.join(out, "manifest.npz"))
+    shards = sorted(glob.glob(os.path.join(out, "shard_*")))
+    assert len(shards) == 4                     # one dir per "host"
+    assert all(os.path.isfile(os.path.join(s, "manifest.json"))
+               for s in shards)
+    assert os.path.exists(os.path.join(out, "manifest.json"))
 
     # restore onto the SAME 8-device mesh: bitwise
     r8 = restore_pario(ShardedAmrSim, params_from_string(NML, ndim=2),
@@ -163,20 +168,53 @@ def test_pario_pm_roundtrip(tmp_path):
     assert np.array_equal(np.asarray(r.p.v), np.asarray(sim.p.v))
 
 
-def test_pario_warns_multiprocess_particles(tmp_path, monkeypatch):
-    """Multi-process dumps stay gas-only for particle state (sharded
-    device arrays cannot ride the process-0 manifest): the PR 1 warning
-    still fires there, and only there."""
+def test_pario_two_phase_multiprocess(tmp_path, monkeypatch):
+    """The gas-only multi-process era is over: simulate a 2-process
+    dump by running both writer passes sequentially (barriers no-op).
+    The non-zero process stages its shard and returns the UNCOMMITTED
+    ``.tmp`` path; process 0's pass stages its shard + tree, validates
+    the full set, and seals the global manifest — and the committed
+    checkpoint restores particles on one device, warning-free."""
+    import warnings as wmod
+
     import jax
 
-    sim = _pm_sim()
+    import ramses_tpu.io.pario as pario
+
+    monkeypatch.setattr(pario, "_barrier", lambda tag: None)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    with pytest.warns(UserWarning, match="does NOT persist"):
-        dump_pario(sim, 1, str(tmp_path))
-    # multi-process writes in place, no atomic manifest rename
-    assert "part_x" not in np.load(
-        os.path.join(str(tmp_path), "pario_00001",
-                     "manifest.npz")).files
+    sim = _pm_sim(dtype=jnp.float64)
+    sim.evolve(0.004, nstepmax=2)
+
+    # pass 1: the OTHER host stages shard_00001; no commit happens
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    out1 = dump_pario(sim, 1, str(tmp_path))
+    assert out1.endswith(".tmp")
+    assert os.path.isfile(os.path.join(out1, "shard_00001",
+                                       "manifest.json"))
+    assert not os.path.exists(os.path.join(out1, "manifest.json"))
+
+    # pass 2: process 0 stages its shard and seals the set — its
+    # stale-stage sweep must keep the sibling's same-nstep shard
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    out = dump_pario(sim, 1, str(tmp_path))
+    assert out.endswith("pario_00001") and os.path.isdir(out)
+    from ramses_tpu.resilience import validate_checkpoint
+    ok, reason = validate_checkpoint(out, verify_hash=True)
+    assert ok, reason
+
+    with wmod.catch_warnings():
+        wmod.simplefilter("error")     # persisted → no gas-only warn
+        r = restore_pario(AmrSim, params_from_string(PM_NML, ndim=2),
+                          out, dtype=jnp.float64)
+    assert r.p is not None
+    for f in ("x", "v", "m", "active", "idp"):
+        assert np.array_equal(np.asarray(getattr(r.p, f)),
+                              np.asarray(getattr(sim.p, f))), f
+    for l in sim.levels():
+        nc = sim.maps[l].noct * 2 ** sim.cfg.ndim
+        assert np.array_equal(np.asarray(r.u[l])[:nc],
+                              np.asarray(sim.u[l])[:nc]), l
 
 
 def test_pario_layout_roundtrip(tmp_path):
@@ -231,14 +269,17 @@ def test_pario_cross_host_waves(tmp_path, monkeypatch):
     b0 = events.index(("barrier", "pario_00007_wave_0"))
     b1 = events.index(("barrier", "pario_00007_wave_1"))
     writes = [i for i, (kind, name) in enumerate(events)
-              if kind == "write" and name.startswith("host_")]
-    assert len(writes) == 2            # split_hosts=2 files this host
+              if kind == "write" and name == "data.npz"]
+    assert len(writes) == 2           # split_hosts=2 shards this host
     # process 1 is in wave 1: every write sits between the two barriers
     assert all(b0 < i < b1 for i in writes)
-    # a non-zero process writes no manifest, and multi-process dumps
-    # are in place (no atomic rename possible across hosts)
-    assert not os.path.exists(os.path.join(out, "manifest.npz"))
-    assert out.endswith("pario_00007")
+    # a non-zero process never seals the global manifest, and with the
+    # commit barrier stubbed out the stage stays uncommitted — the
+    # returned path is the .tmp staging dir, which no scanner selects
+    assert out.endswith(".tmp")
+    assert not os.path.exists(os.path.join(out, "manifest.json"))
+    from ramses_tpu.resilience import latest_valid_checkpoint
+    assert latest_valid_checkpoint(str(tmp_path), log=None) is None
     # the wave schedule covers every residue class once
     assert [pario._host_wave(p, 2) for p in range(4)] == [0, 1, 0, 1]
 
@@ -270,7 +311,7 @@ def test_pario_io_group_throttle(tmp_path, monkeypatch):
     out = dump_pario(sim, 2, str(tmp_path), split_hosts=4,
                      io_group_size=1)
     monkeypatch.setattr(np, "savez", orig)
-    # manifest writes outside the ring; host writers hold the token
+    # tree payload writes outside the ring; shard writers hold the token
     assert peak["max"] <= 2
     r = restore_pario(ShardedAmrSim, params_from_string(NML, ndim=2),
                       out, dtype=jnp.float32, devices=jax.devices()[:8])

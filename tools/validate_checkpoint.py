@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Offline checkpoint scrubber: walk a run directory, verify every
+checkpoint — snapshot ``output_NNNNN`` and elastic pario
+``pario_NNNNN`` alike — against its manifests with FULL SHA-256
+hashing, and for pario format 2 cross-check each shard's payload
+against the row/oct/particle counts its manifest claims.
+
+Per-checkpoint verdicts print to stdout; a machine-readable summary
+lands as JSON (``VALIDATE_JSON`` env or ``--json``, default
+``VALIDATE_CKPT.json`` — the ``tools/profile_amr.py`` convention);
+exit status is nonzero when any torn checkpoint was found, so a CI leg
+or cron scrub can gate on it.
+
+Usage:  python tools/validate_checkpoint.py RUN_DIR [--json OUT.json]
+        [--quarantine]
+
+``--quarantine`` additionally renames torn checkpoints to
+``<name>.corrupt`` (the run-service scrub), so the next auto-resume
+scan never considers them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ramses_tpu.resilience import checkpoint as ckpt  # noqa: E402
+
+
+def _check_shard_counts(sdir: str) -> (bool, str):
+    """Deep payload-vs-manifest cross-check for one pario shard: the
+    row intervals, oct counts and particle rows the shard manifest
+    claims must match the arrays actually present in data.npz."""
+    meta = ckpt.read_manifest_meta(sdir)
+    rows = meta.get("rows") or {}
+    path = os.path.join(sdir, "data.npz")
+    if not os.path.isfile(path):
+        return (not rows), ("" if not rows else "data.npz missing")
+    try:
+        z = np.load(path)
+    except Exception as e:
+        return False, f"data.npz unreadable: {e}"
+    names = {k[:-2] for k in z.files if k.endswith("_n")}
+    if names != set(rows):
+        return False, (f"manifest rows name {sorted(rows)} != payload "
+                       f"{sorted(names)}")
+    for nm in sorted(names):
+        got = []
+        for k in range(int(z[f"{nm}_n"][0])):
+            got.append([int(z[f"{nm}_r{k}"][0]),
+                        int(len(z[f"{nm}_d{k}"]))])
+        if sorted(got) != sorted([list(map(int, iv))
+                                  for iv in rows[nm]]):
+            return False, f"{nm}: manifest rows {rows[nm]} != {got}"
+    return True, ""
+
+
+def check_checkpoint(path: str) -> dict:
+    """One checkpoint's verdict record."""
+    name = os.path.basename(path)
+    rec = {"name": name, "path": path, "verdict": "valid",
+           "reason": ""}
+    if not os.path.isfile(os.path.join(path, ckpt.MANIFEST_NAME)):
+        rec["verdict"] = "unvalidated"
+        rec["reason"] = "no manifest (pre-atomic science output)"
+        return rec
+    ok, reason = ckpt.validate_checkpoint(path, verify_hash=True)
+    if not ok:
+        rec["verdict"] = "torn"
+        rec["reason"] = reason
+        return rec
+    # pario format 2: per-shard deep count checks
+    shards = {}
+    try:
+        with open(os.path.join(path, ckpt.MANIFEST_NAME)) as f:
+            ents = (json.load(f).get("shards") or {})
+    except Exception:
+        ents = {}
+    for sname in sorted(ents):
+        sok, sreason = _check_shard_counts(os.path.join(path, sname))
+        shards[sname] = {"ok": bool(sok), "reason": sreason}
+        if not sok:
+            rec["verdict"] = "torn"
+            rec["reason"] = f"{sname}: {sreason}"
+    if shards:
+        rec["shards"] = shards
+    return rec
+
+
+def scrub(base: str, quarantine: bool = False) -> dict:
+    names = sorted(
+        n for n in (os.listdir(base) if os.path.isdir(base) else [])
+        if os.path.isdir(os.path.join(base, n))
+        and any(n.startswith(p) and n[len(p):].isdigit()
+                for p in ckpt.CHECKPOINT_PREFIXES))
+    res = {"base": os.path.abspath(base), "checkpoints": [],
+           "n_valid": 0, "n_torn": 0, "n_unvalidated": 0}
+    for n in names:
+        rec = check_checkpoint(os.path.join(base, n))
+        if rec["verdict"] == "torn" and quarantine:
+            dst = os.path.join(base, n) + ".corrupt"
+            os.replace(os.path.join(base, n), dst)
+            rec["quarantined"] = dst
+        res["checkpoints"].append(rec)
+        key = {"valid": "n_valid", "torn": "n_torn",
+               "unvalidated": "n_unvalidated"}[rec["verdict"]]
+        res[key] += 1
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline checkpoint scrubber (full-hash + shard "
+                    "count verification)")
+    ap.add_argument("run_dir")
+    ap.add_argument("--json", default=None,
+                    help="summary JSON path (default VALIDATE_JSON "
+                         "env or VALIDATE_CKPT.json)")
+    ap.add_argument("--quarantine", action="store_true",
+                    help="rename torn checkpoints to <name>.corrupt")
+    args = ap.parse_args(argv)
+    res = scrub(args.run_dir, quarantine=args.quarantine)
+    for rec in res["checkpoints"]:
+        mark = {"valid": "ok  ", "torn": "TORN",
+                "unvalidated": "??  "}[rec["verdict"]]
+        extra = f"  ({rec['reason']})" if rec["reason"] else ""
+        print(f" {mark} {rec['name']}{extra}")
+    print(f" {res['n_valid']} valid, {res['n_torn']} torn, "
+          f"{res['n_unvalidated']} unvalidated under {res['base']}")
+    out = args.json or os.environ.get("VALIDATE_JSON",
+                                      "VALIDATE_CKPT.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=1, default=str)
+    print(f" wrote {out}")
+    return 1 if res["n_torn"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
