@@ -36,9 +36,12 @@ Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
 BENCH_ONLY=<comma list of uniform|amr|mg|amr_poisson|ensemble|
-profile_amr — the last runs tools/profile_amr.py's per-kernel probes
-with incremental partial capture; also auto-escalated after a
-hang-classified amr sub>,
+profile_amr|halo — profile_amr runs tools/profile_amr.py's per-kernel
+probes with incremental partial capture (also auto-escalated after a
+hang-classified amr sub); halo times the explicit halo pipeline
+(ppermute vs DMA, 1/2/8 shards, bytes/s + fused step time) and is
+opt-in like profile_amr>,
+BENCH_HALO_LEVEL, BENCH_HALO_STEPS,
 BENCH_SUB_TIMEOUT, BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH,
 BENCH_ENS_LEVEL, BENCH_ENS_STEPS, BENCH_ENS_BATCHES,
 BENCH_HANG_SUB=<sub> (deliberately wedge that child before its jax
@@ -501,20 +504,91 @@ def bench_mg(dtype, jnp, hb=lambda *a, **k: None):
     }
 
 
+def bench_halo(params, dtype, jnp, hb=lambda *a, **k: None):
+    """Explicit halo pipeline: fused sweep step time + halo bytes/s at
+    1/2/8 shards, ppermute vs DMA.  The DMA backend is measured only on
+    a real TPU (the interpreter is a correctness vehicle, not a perf
+    path); elsewhere it reports "unavailable" so the ppermute baseline
+    still lands."""
+    import jax
+
+    from ramses_tpu.driver import Simulation
+    from ramses_tpu.parallel import dma_halo
+    from ramses_tpu.parallel.halo import make_halo_mesh, run_steps_halo
+
+    lvl = int(os.environ.get("BENCH_HALO_LEVEL", "6"))
+    nsteps = int(os.environ.get("BENCH_HALO_STEPS", "8"))
+    params.amr.levelmin = params.amr.levelmax = lvl
+    sim = Simulation(params, dtype=dtype)
+    u0 = sim.state.u
+    nvar = int(u0.shape[0])
+    ncell = int(u0.size // nvar)
+    t0 = jnp.asarray(0.0, u0.dtype)
+    tend = jnp.asarray(1e9, u0.dtype)
+    hb("init", level=lvl)
+
+    ndev = len(jax.devices())
+    shard_counts = [k for k in (1, 2, 8)
+                    if k <= ndev and (1 << lvl) % k == 0]
+    backends = ["ppermute"] + (["dma"] if dma_halo.available() else [])
+    runs = {}
+    for k in shard_counts:
+        mesh = make_halo_mesh(jax.devices()[:k])
+        for backend in backends:
+            key = f"{backend}_x{k}"
+            dma_halo.reset_traffic()
+            # warm: compile the whole window once
+            u, t, n = run_steps_halo(sim.grid, mesh, u0, t0, tend,
+                                     nsteps, halo_backend=backend)
+            float(jnp.sum(u))
+            snap = dma_halo.traffic_snapshot()   # per-STEP traced bytes
+            hb("warm", config=key)
+            reps, wall = 1, 0.0
+            while wall < 0.5 and reps < 512:
+                tstart = time.perf_counter()
+                for _ in range(reps):
+                    u, t, n = run_steps_halo(sim.grid, mesh, u0, t0,
+                                             tend, nsteps,
+                                             halo_backend=backend)
+                float(jnp.sum(u))
+                wall = time.perf_counter() - tstart
+                if wall < 0.5:
+                    reps = min(512, reps * 4)
+            steps_per_sec = nsteps * reps / wall
+            runs[key] = {
+                "steps_per_sec": steps_per_sec,
+                "step_ms": 1e3 / steps_per_sec,
+                "halo_bytes_per_step": snap["halo_bytes"],
+                "halo_bytes_per_sec": snap["halo_bytes"] * steps_per_sec,
+                "halo_exchanges_per_step": snap["halo_exchanges"],
+                "overlap_frac": snap["halo_overlap_frac"],
+            }
+            hb("timed", config=key)
+    if "dma" not in backends:
+        runs["dma"] = "unavailable (no TPU backend)"
+    return {
+        "config": f"halo sweep sedov3d {1 << lvl}^3 "
+                  f"{str(dtype.__name__)} nsteps={nsteps}",
+        "ncell": ncell,
+        "runs": runs,
+        "tunnel_rtt_s": measure_rtt(jnp),
+    }
+
+
 # the default protocol; profile_amr (the per-kernel breakdown of
-# tools/profile_amr.py) is opt-in via BENCH_ONLY or the amr-hang
-# escalation below — too slow for every protocol run
+# tools/profile_amr.py) and halo (the backend comparison above) are
+# opt-in via BENCH_ONLY — too slow for every protocol run
 DEFAULT_SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
-SUBS = DEFAULT_SUBS + ("profile_amr",)
+SUBS = DEFAULT_SUBS + ("profile_amr", "halo")
 # ceilings per sub; the GLOBAL budget (BENCH_TOTAL_BUDGET) always wins —
 # four rounds of rc=124 driver kills came from these summing past the
 # driver's wall clock whenever the tunnel hung
 SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500,
-                "ensemble": 300, "profile_amr": 700}
+                "ensemble": 300, "profile_amr": 700, "halo": 300}
 # share of the REMAINING budget each sub may claim at launch
 SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35,
                "amr_poisson": 0.95, "ensemble": 0.95,
-               "profile_amr": 0.95}
+               "profile_amr": 0.95, "halo": 0.95}
 
 
 def run_sub_inproc(name):
@@ -552,6 +626,8 @@ def run_sub_inproc(name):
     elif name == "ensemble":
         d = bench_ensemble(load_params(nml, ndim=3), dtype, jnp,
                            hb=hb.mark)
+    elif name == "halo":
+        d = bench_halo(load_params(nml, ndim=3), dtype, jnp, hb=hb.mark)
     elif name == "profile_amr":
         # per-kernel breakdown (tools/profile_amr.py): its probes emit
         # incrementally into the result sidecar with completed=False,
@@ -730,7 +806,7 @@ def main():
         raise SystemExit(
             f"BENCH_ONLY={only!r}: unknown sub(s) {bad}; expected a "
             f"comma list of "
-            f"uniform|amr|mg|amr_poisson|ensemble|profile_amr")
+            f"uniform|amr|mg|amr_poisson|ensemble|profile_amr|halo")
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "900"))
     deadline = time.monotonic() + budget
     partial_path = os.environ.get(

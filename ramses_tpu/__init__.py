@@ -10,7 +10,10 @@ Architecture (see README.md):
   * host: octree topology, refinement decisions, I/O, orchestration
   * device: dense per-level batch kernels under ``jax.jit`` — Godunov
     sweeps, multigrid relaxation, CIC deposition — sharded over a
-    ``jax.sharding.Mesh`` with halo exchange via ``lax.ppermute``.
+    ``jax.sharding.Mesh`` with ring halo exchange through the
+    backend-dispatched engine (``parallel/dma_halo.py``): Pallas
+    async remote-copy DMA with comm/compute overlap on TPU,
+    ``lax.ppermute`` elsewhere (``&AMR_PARAMS halo_backend``).
 """
 
 __version__ = "0.1.0"

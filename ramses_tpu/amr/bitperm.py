@@ -147,6 +147,24 @@ def dense_to_flat_slab(dense, lvl: int, ndim: int, mbits: int):
     return jnp.transpose(x, ax).reshape((ncell,) + trailing)
 
 
+def flat_index_np(coords, lvl: int, ndim: int):
+    """Host-side (numpy) flat row index of dense cell coordinates —
+    the scalar form of the bit permutation above, for map builders that
+    need Morton-interleaved scatter targets (``mhd/amr.py`` builds its
+    slab-path EMF override indices with this instead of a C-order
+    ``ravel_multi_index``).  ``coords``: int array ``[..., ndim]``
+    (values in ``[0, 2^lvl)``); returns int64 flat indices of shape
+    ``coords.shape[:-1]``."""
+    import numpy as np
+    coords = np.asarray(coords)
+    seq = _bit_seq(lvl, ndim)
+    nb = len(seq)
+    flat = np.zeros(coords.shape[:-1], dtype=np.int64)
+    for p, (d, i) in enumerate(seq):
+        flat |= ((coords[..., d].astype(np.int64) >> i) & 1) << (nb - 1 - p)
+    return flat
+
+
 def flat_to_dense(rows, lvl: int, ndim: int):
     """[ncell(+pad), *trailing] flat-order rows → dense
     ``(2^lvl,)*ndim + trailing`` array (pure reshape/transpose)."""

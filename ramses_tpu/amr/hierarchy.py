@@ -146,9 +146,10 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
             sl = spec.slab[i] if spec.slab else None
             if sl is not None:
                 # explicit slab-sharded formulation: shard-local bitperm
-                # + ring ppermute halos (parallel/dense_slab.py) — the
-                # GSPMD partitioner never sees the bit-interleaved
-                # transpose, so no involuntary full rematerialization
+                # + backend-dispatched ring halos with DMA overlap
+                # (parallel/dense_slab.py, dma_halo.py) — the GSPMD
+                # partitioner never sees the bit-interleaved transpose,
+                # so no involuntary full rematerialization
                 from ramses_tpu.parallel import dense_slab
                 out = dense_slab.dense_sweep_slab(
                     u[l], d.get("ok_flat"), dtl, dx(l), sl, cfg,
@@ -163,8 +164,9 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
                 phi[l] = phi[l] + out[1]
             corr = None
         elif spec.comm and spec.comm[i] is not None:
-            # explicit per-shard schedule (shard_map + ppermute halos,
-            # deterministic owner-fold) — parallel/amr_comm.py
+            # explicit per-shard schedule (shard_map + backend-
+            # dispatched ring halos, deterministic owner-fold) —
+            # parallel/amr_comm.py
             from ramses_tpu.parallel import amr_comm
             du, unew[l - 1] = amr_comm.sweep_correct_explicit(
                 u[l], u[l - 1], unew[l - 1], d, dtl, dx(l), cfg,

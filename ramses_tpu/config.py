@@ -109,6 +109,11 @@ class AmrParams:
     # ~(3^ndim)x duplicated per-oct batch (single-device hydro/rhd)
     oct_blocking: bool = True
     oct_block_shift: int = 2
+    # multi-chip halo exchange backend (parallel/dma_halo.py): "auto"
+    # resolves to the Pallas async remote-copy (DMA) engine on a real
+    # TPU backend and to lax.ppermute everywhere else; "ppermute" /
+    # "dma" force a backend (an unavailable "dma" warns and falls back)
+    halo_backend: str = "auto"
     cost_weight_hydro: float = 1.0
     cost_weight_mhd: float = 2.0
     cost_weight_rt: float = 1.5

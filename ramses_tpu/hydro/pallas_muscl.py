@@ -34,6 +34,10 @@ except ImportError:         # pragma: no cover - depends on jax version
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams → CompilerParams between releases
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 from ramses_tpu.hydro.core import HydroStatic
 
 NG = 2  # ghost cells per side (matches muscl.NGHOST)
@@ -387,7 +391,7 @@ def fused_step_padded(u_pad, dt, cfg: HydroStatic, dx: float,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,           # CPU parity tests
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
     )(*args)
 

@@ -403,8 +403,9 @@ def _mhd_fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
             sl = spec.slab[i] if spec.slab else None
             if sl is not None:
                 # explicit slab-sharded flags (parallel/dense_slab.py):
-                # shard-local bitperm + depth-1 ppermute halos instead
-                # of the global-view transpose
+                # shard-local bitperm + depth-1 ring halos (DMA or
+                # ppermute per the halo_backend knob) instead of the
+                # global-view transpose
                 from functools import partial as _partial
 
                 from ramses_tpu.parallel import dense_slab
@@ -512,49 +513,86 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
         if spec.complete[i]:
             shape = (1 << l,) * nd
             ncell = shape[0] ** nd
-            grid = mu.MhdGrid(cfg=cfg, shape=shape, dx=dx(l),
-                              bc_kinds=bc_kinds)
-            ud = jnp.moveaxis(
-                K.rows_to_dense(u[l], d.get("inv_perm"), shape), -1, 0)
-            bld = K.rows_to_dense(bf[l], d.get("inv_perm"),
-                                  shape)               # [*shape, 3, 2]
-            bfd = jnp.stack([bld[..., c, 0] for c in range(NCOMP)])
-            ok_d = (d["ok_dense"].reshape(shape)
-                    if d.get("ok_dense") is not None else None)
-            override = None
-            if child_emf is not None:
-                idx = dev[levels[i + 1]].get("emf_dense_idx")
-                if idx is not None:
-                    override = {}
-                    for pi, pair in enumerate(pairs):
-                        rows = idx[:, pi].reshape(-1)
-                        vals = jnp.zeros((ncell,), child_emf.dtype).at[
-                            rows].set(child_emf[:, pi].reshape(-1),
-                                      mode="drop")
-                        msk = jnp.zeros((ncell,), bool).at[rows].set(
-                            True, mode="drop")
-                        override[pair] = (msk.reshape(shape),
-                                          vals.reshape(shape))
-            un_d, bfn_d = mu.step(grid, ud, bfd, dtl, ok=ok_d,
-                                  emf_override=override)
-            du_rows = K.dense_to_rows(jnp.moveaxis(un_d - ud, 0, -1),
-                                      d.get("perm"), shape)
-            if u[l].shape[0] > ncell:
-                du_rows = jnp.zeros_like(u[l]).at[:ncell].set(
-                    du_rows.astype(u[l].dtype))
-            unew[l] = unew[l] + du_rows
-            comps = []
-            for c in range(NCOMP):
-                lo_d = bfn_d[c]
-                if c < nd:
-                    hi_d = _dense_hi(lo_d, c, bc_kinds[c][0] == 0)
-                else:
-                    hi_d = lo_d
-                comps.append(jnp.stack([lo_d, hi_d], axis=-1))
-            b_rows = K.dense_to_rows(jnp.stack(comps, axis=-2),
-                                     d.get("perm"), shape)
-            bf[l] = bf[l].at[:ncell].set(b_rows.astype(bf[l].dtype)) \
-                if bf[l].shape[0] > ncell else b_rows.astype(bf[l].dtype)
+            from ramses_tpu.parallel import dense_slab
+            sl = spec.slab[i] if spec.slab else None
+            use_slab = sl is not None and dense_slab.mhd_slab_ok(sl)
+            if use_slab and child_emf is not None:
+                cd = dev[levels[i + 1]]
+                if (cd.get("emf_dense_idx") is not None
+                        and cd.get("emf_flat_idx") is None):
+                    use_slab = False      # no Morton scatter map built
+            if use_slab:
+                # explicit slab-sharded CT (parallel/dense_slab.py):
+                # shard-local bitperm + ring halos; the coarse-fine EMF
+                # override becomes a row-order scatter OUTSIDE the
+                # shard_map (emf_flat_idx), so the partitioned program
+                # never sees a global index scatter
+                ovr_flat = None
+                if child_emf is not None:
+                    fidx = dev[levels[i + 1]].get("emf_flat_idx")
+                    if fidx is not None:
+                        npair = len(pairs)
+                        om = jnp.zeros((ncell, npair), u[l].dtype)
+                        ov = jnp.zeros((ncell, npair), u[l].dtype)
+                        for pi in range(npair):
+                            rows = fidx[:, pi].reshape(-1)
+                            ov = ov.at[rows, pi].set(
+                                child_emf[:, pi].reshape(-1).astype(
+                                    u[l].dtype), mode="drop")
+                            om = om.at[rows, pi].set(1.0, mode="drop")
+                        ovr_flat = (om, ov)
+                du_rows, b_rows = dense_slab.mhd_ct_slab(
+                    u[l], bf[l], dtl, dx(l), sl, cfg,
+                    ok_flat=d.get("ok_flat"), ovr_flat=ovr_flat)
+                unew[l] = unew[l] + du_rows.astype(u[l].dtype)
+                bf[l] = b_rows.astype(bf[l].dtype)
+            else:
+                grid = mu.MhdGrid(cfg=cfg, shape=shape, dx=dx(l),
+                                  bc_kinds=bc_kinds)
+                ud = jnp.moveaxis(
+                    K.rows_to_dense(u[l], d.get("inv_perm"), shape),
+                    -1, 0)
+                bld = K.rows_to_dense(bf[l], d.get("inv_perm"),
+                                      shape)           # [*shape, 3, 2]
+                bfd = jnp.stack([bld[..., c, 0] for c in range(NCOMP)])
+                ok_d = (d["ok_dense"].reshape(shape)
+                        if d.get("ok_dense") is not None else None)
+                override = None
+                if child_emf is not None:
+                    idx = dev[levels[i + 1]].get("emf_dense_idx")
+                    if idx is not None:
+                        override = {}
+                        for pi, pair in enumerate(pairs):
+                            rows = idx[:, pi].reshape(-1)
+                            vals = jnp.zeros(
+                                (ncell,), child_emf.dtype).at[rows].set(
+                                    child_emf[:, pi].reshape(-1),
+                                    mode="drop")
+                            msk = jnp.zeros((ncell,), bool).at[rows].set(
+                                True, mode="drop")
+                            override[pair] = (msk.reshape(shape),
+                                              vals.reshape(shape))
+                un_d, bfn_d = mu.step(grid, ud, bfd, dtl, ok=ok_d,
+                                      emf_override=override)
+                du_rows = K.dense_to_rows(
+                    jnp.moveaxis(un_d - ud, 0, -1), d.get("perm"), shape)
+                if u[l].shape[0] > ncell:
+                    du_rows = jnp.zeros_like(u[l]).at[:ncell].set(
+                        du_rows.astype(u[l].dtype))
+                unew[l] = unew[l] + du_rows
+                comps = []
+                for c in range(NCOMP):
+                    lo_d = bfn_d[c]
+                    if c < nd:
+                        hi_d = _dense_hi(lo_d, c, bc_kinds[c][0] == 0)
+                    else:
+                        hi_d = lo_d
+                    comps.append(jnp.stack([lo_d, hi_d], axis=-1))
+                b_rows = K.dense_to_rows(jnp.stack(comps, axis=-2),
+                                         d.get("perm"), shape)
+                bf[l] = (bf[l].at[:ncell].set(b_rows.astype(bf[l].dtype))
+                         if bf[l].shape[0] > ncell
+                         else b_rows.astype(bf[l].dtype))
         else:
             if l == spec.lmin:
                 interp_u = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
@@ -782,7 +820,16 @@ class MhdAmrSim(AmrSim):
         father-cell edges onto the parent's dense corner lattice
         (corner of cell (i,j,…) ↔ array position (i,j,…)).  Out-of-
         domain corners (non-periodic walls) get an out-of-range index
-        so the device scatter drops them."""
+        so the device scatter drops them.
+
+        Two index layouts per level: ``emf_dense_idx`` (C-order ravel
+        of the parent's dense box — the global-view ``mu.step`` path)
+        and ``emf_flat_idx`` (the parent's Morton FLAT row order,
+        :func:`ramses_tpu.amr.bitperm.flat_index_np`) — the
+        slab-sharded CT path scatters the override into row-sharded
+        flat arrays OUTSIDE the shard_map, so no global index scatter
+        ever enters the partitioned program."""
+        from ramses_tpu.amr import bitperm
         nd = self.tree_ndim
         pairs = [(d1, d2) for d1 in range(nd)
                  for d2 in range(d1 + 1, nd)]
@@ -793,6 +840,7 @@ class MhdAmrSim(AmrSim):
             if (not pairs or l == self.lmin or self.maps[l].complete
                     or not self.maps[l - 1].complete):
                 d.pop("emf_dense_idx", None)
+                d.pop("emf_flat_idx", None)
                 continue
             og = self.tree.levels[l].og        # father cells at l-1
             noct = len(og)
@@ -801,6 +849,8 @@ class MhdAmrSim(AmrSim):
             m = self.maps[l]
             idx = np.full((m.noct_pad, len(pairs), 2, 2), ncell1,
                           dtype=np.int64)
+            fidx = np.full_like(idx, ncell1)
+            cubic = tuple(self.root or (1,) * nd) == (1,) * nd
             for pi, (d1, d2) in enumerate(pairs):
                 for o1 in (0, 1):
                     for o2 in (0, 1):
@@ -820,7 +870,29 @@ class MhdAmrSim(AmrSim):
                             (n1,) * nd)
                         idx[:noct, pi, o1, o2] = np.where(oob, ncell1,
                                                           flat)
+                        if cubic:
+                            mflat = bitperm.flat_index_np(cc, l - 1, nd)
+                            fidx[:noct, pi, o1, o2] = np.where(
+                                oob, ncell1, mflat)
+                # shared corners are written by up to 2^(nd-1) fine
+                # octs; their values agree only to roundoff, so the
+                # scatter winner would be resolution-order dependent.
+                # Keep ONE canonical writer (first in oct enumeration)
+                # and drop the rest — applied identically to both
+                # layouts so dense and flat scatters stay bitwise equal.
+                v = idx[:noct, pi].reshape(-1).copy()
+                _, first = np.unique(v, return_index=True)
+                dup = np.ones(v.size, dtype=bool)
+                dup[first] = False
+                oi, a1, a2 = np.unravel_index(np.flatnonzero(dup),
+                                              (noct, 2, 2))
+                idx[oi, pi, a1, a2] = ncell1
+                fidx[oi, pi, a1, a2] = ncell1
             d["emf_dense_idx"] = self._place(jnp.asarray(idx), "octs")
+            if cubic:
+                d["emf_flat_idx"] = self._place(jnp.asarray(fidx), "octs")
+            else:
+                d.pop("emf_flat_idx", None)
 
     # ---- transfer operators ------------------------------------------
     def _restrict_all(self):
@@ -921,10 +993,11 @@ class MhdAmrSim(AmrSim):
                 complete=tuple(self.maps[l].complete for l in lv),
                 gravity=self.gravity,
                 itype=int(self.params.refine.interpol_type))
-            # slab-sharded complete-level FLAGS only: the CT advance
-            # keeps the global-view path (its EMF override is a global
-            # index scatter), so only the gradient-flag evaluation gets
-            # the explicit formulation on a multi-device mesh
+            # slab-sharded complete levels: gradient flags AND the CT
+            # advance (mhd_ct_slab — the EMF override scatters into
+            # flat rows via emf_flat_idx, so no global index scatter
+            # remains); levels whose local box is too thin for the
+            # deeper face halos fall back at advance time (mhd_slab_ok)
             slab = tuple(self._slab_spec(l) if self.maps[l].complete
                          else None for l in lv)
             if any(s is not None for s in slab):

@@ -173,9 +173,14 @@ def ct_core(up, bfp, dt, dx: Sequence[float], cfg: MhdStatic,
         ur_c = core.prim_to_cons(q_lo, cfg) + du_half    # this cell's lo face
         ql = core.ctoprim(jnp.roll(ul_c, 1, axis=ax), cfg)
         qr = core.ctoprim(ur_c, cfg)
-        perm = jnp.array(_rot_perm(cfg, d))
+        # static per-row stack, not a gather with an index array: the
+        # Pallas CT kernel traces this body and may not close over
+        # constants, and XLA folds the stack to the same copies anyway
+        perm = _rot_perm(cfg, d)
+        ql_r = jnp.stack([ql[i] for i in perm])
+        qr_r = jnp.stack([qr[i] for i in perm])
         bn = bf_half[d]                # staggered, half-dt predicted
-        fg = rsolve.solve(ql[perm], qr[perm], bn, cfg)
+        fg = rsolve.solve(ql_r, qr_r, bn, cfg)
         # scatter to state layout
         out = [None] * cfg.nvar
         t1, t2 = (d + 1) % 3, (d + 2) % 3
@@ -298,6 +303,50 @@ def ct_core(up, bfp, dt, dx: Sequence[float], cfg: MhdStatic,
     return un, bfn, fl_cell, e_edges
 
 
+def step_padded(cfg: MhdStatic, dx: Sequence[float], up, bfp_ext, dt,
+                okp=None, ovr=None):
+    """The CT step on ALREADY ghost-assembled arrays — the single
+    pipeline behind :func:`step` (global pad), the slab-sharded advance
+    (:func:`ramses_tpu.parallel.dense_slab.mhd_ct_slab`, halo-exchanged
+    ghosts) and the single-block Pallas kernel
+    (:mod:`ramses_tpu.mhd.pallas_ct`).
+
+    ``up`` [nvar, \\*sp+2·ng] padded cell conservative with the RAW
+    (uncentered) B slots — the face-average centering happens here;
+    ``bfp_ext`` [NCOMP, \\*sp+2·(ng+1)] low faces padded one layer
+    deeper (the centred average must be valid in every padded cell);
+    ``okp`` optional padded bool refined mask [\\*sp+2·ng]; ``ovr``
+    optional dict (d1,d2) → (padded mask, padded values) on the padded
+    corner lattice.  Returns the PADDED (un, bfn_list) — callers
+    unpad."""
+    nd = cfg.ndim
+    trim = tuple([slice(None)] + [slice(1, -1)] * nd)
+    bfp = bfp_ext[trim]
+    bc = []
+    for c in range(NCOMP):
+        b = bfp_ext[c]
+        lo = b[tuple(slice(1, -1) for _ in range(nd))]
+        if c < nd:
+            hi_idx = [slice(1, -1)] * nd
+            hi_idx[c] = slice(2, None)      # neighbour's low face = high face
+            bc.append(0.5 * (lo + b[tuple(hi_idx)]))
+        else:
+            bc.append(lo)
+    up = up.at[IBX:IBX + NCOMP].set(jnp.stack(bc))
+
+    flux_mask = None
+    if okp is not None:
+        flux_mask = []
+        for d in range(nd):
+            ax = okp.ndim - nd + d
+            keep = ~(okp | jnp.roll(okp, 1, axis=ax))
+            flux_mask.append(keep.astype(up.dtype))
+    un, bfn, _fluxes, _e = ct_core(up, [bfp[c] for c in range(NCOMP)],
+                                   dt, dx, cfg, flux_mask=flux_mask,
+                                   emf_override=ovr)
+    return un, bfn
+
+
 def step(grid: MhdGrid, u, bf, dt, ok=None, emf_override=None):
     """One CT MUSCL-Hancock step.  ``u`` [nvar, *sp] cell conservative
     (B slots cell-centered, derived), ``bf`` [3, *sp] staggered low-face
@@ -316,37 +365,16 @@ def step(grid: MhdGrid, u, bf, dt, ok=None, emf_override=None):
     # in EVERY padded cell (a rolled average would wrap garbage into the
     # outermost ghosts and contaminate boundary-face slopes)
     bfp_ext = _pad(bf, nd, grid.bc_kinds, ng + 1)
-    trim = tuple([slice(None)] + [slice(1, -1)] * nd)
-    bfp = bfp_ext[trim]
-    bc = []
-    for c in range(NCOMP):
-        b = bfp_ext[c]
-        lo = b[tuple(slice(1, -1) for _ in range(nd))]
-        if c < nd:
-            hi_idx = [slice(1, -1)] * nd
-            hi_idx[c] = slice(2, None)      # neighbour's low face = high face
-            bc.append(0.5 * (lo + b[tuple(hi_idx)]))
-        else:
-            bc.append(lo)
-    up = up.at[IBX:IBX + NCOMP].set(jnp.stack(bc))
-
-    flux_mask = None
+    okp = None
     if ok is not None:
         okp = _pad(ok[None], nd, grid.bc_kinds)[0]
-        flux_mask = []
-        for d in range(nd):
-            ax = okp.ndim - nd + d
-            keep = ~(okp | jnp.roll(okp, 1, axis=ax))
-            flux_mask.append(keep.astype(up.dtype))
     ovr = None
     if emf_override is not None:
         ovr = {}
         for pair, (msk, vals) in emf_override.items():
             ovr[pair] = (_pad(msk[None], nd, grid.bc_kinds)[0],
                          _pad(vals[None], nd, grid.bc_kinds)[0])
-    un, bfn, _fluxes, _e = ct_core(up, [bfp[c] for c in range(NCOMP)],
-                                   dt, dx, cfg, flux_mask=flux_mask,
-                                   emf_override=ovr)
+    un, bfn = step_padded(cfg, dx, up, bfp_ext, dt, okp=okp, ovr=ovr)
     u_out = _unpad(un, nd)
     bf_out = jnp.stack([_unpad(b, nd) for b in bfn])
     return u_out, bf_out

@@ -9,7 +9,11 @@ offset ``lax.ppermute`` schedules:
 * P2 (halo): each shard's 6^d stencil references rows of the SAME level
   owned by other shards, and its ghost-interpolation requests reference
   rows of the COARSER level — both become packed row buffers sent along
-  the Hilbert ring (``make_virtual_fine_dp``, ``:373-533``).
+  the Hilbert ring (``make_virtual_fine_dp``, ``:373-533``).  The
+  permutes ride the backend-dispatched exchange engine
+  (:mod:`ramses_tpu.parallel.dma_halo`): async remote-copy DMA on TPU,
+  ``lax.ppermute`` elsewhere, per the ``&AMR_PARAMS halo_backend``
+  knob resolved into :class:`SweepCommSpec`.
 * P3 (reverse): coarse flux-correction contributions are packed per
   owner, permuted back, and folded into the owner's block in a FIXED
   order — own entries first, then ring offsets ascending — the
@@ -37,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ramses_tpu.parallel import dma_halo
+
 AXIS = "oct"
 
 
@@ -47,14 +53,12 @@ class SweepCommSpec(NamedTuple):
     coarse_offsets: Tuple[int, ...]   # ring offsets carrying u_{l-1} rows
     corr_offsets: Tuple[int, ...]     # ring offsets carrying corr folds
     itype: int
+    backend: str = "ppermute"         # resolved halo backend (dma_halo)
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
-    try:
-        sm = jax.shard_map
-    except AttributeError:                      # pragma: no cover
-        from jax.experimental.shard_map import shard_map as sm
-    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+def _shard_map(fn, mesh, in_specs, out_specs, check_rep=True):
+    return dma_halo.shard_map_compat(fn, mesh, in_specs, out_specs,
+                                     check_rep=check_rep)
 
 
 def _halo_schedule(need: Dict[int, Dict[int, np.ndarray]], ndev: int):
@@ -91,10 +95,13 @@ def _build_need(rows_by_shard, owner_of, ndev):
     return need
 
 
-def build_sweep_comm(m, mc, ndev: int, mesh: Mesh, itype: int):
+def build_sweep_comm(m, mc, ndev: int, mesh: Mesh, itype: int,
+                     halo_backend: str = "auto"):
     """Schedule for one partial level l (maps ``m``) over coarse level
     l-1 (maps ``mc``).  Returns (SweepCommSpec, dict of numpy arrays
-    [ndev, ...]) or None when ndev == 1."""
+    [ndev, ...]) or None when ndev == 1.  ``halo_backend``: the
+    ``&AMR_PARAMS`` knob, resolved here so the sweep's permutes
+    dispatch to the DMA engine on TPU."""
     if ndev == 1:
         return None
     nd = m.ndim
@@ -259,7 +266,8 @@ def build_sweep_comm(m, mc, ndev: int, mesh: Mesh, itype: int):
 
     spec = SweepCommSpec(mesh=mesh, fine_offsets=tuple(foffs),
                          coarse_offsets=tuple(coffs),
-                         corr_offsets=tuple(koffs), itype=itype)
+                         corr_offsets=tuple(koffs), itype=itype,
+                         backend=dma_halo.resolve_backend(halo_backend))
     arrays = dict(
         lsten=lsten, licell=licell, linb=linb, lisgn=lisgn,
         own_src=own_src_a, own_tgt=own_tgt_a, own_w=own_w_a,
@@ -306,18 +314,18 @@ def sweep_correct_explicit(u_l, u_lm1, unew_lm1, d: dict, dt, dx: float,
         corr_w = {k: next(it)[0] for k in spec.corr_offsets}
         corr_tgt = {k: next(it)[0] for k in spec.corr_offsets}
 
-        # P2: fine halo — pack own rows, permute along the ring
-        blocks = [u_loc]
-        for k in spec.fine_offsets:
-            blocks.append(jax.lax.ppermute(u_loc[fsend[k]], AXIS,
-                                           _perm(ndev, k)))
-        u_ext = jnp.concatenate(blocks, axis=0)
-        # P2: coarse halo for the ghost interpolation
-        cblocks = [uc_loc]
-        for k in spec.coarse_offsets:
-            cblocks.append(jax.lax.ppermute(uc_loc[csend[k]], AXIS,
-                                            _perm(ndev, k)))
-        uc_ext = jnp.concatenate(cblocks, axis=0)
+        # P2: fine + coarse halos — pack own rows, move them along the
+        # ring in ONE fused backend exchange (every offset's buffer is
+        # a separate slab of the same DMA kernel on TPU)
+        halo = dma_halo.exchange_slabs(
+            [u_loc[fsend[k]] for k in spec.fine_offsets]
+            + [uc_loc[csend[k]] for k in spec.coarse_offsets],
+            [_perm(ndev, k) for k in spec.fine_offsets]
+            + [_perm(ndev, k) for k in spec.coarse_offsets],
+            AXIS, backend=spec.backend)
+        nf = len(spec.fine_offsets)
+        u_ext = jnp.concatenate([u_loc] + halo[:nf], axis=0)
+        uc_ext = jnp.concatenate([uc_loc] + halo[nf:], axis=0)
 
         interp = K.interp_cells(uc_ext, licell, linb,
                                 lisgn.astype(u_loc.dtype), cfg,
@@ -331,11 +339,15 @@ def sweep_correct_explicit(u_l, u_lm1, unew_lm1, d: dict, dt, dx: float,
         cflat = corr.reshape(-1, corr.shape[-1])
         unew_loc = unew_loc.at[own_tgt].add(
             (cflat[own_src] * own_w[:, None]).astype(unew_loc.dtype))
-        for k in spec.corr_offsets:
-            vals = cflat[corr_send[k]] * corr_w[k][:, None]
-            got = jax.lax.ppermute(vals, AXIS, _perm(ndev, k))
-            unew_loc = unew_loc.at[corr_tgt[k]].add(
-                got.astype(unew_loc.dtype))
+        if spec.corr_offsets:
+            gots = dma_halo.exchange_slabs(
+                [cflat[corr_send[k]] * corr_w[k][:, None]
+                 for k in spec.corr_offsets],
+                [_perm(ndev, k) for k in spec.corr_offsets],
+                AXIS, backend=spec.backend)
+            for k, got in zip(spec.corr_offsets, gots):
+                unew_loc = unew_loc.at[corr_tgt[k]].add(
+                    got.astype(unew_loc.dtype))
         return du, unew_loc
 
     sched_names = (["lsten", "licell", "linb", "lisgn", "own_src",
@@ -353,6 +365,7 @@ def sweep_correct_explicit(u_l, u_lm1, unew_lm1, d: dict, dt, dx: float,
         body, mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS))
         + (P(AXIS),) * len(sched),
-        out_specs=(P(AXIS), P(AXIS)))
+        out_specs=(P(AXIS), P(AXIS)),
+        check_rep=(spec.backend != "dma"))
     return fn(u_l, u_lm1, unew_lm1, jnp.asarray(dt), vsgn, d["ok_ref"],
               *sched)

@@ -27,17 +27,24 @@ rebuilt against the new cuts.
 
 Two comm backends coexist: the default global-view formulation (GSPMD
 inserts the collectives) and, with ``explicit_comm=True``, precomputed
-per-shard halo schedules for partial levels — ring-offset ``ppermute``
-halos plus a deterministic owner-fold, rebuilt at regrid like the
-reference's ``build_comm`` (:mod:`ramses_tpu.parallel.amr_comm`; the
-uniform path's analogue is :mod:`ramses_tpu.parallel.halo`).  Complete
-levels take the EXPLICIT slab-sharded dense path whenever the level is
-a fully periodic unpadded power-of-two cube on a power-of-two device
+per-shard halo schedules for partial levels — ring-offset halos plus a
+deterministic owner-fold, rebuilt at regrid like the reference's
+``build_comm`` (:mod:`ramses_tpu.parallel.amr_comm`; the uniform
+path's analogue is :mod:`ramses_tpu.parallel.halo`).  Complete levels
+take the EXPLICIT slab-sharded dense path whenever the level is a
+fully periodic unpadded power-of-two cube on a power-of-two device
 count (:mod:`ramses_tpu.parallel.dense_slab`): shard-local bitperm +
-ring ``ppermute`` halos, so the GSPMD partitioner never sees the
-bit-interleaved transpose that previously degenerated to involuntary
-full rematerialization (MULTICHIP_r05).  Levels outside that envelope
-keep the global-view sweep with compiler-inserted collectives.
+ring halos, so the GSPMD partitioner never sees the bit-interleaved
+transpose that previously degenerated to involuntary full
+rematerialization (MULTICHIP_r05).  Levels outside that envelope keep
+the global-view sweep with compiler-inserted collectives.
+
+Every explicit ring halo above rides the backend-dispatched exchange
+engine (:mod:`ramses_tpu.parallel.dma_halo`): Pallas async
+remote-copy DMA kernels with comm/compute overlap on TPU,
+``lax.ppermute`` elsewhere, selected by the ``&AMR_PARAMS
+halo_backend`` knob (``auto``/``dma``/``ppermute``) — the two agree
+bitwise, so the choice is pure performance.
 
 Fault tolerance is inherited from :class:`~ramses_tpu.amr.hierarchy.
 AmrSim` unchanged: atomic manifest-validated dumps, the
@@ -136,7 +143,9 @@ class ShardedAmrSim(AmrSim):
         ncell_pad = self.maps[lvl].noct_pad * 2 ** self.cfg.ndim
         return dense_slab.build_slab_spec(
             self.mesh, lvl, self.cfg.ndim, shape, ncell_pad,
-            self.bc_kinds)
+            self.bc_kinds,
+            halo_backend=getattr(self.params.amr, "halo_backend",
+                                 "auto"))
 
     def _noct_pad(self, lvl: int, noct: int) -> int:
         """Bucketed oct count (with the base class's hysteresis) rounded
@@ -166,7 +175,9 @@ class ShardedAmrSim(AmrSim):
                 continue
             built = amr_comm.build_sweep_comm(
                 m, self.maps[l - 1], self.ndev, self.mesh,
-                int(self.params.refine.interpol_type))
+                int(self.params.refine.interpol_type),
+                halo_backend=getattr(self.params.amr, "halo_backend",
+                                     "auto"))
             if built is None:
                 # build_sweep_comm bails only for a 1-device mesh, and
                 # _explicit_comm requires ndev > 1 — anything else here
@@ -202,3 +213,17 @@ class ShardedAmrSim(AmrSim):
             return jax.device_put(arr, self._rep_sharding)
         return jax.device_put(arr, self._row_sharding if arr.ndim == 1
                               else self._row2_sharding)
+
+
+from ramses_tpu.mhd.amr import MhdAmrSim as _MhdAmrSim  # noqa: E402
+
+
+class ShardedMhdAmrSim(ShardedAmrSim, _MhdAmrSim):
+    """MHD AMR on a device mesh: the sharded state layout / placement /
+    slab machinery of :class:`ShardedAmrSim` composed with the CT
+    physics of :class:`ramses_tpu.mhd.amr.MhdAmrSim` (cooperative MRO —
+    both defer to :class:`~ramses_tpu.amr.hierarchy.AmrSim`).  Complete
+    levels run the slab-sharded CT advance
+    (:func:`ramses_tpu.parallel.dense_slab.mhd_ct_slab`) with the
+    Morton-flat EMF override, so the multichip gate sees no global
+    index scatter from the MHD path either."""

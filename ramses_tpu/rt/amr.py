@@ -418,10 +418,10 @@ class RtAmrCoupled:
                     sl = (sim._slab_spec(l) if spec.periodic else None)
                     if sl is not None:
                         # explicit slab-sharded transport: the GLF
-                        # stencil is 1-deep, so one ppermute halo ring
-                        # + the interior of an extended-box
-                        # transport_step reproduces the global result
-                        # (parallel/dense_slab.py)
+                        # stencil is 1-deep, so one ring halo (DMA or
+                        # ppermute per halo_backend) + the interior of
+                        # an extended-box transport_step reproduces the
+                        # global result (parallel/dense_slab.py)
                         from ramses_tpu.parallel import dense_slab
 
                         def _transport_local(ext, _dx=dx_cgs):

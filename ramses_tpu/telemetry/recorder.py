@@ -232,6 +232,12 @@ class Telemetry:
             self._fh = open(self.spec.path,
                             "a" if self._append else "w")
             atexit.register(self.close)
+            # refresh the halo traffic counters at header-write time:
+            # the header lands lazily with the first record, i.e. after
+            # the first step traced, so the per-step traced byte counts
+            # are populated by now (they are zero at sim construction)
+            from ramses_tpu.parallel import dma_halo
+            self.run_info.update(dma_halo.traffic_snapshot())
             self._fh.write(json.dumps({
                 "kind": "run_header",
                 "schema_version": SCHEMA_VERSION,
@@ -368,6 +374,13 @@ class Telemetry:
             "recompiles_total": ncomp,
         }
         self._compiles_last = ncomp
+        if rec["phases_s"]:
+            # timers on: surface how much of each exchanged slab the
+            # overlap split computes behind the in-flight DMA (0.0 on
+            # the ppermute path or when shards are stencil-thin)
+            from ramses_tpu.parallel import dma_halo
+            rec["halo_overlap_frac"] = \
+                dma_halo.traffic_snapshot()["halo_overlap_frac"]
         if chunked:
             rec["chunked"] = int(chunked)
         bs = getattr(sim, "balance_stats", None)
@@ -489,9 +502,12 @@ def sim_run_info(sim) -> Dict[str, Any]:
         "ndev": int(getattr(sim, "ndev", 1)),
     }
     if p is not None:
+        from ramses_tpu.parallel import dma_halo
         info.update(ndim=int(p.ndim), levelmin=int(p.amr.levelmin),
                     levelmax=int(p.amr.levelmax),
-                    boxlen=float(p.amr.boxlen))
+                    boxlen=float(p.amr.boxlen),
+                    halo_backend=dma_halo.resolve_backend(
+                        getattr(p.amr, "halo_backend", "auto")))
     cfg = getattr(sim, "cfg", None)
     if cfg is not None and hasattr(cfg, "nvar"):
         info["nvar"] = int(cfg.nvar)

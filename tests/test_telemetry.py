@@ -366,3 +366,20 @@ def test_bench_timeout_without_heartbeat(tmp_path, monkeypatch):
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     r = bench.run_sub("mg", deadline=time.monotonic() + 1000.0)
     assert "no heartbeat" in r["phase_at_timeout"]
+
+
+def test_run_header_carries_halo_fields(tmp_path):
+    """Every run_header names the resolved halo backend and the traced
+    per-step halo traffic (zero on the GSPMD path, populated once the
+    explicit slab pipeline traces)."""
+    sim = _amr_sim(tmp_path, nstep=2)
+    sim.evolve(1e9, nstepmax=2)
+    sim.telemetry.close(sim, print_timers=False)
+    recs = _records(tmp_path / "run.jsonl")
+    info = recs[0]["run_info"]
+    assert info["halo_backend"] == "ppermute"      # CPU: auto -> ppermute
+    for k in ("halo_bytes", "halo_exchanges", "halo_overlap_frac"):
+        assert k in info
+    # timers are live in this driver -> per-step overlap fraction lands
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert all("halo_overlap_frac" in r for r in steps if r["phases_s"])
