@@ -373,6 +373,17 @@ def bench_amr(params, dtype, jnp, hb=lambda *a, **k: None):
     wprod = time.perf_counter() - t0
     hb("production")
 
+    # per-phase regrid wallclock (flag / balance / maps / migrate /
+    # upload — hierarchy.regrid timer sections), folded out of the
+    # mixed timer dicts so the regrid cost trend is directly readable:
+    # "growth" covers the cadenced-growth window, "production" the
+    # regrid-every-step window above
+    def _regrid_fold(acc):
+        return {k[len("regrid: "):]: round(float(v), 3)
+                for k, v in acc.items() if k.startswith("regrid: ")}
+    regrid_phases = {"growth": _regrid_fold(growth_timers),
+                     "production": _regrid_fold(sim.timers.acc)}
+
     # run-to-run determinism: the same 3 steps from the same state must
     # be BITWISE identical on this device (north-star "bitwise-stable")
     import numpy as np
@@ -397,6 +408,8 @@ def bench_amr(params, dtype, jnp, hb=lambda *a, **k: None):
         "refined_update_fraction": upd_fine / max(updates, 1),
         "timers_s": growth_timers,
         "timers_instrumented_s": inst_timers,
+        "regrid_phase_s": regrid_phases,
+        "blocked_frac": float(sim.block_stats.get("blocked_frac", 1.0)),
         "octs_per_level": {l: sim.tree.noct(l) for l in sim.levels()},
         "leaf_cells": sim.ncell_leaf(),
         "tunnel_rtt_s": measure_rtt(jnp),

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import time
 from contextlib import nullcontext
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, NamedTuple, Optional
 
 import jax
@@ -103,6 +103,10 @@ class FusedSpec(NamedTuple):
     blocked: tuple = ()
     # octs per tile side = 2**block_shift for the blocked levels
     block_shift: int = 2
+    # allow the Pallas tile kernel inside K.tile_sweep (single-device
+    # meshes); multi-device row-sharded trees force the XLA tile
+    # formulation so GSPMD can partition the sweep
+    pallas_tiles: bool = True
 
 
 def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
@@ -182,7 +186,7 @@ def _advance_traced(u, dev, fg, dt, spec: FusedSpec, cool_tables=None):
                 u[l], interp, d["tile_src"], d["tile_vsgn"], d["tile_ok"],
                 d["cell_tile"], d["cell_slot"], d["oct_tile"],
                 d["oct_slot"], dtl, dx(l), cfg, spec.block_shift,
-                ret_flux=spec.want_flux)
+                ret_flux=spec.want_flux, pallas_ok=spec.pallas_tiles)
             # pad cell rows index the kernels' appended zero column
             # (maps.py), so du/phi pad rows are exactly 0 — no masking
             du, corr = out[0], out[1]
@@ -283,6 +287,24 @@ def _migrate_level(old_u, u_coarse, rows_d, rows_s, cell_rep, nb_rep,
     vals = K.interp_cells(u_coarse, cell_rep, nb_rep, sgn_rep, cfg,
                           itype=itype)
     return buf.at[rows_new].set(vals.astype(buf.dtype), mode="drop")
+
+
+@lru_cache(maxsize=None)
+def _mig_consts(ndim: int):
+    """Per-ndim constant migrate tables (child offsets, ±1 prolongation
+    signs, intra-oct arange) — built once instead of on every regrid."""
+    offs = cell_offsets(ndim)
+    return offs, (offs * 2 - 1).astype(np.float64), np.arange(1 << ndim)
+
+
+@partial(jax.jit, static_argnames=("ttd",))
+def _pack_flag_bits(flags, ttd: int):
+    """Bitpack per-oct refinement flags ([n, 2^d] bool each) into one
+    uint8 per oct, so the regrid flag fetch moves 2^d× fewer bytes over
+    the (remote-tunnel) device link."""
+    shifts = jnp.arange(ttd, dtype=jnp.uint32)
+    return tuple((fl.astype(jnp.uint32) << shifts[None, :])
+                 .sum(axis=1).astype(jnp.uint8) for fl in flags)
 
 
 @partial(jax.jit, static_argnames=("spec", "eg", "fls", "itype"))
@@ -507,9 +529,12 @@ class AmrSim:
 
     _needs_mig_log = False
     ndev = 1          # device count of the row sharding (sharded subclass)
-    # gather-fused blocked tile sweep on partial levels: solver families
-    # with their own partial-level gather (MHD faces) and row-sharded
-    # sims (GSPMD owns the gather) opt out
+    # gather-fused blocked tile sweep on partial levels: the universal
+    # default — hydro, MHD (XLA tile formulation), load-balance layouts
+    # (tables layout-composed at emission time), and row-sharded meshes
+    # all take it; only explicit-comm schedules keep the stencil path
+    # (their per-shard owner-fold owns the gather).  Attr so a solver
+    # family can still opt out wholesale.
     _oct_blocked = True
     # solver families whose state layout differs from the hydro
     # [rho, mom, E, ...] convention opt out of the shared SF/sink passes
@@ -924,16 +949,21 @@ class AmrSim:
 
     def _block_level_ok(self, l: int) -> bool:
         """Gate: is a PARTIAL level eligible for the gather-fused blocked
-        tile sweep?  Load-balance layouts permute oct rows (breaking the
-        Morton-contiguous tile property) and explicit comm schedules own
-        their own gather, so both keep the 6^d stencil path."""
+        tile sweep?  Universal since the layouts/sharded/MHD lift: tiles
+        are always built in tree/Morton order and composed with
+        row-permutation layouts at table-emission time
+        (``balance.apply_layout_blocks``), and row-sharded meshes run the
+        XLA tile formulation GSPMD can partition
+        (``FusedSpec.pallas_tiles``).  Documented carve-out: explicit
+        comm schedules keep the 6^d stencil path —
+        ``amr_comm.sweep_correct_explicit`` owns both the per-shard
+        gather and the deterministic owner-fold, and ``_advance_traced``
+        dispatches the comm branch before the blocked one."""
         if not self._oct_blocked:
             return False
         if not bool(getattr(self.params.amr, "oct_blocking", True)):
             return False
         if getattr(self, "_comm_specs", {}):
-            return False
-        if any(self.layouts.get(j) is not None for j in (l - 1, l, l + 1)):
             return False
         return True
 
@@ -1046,27 +1076,32 @@ class AmrSim:
                     shift=int(getattr(self.params.amr,
                                       "oct_block_shift", 2)),
                     noct_pad=m.noct_pad, prev=prev_blocks.get(l))
+                # cached/prev-reused in TREE order; layout-composed copy
+                # (flat-row values and scatter rows permuted, tile
+                # geometry untouched) is what ships to the device
                 self.blocks[l] = b
                 self.block_stats["blocks_total"] += b.ntile
                 self.block_stats["blocks_rebuilt"] += b.blocks_rebuilt
+                bt = (balance.apply_layout_blocks(b, lay_m1, lay_l)
+                      if (lay_m1 is not None or lay_l is not None) else b)
                 self.dev[l].update(
-                    tile_src=self._place(jnp.asarray(b.tile_src), "rep"),
-                    tile_vsgn=(self._place(jnp.asarray(b.tile_vsgn),
-                                           "rep")
-                               if b.tile_vsgn is not None else None),
-                    tile_ok=self._place(jnp.asarray(b.tile_ok), "rep"),
-                    cell_tile=self._place(jnp.asarray(b.cell_tile),
+                    tile_src=self._place(jnp.asarray(bt.tile_src), "octs"),
+                    tile_vsgn=(self._place(jnp.asarray(bt.tile_vsgn),
+                                           "octs")
+                               if bt.tile_vsgn is not None else None),
+                    tile_ok=self._place(jnp.asarray(bt.tile_ok), "octs"),
+                    cell_tile=self._place(jnp.asarray(bt.cell_tile),
                                           "cells"),
-                    cell_slot=self._place(jnp.asarray(b.cell_slot),
+                    cell_slot=self._place(jnp.asarray(bt.cell_slot),
                                           "cells"),
-                    oct_tile=self._place(jnp.asarray(b.oct_tile), "octs"),
-                    oct_slot=self._place(jnp.asarray(b.oct_slot), "octs"),
+                    oct_tile=self._place(jnp.asarray(bt.oct_tile), "octs"),
+                    oct_slot=self._place(jnp.asarray(bt.oct_slot), "octs"),
                     b_interp_cell=self._place(
-                        jnp.asarray(b.interp_cell), "rep"),
-                    b_interp_nb=self._place(jnp.asarray(b.interp_nb),
+                        jnp.asarray(bt.interp_cell), "rep"),
+                    b_interp_nb=self._place(jnp.asarray(bt.interp_nb),
                                             "rep"),
                     b_interp_sgn=self._place(
-                        jnp.asarray(b.interp_sgn, dtype=self.dtype),
+                        jnp.asarray(bt.interp_sgn, dtype=self.dtype),
                         "rep"),
                 )
             if self.gravity:
@@ -1090,6 +1125,13 @@ class AmrSim:
                                             "octs" if j == 0 else "rep"))
                                for j, (nb_j, par_j, _n)
                                in enumerate(g.mg)))
+        # coverage telemetry: fraction of partial-level octs swept via
+        # the blocked tile path (1.0 when every partial level is blocked
+        # or there is none to block)
+        part = [l for l, lm in self.maps.items() if not lm.complete]
+        tot = sum(self.tree.noct(l) for l in part)
+        blk = sum(self.tree.noct(l) for l in part if l in self.blocks)
+        self.block_stats["blocked_frac"] = (blk / tot) if tot else 1.0
 
     # ------------------------------------------------------------------
     # cosmology helpers (host interpolation of the Friedmann tables)
@@ -1186,11 +1228,17 @@ class AmrSim:
     def _flag_and_tree(self) -> Octree:
         r = self.params.refine
         spec = self._fused_spec()
-        flags = jax.device_get(self._criteria_flags(spec))  # ONE trip
+        ttd = 2 ** self.tree_ndim
+        # flags bitpacked on device (one uint8 per oct) so the single
+        # flag fetch — the only device→host copy of a steady regrid —
+        # moves 2^d× fewer bytes; unpacked to per-cell bools below
+        flags = jax.device_get(_pack_flag_bits(
+            self._criteria_flags(spec), ttd))           # ONE trip
         crit: Dict[int, np.ndarray] = {}
         for fl, l in zip(flags, spec.levels):
             m = self.maps[l]
-            fl = np.asarray(fl)
+            fl = ((np.asarray(fl)[:, None] >> np.arange(ttd)) & 1) \
+                .astype(bool)
             if l in self.layouts:      # rows → tree oct order first
                 fl = fl[self.layouts[l].oct_row]
             else:
@@ -1226,6 +1274,26 @@ class AmrSim:
             return flagmod.compute_new_tree(self.tree, crit, self.bc_kinds,
                                             self.params)
 
+    def _bc_sig(self) -> tuple:
+        """Hashable (lo, hi) bc-kind tuple per dim — jit static key."""
+        return tuple(tuple(int(k) for k in f) for f in self.bc_kinds)
+
+    def _device_regrid_ok(self) -> bool:
+        """Gate for the jitted device-resident migrate
+        (``amr/device_regrid.py``).  Families that replay migration into
+        side-channel state (MHD face fields, RT) need the host prolong
+        maps (``_mig_log``), and layout-permuted levels keep the host
+        path (the row-remap tables are host objects) — both fall back to
+        the bitwise-identical host reference, as does a key range too
+        deep for the device integer width."""
+        if not bool(getattr(self.params.amr, "device_regrid", True)):
+            return False
+        if self._needs_mig_log:
+            return False
+        from ramses_tpu.amr import device_regrid as dregrid
+        return dregrid.keys_fit(self.tree_ndim, max(self.levels()),
+                                self.root)
+
     def regrid(self):
         """Flag, rebuild the tree, and migrate device state
         (``flag_fine`` + ``refine_fine``/``kill_grid``,
@@ -1254,25 +1322,65 @@ class AmrSim:
                 self.block_stats = {
                     "blocks_total": sum(b.ntile
                                         for b in self.blocks.values()),
-                    "blocks_rebuilt": 0}
+                    "blocks_rebuilt": 0,
+                    "blocked_frac": self.block_stats.get(
+                        "blocked_frac", 1.0)}
             return
         with self.timers.section("regrid: maps"):
             self._rebuild_maps(oldtree, old_maps, old_dev)
         self.timers.timer("regrid: migrate")
         twotondim = 2 ** self.cfg.ndim
-        offs = cell_offsets(self.cfg.ndim)
+        offs, sgn_tab, oct_ar = _mig_consts(self.cfg.ndim)
         self._mig_log = {}
+        dregrid = None
+        if self._device_regrid_ok():
+            from ramses_tpu.amr import device_regrid as dregrid
+        dev_keys: Dict[tuple, jnp.ndarray] = {}
+
+        def _keys_dev(tree_, l_, pad_):
+            kk = (id(tree_), l_, pad_)
+            if kk not in dev_keys:
+                kn = (tree_.levels[l_].keys if tree_.has(l_)
+                      else np.zeros(0, np.int64))
+                dev_keys[kk] = dregrid.upload_keys(kn, pad_)
+            return dev_keys[kk]
+
         new_u: Dict[int, jnp.ndarray] = {}
         for l in self.levels():
             m = self.maps[l]
             lay_new = self.layouts.get(l)
             lay_old = old_layouts.get(l)
+            lay_m1 = self.layouts.get(l - 1)
             same_lay = (balance.layout_sig(lay_new)
                         == balance.layout_sig(lay_old))
             if (l == self.lmin or self._keys_same(oldtree, l)) \
                     and same_lay and old_u[l].shape[0] == m.ncell_pad:
                 # identical oct set and identical padded layout: reuse
                 new_u[l] = old_u[l]
+                continue
+            if dregrid is not None and lay_new is None \
+                    and lay_old is None and lay_m1 is None:
+                # device-resident migrate: survivor copy + new-oct
+                # prolongation maps derived on device from the sorted
+                # level key arrays (amr/device_regrid.py) — no per-level
+                # host table construction, bitwise-identical to the
+                # host reference path below
+                old = old_u.get(l)
+                if old is None:
+                    old = jnp.zeros((1, new_u[l - 1].shape[1]),
+                                    self.dtype)
+                onoct = oldtree.noct(l) if oldtree.has(l) else 0
+                new_u[l] = self._place(dregrid.migrate_level(
+                    old, new_u[l - 1],
+                    _keys_dev(self.tree, l, m.noct_pad),
+                    _keys_dev(oldtree, l,
+                              mapmod.bucket(max(onoct, 1), 8)),
+                    _keys_dev(self.tree, l - 1,
+                              self.maps[l - 1].noct_pad),
+                    m.ncell_pad, self.cfg.ndim, self._bc_sig(),
+                    tuple(int(n) for n in self.tree.cell_dims(l - 1)),
+                    self.cfg,
+                    int(self.params.refine.interpol_type)), "cells")
                 continue
             cd, cs, new_octs, f_cell, nb = mapmod.build_prolong_maps(
                 self.tree, oldtree, l, self.bc_kinds)
@@ -1287,7 +1395,6 @@ class AmrSim:
             else:
                 cd_r, new_r = cd, new_octs
             cs_r = lay_old.oct_row[cs] if lay_old is not None else cs
-            lay_m1 = self.layouts.get(l - 1)
             if lay_m1 is not None:
                 f_cell = balance.remap_cells(f_cell, lay_m1, twotondim)
                 nb = balance.remap_cells(nb, lay_m1, twotondim)
@@ -1302,20 +1409,19 @@ class AmrSim:
             rows_s = np.zeros(cpad, dtype=np.int64)
             if ncopy:
                 rows_d[:ncopy] = (cd_r[:, None] * twotondim
-                                  + np.arange(twotondim)).reshape(-1)
+                                  + oct_ar).reshape(-1)
                 rows_s[:ncopy] = (cs_r[:, None] * twotondim
-                                  + np.arange(twotondim)).reshape(-1)
+                                  + oct_ar).reshape(-1)
             cell_rep = np.zeros(npad, dtype=np.int64)
             nb_rep = np.zeros((npad, self.cfg.ndim, 2), dtype=np.int64)
             sgn_rep = np.ones((npad, self.cfg.ndim))
             rows_new = np.full(npad, m.ncell_pad, dtype=np.int64)  # drop
             if nnew:
-                sgn = (offs * 2 - 1).astype(np.float64)   # [2^d, ndim]
                 cell_rep[:nnew] = np.repeat(f_cell, twotondim)
                 nb_rep[:nnew] = np.repeat(nb, twotondim, axis=0)
-                sgn_rep[:nnew] = np.tile(sgn, (len(new_octs), 1))
+                sgn_rep[:nnew] = np.tile(sgn_tab, (len(new_octs), 1))
                 rows_new[:nnew] = (new_r[:, None] * twotondim
-                                   + np.arange(twotondim)).reshape(-1)
+                                   + oct_ar).reshape(-1)
             old = old_u.get(l)
             if old is None:
                 old = jnp.zeros((1, new_u[l - 1].shape[1]), self.dtype)
@@ -1401,7 +1507,8 @@ class AmrSim:
                 self._spec = self._spec._replace(
                     blocked=blocked,
                     block_shift=int(getattr(self.params.amr,
-                                            "oct_block_shift", 2)))
+                                            "oct_block_shift", 2)),
+                    pallas_tiles=(int(getattr(self, "ndev", 1)) == 1))
         return self._spec
 
     def _slab_spec(self, l: int):

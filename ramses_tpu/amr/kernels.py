@@ -324,11 +324,12 @@ def _corr_from_planes(planes, d, ndim: int, c: int):
     return _oct_rows(lo), _oct_rows(hi)
 
 
-@partial(jax.jit, static_argnames=("cfg", "dx", "shift", "ret_flux"))
+@partial(jax.jit, static_argnames=("cfg", "dx", "shift", "ret_flux",
+                                   "pallas_ok"))
 def tile_sweep(u_flat, interp_vals, tile_src, tile_vsgn, tile_ok,
                cell_tile, cell_slot, oct_tile, oct_slot,
                dt, dx: float, cfg: HydroStatic, shift: int,
-               ret_flux: bool = False):
+               ret_flux: bool = False, pallas_ok: bool = True):
     """Full godfine1 for one blocked partial level — the gather-fused
     replacement for :func:`level_sweep` (same return convention:
     du_flat [ncell, nvar], corr [noct, ndim, 2, nvar] [, phi
@@ -336,7 +337,12 @@ def tile_sweep(u_flat, interp_vals, tile_src, tile_vsgn, tile_ok,
     materialized: the sweep runs on the compact [nvar, td..., ntile]
     tile batch (Pallas kernel on TPU, trailing-batch XLA fallback
     elsewhere), and du/corr/phi are reordered back to flat rows with
-    small per-cell/per-oct gathers."""
+    small per-cell/per-oct gathers.
+
+    ``pallas_ok=False`` forces the XLA tile formulation regardless of
+    :func:`~ramses_tpu.hydro.pallas_oct.tile_available` — row-sharded
+    meshes use it so GSPMD can partition the sweep (the two
+    formulations are pinned bitwise-identical by tests)."""
     ndim, nvar = cfg.ndim, cfg.nvar
     c = 1 << (shift + 1)
     td = c + 2 * _NG
@@ -345,7 +351,7 @@ def tile_sweep(u_flat, interp_vals, tile_src, tile_vsgn, tile_ok,
     okl = tile_ok.T.reshape((td,) * ndim + (ntile,))
 
     from ramses_tpu.hydro import pallas_oct
-    if pallas_oct.tile_available(cfg, ntile, u_flat.dtype):
+    if pallas_ok and pallas_oct.tile_available(cfg, ntile, u_flat.dtype):
         out_k = pallas_oct.tile_sweep(ut, okl.astype(ut.dtype), dt, cfg,
                                       dx, shift, want_flux=ret_flux)
         du_t, corrp = out_k[0], out_k[1]

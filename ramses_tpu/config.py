@@ -106,9 +106,17 @@ class AmrParams:
     # gather-fused blocked tile sweep on partial levels: octs grouped
     # into Morton-aligned tiles of 2^oct_block_shift octs per side so
     # the stencil gather is one compact tile batch instead of a
-    # ~(3^ndim)x duplicated per-oct batch (single-device hydro/rhd)
+    # ~(3^ndim)x duplicated per-oct batch (universal: hydro/rhd/MHD,
+    # load-balance layouts, and row-sharded meshes; explicit-comm
+    # schedules keep the stencil path)
     oct_blocking: bool = True
     oct_block_shift: int = 2
+    # device-resident regrid migration (amr/device_regrid.py): derive
+    # the survivor-copy/prolongation maps on device from the level key
+    # arrays instead of per-level host numpy tables; families that
+    # replay migration into side-channel state (MHD/RT) and
+    # layout-permuted levels keep the bitwise-identical host path
+    device_regrid: bool = True
     # multi-chip halo exchange backend (parallel/dma_halo.py): "auto"
     # resolves to the Pallas async remote-copy (DMA) engine on a real
     # TPU backend and to lax.ppermute everywhere else; "ppermute" /

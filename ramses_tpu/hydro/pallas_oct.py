@@ -16,10 +16,15 @@ stencil axes lead.  Neighbour access is ``jnp.roll`` along the leading
 axes, wrap-around junk confined to stencil cells the 2³ interior never
 consumes — exactly the XLA path's contract.
 
-Scope (gated by :func:`available`, falls back to the XLA path
-otherwise): ndim=3 hydro, nener=npassive=0, no pressure_fix,
-scheme=muscl, slope_type∈{1,2,8}, riemann∈{llf, hllc}, f32, single
-device.  Self-gravity needs NO kernel support: the hierarchy applies
+Scope (gated by :func:`available` / :func:`tile_available`, falls
+back to the XLA formulation otherwise): ndim=3 hydro,
+nener=npassive=0, no pressure_fix, scheme=muscl, slope_type∈{1,2,8},
+riemann∈{llf, hllc}, f32, single device.  The gate only selects the
+KERNEL, not the blocked decomposition: sharded meshes, f64, and MHD
+still run the blocked Morton-tile sweep in its XLA formulation
+(``FusedSpec.pallas_tiles=False``; ``mhd/amr.py mhd_tile_sweep``),
+bitwise-identical to this kernel where both apply.  Self-gravity
+needs NO kernel support: the hierarchy applies
 it as a separate traced half-kick around the sweep
 (``kick_flat`` — ``amr/hierarchy.py _advance_traced``), so gravity
 production runs take this kernel too.  ``want_flux=True`` adds the MC
@@ -57,7 +62,8 @@ FORCE_INTERPRET = bool(__import__("os").environ
 def available(cfg: HydroStatic, noct_pad: int, dtype) -> bool:
     """Availability gate for the oct-batch kernel (see module docstring;
     the single-device restriction mirrors ``pallas_muscl.kernel_available``
-    — sharded levels must keep the XLA solver so GSPMD can partition)."""
+    — sharded levels keep the XLA formulation so GSPMD can partition;
+    with blocking on they still get the compact tile batch)."""
     if DISABLED:
         return False
     if not FORCE_INTERPRET and (jax.default_backend() != "tpu"

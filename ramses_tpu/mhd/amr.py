@@ -160,6 +160,19 @@ def _gather_faces(bf_flat, interp_faces, stencil_src, nd: int):
     return g.reshape((NCOMP, 2) + (6,) * nd + (noct,))
 
 
+def _gather_ftile(bf_flat, interp_faces, tile_src, nd: int, td: int):
+    """[NCOMP, 2, td…, ntile] blocked face batch (cf. K._gather_utile):
+    each Morton tile's staggered faces once plus the 2-cell halo instead
+    of the ~(3^d)x-duplicated per-oct stencil copy."""
+    trash = jnp.zeros((1, NCOMP, 2), bf_flat.dtype)
+    src = jnp.concatenate([bf_flat, interp_faces, trash], axis=0)
+    g = src[tile_src]                                  # [ntile, td^d, 3, 2]
+    ntile = g.shape[0]
+    g = jnp.moveaxis(g, (2, 3), (0, 1))                # [3, 2, ntile, td^d]
+    g = jnp.swapaxes(g, 2, 3)                          # [3, 2, td^d, ntile]
+    return g.reshape((NCOMP, 2) + (td,) * nd + (ntile,))
+
+
 # ----------------------------------------------------------------------
 # per-level sweep on the oct-stencil batch
 # ----------------------------------------------------------------------
@@ -282,6 +295,143 @@ def mhd_level_sweep(u_flat, interp_u, bf_flat, interp_bf, stencil_src,
                     row.append(v.mean(axis=red) if red else v)
                 corners.append(jnp.stack(row, axis=-1))
             outp.append(jnp.stack(corners, axis=-2))   # [noct, 2, 2]
+        emf = jnp.stack(outp, axis=1)                  # [noct, np, 2, 2]
+    return du_flat, bf_new, corr, emf
+
+
+@partial(jax.jit, static_argnames=("cfg", "shift"))
+def mhd_tile_sweep(u_flat, interp_u, bf_flat, interp_bf, tile_src,
+                   tile_ok, cell_tile, cell_slot, oct_tile, oct_slot,
+                   dt, dx: float, cfg: MhdStatic, shift: int):
+    """CT MUSCL-Hancock on the compact blocked tile batch — the
+    gather-fused replacement for :func:`mhd_level_sweep` (same return
+    convention: du_flat [ncell_pad, nvar], bf_new [ncell_pad, NCOMP, 2],
+    corr [noct_pad, nd, 2, nvar], emf [noct_pad, npairs, 2, 2] | None).
+
+    MHD never passes ``pallas_oct.tile_available`` (that kernel is
+    hydro-only), so this is always the trailing-batch XLA tile
+    formulation; what it removes is the 6^d-duplicated stencil gather
+    of cells AND staggered faces.  Every interior cell/face/corner sees
+    the same radius-2 neighbourhood values as the stencil batch (tile
+    halo = NGHOST_TILE = 2, shared ``maps._interp_requests`` ghost
+    semantics) and ``mu.ct_core`` is shift-invariant, so the extracted
+    du/bf/corr/EMF rows are bitwise identical to
+    :func:`mhd_level_sweep` (pinned by tests/test_oct_blocking.py)."""
+    nd = cfg.ndim
+    c = 1 << (shift + 1)
+    td = c + 2 * K._NG
+    ut = K._gather_utile(u_flat, interp_u, tile_src, None, cfg, td)
+    floc = _gather_ftile(bf_flat, interp_bf, tile_src, nd, td)
+    ntile = ut.shape[-1]
+    real = (tile_src < u_flat.shape[0]).T.reshape((td,) * nd + (ntile,))
+    okl = tile_ok.T.reshape((td,) * nd + (ntile,))
+
+    # cell-centred B from the duplicated faces (valid in every tile
+    # cell, halo included — cf. mhd_level_sweep)
+    centers = 0.5 * (floc[:, 0] + floc[:, 1])          # [NCOMP, td…, ntile]
+    ut = ut.at[IBX:IBX + NCOMP].set(centers)
+
+    # Riemann normal faces: stored values win next to a real cell (a
+    # ghost's injected coarse value must not override the fine stored
+    # field on a shared coarse-fine face)
+    bn_faces = []
+    for comp in range(NCOMP):
+        lo_c = floc[comp, 0]
+        if comp < nd:
+            hi_m1 = jnp.roll(floc[comp, 1], 1, axis=comp)
+            real_m1 = jnp.roll(real, 1, axis=comp)
+            bn_faces.append(jnp.where(real, lo_c,
+                                      jnp.where(real_m1, hi_m1, lo_c)))
+        else:
+            bn_faces.append(lo_c)
+
+    flux_mask = []
+    for d in range(nd):
+        keep = jnp.logical_not(jnp.logical_or(okl,
+                                              jnp.roll(okl, 1, axis=d)))
+        flux_mask.append(keep.astype(ut.dtype))
+    un, bfn, fl_cell, e_edges = mu.ct_core(
+        ut, [floc[comp, 0] for comp in range(NCOMP)], dt, (dx,) * nd,
+        cfg, bax=1, bn_faces=bn_faces, flux_mask=flux_mask)
+
+    # interior update → flat rows.  Pad cell rows carry slot c^d /
+    # tile 0 (maps.py), which flattens one past the interior batch —
+    # the appended zero column — so they come out exactly 0 (K.tile_sweep
+    # does the same)
+    interior = tuple(slice(K._NG, K._NG + c) for _ in range(nd))
+    du = (un - ut)[(slice(None),) + interior]          # [nvar, c…, ntile]
+    flat_idx = cell_slot * ntile + cell_tile
+    du_flat = jnp.concatenate(
+        [du.reshape((cfg.nvar, c ** nd * ntile)),
+         jnp.zeros((cfg.nvar, 1), du.dtype)], axis=1)[:, flat_idx].T
+
+    # coarse flux-correction payload: the kernels tile helpers' per-oct
+    # boundary-plane sums, gathered back to tree oct rows
+    corr = []
+    for d in range(nd):
+        planes = K._face_planes(fl_cell[d] * (dt / dx), d, nd, c)
+        lo, hi = K._corr_from_planes(planes, d, nd, c)
+        corr.append(jnp.stack([lo[:, oct_slot, oct_tile],
+                               hi[:, oct_slot, oct_tile]], axis=-1))
+    corr = jnp.stack(corr, axis=-2)                    # [nvar, noct, nd, 2]
+    corr = jnp.moveaxis(corr, 0, -1)                   # [noct, nd, 2, nvar]
+
+    def _flat_cells(a):
+        """[c…, ntile] → flat cell rows [ncell_pad] (pad rows 0)."""
+        af = jnp.concatenate([a.reshape(c ** nd * ntile),
+                              jnp.zeros((1,), a.dtype)])
+        return af[flat_idx]
+
+    # interior faces: cell's lo at its own position, hi one step up in d
+    comps = []
+    for comp in range(NCOMP):
+        if comp < nd:
+            hi_sl = tuple(slice(K._NG + 1, K._NG + c + 1) if dd == comp
+                          else slice(K._NG, K._NG + c) for dd in range(nd))
+            lo = _flat_cells(bfn[comp][interior])
+            hi = _flat_cells(bfn[comp][hi_sl])
+        else:
+            lo = hi = _flat_cells(un[IBX + comp][interior])
+        comps.append(jnp.stack([lo, hi], axis=-1))
+    bf_new = jnp.stack(comps, axis=1)                  # [ncell, NCOMP, 2]
+
+    # father-cell edge EMFs: corner-lattice planes at even cell offsets
+    # (the stencil path's positions {2, 4} generalised to every oct in
+    # the tile), edge-averaged over the remaining interior positions
+    pairs = [(d1, d2) for d1 in range(nd) for d2 in range(d1 + 1, nd)]
+    emf = None
+    if pairs:
+        o = c // 2
+        outp = []
+        for (d1, d2) in pairs:
+            idx = tuple(slice(K._NG, K._NG + c + 1, 2) if dd in (d1, d2)
+                        else slice(K._NG, K._NG + c) for dd in range(nd))
+            g = e_edges[(d1, d2)][idx]
+            # collapse each non-pair dim c → (o, 2) and average the
+            # 2-subaxis (the stencil slice(2,4).mean edge average)
+            shp, red, ax = [], [], 0
+            for dd in range(nd):
+                if dd in (d1, d2):
+                    shp.append(o + 1)
+                    ax += 1
+                else:
+                    shp += [o, 2]
+                    red.append(ax + 1)
+                    ax += 2
+            g = g.reshape(shp + [ntile])
+            if red:
+                g = g.mean(axis=tuple(red))
+            corners = []
+            for i1 in (0, 1):
+                row = []
+                for i2 in (0, 1):
+                    sl = [slice(None)] * (nd + 1)
+                    sl[d1] = slice(i1, i1 + o)
+                    sl[d2] = slice(i2, i2 + o)
+                    row.append(g[tuple(sl)].reshape(o ** nd, ntile))
+                corners.append(jnp.stack(row, axis=-1))
+            pv = jnp.stack(corners, axis=-2)           # [o^nd, ntile, 2, 2]
+            outp.append(pv[oct_slot, oct_tile])        # [noct, 2, 2]
         emf = jnp.stack(outp, axis=1)                  # [noct, np, 2, 2]
     return du_flat, bf_new, corr, emf
 
@@ -426,6 +576,28 @@ def _mhd_fused_flags(u, dev, spec: FusedSpec, eg, fls, itype: int):
                 ok = ok[tuple(slice(1, -1) for _ in range(nd))]
                 fl = K.dense_to_rows(ok, d.get("perm"), shape).reshape(
                     ncell // 2 ** nd, 2 ** nd)
+        elif spec.blocked and spec.blocked[i]:
+            # flags reuse the blocked shared gather (tile batch) —
+            # cf. K.tile_refine_flags
+            if l == spec.lmin:
+                interp = jnp.zeros((d["b_interp_cell"].shape[0],
+                                    cfg.nvar), u[l].dtype)
+            else:
+                interp = K.interp_cells(u[l - 1], d["b_interp_cell"],
+                                        d["b_interp_nb"],
+                                        d["b_interp_sgn"],
+                                        cfg, itype=itype)
+            c = 1 << (spec.block_shift + 1)
+            td = c + 2 * K._NG
+            ut = K._gather_utile(u[l], interp, d["tile_src"], None,
+                                 cfg, td)
+            ntile = ut.shape[-1]
+            ok = _mhd_grad_flags(ut, eg, fls, 0, cfg)
+            oki = ok[tuple(slice(K._NG, K._NG + c) for _ in range(nd))]
+            okc = jnp.concatenate([oki.reshape(c ** nd * ntile),
+                                   jnp.zeros((1,), ok.dtype)])
+            rows = okc[d["cell_slot"] * ntile + d["cell_tile"]]
+            fl = rows.reshape(rows.shape[0] // 2 ** nd, 2 ** nd)
         else:
             if l == spec.lmin:
                 interp = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
@@ -594,11 +766,24 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
                          if bf[l].shape[0] > ncell
                          else b_rows.astype(bf[l].dtype))
         else:
+            # gather-fused blocked tile path: the compact Morton-tile
+            # batch replaces the 6^d-duplicated stencil gather of cells
+            # and staggered faces (see AmrSim._advance_traced)
+            blocked = bool(spec.blocked and spec.blocked[i])
+            ic = "b_interp_cell" if blocked else "interp_cell"
             if l == spec.lmin:
-                interp_u = jnp.zeros((d["interp_cell"].shape[0], cfg.nvar),
+                interp_u = jnp.zeros((d[ic].shape[0], cfg.nvar),
                                      u[l].dtype)
                 interp_bf = jnp.zeros(
-                    (d["interp_cell"].shape[0], NCOMP, 2), bf[l].dtype)
+                    (d[ic].shape[0], NCOMP, 2), bf[l].dtype)
+            elif blocked:
+                interp_u = K.interp_cells(u[l - 1], d["b_interp_cell"],
+                                          d["b_interp_nb"],
+                                          d["b_interp_sgn"],
+                                          cfg, itype=spec.itype)
+                interp_bf = balsara_child_faces(
+                    bf[l - 1][d["b_interp_cell"]],
+                    d["b_interp_sgn"].astype(bf[l - 1].dtype), nd)
             else:
                 interp_u = K.interp_cells(u[l - 1], d["interp_cell"],
                                           d["interp_nb"], d["interp_sgn"],
@@ -606,9 +791,16 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
                 interp_bf = balsara_child_faces(
                     bf[l - 1][d["interp_cell"]],
                     d["interp_sgn"].astype(bf[l - 1].dtype), nd)
-            du, bfn, corr, my_emf = mhd_level_sweep(
-                u[l], interp_u, bf[l], interp_bf, d["stencil_src"],
-                d["ok_ref"], dtl, dx(l), cfg)
+            if blocked:
+                du, bfn, corr, my_emf = mhd_tile_sweep(
+                    u[l], interp_u, bf[l], interp_bf, d["tile_src"],
+                    d["tile_ok"], d["cell_tile"], d["cell_slot"],
+                    d["oct_tile"], d["oct_slot"], dtl, dx(l), cfg,
+                    spec.block_shift)
+            else:
+                du, bfn, corr, my_emf = mhd_level_sweep(
+                    u[l], interp_u, bf[l], interp_bf, d["stencil_src"],
+                    d["ok_ref"], dtl, dx(l), cfg)
             unew[l] = unew[l] + du
             if l > spec.lmin:
                 # staggered B centers are face means, not flux-updated
@@ -702,7 +894,11 @@ class MhdAmrSim(AmrSim):
     _needs_mig_log = True
     _pm_physics = False      # MHD state layout carries cell-centred B
     _noncubic_ok = False     # dense CT path assumes one root cube
-    _oct_blocked = False     # CT partial sweep gathers staggered faces
+    # partial levels take the gather-fused blocked tile sweep too:
+    # mhd_tile_sweep runs ct_core on the compact Morton-tile batch (XLA
+    # tile formulation — the Pallas oct kernel stays hydro-only), so
+    # cells AND staggered faces stop paying the 6^d stencil gather
+    _oct_blocked = True
 
     def __init__(self, params: Params, dtype=jnp.float32, **kw):
         from ramses_tpu import patch
@@ -1002,6 +1198,12 @@ class MhdAmrSim(AmrSim):
                          else None for l in lv)
             if any(s is not None for s in slab):
                 self._spec = self._spec._replace(slab=slab)
+            blocked = tuple(l in self.blocks for l in lv)
+            if any(blocked):
+                self._spec = self._spec._replace(
+                    blocked=blocked,
+                    block_shift=int(getattr(self.params.amr,
+                                            "oct_block_shift", 2)))
         return self._spec
 
     def coarse_dt(self) -> float:

@@ -72,9 +72,13 @@ from ramses_tpu.parallel.mesh import oct_mesh
 class ShardedAmrSim(AmrSim):
     """AmrSim with per-level state sharded over an ``oct`` mesh axis."""
 
-    # row-sharded partial levels keep the 6^d stencil gather so GSPMD
-    # (or the explicit comm schedule) can partition it
-    _oct_blocked = False
+    # row-sharded partial levels take the gather-fused blocked tile
+    # sweep too: tile tables are row-sharded like the stencil ones and
+    # FusedSpec.pallas_tiles=False forces the XLA tile formulation, so
+    # GSPMD partitions the compact tile batch the same way it used to
+    # partition the 6^d gather (explicit-comm schedules still take the
+    # stencil path — see AmrSim._block_level_ok)
+    _oct_blocked = True
 
     def __init__(self, params: Params,
                  devices: Optional[Sequence[jax.Device]] = None,

@@ -36,7 +36,8 @@ from ramses_tpu.amr.hilbert import hilbert_order
 __all__ = [
     "LevelLayout", "BalanceStats", "oct_costs", "balanced_cuts",
     "make_layout", "compute_layouts", "measure", "enabled",
-    "apply_layout_level", "apply_layout_gravity", "remap_son_oct",
+    "apply_layout_level", "apply_layout_blocks", "apply_layout_gravity",
+    "remap_son_oct",
     "remap_octs", "remap_cells", "layout_sig", "layouts_same",
     "merge_ranges", "ranges_cover",
 ]
@@ -403,6 +404,43 @@ def apply_layout_level(m, lay_m1: Optional[LevelLayout],
         son = remap_octs(son, lay_p1)
     kw["son_oct"] = son
     return replace(m, **kw)
+
+
+def apply_layout_blocks(b, lay_m1: Optional[LevelLayout],
+                        lay: Optional[LevelLayout]):
+    """Transform tree-order ``BlockMaps`` into layout order.
+
+    Tile-indexed arrays (``tile_src``/``tile_ok``/``tile_vsgn`` rows and
+    the incremental-rebuild geometry) keep tree/Morton row order — tiles
+    are a pure function of the Morton prefix set, independent of where
+    the layout placed each oct's flat row.  Only the *values* that point
+    at flat cell rows remap: ``tile_src`` entries (cells of l; interp
+    slots and the trash row pass through), ``interp_cell``/``interp_nb``
+    (cells of l-1), and the scatter-back maps ``cell_tile``/``cell_slot``
+    / ``oct_tile``/``oct_slot``, whose ROWS are flat-cell/oct rows and so
+    permute with the layout.  Pad rows keep the zero-output sentinels
+    (``cell_slot = c^ndim`` gathers the appended zero column; pad-oct
+    corr garbage is dropped by the layout-transformed ``corr_idx = -1``).
+    """
+    from dataclasses import replace
+    if lay is None and lay_m1 is None:
+        return b
+    ttd = 1 << b.ndim
+    kw = {}
+    if lay is not None:
+        assert lay.noct == b.noct and lay.noct_pad == b.noct_pad, \
+            f"layout/blocks mismatch at lvl {b.lvl}"
+        c = 1 << (b.shift + 1)
+        kw["tile_src"] = remap_cells(b.tile_src, lay, ttd)
+        kw["cell_tile"] = _perm_cell_rows(b.cell_tile, lay, ttd, 0)
+        kw["cell_slot"] = _perm_cell_rows(b.cell_slot, lay, ttd,
+                                          c ** b.ndim)
+        kw["oct_tile"] = _perm_oct_rows(b.oct_tile, lay, 0)
+        kw["oct_slot"] = _perm_oct_rows(b.oct_slot, lay, 0)
+    if lay_m1 is not None:
+        kw["interp_cell"] = remap_cells(b.interp_cell, lay_m1, ttd)
+        kw["interp_nb"] = remap_cells(b.interp_nb, lay_m1, ttd)
+    return replace(b, **kw)
 
 
 def apply_layout_gravity(g, lay_m1: Optional[LevelLayout],

@@ -48,15 +48,22 @@ def count_gather_elems(text: str) -> int:
 def lower_fused_step(sim, dt: float = 1e-6) -> str:
     """Lowered (pre-optimization) StableHLO text of one fused AMR coarse
     step for ``sim``'s current tree — the program whose gather traffic
-    the inventory counts."""
+    the inventory counts.  Dispatches on the solver family: MHD sims
+    (``sim.bfs``) lower the CT fused step."""
     import jax.numpy as jnp
 
+    dt_arr = jnp.asarray(float(sim.dt_old or dt), sim.dtype)
+    spec = sim._fused_spec()
+    if hasattr(sim, "bfs"):
+        from ramses_tpu.mhd import amr as M
+
+        return M._mhd_fused_coarse_step.lower(
+            sim.u, sim.bfs, sim.dev, dt_arr, spec,
+            sim.fg if sim.gravity else None).as_text()
     from ramses_tpu.amr import hierarchy as H
 
-    spec = sim._fused_spec()
     return H._fused_coarse_step.lower(
-        sim.u, sim.dev, sim.fg if sim.gravity else {},
-        jnp.asarray(float(sim.dt_old or dt), sim.dtype), spec,
+        sim.u, sim.dev, sim.fg if sim.gravity else {}, dt_arr, spec,
         sim._cool_bundle()).as_text()
 
 

@@ -391,6 +391,10 @@ class Telemetry:
                 "imbalance": float(bs.imbalance),
                 "nreb": int(getattr(sim, "_rebalance_count", 0)),
             }
+        bst = getattr(sim, "block_stats", None)
+        if bst and "blocked_frac" in bst:
+            # fraction of partial-level octs on the blocked tile sweep
+            rec["blocked_frac"] = round(float(bst["blocked_frac"]), 4)
         nq = getattr(sim, "quarantined_count", None)
         if nq:
             # member isolation ladder (ensemble engines): evicted
@@ -511,4 +515,7 @@ def sim_run_info(sim) -> Dict[str, Any]:
     cfg = getattr(sim, "cfg", None)
     if cfg is not None and hasattr(cfg, "nvar"):
         info["nvar"] = int(cfg.nvar)
+    bst = getattr(sim, "block_stats", None)
+    if bst and "blocked_frac" in bst:
+        info["blocked_frac"] = round(float(bst["blocked_frac"]), 4)
     return info

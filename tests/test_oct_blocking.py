@@ -253,3 +253,221 @@ def test_blocked_parity_pallas_interpret(monkeypatch):
                                   np.asarray(sb.u[l])[:nreal]), l
     finally:
         jax.clear_caches()              # do not leak into other tests
+
+
+# -------------------------------------------- universal eligibility
+
+@pytest.mark.slow          # ~26s; nightly tier on the 1-core box
+def test_blocked_parity_forced_layout():
+    """Layout-composed tile tables: after a forced Hilbert relayout
+    permutes the rows (balance.apply_layout_blocks), the blocked sweep
+    must still reproduce the stencil path bitwise."""
+    sims = {}
+    for blk in (".true.", ".false."):
+        p = params_from_string(
+            SEDOV3D.format(lmin=4, lmax=6, blk=blk, riemann="llf"),
+            ndim=2)
+        p.amr.load_balance = True
+        s = AmrSim(p, dtype=jnp.float64)
+        for _ in range(2):
+            s.step_coarse(s.coarse_dt())
+        s.request_rebalance()
+        s.regrid()
+        assert s.layouts, "forced rebalance adopted no layout"
+        for _ in range(2):
+            s.step_coarse(s.coarse_dt())
+        sims[blk] = s
+    sa, sb = sims[".true."], sims[".false."]
+    assert sa.blocks and not sb.blocks
+    # the gate lift is doing work: a layout level IS blocked
+    assert set(sa.blocks) & set(sa.layouts), (sa.blocks, sa.layouts)
+    assert sorted(sa.layouts) == sorted(sb.layouts)
+    for l, lay in sa.layouts.items():
+        assert np.array_equal(lay.oct_row, sb.layouts[l].oct_row), l
+    for l in sa.levels():
+        assert np.array_equal(np.asarray(sa.tree.levels[l].keys),
+                              np.asarray(sb.tree.levels[l].keys)), l
+        ua, ub = np.asarray(sa.u[l]), np.asarray(sb.u[l])
+        assert np.array_equal(ua, ub), \
+            f"level {l}: maxdiff={np.abs(ua - ub).max()}"
+
+
+def test_blocked_parity_sharded_mesh8():
+    """mesh-of-8 == mesh-of-1 on the blocked path: row-sharded tile
+    tables under GSPMD (FusedSpec.pallas_tiles=False pins the XLA tile
+    formulation) reproduce the single-device run bitwise.  f32/3D is
+    the regime the decomposition-invariance north star pins
+    (test_determinism_f32.py); the partitioned tile program is NOT
+    ulp-stable in other dtype/ndim corners."""
+    from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+    if len(jax.devices()) < 8:
+        pytest.skip("needs an 8-device mesh")
+
+    def mk(cls, **kw):
+        p = params_from_string(
+            SEDOV3D.format(lmin=4, lmax=5, blk=".true.", riemann="llf"),
+            ndim=3)
+        return cls(p, dtype=jnp.float32, **kw)
+
+    s1 = mk(AmrSim)
+    s8 = mk(ShardedAmrSim, devices=jax.devices()[:8])
+    assert s1.blocks and s8.blocks, "blocked gate closed somewhere"
+    assert s8._fused_spec().pallas_tiles is False
+    for s in (s1, s8):
+        for _ in range(2):
+            s.step_coarse(s.coarse_dt())
+        s.regrid()
+        s.step_coarse(s.coarse_dt())
+    for l in s1.levels():
+        assert s8.tree.noct(l) == s1.tree.noct(l), l
+        # noct_pad differs (mesh-multiple rounding): real rows only
+        nreal = s1.tree.noct(l) * 8
+        a = np.asarray(s1.u[l])[:nreal]
+        b = np.asarray(s8.u[l])[:nreal]
+        assert (a.view(np.uint32) == b.view(np.uint32)).all(), l
+
+
+@pytest.mark.slow
+def test_blocked_parity_sharded_blocked_vs_stencil():
+    """3D f32 on the 8-device mesh: the row-sharded blocked tile sweep
+    vs the row-sharded stencil sweep vs the mesh-of-1 stencil
+    reference — one bitwise XLA family.  (The Pallas tile kernel's
+    interpret-mode family is pinned single-device by
+    test_blocked_parity_pallas_interpret: sharded meshes never take
+    the Pallas kernel — FusedSpec.pallas_tiles=False by design.)"""
+    from ramses_tpu.parallel.amr_sharded import ShardedAmrSim
+    if len(jax.devices()) < 8:
+        pytest.skip("needs an 8-device mesh")
+
+    def mk(cls, blk, **kw):
+        p = params_from_string(
+            SEDOV3D.format(lmin=4, lmax=5, blk=blk, riemann="llf"),
+            ndim=3)
+        s = cls(p, dtype=jnp.float32, **kw)
+        for _ in range(2):
+            s.step_coarse(s.coarse_dt())
+        return s
+
+    s1 = mk(AmrSim, ".false.")
+    s8b = mk(ShardedAmrSim, ".true.", devices=jax.devices()[:8])
+    s8s = mk(ShardedAmrSim, ".false.", devices=jax.devices()[:8])
+    assert s8b.blocks and not s8s.blocks
+    for l in s1.levels():
+        nreal = s1.tree.noct(l) * 8
+        ref = np.asarray(s1.u[l])[:nreal]
+        for tag, s in (("blocked8", s8b), ("stencil8", s8s)):
+            got = np.asarray(s.u[l])[:nreal]
+            assert (ref.view(np.uint32) == got.view(np.uint32)).all(), \
+                (l, tag)
+
+
+def _mhd_parity(lmin, lmax, ndim, nstep=2):
+    """MHD CT blocked-vs-stencil parity: cells AND staggered faces."""
+    from ramses_tpu.config import load_params
+    from ramses_tpu.mhd.amr import MhdAmrSim
+    sims = {}
+    for blk in (True, False):
+        p = load_params("namelists/tube_mhd.nml", ndim=ndim)
+        p.amr.levelmin, p.amr.levelmax = lmin, lmax
+        p.amr.oct_blocking = blk
+        p.refine.err_grad_d = 0.02
+        p.refine.err_grad_p = 0.05
+        s = MhdAmrSim(p, dtype=jnp.float64)
+        if blk:
+            assert s.blocks, "no blocked MHD levels built"
+        else:
+            assert not s.blocks
+        for _ in range(nstep):
+            s.step_coarse(s.coarse_dt())
+        s.regrid()
+        s.step_coarse(s.coarse_dt())
+        sims[blk] = s
+    sa, sb = sims[True], sims[False]
+    assert sorted(sa.levels()) == sorted(sb.levels())
+    ttd = 1 << ndim
+    for l in sa.levels():
+        assert np.array_equal(np.asarray(sa.tree.levels[l].keys),
+                              np.asarray(sb.tree.levels[l].keys)), l
+        nreal = sa.tree.noct(l) * ttd
+        # real rows only: the tile path zeroes the pad bf rows the
+        # stencil path leaves as garbage (no consumer reads them)
+        assert np.array_equal(np.asarray(sa.u[l])[:nreal],
+                              np.asarray(sb.u[l])[:nreal]), l
+        assert np.array_equal(np.asarray(sa.bfs[l])[:nreal],
+                              np.asarray(sb.bfs[l])[:nreal]), l
+
+
+@pytest.mark.slow          # ~145s; nightly tier on the 1-core box
+def test_blocked_parity_mhd_ct_2d():
+    """mhd_tile_sweep vs mhd_level_sweep through steps + a regrid:
+    bitwise u and bf, including the z-EMF corner extraction."""
+    _mhd_parity(4, 6, 2)
+
+
+@pytest.mark.slow          # ~147s; nightly tier on the 1-core box
+def test_blocked_parity_mhd_ct_3d():
+    """3D exercises all three EMF pair planes and the non-pair-axis
+    2-subcell mean."""
+    _mhd_parity(3, 4, 3, nstep=1)
+
+
+# --------------------------------------------- device-resident regrid
+
+def test_device_regrid_matches_host(monkeypatch):
+    """Changed-tree regrids on the device path must be bitwise-identical
+    to the host build_prolong_maps reference — and must construct ZERO
+    host prolongation tables while the reference builds many."""
+    real = mapmod.build_prolong_maps
+    counts, sims = {}, {}
+    for dev_rg in (True, False):
+        p = params_from_string(
+            SEDOV3D.format(lmin=4, lmax=6, blk=".true.", riemann="llf"),
+            ndim=2)
+        p.amr.device_regrid = dev_rg
+        s = AmrSim(p, dtype=jnp.float64)
+        n = {"calls": 0}
+
+        def spy(*a, _n=n, **k):
+            _n["calls"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(mapmod, "build_prolong_maps", spy)
+        try:
+            for _ in range(3):
+                for _ in range(2):
+                    s.step_coarse(s.coarse_dt())
+                s.regrid()
+        finally:
+            monkeypatch.setattr(mapmod, "build_prolong_maps", real)
+        counts[dev_rg], sims[dev_rg] = n["calls"], s
+    # the comparison is meaningful only if trees actually changed
+    assert counts[False] > 0, "host run saw no changed-tree regrid"
+    assert counts[True] == 0, "device path fell back to host tables"
+    sa, sb = sims[True], sims[False]
+    for l in sa.levels():
+        assert np.array_equal(np.asarray(sa.tree.levels[l].keys),
+                              np.asarray(sb.tree.levels[l].keys)), l
+        ua, ub = np.asarray(sa.u[l]), np.asarray(sb.u[l])
+        assert np.array_equal(ua, ub), \
+            f"level {l}: maxdiff={np.abs(ua - ub).max()}"
+
+
+def test_steady_regrid_builds_no_host_tables(monkeypatch):
+    """Zero-host-allocation pin: a steady-state regrid (unchanged tree,
+    unchanged layouts) must construct no host migration tables, upload
+    no key arrays, and reuse every level array by identity."""
+    from ramses_tpu.amr import device_regrid as dregrid
+    sim = _sedov(".true.", lmin=4, lmax=5, ndim=2)
+    for _ in range(2):
+        sim.step_coarse(sim.coarse_dt())
+    sim.regrid()                        # absorb any pending tree change
+    before = {l: sim.u[l] for l in sim.levels()}
+
+    def boom(*a, **k):
+        raise AssertionError("host table built on a steady regrid")
+
+    monkeypatch.setattr(mapmod, "build_prolong_maps", boom)
+    monkeypatch.setattr(dregrid, "upload_keys", boom)
+    sim.regrid()                        # guaranteed steady-state
+    for l in sim.levels():
+        assert sim.u[l] is before[l], l
