@@ -36,12 +36,14 @@ Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
 BENCH_ONLY=<comma list of uniform|amr|mg|amr_poisson|ensemble|
-profile_amr|halo — profile_amr runs tools/profile_amr.py's per-kernel
-probes with incremental partial capture (also auto-escalated after a
-hang-classified amr sub); halo times the explicit halo pipeline
-(ppermute vs DMA, 1/2/8 shards, bytes/s + fused step time) and is
-opt-in like profile_amr>,
+profile_amr|halo|offload — profile_amr runs tools/profile_amr.py's
+per-kernel probes with incremental partial capture (also auto-escalated
+after a hang-classified amr sub); halo times the explicit halo pipeline
+(ppermute vs DMA, 1/2/8 shards, bytes/s + fused step time); offload
+times the out-of-core deep hierarchy (&AMR_PARAMS offload) on vs off —
+both opt-in like profile_amr>,
 BENCH_HALO_LEVEL, BENCH_HALO_STEPS,
+BENCH_OFF_LMIN, BENCH_OFF_LMAX, BENCH_OFF_STEPS, BENCH_OFF_WARM,
 BENCH_SUB_TIMEOUT, BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH,
 BENCH_ENS_LEVEL, BENCH_ENS_STEPS, BENCH_ENS_BATCHES,
 BENCH_HANG_SUB=<sub> (deliberately wedge that child before its jax
@@ -588,20 +590,106 @@ def bench_halo(params, dtype, jnp, hb=lambda *a, **k: None):
     }
 
 
+def bench_offload(dtype, jnp, hb=lambda *a, **k: None):
+    """Out-of-core AMR (amr/offload.py): deep-hierarchy per-step time
+    and managed-state device high-water at ``offload=off`` vs ``on``
+    under a simulated HBM cap.  Both runs step the SAME schedule from
+    the same ICs (the engine is pinned bitwise-identical by
+    tests/test_offload.py), so the step-time ratio IS the offload
+    overhead and the high-water ratio IS the capacity win."""
+    import numpy as np
+
+    from ramses_tpu.amr.hierarchy import AmrSim
+    from ramses_tpu.config import params_from_string
+
+    lmin = int(os.environ.get("BENCH_OFF_LMIN", "4"))
+    lmax = int(os.environ.get("BENCH_OFF_LMAX", "8"))
+    nsteps = int(os.environ.get("BENCH_OFF_STEPS", "6"))
+    warm = int(os.environ.get("BENCH_OFF_WARM", "4"))
+    nml = "\n".join([
+        "&RUN_PARAMS", "hydro=.true.", "nremap=1", "/",
+        "&AMR_PARAMS", f"levelmin={lmin}", f"levelmax={lmax}",
+        "boxlen=1.0", "offload='{mode}'", "/",
+        "&INIT_PARAMS", "nregion=2", "region_type(1)='square'",
+        "region_type(2)='point'", "x_center=0.5,0.5",
+        "y_center=0.5,0.5", "length_x=10.0,1.0", "length_y=10.0,1.0",
+        "exp_region=10.0,10.0", "d_region=1.0,0.0",
+        "p_region=1e-5,0.1", "/",
+        "&OUTPUT_PARAMS", "tend=1.0", "/",
+        "&HYDRO_PARAMS", "gamma=1.4", "courant_factor=0.8", "/",
+        "&REFINE_PARAMS", "err_grad_p=0.1", "/",
+    ])
+
+    def run(mode):
+        p = params_from_string(nml.format(mode=mode), ndim=2)
+        sim = AmrSim(p, dtype=dtype)
+        sim.evolve(1e9, nstepmax=warm)     # compile + develop the blast
+        sim.drain()
+        hb("warm", mode=mode)
+        stats = dict(stalls=0, prefetches=0, fetches=0, bytes_parked=0,
+                     bytes_fetched=0)
+        hwm = 0
+        t0 = time.perf_counter()
+        for _ in range(nsteps):
+            if sim.regrid_interval and \
+                    sim.nstep % sim.regrid_interval == 0:
+                sim.regrid()
+            sim.step_coarse(sim.coarse_dt())
+            eng = sim._offload
+            if eng is not None and eng.last_step_stats is not None:
+                for k in stats:
+                    stats[k] += int(eng.last_step_stats.get(k, 0))
+                hwm = max(hwm, int(eng.last_step_stats
+                                   .get("device_hwm_bytes", 0)))
+        sim.drain()
+        wall = time.perf_counter() - t0
+        hb("timed", mode=mode)
+        managed = sum(int(np.asarray(sim.u[l]).nbytes)
+                      for l in sim.levels())
+        return sim, wall, managed, stats, hwm
+
+    s_off, w_off, managed, _, _ = run("off")
+    s_on, w_on, _, stats, hwm = run("on")
+    engaged = (s_on._offload is not None
+               and s_on._offload.engaged(s_on))
+    # cheap end-to-end cross-check: both runs stepped the same physics
+    bitwise = all(
+        np.array_equal(np.asarray(s_off.u[l]), np.asarray(s_on.u[l]))
+        for l in s_off.levels()) and s_off.t == s_on.t
+    fetches = max(stats["fetches"], 1)
+    return {
+        "config": f"offload sedov2d lmin={lmin} lmax={lmax} "
+                  f"{str(dtype.__name__)} nsteps={nsteps}",
+        "engaged": engaged,
+        "bitwise_equal_on_vs_off": bitwise,
+        "nsteps": nsteps,
+        "off": {"step_ms": 1e3 * w_off / nsteps,
+                "managed_resident_bytes": managed},
+        "on": {"step_ms": 1e3 * w_on / nsteps,
+               "device_hwm_bytes": hwm, **stats,
+               "overlap_frac": round(
+                   (stats["fetches"] - stats["stalls"]) / fetches, 3)},
+        "overhead_frac": round(w_on / max(w_off, 1e-9) - 1.0, 3),
+        "hwm_reduction_frac": round(1.0 - hwm / max(managed, 1), 3),
+        "tunnel_rtt_s": measure_rtt(jnp),
+    }
+
+
 # the default protocol; profile_amr (the per-kernel breakdown of
 # tools/profile_amr.py) and halo (the backend comparison above) are
 # opt-in via BENCH_ONLY — too slow for every protocol run
 DEFAULT_SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
-SUBS = DEFAULT_SUBS + ("profile_amr", "halo")
+SUBS = DEFAULT_SUBS + ("profile_amr", "halo", "offload")
 # ceilings per sub; the GLOBAL budget (BENCH_TOTAL_BUDGET) always wins —
 # four rounds of rc=124 driver kills came from these summing past the
 # driver's wall clock whenever the tunnel hung
 SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500,
-                "ensemble": 300, "profile_amr": 700, "halo": 300}
+                "ensemble": 300, "profile_amr": 700, "halo": 300,
+                "offload": 600}
 # share of the REMAINING budget each sub may claim at launch
 SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35,
                "amr_poisson": 0.95, "ensemble": 0.95,
-               "profile_amr": 0.95, "halo": 0.95}
+               "profile_amr": 0.95, "halo": 0.95, "offload": 0.95}
 
 
 def run_sub_inproc(name):
@@ -641,6 +729,8 @@ def run_sub_inproc(name):
                            hb=hb.mark)
     elif name == "halo":
         d = bench_halo(load_params(nml, ndim=3), dtype, jnp, hb=hb.mark)
+    elif name == "offload":
+        d = bench_offload(dtype, jnp, hb=hb.mark)
     elif name == "profile_amr":
         # per-kernel breakdown (tools/profile_amr.py): its probes emit
         # incrementally into the result sidecar with completed=False,

@@ -106,6 +106,11 @@ def main(argv=None) -> int:
     dtype = getattr(jnp, args.dtype)
     params = load_params(args.namelist, ndim=args.ndim)
 
+    # persistent compile cache (&RUN_PARAMS compile_cache_dir, env
+    # RAMSES_COMPILE_CACHE): must land before the first trace
+    from ramses_tpu.platform import setup_compile_cache
+    setup_compile_cache(params)
+
     if params.run.debug_nan:
         # jit-level NaN trap (SURVEY.md §5.2): every compiled program
         # re-checks outputs and raises AT the producing op — the

@@ -188,8 +188,6 @@ def _find(sorted_keys, ks):
     return pos, sorted_keys[pos] == ks
 
 
-@partial(jax.jit, static_argnames=("ncell_pad", "ndim", "bc_kinds",
-                                   "dims", "cfg", "itype"))
 def migrate_level(old_u, u_coarse, new_keys, old_keys, coarse_keys,
                   ncell_pad: int, ndim: int, bc_kinds: tuple,
                   dims: tuple, cfg, itype: int):
@@ -200,7 +198,22 @@ def migrate_level(old_u, u_coarse, new_keys, old_keys, coarse_keys,
     the new coarser level; ``dims`` are the lvl-1 cell counts per dim.
     Returns the migrated [ncell_pad, nvar] batch, bitwise identical to
     ``build_prolong_maps`` + ``_migrate_level``.
+
+    Host-parked state (``offload.HostBuffer``, &AMR_PARAMS offload)
+    composes: parked operands are fetched here, outside the jit, so the
+    traced program always sees device arrays.
     """
+    from ramses_tpu.amr.offload import as_device
+    return _migrate_level_jit(as_device(old_u), as_device(u_coarse),
+                              new_keys, old_keys, coarse_keys, ncell_pad,
+                              ndim, bc_kinds, dims, cfg, itype)
+
+
+@partial(jax.jit, static_argnames=("ncell_pad", "ndim", "bc_kinds",
+                                   "dims", "cfg", "itype"))
+def _migrate_level_jit(old_u, u_coarse, new_keys, old_keys, coarse_keys,
+                       ncell_pad: int, ndim: int, bc_kinds: tuple,
+                       dims: tuple, cfg, itype: int):
     ttd = 1 << ndim
     sent = _sent(new_keys.dtype)
     valid = new_keys < sent                       # real (non-pad) octs

@@ -546,6 +546,11 @@ class AmrSim:
     # positions — true for hydro AND MHD layouts; SRHD's (D, S) are
     # not coordinate velocities, so RhdAmrSim opts out
     _tracer_physics = True
+    # out-of-core residency (amr/offload.py): families whose coarse
+    # step is the shared fused hydro window may run it as per-level
+    # segments with host-parked inactive levels; MHD drives its own
+    # step chain (CT staggered fields) and opts out
+    _offload_capable = True
 
     @staticmethod
     def _make_cfg(params: Params):
@@ -621,6 +626,11 @@ class AmrSim:
         self._sguard = StepGuard.from_params(params,
                                              telemetry=self.telemetry)
         self._fault = FaultInjector.from_params(params)
+        # out-of-core residency engine (&AMR_PARAMS offload): None when
+        # off — the monolithic fused window then runs bit-for-bit
+        # untouched with zero added device fetches
+        from ramses_tpu.amr.offload import OffloadEngine
+        self._offload = OffloadEngine.from_params(params)
         from ramses_tpu.resilience.watchdog import Watchdog
         self._wd = Watchdog.from_params(params, telemetry=self.telemetry)
         self._guard_snap = None
@@ -1232,8 +1242,20 @@ class AmrSim:
         # flags bitpacked on device (one uint8 per oct) so the single
         # flag fetch — the only device→host copy of a steady regrid —
         # moves 2^d× fewer bytes; unpacked to per-cell bools below
-        flags = jax.device_get(_pack_flag_bits(
-            self._criteria_flags(spec), ttd))           # ONE trip
+        if self._offload is not None and self._offload.engaged(self):
+            # out-of-core: per-level flag segments so parked levels are
+            # fetched one (plus interp source) at a time
+            rr = self.params.refine
+            eg = (float(rr.err_grad_d), float(rr.err_grad_u),
+                  float(rr.err_grad_p))
+            fls = (float(rr.floor_d), float(rr.floor_u),
+                   float(rr.floor_p))
+            flags = jax.device_get(self._offload.criteria_flags_packed(
+                self, spec, eg, fls,
+                int(self.params.refine.interpol_type), ttd))
+        else:
+            flags = jax.device_get(_pack_flag_bits(
+                self._criteria_flags(spec), ttd))       # ONE trip
         crit: Dict[int, np.ndarray] = {}
         for fl, l in zip(flags, spec.levels):
             m = self.maps[l]
@@ -1346,6 +1368,16 @@ class AmrSim:
             return dev_keys[kk]
 
         new_u: Dict[int, jnp.ndarray] = {}
+        from ramses_tpu.amr import offload as offmod
+
+        def _coarse_dev(l_):
+            # a parked (HostBuffer) coarse level must be device-resident
+            # to serve as the prolongation source; fetch once and write
+            # the device copy back so every finer level reuses it
+            if offmod.is_parked(new_u[l_]):
+                new_u[l_] = offmod.as_device(new_u[l_])
+            return new_u[l_]
+
         for l in self.levels():
             m = self.maps[l]
             lay_new = self.layouts.get(l)
@@ -1365,13 +1397,13 @@ class AmrSim:
                 # level key arrays (amr/device_regrid.py) — no per-level
                 # host table construction, bitwise-identical to the
                 # host reference path below
-                old = old_u.get(l)
+                old = offmod.as_device(old_u.get(l))
                 if old is None:
                     old = jnp.zeros((1, new_u[l - 1].shape[1]),
                                     self.dtype)
                 onoct = oldtree.noct(l) if oldtree.has(l) else 0
                 new_u[l] = self._place(dregrid.migrate_level(
-                    old, new_u[l - 1],
+                    old, _coarse_dev(l - 1),
                     _keys_dev(self.tree, l, m.noct_pad),
                     _keys_dev(oldtree, l,
                               mapmod.bucket(max(onoct, 1), 8)),
@@ -1422,7 +1454,7 @@ class AmrSim:
                 sgn_rep[:nnew] = np.tile(sgn_tab, (len(new_octs), 1))
                 rows_new[:nnew] = (new_r[:, None] * twotondim
                                    + oct_ar).reshape(-1)
-            old = old_u.get(l)
+            old = offmod.as_device(old_u.get(l))
             if old is None:
                 old = jnp.zeros((1, new_u[l - 1].shape[1]), self.dtype)
             rows_d = jnp.asarray(rows_d)
@@ -1435,7 +1467,7 @@ class AmrSim:
                                     rows_new, m.ncell_pad, new_octs,
                                     f_cell, jnp.asarray(nb_rep))
             new_u[l] = self._place(_migrate_level(
-                old, new_u[l - 1], rows_d, rows_s, cell_rep,
+                old, _coarse_dev(l - 1), rows_d, rows_s, cell_rep,
                 jnp.asarray(nb_rep), sgn_dev, rows_new, m.ncell_pad,
                 self.cfg,
                 int(self.params.refine.interpol_type)), "cells")
@@ -1461,6 +1493,11 @@ class AmrSim:
 
     def _restrict_all(self):
         """Restriction sweep fine→coarse so non-leaf cells hold son means."""
+        if self._offload is not None and self._offload.engaged(self):
+            # out-of-core: sweep with at most two levels resident,
+            # re-parking each fine source as soon as it is consumed
+            self._offload.restrict_all_segmented(self, self._fused_spec())
+            return
         for l in sorted(self.levels(), reverse=True):
             if self.tree.has(l + 1):
                 d = self.dev[l]
@@ -1531,6 +1568,12 @@ class AmrSim:
                 # emitted by the previous fused step (dtnew bookkeeping):
                 # u is unchanged since, so this IS the current CFL dt
                 dts = [float(self._dt_cache)]
+            elif self._offload is not None and self._offload.engaged(self):
+                # out-of-core: per-level Courant segments so parked
+                # levels are fetched one at a time (same stack-then-min
+                # reduction order — bitwise equal to the fused program)
+                dts = [self._offload.coarse_dt_min(self,
+                                                   self._fused_spec())]
             else:
                 dts = [float(jnp.min(_fused_courant(
                     self.u, self.dev, self._fused_spec(),
@@ -1754,14 +1797,21 @@ class AmrSim:
             # denominator (move_tracer.f90 uses the pre-step cell mass)
             self._tracer_rho0 = {l: self.u[l][:, 0] for l in self.levels()}
         with self.timers.section("hydro - godunov"):
-            out = _fused_coarse_step(
-                self.u, self.dev, self.fg if self.gravity else {},
-                jnp.asarray(float(dt), self.dtype), spec,
-                self._cool_bundle())
-            if spec.want_flux:
-                self.u, self._dt_cache, self._tracer_phi = out
+            if self._offload is not None and self._offload.engaged(self):
+                # out-of-core: the same step as per-level segments with
+                # host-park/prefetch swap points (amr/offload.py) —
+                # bitwise identical to the monolithic window
+                self.u, self._dt_cache = self._offload.run_step(
+                    self, float(dt), spec)
             else:
-                self.u, self._dt_cache = out
+                out = _fused_coarse_step(
+                    self.u, self.dev, self.fg if self.gravity else {},
+                    jnp.asarray(float(dt), self.dtype), spec,
+                    self._cool_bundle())
+                if spec.want_flux:
+                    self.u, self._dt_cache, self._tracer_phi = out
+                else:
+                    self.u, self._dt_cache = out
         self._pm_drift(float(dt))
         self.t += float(dt)
         self._source_passes(float(dt))
@@ -1839,6 +1889,11 @@ class AmrSim:
         outputs — one extra summary fetch, the fused program itself is
         unchanged in structure."""
         assert not self.gravity and not self.pic
+        if self._offload is not None:
+            # the multi-step window keeps the whole hierarchy in one
+            # donated scan carry — callers gate chunking on engagement,
+            # this is the defensive unpark for direct calls
+            self._offload.unpark_all(self)
         spec = self._fused_spec()
         tdtype = jnp.result_type(float)
         if self._dt_cache is not None:
@@ -2043,7 +2098,9 @@ class AmrSim:
                     and self.cosmo is None and self.sinks is None \
                     and self.tracer_x is None and self.movie is None \
                     and getattr(self, "rt_amr", None) is None \
-                    and _patch.hook("source") is None and chunk > 1:
+                    and _patch.hook("source") is None and chunk > 1 \
+                    and (self._offload is None
+                         or not self._offload.engaged(self)):
                 if sguard is not None:
                     # capture BEFORE injection: the injected NaN plays
                     # a transient solver fault, so the retained state

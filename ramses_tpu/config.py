@@ -82,6 +82,13 @@ class RunParams:
     # on 8, restore on 4 or 1, and vice versa).  .false. refuses a
     # restore whose saved process count differs from the current run.
     elastic_restore: bool = True
+    # JAX persistent compilation cache directory (env fallback
+    # RAMSES_COMPILE_CACHE): set before the first trace so a known
+    # namelist cold-starts in O(load) instead of O(compile); "" keeps
+    # the package default (~/.cache/ramses_tpu_xla on TPU, off on
+    # CPU-forced runs).  Cache hit/miss counts land in the telemetry
+    # run header.
+    compile_cache_dir: str = ""
 
 
 @dataclass
@@ -126,6 +133,19 @@ class AmrParams:
     cost_weight_mhd: float = 2.0
     cost_weight_rt: float = 1.5
     cost_weight_part: float = 0.3
+    # out-of-core hierarchy (amr/offload.py): "off" keeps every level
+    # HBM-resident (the bit-for-bit untouched fast path); "on" parks
+    # inactive levels in host RAM with async double-buffered prefetch
+    # around the subcycle schedule; "auto" engages only when the
+    # estimated resident set exceeds offload_hbm_budget_mb
+    offload: str = "off"
+    # device-memory budget [MiB] the auto mode compares the estimated
+    # resident set against; 0 reads the device's reported bytes_limit
+    # (platforms that report none never auto-engage)
+    offload_hbm_budget_mb: float = 0.0
+    # levels smaller than this [MiB] are never parked — the transfer
+    # cost outweighs the HBM reclaimed
+    offload_min_park_mb: float = 0.0
 
 
 @dataclass

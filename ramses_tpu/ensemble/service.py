@@ -41,6 +41,11 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
         f.write(rec["namelist"])
     params = params_from_string(rec["namelist"],
                                 ndim=int(rec.get("ndim", 3)))
+    # persistent compile cache before the first trace: a fleet worker
+    # re-claiming a known namelist cold-starts in O(load), not
+    # O(compile) (&RUN_PARAMS compile_cache_dir / RAMSES_COMPILE_CACHE)
+    from ramses_tpu.platform import setup_compile_cache
+    setup_compile_cache(params)
     params.output.output_dir = rdir
     if not params.output.telemetry:
         params.output.telemetry = os.path.join(rdir, "telemetry.jsonl")

@@ -178,7 +178,12 @@ def _attach_particles(sim, lanes: Dict[str, np.ndarray],
 
 def _level_arrays(sim) -> Dict[str, object]:
     """Name → sharded device array for everything that must ride the
-    checkpoint (solver family decides: hydro u; MHD adds faces)."""
+    checkpoint (solver family decides: hydro u; MHD adds faces).
+
+    Under &AMR_PARAMS offload, a parked level rides as an
+    ``offload.HostBuffer``: ``_shard_blocks`` stages it through
+    ``np.asarray`` (zero-copy ``__array__``), so dumping a parked
+    hierarchy reads host staging directly — no device round-trip."""
     arrs = {f"u{l}": sim.u[l] for l in sim.levels()}
     bf = getattr(sim, "bf", None)
     if isinstance(bf, dict):

@@ -807,8 +807,18 @@ def _mhd_advance_traced(u, bf, dev, fg, dt, spec: FusedSpec):
                 # cell variables — exclude them; degenerate components
                 # (c >= ndim) are genuinely conserved and keep theirs
                 corr = corr.at[..., IBX:IBX + min(nd, NCOMP)].set(0.0)
-                unew[l - 1] = K.scatter_corrections(unew[l - 1], corr,
-                                                    d["corr_idx"], cfg)
+                if spec.comm and spec.comm[i] is not None:
+                    # sharded mesh with an explicit schedule: the CT
+                    # sweep stays global-view (staggered faces + child
+                    # EMF), but the coarse fold goes through the
+                    # deterministic owner-fold instead of a GSPMD
+                    # scatter-add (parallel/amr_comm.py)
+                    from ramses_tpu.parallel import amr_comm
+                    unew[l - 1] = amr_comm.fold_corrections_explicit(
+                        corr, unew[l - 1], d, spec.comm[i])
+                else:
+                    unew[l - 1] = K.scatter_corrections(
+                        unew[l - 1], corr, d["corr_idx"], cfg)
             bf[l] = bfn
         u[l] = unew[l]
         if spec.gravity:
@@ -894,6 +904,10 @@ class MhdAmrSim(AmrSim):
     _needs_mig_log = True
     _pm_physics = False      # MHD state layout carries cell-centred B
     _noncubic_ok = False     # dense CT path assumes one root cube
+    # out-of-core offload drives the base class's per-level segmented
+    # step, which doesn't carry the staggered face state — MHD keeps
+    # its own fused step chain and opts out (amr/offload.py)
+    _offload_capable = False
     # partial levels take the gather-fused blocked tile sweep too:
     # mhd_tile_sweep runs ct_core on the compact Morton-tile batch (XLA
     # tile formulation — the Pallas oct kernel stays hydro-only), so
@@ -1183,12 +1197,19 @@ class MhdAmrSim(AmrSim):
     def _fused_spec(self) -> FusedSpec:
         if self._spec is None:
             lv = tuple(self.levels())
+            cspecs = getattr(self, "_comm_specs", {})
             self._spec = FusedSpec(
                 cfg=self.mcfg, bspec=self.bspec, lmin=self.lmin,
                 boxlen=self.boxlen, levels=lv,
                 complete=tuple(self.maps[l].complete for l in lv),
                 gravity=self.gravity,
-                itype=int(self.params.refine.interpol_type))
+                itype=int(self.params.refine.interpol_type),
+                # explicit-comm meshes: partial levels route the coarse
+                # correction fold through the deterministic owner-fold
+                # (fold_corrections_explicit) — the CT sweep itself
+                # stays global-view
+                comm=(tuple(cspecs.get(l) for l in lv) if cspecs
+                      else ()))
             # slab-sharded complete levels: gradient flags AND the CT
             # advance (mhd_ct_slab — the EMF override scatters into
             # flat rows via emf_flat_idx, so no global index scatter

@@ -369,3 +369,60 @@ def sweep_correct_explicit(u_l, u_lm1, unew_lm1, d: dict, dt, dx: float,
         check_rep=(spec.backend != "dma"))
     return fn(u_l, u_lm1, unew_lm1, jnp.asarray(dt), vsgn, d["ok_ref"],
               *sched)
+
+
+def fold_corrections_explicit(corr, unew_lm1, d: dict,
+                              spec: SweepCommSpec):
+    """Deterministic owner-fold of precomputed partial-level corrections
+    — the P3 leg of :func:`sweep_correct_explicit` alone.
+
+    For solvers whose partial-level sweep cannot run inside the
+    shard_map (the MHD CT sweep carries staggered faces and child-EMF
+    overrides the hydro schedule knows nothing about), the sweep stays
+    global-view but the coarse fold still must not be a GSPMD scatter-
+    add: the partitioner turns ``unew.at[idx].add`` over shard-crossing
+    indices into an all-gathered scatter whose fold order is
+    unspecified.  This reuses the same reverse schedule — own entries
+    first, then ring offsets ascending — so the fold is bitwise
+    reproducible and identical across halo backends.
+
+    ``corr`` is the level-l ``[noct_pad, ndim, 2, nvar]`` correction
+    block (row-sharded like u_l); the schedule's weights already carry
+    ``±1/2^ndim`` and the validity mask, making this a drop-in for
+    ``K.scatter_corrections(unew_lm1, corr, corr_idx, cfg)``."""
+    mesh = spec.mesh
+    ndev = mesh.shape[AXIS]
+    cm = d["comm"]
+
+    def body(c_loc, unew_loc, *sched):
+        it = iter(sched)
+        own_src, own_tgt, own_w = (next(it)[0], next(it)[0],
+                                   next(it)[0])
+        corr_send = {k: next(it)[0] for k in spec.corr_offsets}
+        corr_w = {k: next(it)[0] for k in spec.corr_offsets}
+        corr_tgt = {k: next(it)[0] for k in spec.corr_offsets}
+        cflat = c_loc.reshape(-1, c_loc.shape[-1])
+        unew_loc = unew_loc.at[own_tgt].add(
+            (cflat[own_src] * own_w[:, None]).astype(unew_loc.dtype))
+        if spec.corr_offsets:
+            gots = dma_halo.exchange_slabs(
+                [cflat[corr_send[k]] * corr_w[k][:, None]
+                 for k in spec.corr_offsets],
+                [_perm(ndev, k) for k in spec.corr_offsets],
+                AXIS, backend=spec.backend)
+            for k, got in zip(spec.corr_offsets, gots):
+                unew_loc = unew_loc.at[corr_tgt[k]].add(
+                    got.astype(unew_loc.dtype))
+        return unew_loc
+
+    sched_names = (["own_src", "own_tgt", "own_w"]
+                   + [f"corr_send_{k}" for k in spec.corr_offsets]
+                   + [f"corr_w_{k}" for k in spec.corr_offsets]
+                   + [f"corr_tgt_{k}" for k in spec.corr_offsets])
+    sched = [cm[n] for n in sched_names]
+    fn = _shard_map(
+        body, mesh,
+        in_specs=(P(AXIS), P(AXIS)) + (P(AXIS),) * len(sched),
+        out_specs=P(AXIS),
+        check_rep=(spec.backend != "dma"))
+    return fn(corr, unew_lm1, *sched)

@@ -18,6 +18,85 @@ import re
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# persistent-compile-cache hit/miss counters (best-effort, via
+# jax.monitoring): "hits" counts executables served from the on-disk
+# cache, "misses" counts real backend compiles.  Surfaced in the
+# telemetry run header so worker cold-start economics are observable.
+_CACHE_STATS = {"hits": 0, "misses": 0, "dir": ""}
+_cache_listener_installed = False
+
+
+def _install_cache_listener():
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_event(name, **kw):
+            if "persistent_cache_hit" in name \
+                    or ("compilation_cache" in name and "hit" in name):
+                _CACHE_STATS["hits"] += 1
+
+        def _on_duration(name, secs, **kw):
+            if name.endswith("backend_compile_duration"):
+                _CACHE_STATS["misses"] += 1
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _cache_listener_installed = True
+    except Exception:      # monitoring API drift must not kill a run
+        pass
+
+
+def compile_cache_stats() -> dict:
+    """Snapshot of {hits, misses, dir} for telemetry headers."""
+    return dict(_CACHE_STATS)
+
+
+def setup_compile_cache(params) -> str:
+    """Point the persistent compilation cache at an explicit directory.
+
+    ``&RUN_PARAMS compile_cache_dir`` (env fallback
+    ``RAMSES_COMPILE_CACHE``) — called from ``__main__`` and the
+    ensemble service BEFORE the first trace, so a known namelist
+    cold-starts in O(load) instead of O(compile).  Unlike the
+    package-import default (:func:`enable_compile_cache`) an explicit
+    directory is honored on every backend, including CPU-forced runs —
+    the operator asked for it by name.  Returns the directory in
+    effect ("" when unset).  Best-effort: an unwritable path warns and
+    leaves the run uncached rather than failing it.
+    """
+    path = str(getattr(getattr(params, "run", params),
+                       "compile_cache_dir", "") or "").strip()
+    if not path:
+        path = os.environ.get("RAMSES_COMPILE_CACHE", "").strip()
+    if not path:
+        return ""
+    path = os.path.expanduser(path)
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every entry: the point is O(load) worker cold-start,
+        # and the fused AMR programs the growth phase re-traces are
+        # individually small but numerous
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          0)
+        # JAX-level executable cache only (see enable_compile_cache):
+        # the XLA:CPU AOT cache keys on exact host machine features
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "none")
+        _CACHE_STATS["dir"] = path
+        _install_cache_listener()
+        return path
+    except Exception as e:
+        import warnings
+        warnings.warn(f"compile_cache_dir={path!r} not usable: {e}")
+        return ""
+
 
 def enable_compile_cache():
     """Point JAX's persistent compilation cache at a durable directory.

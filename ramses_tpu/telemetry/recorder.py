@@ -219,6 +219,13 @@ class Telemetry:
         self._prev_showwarning = None
         self._append = False           # resume: keep prior attempts' log
         self._event_counts: Dict[str, int] = {}
+        # out-of-core residency totals (&AMR_PARAMS offload) — summed
+        # from per-step stats, surfaced flat in the run footer
+        self._off_totals: Dict[str, int] = {
+            "offload_stalls": 0, "offload_prefetches": 0,
+            "offload_fetches": 0, "offload_overlapped": 0,
+            "offload_bytes_parked": 0, "offload_bytes_fetched": 0,
+            "offload_device_hwm_bytes": 0}
         _install_compile_listener()
 
     # -- sinks ---------------------------------------------------------
@@ -395,6 +402,33 @@ class Telemetry:
         if bst and "blocked_frac" in bst:
             # fraction of partial-level octs on the blocked tile sweep
             rec["blocked_frac"] = round(float(bst["blocked_frac"]), 4)
+        off = getattr(sim, "_offload", None)
+        ost = getattr(off, "last_step_stats", None)
+        if ost is not None:
+            # out-of-core residency traffic of the step cycle that
+            # ENDED with this step (regrid/dt fetches included)
+            rec["offload"] = {
+                "stalls": int(ost["stalls"]),
+                "prefetches": int(ost["prefetches"]),
+                "fetches": int(ost["fetches"]),
+                "overlap_frac": round(float(ost["overlap_frac"]), 4),
+                "bytes_parked": int(ost["bytes_parked"]),
+                "bytes_fetched": int(ost["bytes_fetched"]),
+                "device_hwm_bytes": int(ost["device_hwm_bytes"]),
+            }
+            self._off_totals["offload_stalls"] += int(ost["stalls"])
+            self._off_totals["offload_prefetches"] += \
+                int(ost["prefetches"])
+            self._off_totals["offload_fetches"] += int(ost["fetches"])
+            self._off_totals["offload_overlapped"] += \
+                int(ost["overlapped"])
+            self._off_totals["offload_bytes_parked"] += \
+                int(ost["bytes_parked"])
+            self._off_totals["offload_bytes_fetched"] += \
+                int(ost["bytes_fetched"])
+            hwm = int(ost["device_hwm_bytes"])
+            if hwm > self._off_totals["offload_device_hwm_bytes"]:
+                self._off_totals["offload_device_hwm_bytes"] = hwm
         nq = getattr(sim, "quarantined_count", None)
         if nq:
             # member isolation ladder (ensemble engines): evicted
@@ -472,6 +506,16 @@ class Telemetry:
         }
         if self._event_counts:
             footer["events"] = dict(self._event_counts)
+        off_ran = (sim is not None and getattr(
+            getattr(sim, "_offload", None), "last_step_stats", None)
+            is not None)
+        if off_ran or self._off_totals["offload_fetches"] \
+                or self._off_totals["offload_bytes_parked"]:
+            footer.update(self._off_totals)
+            f = self._off_totals["offload_fetches"]
+            footer["offload_overlap_frac"] = round(
+                self._off_totals["offload_overlapped"] / f, 4) if f \
+                else 1.0
         if sim is not None:
             footer["nstep"] = int(getattr(sim, "nstep", 0))
             footer["t"] = float(getattr(sim, "t", 0.0))
@@ -518,4 +562,14 @@ def sim_run_info(sim) -> Dict[str, Any]:
     bst = getattr(sim, "block_stats", None)
     if bst and "blocked_frac" in bst:
         info["blocked_frac"] = round(float(bst["blocked_frac"]), 4)
+    off = getattr(sim, "_offload", None)
+    if off is not None:
+        info["offload"] = off.mode
+        info["offload_hbm_budget_mb"] = float(off.budget_mb)
+    from ramses_tpu import platform
+    cs = platform.compile_cache_stats()
+    if cs["dir"]:
+        info["compile_cache_dir"] = cs["dir"]
+        info["compile_cache_hits"] = int(cs["hits"])
+        info["compile_cache_misses"] = int(cs["misses"])
     return info

@@ -1,0 +1,89 @@
+"""Persistent compile cache setup (&RUN_PARAMS compile_cache_dir).
+
+``platform.setup_compile_cache`` points JAX's persistent compilation
+cache at an operator-named directory BEFORE the first trace — unlike
+the package-import default it is honored on CPU-forced runs too, since
+the operator asked for it by name.  These tests pin the plumbing only
+(config update, env fallback, stats surface, fail-soft on a bad path);
+actual cache hits are a backend concern exercised on TPU.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ramses_tpu import platform
+from ramses_tpu.config import params_from_string
+
+pytestmark = pytest.mark.smoke
+
+MINI = """
+&RUN_PARAMS
+hydro=.true.
+{extra}
+/
+&AMR_PARAMS
+levelmin=3
+levelmax=3
+/
+"""
+
+
+def _params(extra=""):
+    return params_from_string(MINI.format(extra=extra), ndim=2)
+
+
+@pytest.fixture
+def restore_jax_cache_config():
+    import jax
+
+    keys = ("jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+            "jax_persistent_cache_enable_xla_caches")
+    old = {k: getattr(jax.config, k) for k in keys}
+    olddir = platform._CACHE_STATS["dir"]
+    yield
+    for k, v in old.items():
+        jax.config.update(k, v)
+    platform._CACHE_STATS["dir"] = olddir
+
+
+def test_explicit_dir_configures_jax(tmp_path, restore_jax_cache_config):
+    import jax
+
+    d = str(tmp_path / "xla_cache")
+    p = _params(f"compile_cache_dir='{d}'")
+    got = platform.setup_compile_cache(p)
+    assert got == d
+    assert os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+    assert platform.compile_cache_stats()["dir"] == d
+
+
+def test_unset_leaves_cache_alone(monkeypatch):
+    monkeypatch.delenv("RAMSES_COMPILE_CACHE", raising=False)
+    assert platform.setup_compile_cache(_params()) == ""
+
+
+def test_env_fallback(tmp_path, monkeypatch, restore_jax_cache_config):
+    d = str(tmp_path / "env_cache")
+    monkeypatch.setenv("RAMSES_COMPILE_CACHE", d)
+    assert platform.setup_compile_cache(_params()) == d
+    # the namelist field wins over the env when both are set
+    d2 = str(tmp_path / "nml_cache")
+    p = _params(f"compile_cache_dir='{d2}'")
+    assert platform.setup_compile_cache(p) == d2
+
+
+def test_bad_path_warns_and_runs_uncached(tmp_path,
+                                          restore_jax_cache_config):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("x")
+    p = _params(f"compile_cache_dir='{blocker}/sub'")
+    with pytest.warns(UserWarning, match="not usable"):
+        assert platform.setup_compile_cache(p) == ""

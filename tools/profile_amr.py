@@ -349,6 +349,30 @@ def collect(hb=lambda *a, **k: None, emit=None):
         res["updates_per_coarse_step"] = upd
     probe("steady_state", p_steady)
 
+    def p_offload():
+        # segmented out-of-core step (amr/offload.py) on the same
+        # frozen tree: per-step wall with inactive levels cycling
+        # through host parks — side-by-side with fused_coarse_step
+        # above, the segmentation + transfer overhead is the delta;
+        # the residency counters land under res["offload"]
+        from ramses_tpu.amr.offload import OffloadEngine
+        eng = OffloadEngine("on")
+        why = eng.ineligible_reason(sim)
+        if why is not None:
+            res["offload"] = {"skipped": why}
+            return
+        dtf = float(sim.coarse_dt())
+        spec_now = sim._fused_spec()
+
+        def _ostep():
+            sim.u, sim._dt_cache = eng.run_step(sim, dtf, spec_now)
+            return sim.u[sim.lmin]
+        t["offload_step"] = timeit(_ostep, max(3, reps // 2), _sync)
+        res["offload"] = dict(eng.last_step_stats or {})
+        eng.unpark_all(sim)       # later probes expect device arrays
+        sim._dt_cache = None
+    probe("offload_step", p_offload)
+
     def p_trace():
         tdir = os.environ.get("PROFILE_TRACE_DIR")
         if tdir:
