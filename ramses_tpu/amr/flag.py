@@ -12,7 +12,7 @@ father-cell stencil exists.
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List
+from typing import Dict
 
 import numpy as np
 

@@ -2060,6 +2060,14 @@ class AmrSim:
                     telem.run_info["hlo_gather_elems"] = \
                         sum(n for n, _ in inv)
                     telem.run_info["hlo_gather_ops"] = len(inv)
+                    # static-analysis audit of the same lowering:
+                    # severity counts of UNBASELINED findings (see
+                    # ramses_tpu/analysis) — nonzero error/warn here
+                    # means this exact run pays for a hazard the lint
+                    # gate would flag
+                    from ramses_tpu.analysis import engine as _aeng
+                    telem.run_info["analysis_findings"] = \
+                        _aeng.audit_sim(self, text=txt)
                 except Exception as e:  # pragma: no cover - best effort
                     telem.run_info["hlo_gather_elems"] = None
                     telem.run_info["hlo_gather_error"] = repr(e)

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from dataclasses import replace as dreplace
 from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,75 @@
+"""Run the rule registry over a program set + the source tree.
+
+The one orchestration layer every gate shares: ``tools/lint.py``
+(CLI / CI), the nightly gather gate, the multichip dryrun's lint leg,
+and the telemetry run-header hook all call :func:`run` or
+:func:`audit_program` so there is exactly one implementation of "what
+does a clean program look like".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ramses_tpu.analysis.rules import (Finding, Severity, all_rules,
+                                       load_baseline, severity_counts,
+                                       split_baselined)
+
+
+def audit_program(program) -> List[Finding]:
+    """All HLO-rule findings for one lowered program (duck-typed:
+    ``.name``/``.text``/``.meta``)."""
+    out: List[Finding] = []
+    for rule in all_rules():
+        if rule.kind == "hlo":
+            out.extend(rule.check(program))
+    return out
+
+
+def audit_sim(sim, text: Optional[str] = None) -> Dict[str, int]:
+    """Severity counts of the HLO audit of ``sim``'s own fused step —
+    the telemetry run-header ``analysis_findings`` payload (accepted
+    baseline findings excluded, so the header reports the *new*
+    hazard state of the exact program the run measures).  ``text``
+    reuses an already-held lowering instead of re-tracing."""
+    from ramses_tpu.analysis.programs import sim_program
+    findings = audit_program(sim_program(sim, text=text))
+    new, _accepted = split_baselined(findings, load_baseline())
+    return severity_counts(new)
+
+
+def run(programs, source_root: Optional[str] = None,
+        rule_ids: Optional[List[str]] = None) -> List[Finding]:
+    """Every finding from every registered rule: HLO rules over each
+    of ``programs``, source rules over the package tree (or
+    ``source_root``)."""
+    findings: List[Finding] = []
+    for rule in all_rules():
+        if rule_ids is not None and rule.id not in rule_ids:
+            continue
+        if rule.kind == "hlo":
+            for prog in programs:
+                findings.extend(rule.check(prog))
+        else:
+            findings.extend(rule.check(source_root))
+    return findings
+
+
+def report(findings: List[Finding],
+           baseline_path: Optional[str] = None) -> Dict[str, Any]:
+    """Machine-readable verdict: findings partitioned against the
+    baseline plus severity counts — the ``tools/lint.py`` JSON
+    shape."""
+    baseline = load_baseline(baseline_path)
+    new, accepted = split_baselined(findings, baseline)
+    stale = sorted(set(baseline)
+                   - {f.fingerprint for f in findings})
+    return {
+        "schema_version": 1,
+        "counts": severity_counts(findings),
+        "new_counts": severity_counts(new),
+        "new": [f.to_json() for f in new],
+        "accepted": [f.to_json() for f in accepted],
+        "stale_baseline": stale,
+        "ok": not any(f.severity >= Severity.WARN for f in new),
+    }

@@ -1,0 +1,258 @@
+"""Source-level rules: host-sync hazards the lowered HLO cannot show.
+
+A ``jax.device_get`` / ``.block_until_ready()`` in a kernel-layer
+module serializes the dispatch pipeline — the class of bug the
+telemetry "zero added device fetches" pins guard dynamically; this
+rule catches new ones statically, at the AST level, before any test
+runs.  The driver layer (``driver.py`` modules, ensemble engine,
+resilience, io, telemetry, utils) is allowlisted: that is where the
+one designed sync per fused window lives.
+
+Also covers the non-hashable jit static-arg hazard: a function
+jitted with ``static_argnums``/``static_argnames`` whose static
+parameter defaults to a list/dict/set literal fails at call time
+with an unhashable-type error — but only on the first call with the
+default, which is exactly the path tests skip.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Tuple
+
+from ramses_tpu.analysis.rules import Finding, Rule, Severity, register
+
+# module prefixes (relative to the package root) where host syncs are
+# the designed fetch boundary, not a hazard
+HOST_SYNC_ALLOW_PREFIXES = (
+    "telemetry/", "utils/", "resilience/", "io/", "ensemble/",
+)
+# file basenames allowlisted anywhere: the driver layer owns the one
+# sync per fused window, and the platform/__main__ shims run at startup
+HOST_SYNC_ALLOW_BASENAMES = (
+    "driver.py", "__main__.py", "platform.py", "patch.py",
+)
+
+_SYNC_CALLS = ("device_get", "block_until_ready")
+# state-array roots: float()/int()/np.asarray() directly on a device
+# state attribute is an implicit transfer + sync in a hot loop
+_STATE_ATTRS = ("u", "bfs", "fg", "dev")
+_CAST_FUNCS = ("float", "int")
+_NP_FUNCS = ("asarray", "array")
+
+
+def _pkg_root() -> str:
+    import ramses_tpu
+    return os.path.dirname(os.path.abspath(ramses_tpu.__file__))
+
+
+def _iter_sources(root: str):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _relmod(path: str, root: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def _enclosing_func(stack: List[ast.AST]) -> str:
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return ".".join(names) or "<module>"
+
+
+def _state_attr_root(node: ast.AST) -> Optional[str]:
+    """``self.u[...]`` / ``sim.bfs`` style roots of a device state
+    array, or None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _STATE_ATTRS \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "sim"):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+class _SyncVisitor(ast.NodeVisitor):
+    def __init__(self, module: str):
+        self.module = module
+        self.stack: List[ast.AST] = []
+        # {(func, callname): count}
+        self.hits: dict = {}
+
+    def _record(self, callname: str):
+        key = (_enclosing_func(self.stack), callname)
+        self.hits[key] = self.hits.get(key, 0) + 1
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_CALLS:
+                # jax.device_get(...) / arr.block_until_ready()
+                self._record(f.attr)
+            elif f.attr in _NP_FUNCS and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy") and node.args \
+                    and _state_attr_root(node.args[0]):
+                self._record(
+                    f"np.{f.attr}({_state_attr_root(node.args[0])})")
+        elif isinstance(f, ast.Name) and f.id in _CAST_FUNCS \
+                and node.args and _state_attr_root(node.args[0]):
+            self._record(f"{f.id}({_state_attr_root(node.args[0])})")
+        self.generic_visit(node)
+
+
+def _allowlisted(rel: str) -> bool:
+    return rel.startswith(HOST_SYNC_ALLOW_PREFIXES) \
+        or os.path.basename(rel) in HOST_SYNC_ALLOW_BASENAMES
+
+
+def _check_host_sync(root: Optional[str] = None) -> List[Finding]:
+    root = root or _pkg_root()
+    out: List[Finding] = []
+    for path in _iter_sources(root):
+        rel = _relmod(path, root)
+        if _allowlisted(rel):
+            continue
+        try:
+            tree = ast.parse(open(path).read(), filename=path)
+        except SyntaxError as e:    # a broken file is its own finding
+            out.append(Finding(
+                rule="host-sync", severity=Severity.ERROR,
+                program=rel, message=f"unparseable module: {e}",
+                key="syntax-error"))
+            continue
+        v = _SyncVisitor(rel)
+        v.visit(tree)
+        for (func, callname), n in sorted(v.hits.items()):
+            # explicit sync calls (device_get / block_until_ready)
+            # gate at WARN; implicit transfers (float()/np.asarray()
+            # on a state root) are INFO — usually host-side
+            # IC/diagnostic passes, but worth surfacing in the report
+            sev = Severity.WARN if callname in _SYNC_CALLS \
+                else Severity.INFO
+            out.append(Finding(
+                rule="host-sync", severity=sev,
+                program=rel,
+                message=(f"{callname} in {rel}:{func} ({n} site(s)) "
+                         "— a host sync in a kernel-layer module "
+                         "serializes the dispatch pipeline; move the "
+                         "fetch to the driver layer or baseline it "
+                         "as a designed sync point"),
+                key=f"{func}:{callname}",
+                detail={"function": func, "call": callname,
+                        "count": n}))
+    return out
+
+
+register(Rule(
+    id="host-sync", kind="source", check=_check_host_sync,
+    doc=("The telemetry zero-overhead pins count device fetches "
+         "dynamically; this is the static version.  Flags "
+         "jax.device_get / .block_until_ready() / float(state) / "
+         "np.asarray(state) in kernel-layer modules outside the "
+         "driver/telemetry/guard allowlist.")))
+
+
+# ---------------------------------------------------------------------
+# static-arg-hazard: non-hashable jit static arguments
+# ---------------------------------------------------------------------
+def _jit_static_args(dec: ast.AST) -> Optional[Tuple[List[int],
+                                                     List[str]]]:
+    """``(static_argnums, static_argnames)`` when ``dec`` is a
+    ``jax.jit`` / ``partial(jax.jit, ...)`` decorator, else None."""
+    if not isinstance(dec, ast.Call):
+        return None
+    f = dec.func
+    is_jit = (isinstance(f, ast.Attribute) and f.attr == "jit") or \
+        (isinstance(f, ast.Name) and f.id == "jit")
+    if isinstance(f, ast.Name) and f.id == "partial" and dec.args:
+        inner = dec.args[0]
+        if (isinstance(inner, ast.Attribute) and inner.attr == "jit") \
+                or (isinstance(inner, ast.Name) and inner.id == "jit"):
+            is_jit = True
+    if not is_jit:
+        return None
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in dec.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        vals = kw.value.elts if isinstance(
+            kw.value, (ast.Tuple, ast.List)) else [kw.value]
+        for v in vals:
+            if isinstance(v, ast.Constant):
+                if isinstance(v.value, int):
+                    nums.append(v.value)
+                elif isinstance(v.value, str):
+                    names.append(v.value)
+    return nums, names
+
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+def _check_static_args(root: Optional[str] = None) -> List[Finding]:
+    root = root or _pkg_root()
+    out: List[Finding] = []
+    for path in _iter_sources(root):
+        rel = _relmod(path, root)
+        try:
+            tree = ast.parse(open(path).read(), filename=path)
+        except SyntaxError:
+            continue                # host-sync already reports this
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            spec = None
+            for dec in node.decorator_list:
+                spec = _jit_static_args(dec)
+                if spec:
+                    break
+            if not spec:
+                continue
+            nums, names = spec
+            args = node.args.args
+            ndef = len(node.args.defaults)
+            for i, a in enumerate(args):
+                static = i in nums or a.arg in names
+                if not static:
+                    continue
+                di = i - (len(args) - ndef)
+                if di < 0:
+                    continue        # no default
+                if isinstance(node.args.defaults[di],
+                              _MUTABLE_LITERALS):
+                    out.append(Finding(
+                        rule="static-arg-hazard",
+                        severity=Severity.ERROR, program=rel,
+                        message=(f"{rel}:{node.name} jits "
+                                 f"{a.arg!r} as a static argument "
+                                 "with a mutable (unhashable) "
+                                 "default — the first call relying "
+                                 "on the default raises TypeError "
+                                 "at the jit cache lookup"),
+                        key=f"{node.name}:{a.arg}",
+                        detail={"function": node.name,
+                                "arg": a.arg}))
+    return out
+
+
+register(Rule(
+    id="static-arg-hazard", kind="source", check=_check_static_args,
+    doc=("jit static arguments are dict keys of the compile cache; a "
+         "mutable default (list/dict/set) on a static parameter is "
+         "unhashable and explodes only on the rarely-tested "
+         "default-argument path.  Flags jitted functions whose "
+         "static args default to mutable literals.")))

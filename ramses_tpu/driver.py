@@ -9,8 +9,8 @@ from __future__ import annotations
 
 import time
 from contextlib import nullcontext
-from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from dataclasses import dataclass
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -18,7 +18,7 @@ import numpy as np
 
 from ramses_tpu.config import Params, load_params
 from ramses_tpu.grid import boundary as bmod
-from ramses_tpu.grid.uniform import UniformGrid, cfl_dt, run_steps, step, totals
+from ramses_tpu.grid.uniform import UniformGrid, run_steps, step
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init.regions import condinit
 from ramses_tpu.pm.coupling import PMSpec, run_steps_pm, total_density

@@ -13,7 +13,7 @@ compose, mirroring the region-ordered fill of the reference.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -24,14 +24,12 @@ erg cm^3 / s so that dT2/dt = -(2X/3kB) * nH * Lambda_net.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ramses_tpu.units import X_frac, kB, mH
+from ramses_tpu.units import X_frac, kB
 
 # table geometry (cooling_module.f90:40-45)
 NBIN_T = 101

@@ -19,7 +19,7 @@ vector lanes.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from typing import Tuple
 
 from ramses_tpu.config import Params

@@ -18,10 +18,8 @@ region never consumes.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
-import jax
 import jax.numpy as jnp
 
 from ramses_tpu.hydro import riemann as rsolve

@@ -35,7 +35,6 @@ gas-tracer per-cell face mass-flux capture as a third output
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,7 @@ concatenation of such records.  This module is the byte-level substrate for
 from __future__ import annotations
 
 import struct
-from typing import BinaryIO, Optional, Sequence, Union
+from typing import BinaryIO
 
 import numpy as np
 

@@ -15,7 +15,7 @@ Layout (SnapFormat=1, little-endian Fortran records):
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Tuple
 
 import numpy as np

@@ -10,8 +10,7 @@ the reference's layout: time + bounds header, [nw, nh], float32 data.
 from __future__ import annotations
 
 import os
-import struct
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np

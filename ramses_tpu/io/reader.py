@@ -9,10 +9,9 @@ level/x/y/z/dx + primitive variables).
 
 from __future__ import annotations
 
-import glob
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 

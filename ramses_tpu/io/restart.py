@@ -8,7 +8,6 @@ particle set.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Optional, Tuple
 
 import numpy as np

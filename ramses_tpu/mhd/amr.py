@@ -37,7 +37,7 @@ hierarchy's per-level Poisson solve with MHD-layout kicks
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, NamedTuple, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -852,7 +852,7 @@ def _mhd_courant_traced(u, bf, dev, spec: FusedSpec, fg=None):
     return jnp.stack(dts)
 
 
-@partial(jax.jit, static_argnames=("spec",))
+@partial(jax.jit, static_argnames=("spec",), donate_argnums=(0, 1))
 def _mhd_fused_coarse_step(u, bf, dev, dt, spec: FusedSpec, fg=None):
     u, bf = _mhd_advance_traced(u, bf, dev, fg, dt, spec)
     return u, bf, jnp.min(_mhd_courant_traced(

@@ -16,7 +16,7 @@ Primitive ``q``:  [ρ, v_x, v_y, v_z, P, Bc_x, Bc_y, Bc_z, passives…]
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax.numpy as jnp
 

@@ -11,14 +11,13 @@ from __future__ import annotations
 
 import time
 from contextlib import nullcontext
-from dataclasses import dataclass
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ramses_tpu.config import Params, load_params
+from ramses_tpu.config import Params
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.mhd import core, uniform as mu
 from ramses_tpu.mhd.core import IBX, IP, MhdStatic, NCOMP

@@ -61,10 +61,9 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ramses_tpu.amr.hierarchy import AmrSim
-from ramses_tpu.amr.maps import bucket
 from ramses_tpu.config import Params
 from ramses_tpu.parallel.mesh import oct_mesh
 

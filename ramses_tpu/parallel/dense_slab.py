@@ -50,7 +50,6 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 

@@ -53,7 +53,7 @@ from __future__ import annotations
 
 import importlib.util
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Dict, Optional
 
 HOOK_NAMES = ("condinit", "gravana", "boundana", "source")
 # hooks whose lookup happens at jit TRACE time: swapping them must

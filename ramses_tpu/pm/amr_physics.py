@@ -27,17 +27,15 @@ Level semantics:
 from __future__ import annotations
 
 from dataclasses import replace as dreplace
-from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ramses_tpu.amr.tree import Octree, map_coords
-from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
 from ramses_tpu.pm.star_formation import (FLAG_SN_DONE, M_SUN, SfSpec,
                                           append_stars, mstar_quantum,
                                           sf_timescale_code)
-from ramses_tpu.units import Units, factG_in_cgs, yr2sec
+from ramses_tpu.units import Units, factG_in_cgs
 
 
 def ngp_rows(tree: Octree, x: np.ndarray, lvl: int, boxlen: float,

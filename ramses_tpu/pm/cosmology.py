@@ -20,7 +20,7 @@ no 1e6-step Python loop.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Tuple
 
 import jax.numpy as jnp

@@ -18,8 +18,7 @@ Conventions bridged here (code units: box = 1, conformal time τ in
 
 from __future__ import annotations
 
-import os
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

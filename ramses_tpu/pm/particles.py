@@ -16,8 +16,7 @@ static shapes for XLA, masked lanes instead of linked-list surgery.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace as dreplace
-from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

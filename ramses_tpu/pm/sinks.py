@@ -10,8 +10,8 @@ numpy; only the gas-side mass removal touches device arrays.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional, Tuple
+from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 

@@ -19,7 +19,6 @@ the sinks they attach to.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List
 
 import numpy as np
 

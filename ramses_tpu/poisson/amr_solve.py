@@ -13,7 +13,6 @@ face-neighbour index map.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,9 +16,8 @@ reference's single ``amr_step``).  This module holds the pieces:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 
 from ramses_tpu.grid import boundary as bmod

@@ -9,7 +9,6 @@ and f = -grad(phi).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 

@@ -22,7 +22,6 @@ from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def _shift0(a, s: int, ax: int):

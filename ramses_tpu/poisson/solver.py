@@ -19,7 +19,7 @@ reference's MAXITER=10 cap, ``multigrid_fine_commons.f90:33-34``).
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.hydro import muscl as hmuscl
 from ramses_tpu.rhd import core
-from ramses_tpu.rhd.core import NCOMP, RhdStatic
+from ramses_tpu.rhd.core import RhdStatic
 
 NGHOST = 2
 

@@ -9,13 +9,12 @@ into the gas energy).  Unit bridging follows ``amr/units.f90`` /
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ramses_tpu.rt.driver import RtSim, RtSpec
-from ramses_tpu.units import X_frac, mH, kB
+from ramses_tpu.units import X_frac, mH
 
 
 class RtCoupled:

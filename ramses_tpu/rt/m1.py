@@ -13,10 +13,7 @@ makes the scheme a plain roll-stencil that XLA fuses into one kernel —
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 
 SMALL_NP = 1e-30

@@ -7,34 +7,85 @@ proxy for that HBM traffic — countable on the CPU test backend, stable
 across XLA versions (it is read from the *lowered* StableHLO, before
 the partitioner or fusion touch it).  The blocked Morton-tile path
 exists to shrink exactly this number, so the regression test pins it
-(tests/test_hlo_inventory.py) and the telemetry run header records it
+(tests/test_hlo_inventory.py via the ``gather-blowup`` rule of
+:mod:`ramses_tpu.analysis`) and the telemetry run header records it
 (``hlo_gather_elems``) for offline trend tracking.
+
+This module is the one low-level implementation: the ``analysis``
+rule engine and the legacy telemetry hooks both count through
+:func:`gather_inventory`, so the nightly gate and the lint CLI can
+never drift apart.
 """
 
 from __future__ import annotations
 
 import re
+import warnings
 from typing import List, Tuple
 
-# `stablehlo.gather ... -> tensor<AxBx...xf32>` (also matches the
-# `"stablehlo.gather"(...)` generic-syntax form and dynamic_gather)
-_GATHER_RE = re.compile(
-    r"stablehlo\.(?:dynamic_)?gather\"?.*->\s*tensor<([0-9x]+)x?[a-z]")
+# One gather op, pretty OR quoted generic syntax, possibly spanning
+# lines (MLIR wraps long attribute dictionaries): anchor on the op
+# name, then take the FIRST `-> tensor<...>` result type that follows
+# within the op's own text window.  Gathers carry no region, so the
+# window never swallows a neighbouring op's arrow: it is cut at the
+# next `stablehlo.` op-name occurrence.
+# negative lookbehind: `#stablehlo.gather<...>` is the op's
+# dimension-numbers ATTRIBUTE, not an op occurrence
+_GATHER_OP_RE = re.compile(r"(?<!#)stablehlo\.(?:dynamic_)?gather\b")
+_ARROW_RE = re.compile(
+    r"->\s*(?:\()?\s*tensor<([0-9x]+)x?([a-z][a-z0-9]*)>", re.DOTALL)
+
+
+def _result_elems(dims_txt: str) -> int:
+    n = 1
+    for d in dims_txt.split("x"):
+        if d:
+            n *= int(d)
+    return n
+
+
+def raw_gather_count(text: str) -> int:
+    """Number of ``stablehlo.gather``/``dynamic_gather`` op-name
+    occurrences in ``text`` — the cross-check denominator for the
+    inventory (a parse that silently drops ops is how a traffic gate
+    rots)."""
+    return len(_GATHER_OP_RE.findall(text))
 
 
 def gather_inventory(text: str) -> List[Tuple[int, str]]:
     """All gather ops in lowered StableHLO/HLO ``text`` as
-    ``(result_elems, op_line)`` pairs, largest first."""
-    out = []
-    for line in text.splitlines():
-        m = _GATHER_RE.search(line)
+    ``(result_elems, op_text)`` pairs, largest first.
+
+    Handles the pretty syntax (``%9 = stablehlo.gather ... ->
+    tensor<...>``), the quoted generic syntax
+    (``"stablehlo.gather"(...) <{...}> : (...) -> tensor<...>``), and
+    ops whose attribute dictionary wraps across lines.  When the
+    number of parsed ops disagrees with the raw op-name count a
+    ``RuntimeWarning`` is emitted — the inventory is a CI gate, so a
+    silent undercount is itself a bug.
+    """
+    starts = [m.start() for m in _GATHER_OP_RE.finditer(text)]
+    out: List[Tuple[int, str]] = []
+    for i, s in enumerate(starts):
+        # op text window: from this op name to the next gather op (or
+        # a bounded lookahead) — enough to cover a wrapped attr dict
+        end = starts[i + 1] if i + 1 < len(starts) else min(
+            len(text), s + 4000)
+        window = text[s:end]
+        # generic syntax puts the function type after `: ( ... ) ->`;
+        # pretty syntax is `... -> tensor<...>` directly.  Either way
+        # the first arrow-to-tensor in the window is the result type.
+        m = _ARROW_RE.search(window)
         if not m:
             continue
-        dims = [int(d) for d in m.group(1).split("x") if d]
-        n = 1
-        for d in dims:
-            n *= d
-        out.append((n, line.strip()[:200]))
+        op_txt = " ".join(window[:m.end()].split())
+        out.append((_result_elems(m.group(1)), op_txt[:200]))
+    if len(out) != len(starts):
+        warnings.warn(
+            f"gather inventory parsed {len(out)} of {len(starts)} "
+            "stablehlo.gather ops — the traffic count is an "
+            "UNDERCOUNT; fix telemetry/hlo.py's parser",
+            RuntimeWarning, stacklevel=2)
     out.sort(key=lambda t: -t[0])
     return out
 

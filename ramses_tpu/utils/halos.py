@@ -17,14 +17,12 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import List
 
 import numpy as np
 
 from ramses_tpu.io import reader as rdr
 from ramses_tpu.pm.clumps import find_clumps
-from ramses_tpu.pm.halo import (Halo, MergerTree, build_catalogue,
-                                particle_labels, write_halo_table)
+from ramses_tpu.pm.halo import MergerTree, build_catalogue, write_halo_table
 
 
 def load_particles(outdir: str):

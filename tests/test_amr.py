@@ -21,7 +21,6 @@ from ramses_tpu.amr.tree import Octree
 from ramses_tpu.config import params_from_string
 from ramses_tpu.grid import boundary as bmod
 from ramses_tpu.grid.uniform import UniformGrid, step as ustep
-from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.init.regions import condinit
 from tests.exact_riemann import exact_riemann
 

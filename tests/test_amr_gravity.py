@@ -200,7 +200,6 @@ def test_mg_ladder_preconditioner():
     consistent parent maps, the preconditioned solve matches plain CG,
     and it converges in no more iterations than the two-level variant
     from the same cold start."""
-    from ramses_tpu.amr.maps import build_mg_lattices
 
     # a large complete periodic level gives a deep ladder
     t = Octree.base(2, 6, 6)
@@ -252,7 +251,6 @@ def test_mg_ladder_masked_nonperiodic():
     non-periodic walls: sentinel neighbours outside the mask/box,
     sentinel parents on padded rows, and the preconditioned solve
     still matches plain CG."""
-    from ramses_tpu.amr.maps import build_mg_lattices
 
     # disc-shaped refined patch at level 6 inside an outflow box
     t = Octree.base(2, 5, 6)

@@ -1,0 +1,333 @@
+"""Fixture tests for the static-analysis engine (ramses_tpu/analysis).
+
+Each rule gets a known-bad micro-program that must fire and a clean
+program that must stay silent — the rule-level contract the repo-wide
+``tools/lint.py --check`` gate is built on.  Micro-programs are real
+jax lowerings where cheap (constants, donation, f64) and synthetic
+StableHLO where a real reproduction needs a multi-device mesh
+(partitioned scatter).
+"""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from ramses_tpu.analysis import engine  # noqa: E402
+from ramses_tpu.analysis import hlo_rules, source_rules  # noqa: E402
+from ramses_tpu.analysis.programs import (BUILDERS,  # noqa: E402
+                                          GATHER_BUDGETS, Program)
+from ramses_tpu.analysis.rules import (Finding, Severity,  # noqa: E402
+                                       load_baseline, save_baseline,
+                                       severity_counts, split_baselined)
+
+
+def _prog(text, name="micro", **meta):
+    return Program(name=name, family="test", text=text, meta=meta)
+
+
+def _findings(rule_check, prog, rule=None):
+    out = rule_check(prog)
+    if rule is not None:
+        assert all(f.rule == rule for f in out)
+    return out
+
+
+# ---------------------------------------------------------------------
+# gather-blowup
+# ---------------------------------------------------------------------
+_GATHER_TXT = """
+  %9 = "stablehlo.gather"(%2, %8) : (tensor<100x5xf32>, tensor<7x1xi32>) -> tensor<5x7xf32>
+"""
+
+
+def test_gather_blowup_budget_fires_and_clears():
+    bad = _prog(_GATHER_TXT, gather_budget_elems=10)
+    hits = _findings(hlo_rules._check_gather_blowup, bad,
+                     "gather-blowup")
+    assert [f.key for f in hits] == ["budget"]
+    assert hits[0].severity == Severity.ERROR
+    assert hits[0].detail["elems"] == 35
+
+    clean = _prog(_GATHER_TXT, gather_budget_elems=100)
+    assert _findings(hlo_rules._check_gather_blowup, clean) == []
+
+
+def test_gather_blowup_ratio_gate():
+    # "reference" gathers 35 elements, "optimized" gathers the same —
+    # no 2x win, the rule must fire
+    bad = _prog(_GATHER_TXT, gather_ref_text=_GATHER_TXT)
+    hits = _findings(hlo_rules._check_gather_blowup, bad)
+    assert [f.key for f in hits] == ["ratio"]
+    ok, ref, cur = hlo_rules.check_gather_ratio(
+        _GATHER_TXT, "no gathers", min_ratio=2.0)
+    assert ok and ref == 35 and cur == 0
+
+
+# ---------------------------------------------------------------------
+# large-constant-capture  (real lowering: closed-over numpy table)
+# ---------------------------------------------------------------------
+def test_large_constant_capture_fires_on_closed_over_table():
+    table = np.arange(65536, dtype=np.float32)      # 256 KiB
+    idx = jnp.zeros(4, jnp.int32)
+    text = jax.jit(lambda i: jnp.take(jnp.asarray(table), i)).lower(
+        idx).as_text()
+    hits = _findings(hlo_rules._check_large_constant, _prog(text),
+                     "large-constant-capture")
+    assert len(hits) == 1 and hits[0].severity == Severity.ERROR
+    assert "65536" in hits[0].key
+
+    # same program with the table passed as an argument is clean
+    text = jax.jit(lambda i, t: jnp.take(t, i)).lower(
+        idx, jnp.asarray(table)).as_text()
+    assert _findings(hlo_rules._check_large_constant, _prog(text)) == []
+
+
+# ---------------------------------------------------------------------
+# nondeterministic-scatter  (synthetic: needs a partitioned module)
+# ---------------------------------------------------------------------
+_SCATTER_TMPL = """
+module @jit_f attributes {{mhlo.num_partitions = {np} : i32}} {{
+  func.func public @main(%arg0: tensor<64x4xf32>) -> tensor<64x4xf32> {{
+    %1 = "stablehlo.scatter"(%arg0, %idx, %upd) <{{
+        indices_are_sorted = false, unique_indices = {uniq}
+      }}> ({{
+    ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+      %s = stablehlo.{comb} %a, %b : tensor<f32>
+      stablehlo.return %s : tensor<f32>
+    }}) : (tensor<64x4xf32>, tensor<9x1xi32>, tensor<9x4xf32>) -> tensor<64x4xf32>
+    return %1 : tensor<64x4xf32>
+  }}
+}}
+"""
+
+
+def test_nondet_scatter_fires_only_partitioned_nonunique_add():
+    bad = _SCATTER_TMPL.format(np=8, uniq="false", comb="add")
+    hits = _findings(hlo_rules._check_nondet_scatter, _prog(bad),
+                     "nondeterministic-scatter")
+    assert len(hits) == 1 and hits[0].severity == Severity.WARN
+    assert "tensor<64x4xf32>" in hits[0].key
+
+    for clean in (
+            _SCATTER_TMPL.format(np=1, uniq="false", comb="add"),
+            _SCATTER_TMPL.format(np=8, uniq="true", comb="add"),
+            # overwrite combiner reorders safely
+            _SCATTER_TMPL.format(np=8, uniq="false", comb="maximum")):
+        assert _findings(hlo_rules._check_nondet_scatter,
+                         _prog(clean)) == []
+
+
+# ---------------------------------------------------------------------
+# donation-miss  (real lowerings)
+# ---------------------------------------------------------------------
+def test_donation_miss_fires_when_expected_donation_dropped():
+    x = jnp.ones((4, 4), jnp.float32)
+    undonated = jax.jit(lambda x: x + 1).lower(x).as_text()
+    hits = _findings(hlo_rules._check_donation,
+                     _prog(undonated, expect_donation=True),
+                     "donation-miss")
+    assert [f.key for f in hits] == ["no-aliasing"]
+    assert hits[0].severity == Severity.ERROR
+
+    donated = jax.jit(lambda x: x + 1,
+                      donate_argnums=0).lower(x).as_text()
+    assert _findings(hlo_rules._check_donation,
+                     _prog(donated, expect_donation=True)) == []
+
+
+def test_donation_detects_buffer_donor_past_nested_braces():
+    """Sharded lowerings emit ``jax.buffer_donor`` plus a sharding
+    string with NESTED braces before/after it — the attr parse must
+    not truncate there (the bug that made every sharded program look
+    donation-less)."""
+    sig = ('func.func public @main(%arg0: tensor<256x4xf32> '
+           '{jax.buffer_donor = true, '
+           'mhlo.sharding = "{devices=[8,1]<=[8]}"}, '
+           '%arg1: tensor<256x4xf32> '
+           '{mhlo.sharding = "{devices=[8,1]<=[8]}", '
+           'tf.aliasing_output = 0 : i32}) -> tensor<256x4xf32> {')
+    args = hlo_rules.main_args(sig)
+    assert len(args) == 2
+    assert all(hlo_rules._is_donated(a) for _, _, a in args)
+    assert _findings(hlo_rules._check_donation,
+                     _prog(sig, expect_donation=True)) == []
+
+
+def test_donation_warns_on_large_undonated_input():
+    sig = ('func.func public @main(%arg0: tensor<4194304xf32>) '
+           '-> tensor<4194304xf32> {')
+    hits = _findings(hlo_rules._check_donation,
+                     _prog(sig, expect_donation=False))
+    assert len(hits) == 1 and hits[0].severity == Severity.WARN
+    assert hits[0].detail["bytes"] == 16 << 20
+
+
+# ---------------------------------------------------------------------
+# f64-leak  (real lowering under the suite's x64 host config)
+# ---------------------------------------------------------------------
+def test_f64_leak_fires_on_uncast_double():
+    text = jax.jit(
+        lambda x: x * np.float64(2.0) + np.float64(1.0)).lower(
+        jnp.ones(4, jnp.float32)).as_text()
+    hits = _findings(hlo_rules._check_f64_leak,
+                     _prog(text, dtype_bits=32), "f64-leak")
+    assert len(hits) == 1 and hits[0].severity == Severity.WARN
+    # an f64-configured program is allowed to be full of f64
+    assert _findings(hlo_rules._check_f64_leak,
+                     _prog(text, dtype_bits=64)) == []
+
+    clean = jax.jit(lambda x: x * 2.0 + 1.0).lower(
+        jnp.ones(4, jnp.float32)).as_text()
+    assert _findings(hlo_rules._check_f64_leak,
+                     _prog(clean, dtype_bits=32)) == []
+
+
+# ---------------------------------------------------------------------
+# host-sync + static-arg-hazard  (AST rules over a tmp tree)
+# ---------------------------------------------------------------------
+def _write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def test_host_sync_rule_on_fixture_tree(tmp_path):
+    root = _write_tree(tmp_path, {
+        "kernels/sweep.py": """
+            import jax
+            import numpy as np
+
+            def hot(self):
+                jax.device_get(self.u)
+                x = self.u[0].block_until_ready()
+                return float(self.u), np.asarray(sim.bfs)
+
+            def cold(arr):
+                return np.asarray(arr)     # not a state root: silent
+        """,
+        # allowlisted locations: same calls, no findings
+        "driver.py": "import jax\n\ndef s(self):\n"
+                     "    return jax.device_get(self.u)\n",
+        "telemetry/rec.py": "import jax\n\ndef s(self):\n"
+                            "    return jax.device_get(self.u)\n",
+    })
+    hits = source_rules._check_host_sync(root)
+    assert {f.program for f in hits} == {"kernels/sweep.py"}
+    by_key = {f.key: f for f in hits}
+    # explicit syncs gate at WARN, implicit transfers are INFO
+    assert by_key["hot:device_get"].severity == Severity.WARN
+    assert by_key["hot:block_until_ready"].severity == Severity.WARN
+    assert by_key["hot:float(self.u)"].severity == Severity.INFO
+    assert by_key["hot:np.asarray(sim.bfs)"].severity == Severity.INFO
+    assert "cold:np.asarray" not in {f.key for f in hits}
+
+
+def test_host_sync_reports_syntax_error(tmp_path):
+    root = _write_tree(tmp_path, {"kernels/broken.py": "def f(:\n"})
+    hits = source_rules._check_host_sync(root)
+    assert [f.key for f in hits] == ["syntax-error"]
+    assert hits[0].severity == Severity.ERROR
+
+
+def test_static_arg_hazard_on_fixture_tree(tmp_path):
+    root = _write_tree(tmp_path, {
+        "mod.py": """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("opts",))
+            def bad(x, opts={"a": 1}):
+                return x
+
+            @partial(jax.jit, static_argnums=(1,))
+            def bad2(x, ids=[1, 2]):
+                return x
+
+            @partial(jax.jit, static_argnames=("opts",))
+            def good(x, opts=("a",)):
+                return x
+
+            def plain(x, opts={}):
+                return x
+        """,
+    })
+    hits = source_rules._check_static_args(root)
+    assert {f.key for f in hits} == {"bad:opts", "bad2:ids"}
+    assert all(f.severity == Severity.ERROR for f in hits)
+
+
+# ---------------------------------------------------------------------
+# registry / baseline / engine plumbing
+# ---------------------------------------------------------------------
+def test_registry_has_the_documented_rules():
+    from ramses_tpu.analysis.rules import all_rules
+    ids = {r.id for r in all_rules()}
+    assert {"gather-blowup", "large-constant-capture",
+            "nondeterministic-scatter", "donation-miss", "f64-leak",
+            "host-sync", "static-arg-hazard"} <= ids
+    assert all(r.doc for r in all_rules())
+
+
+def test_budget_names_match_builders():
+    assert set(GATHER_BUDGETS) <= set(BUILDERS)
+
+
+def test_fingerprints_stable_and_baseline_roundtrip(tmp_path):
+    f1 = Finding(rule="r", severity=Severity.WARN, program="p",
+                 message="msg A", key="k")
+    f2 = Finding(rule="r", severity=Severity.ERROR, program="p",
+                 message="msg B (moved lines, new message)", key="k")
+    f3 = Finding(rule="r", severity=Severity.WARN, program="p",
+                 message="msg", key="other")
+    # identity = (rule, program, key): message/severity churn keeps
+    # the fingerprint, a different key changes it
+    assert f1.fingerprint == f2.fingerprint != f3.fingerprint
+
+    path = str(tmp_path / "baseline.json")
+    save_baseline([f1, f2], path)
+    with open(path) as fh:
+        assert len(json.load(fh)["findings"]) == 1   # deduped
+    base = load_baseline(path)
+    new, accepted = split_baselined([f2, f3], base)
+    assert [f.key for f in accepted] == ["k"]
+    assert [f.key for f in new] == ["other"]
+    assert severity_counts([f1, f2, f3]) == {
+        "error": 1, "warn": 2, "info": 0}
+
+
+def test_report_gates_on_unbaselined_warn(tmp_path):
+    warn = Finding(rule="r", severity=Severity.WARN, program="p",
+                   message="m", key="k")
+    info = Finding(rule="r", severity=Severity.INFO, program="p",
+                   message="m", key="i")
+    empty = str(tmp_path / "none.json")
+    rep = engine.report([warn, info], baseline_path=empty)
+    assert not rep["ok"] and rep["new_counts"]["warn"] == 1
+    # info alone never gates
+    rep = engine.report([info], baseline_path=empty)
+    assert rep["ok"]
+    # baselining the warn restores ok, and a vanished entry is stale
+    path = str(tmp_path / "base.json")
+    save_baseline([warn], path)
+    rep = engine.report([info], baseline_path=path)
+    assert rep["ok"] and rep["stale_baseline"] == [warn.fingerprint]
+
+
+def test_canonical_program_enumerator_uniform():
+    """One cheap end-to-end canonical build: the uniform program
+    lowers x64-free even under the suite's x64 host config, and the
+    full HLO rule set leaves it clean."""
+    from ramses_tpu.analysis.programs import build_programs
+    progs = build_programs(["hydro_uniform"])
+    assert [p.name for p in progs] == ["hydro_uniform"]
+    prog = progs[0]
+    assert prog.meta["dtype_bits"] == 32
+    assert "f64" not in prog.text
+    assert engine.audit_program(prog) == []

@@ -1,6 +1,5 @@
 """Auxiliary subsystem tests: timers, movie frames, map tools, lightcone."""
 
-import os
 import time
 
 import numpy as np
@@ -264,7 +263,6 @@ def test_movie_emit_amr(tmp_path):
 def test_movie_params_wiring(tmp_path):
     """&MOVIE_PARAMS drives on-the-fly frames from the namelist in both
     drivers (movie=.true., proj_axis cameras, imov cadence)."""
-    import os
 
     import jax.numpy as jnp
     import numpy as np

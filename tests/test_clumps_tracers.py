@@ -6,7 +6,7 @@ import pytest
 jnp = pytest.importorskip("jax.numpy")
 import jax
 
-from ramses_tpu.pm.clumps import find_clumps, watershed, write_clump_table
+from ramses_tpu.pm.clumps import find_clumps, write_clump_table
 from ramses_tpu.pm.tracers import mc_tracer_step
 
 

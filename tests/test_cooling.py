@@ -14,7 +14,6 @@ jnp = pytest.importorskip("jax.numpy")
 from ramses_tpu.config import params_from_dict
 from ramses_tpu.hydro import cooling as cm
 from ramses_tpu.hydro.eos import barotropic_eos_temperature
-from ramses_tpu.units import X_frac, kB
 
 
 

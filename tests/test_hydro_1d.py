@@ -99,7 +99,6 @@ def test_conservation_periodic():
     p = params_from_string(SOD.format(lmin=6, slope=2, riemann="hllc"),
                            ndim=1)
     p.boundary.nboundary = 0  # periodic
-    from ramses_tpu.grid import boundary as bmod
     sim = Simulation(p, dtype=jnp.float64)
     tot0 = totals(sim.state.u, sim.cfg, sim.grid.dx)
     sim.evolve()

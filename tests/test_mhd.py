@@ -11,12 +11,11 @@ import numpy as np
 import pytest
 
 jnp = pytest.importorskip("jax.numpy")
-import jax
 
 from ramses_tpu.config import params_from_dict
 from ramses_tpu.mhd import core, uniform as mu
-from ramses_tpu.mhd.core import IBX, IP, NCOMP
-from ramses_tpu.mhd.driver import MhdSimulation, mhd_condinit
+from ramses_tpu.mhd.core import IBX, IP
+from ramses_tpu.mhd.driver import MhdSimulation
 
 
 def _briowu_params(lmin=6, riemann="hlld", slope=1):

@@ -14,7 +14,7 @@ import numpy as np
 import pytest
 
 from ramses_tpu.config import load_params
-from ramses_tpu.mhd import core as mcore, uniform as mu
+from ramses_tpu.mhd import core as mcore
 from ramses_tpu.mhd.amr import MhdAmrSim
 from ramses_tpu.mhd.core import IBX, IP, NCOMP
 from ramses_tpu.mhd.driver import MhdSimulation
@@ -170,6 +170,7 @@ def test_ot_amr_conservation():
     assert tot1[IP] == pytest.approx(tot0[IP], rel=1e-9)      # energy
 
 
+@pytest.mark.slow          # ~19s; nightly tier on the 1-core box
 def test_mhd_amr_snapshot_roundtrip(tmp_path):
     """Dump → restore: cell state AND duplicated staggered faces come
     back exactly, divB stays machine-zero, and continued stepping

@@ -11,9 +11,7 @@ shipped file's.
 
 import os
 import re
-import shutil
 
-import numpy as np
 import pytest
 
 jnp = pytest.importorskip("jax.numpy")
@@ -68,7 +66,7 @@ def _shrunk_copy(name: str, tmp_path) -> str:
 
 
 # the longest-running configs ride the nightly tier only
-_SLOW_NMLS = {"collapse_iso.nml", "tube_mhd.nml"}
+_SLOW_NMLS = {"collapse_iso.nml", "tube_mhd.nml", "smbh_bondi.nml"}
 
 
 @pytest.mark.parametrize("name", [

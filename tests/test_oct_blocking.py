@@ -180,6 +180,7 @@ def test_blocked_parity_3d_sedov():
     _parity(4, 5, 3)
 
 
+@pytest.mark.slow          # ~32s; nightly tier on the 1-core box
 def test_blocked_parity_2d_sedov():
     _parity(4, 6, 2)
 
@@ -292,6 +293,7 @@ def test_blocked_parity_forced_layout():
             f"level {l}: maxdiff={np.abs(ua - ub).max()}"
 
 
+@pytest.mark.slow          # ~33s; nightly tier on the 1-core box
 def test_blocked_parity_sharded_mesh8():
     """mesh-of-8 == mesh-of-1 on the blocked path: row-sharded tile
     tables under GSPMD (FusedSpec.pallas_tiles=False pins the XLA tile

@@ -27,7 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from ramses_tpu.amr.hierarchy import AmrSim
-from ramses_tpu.amr.offload import OffloadEngine, is_parked
+from ramses_tpu.amr.offload import is_parked
 from ramses_tpu.config import params_from_string
 
 pytestmark = pytest.mark.smoke
@@ -86,6 +86,7 @@ def _assert_state_equal(a, b):
 # ---------------------------------------------------------------------
 # bitwise parity: steps + regrids + checkpoint-while-parked + restore
 # ---------------------------------------------------------------------
+@pytest.mark.slow          # ~38s; nightly tier on the 1-core box
 def test_bitwise_parity_through_steps_regrid_restart(tmp_path):
     s_off = AmrSim(_params("off", lmax=6))
     s_on = AmrSim(_params("on", lmax=6))

@@ -13,7 +13,6 @@ import numpy as np
 import pytest
 
 from ramses_tpu.grid import boundary as bmod
-from ramses_tpu.grid.uniform import UniformGrid
 from ramses_tpu.hydro import muscl, pallas_muscl as pk
 from ramses_tpu.hydro.core import HydroStatic
 from ramses_tpu.config import Params

@@ -5,7 +5,6 @@ current ``config.py`` — a config change without a doc regen fails here
 
 import importlib.util
 import os
-import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,6 +1,5 @@
 """Particle-mesh tests: deposition, interpolation, orbits, cosmology."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

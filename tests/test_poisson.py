@@ -6,7 +6,6 @@ force gradient and analytic models are validated against closed forms
 (the reference's poisson/ana-disk-potential test pattern).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

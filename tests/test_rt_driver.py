@@ -14,7 +14,7 @@ jnp = pytest.importorskip("jax.numpy")
 from ramses_tpu.config import load_params
 from ramses_tpu.rt import chem as chem_mod
 from ramses_tpu.rt import spectra
-from ramses_tpu.rt.driver import RtSpec, RtSim, stromgren_radius
+from ramses_tpu.rt.driver import stromgren_radius
 
 NML = "namelists/stromgren3.nml"
 

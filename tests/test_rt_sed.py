@@ -6,9 +6,7 @@ import pytest
 
 jnp = pytest.importorskip("jax.numpy")
 
-from ramses_tpu.rt import sed as sedmod
-from ramses_tpu.rt.sed import (SedLibrary, SedTables, blackbody_library,
-                               read_sed_dir, write_sed_dir)
+from ramses_tpu.rt.sed import (SedTables, blackbody_library, read_sed_dir, write_sed_dir)
 
 
 

@@ -8,9 +8,7 @@ jnp = pytest.importorskip("jax.numpy")
 from ramses_tpu.pm.particles import FAM_STAR, ParticleSet
 from ramses_tpu.pm.sinks import (SinkSet, SinkSpec, accrete, create_sinks,
                                  drift_kick, merge_sinks)
-from ramses_tpu.pm.star_formation import (FLAG_SN_DONE, SfSpec,
-                                          mstar_quantum, star_formation,
-                                          thermal_feedback)
+from ramses_tpu.pm.star_formation import (FLAG_SN_DONE, SfSpec, star_formation, thermal_feedback)
 from ramses_tpu.units import Units, yr2sec
 
 

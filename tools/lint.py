@@ -1,0 +1,117 @@
+"""Static-analysis gate: lint the canonical lowered programs.
+
+Builds the canonical step-chain programs (one per driver family — see
+``ramses_tpu/analysis/programs.py``) on a CPU host-device mesh, runs
+every registered rule over their StableHLO plus the source-level AST
+rules over the package tree, and reports findings against the
+committed baseline of accepted fingerprints
+(``ramses_tpu/analysis/baseline.json``).
+
+Exit policy (``--check``): fails only on *new* findings of severity
+``warn`` or higher — accepted (baselined) findings and ``info``-level
+notes never gate.  ``--update-baseline`` rewrites the baseline from
+the current ``warn+`` findings (info findings are reported but never
+baselined, so the file stays a short list of consciously accepted
+hazards).
+
+Usage::
+
+    python tools/lint.py                  # report, exit 0
+    python tools/lint.py --check          # CI gate
+    python tools/lint.py --check --json lint.json
+    python tools/lint.py --update-baseline
+    python tools/lint.py --programs hydro_amr,mhd_amr --rules gather-blowup
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _parse_list(txt):
+    return [s for s in (txt or "").split(",") if s] or None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on unbaselined warn+ findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current warn+ "
+                         "findings")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the machine-readable report here")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline file (default: the committed "
+                         "ramses_tpu/analysis/baseline.json)")
+    ap.add_argument("--programs", default=None,
+                    help="comma list of canonical programs (default: "
+                         "all)")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of rule ids (default: all)")
+    ap.add_argument("--source-root", default=None,
+                    help="package tree for source rules (default: the "
+                         "installed ramses_tpu)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="CPU host-device mesh size (>=2 enables the "
+                         "sharded program)")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ramses_tpu.platform import force_cpu_mesh
+    force_cpu_mesh(args.devices)
+
+    from ramses_tpu.analysis import engine
+    from ramses_tpu.analysis.programs import build_programs
+    from ramses_tpu.analysis.rules import Severity, save_baseline
+
+    programs = build_programs(_parse_list(args.programs))
+    findings = engine.run(programs, source_root=args.source_root,
+                          rule_ids=_parse_list(args.rules))
+
+    if args.update_baseline:
+        accepted = [f for f in findings if f.severity >= Severity.WARN]
+        path = save_baseline(accepted, args.baseline)
+        print(f"lint: baseline of {len(accepted)} finding(s) -> {path}")
+        return 0
+
+    rep = engine.report(findings, baseline_path=args.baseline)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rep, f, indent=1)
+            f.write("\n")
+
+    nprog = len(programs)
+    print(f"lint: {nprog} canonical program(s), "
+          f"{sum(rep['counts'].values())} finding(s) "
+          f"({rep['counts']['error']} error / {rep['counts']['warn']} "
+          f"warn / {rep['counts']['info']} info), "
+          f"{len(rep['accepted'])} baselined")
+    for f in rep["new"]:
+        print(f"  [{f['severity']:5}] {f['rule']} @ {f['program']}: "
+              f"{f['message']}")
+    if rep["stale_baseline"]:
+        print(f"lint: note — {len(rep['stale_baseline'])} baseline "
+              "entr(ies) no longer fire "
+              f"({', '.join(rep['stale_baseline'][:4])}"
+              f"{'...' if len(rep['stale_baseline']) > 4 else ''}); "
+              "run --update-baseline to prune")
+    if args.check and not rep["ok"]:
+        bad = sum(1 for f in rep["new"]
+                  if f["severity"] in ("warn", "error"))
+        print(f"lint: FAIL — {bad} unbaselined warn+ finding(s); fix "
+              "them or accept consciously with --update-baseline",
+              file=sys.stderr)
+        return 1
+    print("lint: OK" if rep["ok"] else
+          "lint: findings above are unbaselined (no --check, exit 0)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

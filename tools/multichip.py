@@ -61,6 +61,30 @@ def run_dryrun(n_devices: int, repo: str):
     }, stderr
 
 
+def run_lint(n_devices: int, repo: str):
+    """Static-analysis leg: ``__graft_entry__.dryrun_lint(n)`` in a
+    subprocess — the same engine and baseline as ``tools/lint.py
+    --check``, on the same CPU mesh as the dryrun, so a partitioner-
+    visible hazard (dropped donation, non-unique scatter-add, gather
+    budget blowout) fails this gate even when it does not remat."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    code = (f"import __graft_entry__ as g; "
+            f"g.dryrun_lint({n_devices})")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=1800)
+    tail = ((proc.stdout or "")[-TAIL_BYTES:]
+            + (proc.stderr or "")[-TAIL_BYTES:])
+    return {
+        "n_devices": n_devices,
+        "rc": proc.returncode,
+        "ok": proc.returncode == 0,
+        "tail": tail,
+    }
+
+
 def emit_telemetry(path: str, res: dict, stderr: str, repo: str):
     """Mirror the dryrun result into a telemetry JSONL event log: a
     run-header, one ``dryrun`` event, one ``xla_warning`` event per
@@ -92,9 +116,15 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry", default=None,
                     help="telemetry JSONL path (default: --out with a "
                          ".jsonl suffix)")
+    ap.add_argument("--lint", action="store_true",
+                    help="also run the static-analysis leg "
+                         "(__graft_entry__.dryrun_lint) and fail on "
+                         "unbaselined findings")
     args = ap.parse_args(argv)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     res, stderr = run_dryrun(args.devices, repo)
+    if args.lint:
+        res["lint"] = run_lint(args.devices, repo)
     tpath = args.telemetry or (
         os.path.splitext(args.out)[0] + ".jsonl")
     try:
@@ -115,6 +145,10 @@ def main(argv=None) -> int:
             "rematerialization warning(s) — a global-view op reached "
             "the SPMD partitioner (see parallel/dense_slab.py)\n")
         return 3
+    if args.lint and not res["lint"]["ok"]:
+        sys.stderr.write("FAIL: static-analysis leg found unbaselined "
+                         "findings\n" + res["lint"]["tail"] + "\n")
+        return 4
     return 0
 
 

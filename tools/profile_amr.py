@@ -183,10 +183,14 @@ def collect(hb=lambda *a, **k: None, emit=None):
 
     # --- static HLO gather inventory of the fused step ---------------
     def p_hlo():
+        from ramses_tpu.analysis import engine as aeng
         from ramses_tpu.telemetry import hlo as hmod
-        inv = hmod.gather_inventory(hmod.lower_fused_step(sim))
+        txt = hmod.lower_fused_step(sim)
+        inv = hmod.gather_inventory(txt)
         res["hlo_gather_elems"] = sum(n for n, _ in inv)
         res["hlo_gather_ops"] = len(inv)
+        # unbaselined static-analysis findings of the same lowering
+        res["analysis_findings"] = aeng.audit_sim(sim, text=txt)
     probe("hlo_inventory", p_hlo)
 
     # --- full fused coarse step (the steady-state unit of work) ------
