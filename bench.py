@@ -36,14 +36,17 @@ Env knobs (small hosts / quick checks): BENCH_LEVEL, BENCH_STEPS,
 BENCH_AMR_LMIN, BENCH_AMR_LMAX, BENCH_AMR_STEPS, BENCH_AMR_SS_STEPS,
 BENCH_AMR_PROD_STEPS, BENCH_MG_N, BENCH_BF16,
 BENCH_ONLY=<comma list of uniform|amr|mg|amr_poisson|ensemble|
-profile_amr|halo|offload — profile_amr runs tools/profile_amr.py's
+profile_amr|halo|offload|grad — profile_amr runs tools/profile_amr.py's
 per-kernel probes with incremental partial capture (also auto-escalated
 after a hang-classified amr sub); halo times the explicit halo pipeline
 (ppermute vs DMA, 1/2/8 shards, bytes/s + fused step time); offload
-times the out-of-core deep hierarchy (&AMR_PARAMS offload) on vs off —
-both opt-in like profile_amr>,
+times the out-of-core deep hierarchy (&AMR_PARAMS offload) on vs off;
+grad times the checkpointed adjoint rollout (ramses_tpu/diff) —
+grad/forward wall-time and peak-temp-memory ratios at nstep 8 and 32 —
+all opt-in like profile_amr>,
 BENCH_HALO_LEVEL, BENCH_HALO_STEPS,
 BENCH_OFF_LMIN, BENCH_OFF_LMAX, BENCH_OFF_STEPS, BENCH_OFF_WARM,
+BENCH_GRAD_N, BENCH_GRAD_REPS,
 BENCH_SUB_TIMEOUT, BENCH_TOTAL_BUDGET, BENCH_PARTIAL_PATH,
 BENCH_ENS_LEVEL, BENCH_ENS_STEPS, BENCH_ENS_BATCHES,
 BENCH_HANG_SUB=<sub> (deliberately wedge that child before its jax
@@ -675,21 +678,104 @@ def bench_offload(dtype, jnp, hb=lambda *a, **k: None):
     }
 
 
+def bench_grad(dtype, jnp, hb=lambda *a, **k: None):
+    """Checkpointed adjoint rollout cost profile (ramses_tpu/diff):
+    grad/forward wall-time ratio and adjoint peak-temp-memory ratio at
+    nstep in {8, 32} on a 2D Sedov uniform grid.  The memory baseline
+    is the UN-checkpointed adjoint of the plain driver's scan (what a
+    naive jax.grad would pay, O(nstep) residuals), so
+    ``mem_vs_plain_adjoint < 1`` is direct evidence the sqrt-schedule
+    remat (diff/rollout._scan_windows) is engaged — reported as
+    ``checkpoint_engaged``."""
+    import numpy as np
+
+    import jax
+    from ramses_tpu.diff.rollout import (checkpointed_run_steps,
+                                         default_inner)
+    from ramses_tpu.grid.boundary import BoundarySpec
+    from ramses_tpu.grid.uniform import UniformGrid, run_steps
+    from ramses_tpu.hydro.core import HydroStatic
+
+    n = int(os.environ.get("BENCH_GRAD_N", "64"))
+    reps = int(os.environ.get("BENCH_GRAD_REPS", "5"))
+    cfg = HydroStatic(ndim=2, riemann="llf")
+    grid = UniformGrid(cfg=cfg, shape=(n, n), dx=1.0 / n,
+                       bc=BoundarySpec.periodic(2))
+    c = n // 2
+    p = np.full((n, n), 1e-5)
+    p[c - 1:c + 1, c - 1:c + 1] = 0.1
+    u = np.zeros((cfg.nvar, n, n))
+    u[0], u[cfg.ndim + 1] = 1.0, p / (cfg.gamma - 1.0)
+    uj = jnp.asarray(u, dtype)
+    t0 = jnp.zeros((), uj.dtype)
+    tend = jnp.asarray(1e9, uj.dtype)
+
+    def best_of(fn, *a):
+        w = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            w.append(time.perf_counter() - t)
+        return min(w)
+
+    def temp_bytes(compiled):
+        ma = compiled.memory_analysis()
+        return int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+
+    out = {"config": f"grad sedov2d n={n} {str(dtype.__name__)} "
+                     f"inner=sqrt reps={reps}"}
+    engaged = True
+    for ns in (8, 32):
+        def loss_fwd(u, ns=ns):
+            return jnp.mean(run_steps(grid, u, t0, tend, ns)[0] ** 2)
+
+        def loss_ckpt(u, ns=ns):
+            return jnp.mean(
+                checkpointed_run_steps(grid, u, t0, tend, ns)[0] ** 2)
+
+        cf = jax.jit(loss_fwd).lower(uj).compile()
+        cg = jax.jit(jax.grad(loss_ckpt)).lower(uj).compile()
+        # memory baseline only — never timed (its compile alone shows
+        # the O(nstep) residual footprint remat exists to avoid)
+        cgp = jax.jit(jax.grad(loss_fwd)).lower(uj).compile()
+        hb("compiled", nstep=ns)
+        f_ms = 1e3 * best_of(cf, uj)
+        g_ms = 1e3 * best_of(cg, uj)
+        hb("timed", nstep=ns)
+        fb, gb, pb = temp_bytes(cf), temp_bytes(cg), temp_bytes(cgp)
+        engaged = engaged and 0 < gb < pb
+        out[f"nstep{ns}"] = {
+            "inner": default_inner(ns),
+            "forward_ms": round(f_ms, 3),
+            "grad_ms": round(g_ms, 3),
+            "grad_over_forward": round(g_ms / max(f_ms, 1e-9), 3),
+            "forward_temp_bytes": fb,
+            "grad_temp_bytes": gb,
+            "plain_adjoint_temp_bytes": pb,
+            "mem_vs_forward": round(gb / max(fb, 1), 3),
+            "mem_vs_plain_adjoint": round(gb / max(pb, 1), 3),
+        }
+    out["checkpoint_engaged"] = engaged
+    out["tunnel_rtt_s"] = measure_rtt(jnp)
+    return out
+
+
 # the default protocol; profile_amr (the per-kernel breakdown of
 # tools/profile_amr.py) and halo (the backend comparison above) are
 # opt-in via BENCH_ONLY — too slow for every protocol run
 DEFAULT_SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
-SUBS = DEFAULT_SUBS + ("profile_amr", "halo", "offload")
+SUBS = DEFAULT_SUBS + ("profile_amr", "halo", "offload", "grad")
 # ceilings per sub; the GLOBAL budget (BENCH_TOTAL_BUDGET) always wins —
 # four rounds of rc=124 driver kills came from these summing past the
 # driver's wall clock whenever the tunnel hung
 SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500,
                 "ensemble": 300, "profile_amr": 700, "halo": 300,
-                "offload": 600}
+                "offload": 600, "grad": 400}
 # share of the REMAINING budget each sub may claim at launch
 SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35,
                "amr_poisson": 0.95, "ensemble": 0.95,
-               "profile_amr": 0.95, "halo": 0.95, "offload": 0.95}
+               "profile_amr": 0.95, "halo": 0.95, "offload": 0.95,
+               "grad": 0.95}
 
 
 def run_sub_inproc(name):
@@ -731,6 +817,8 @@ def run_sub_inproc(name):
         d = bench_halo(load_params(nml, ndim=3), dtype, jnp, hb=hb.mark)
     elif name == "offload":
         d = bench_offload(dtype, jnp, hb=hb.mark)
+    elif name == "grad":
+        d = bench_grad(dtype, jnp, hb=hb.mark)
     elif name == "profile_amr":
         # per-kernel breakdown (tools/profile_amr.py): its probes emit
         # incrementally into the result sidecar with completed=False,

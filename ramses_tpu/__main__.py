@@ -25,6 +25,12 @@ def main(argv=None) -> int:
     ap.add_argument("--submit", metavar="QUEUE_DIR", default=None,
                     help="enqueue the namelist as a job instead of "
                          "running it; prints the job id")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="run (or with --submit, enqueue) the namelist "
+                         "as a gradient-descent calibration against a "
+                         "target rollout (&CALIBRATION_PARAMS, "
+                         "ramses_tpu/diff) instead of a forward "
+                         "simulation")
     ap.add_argument("--sweep", action="append", metavar="KEY=V1,V2,...",
                     help="with --submit: per-member parameter sweep "
                          "rows, dotted paths into the namelist "
@@ -84,7 +90,8 @@ def main(argv=None) -> int:
         job_id = submit_namelist(
             args.submit, args.namelist,
             sweeps=parse_sweep_args(args.sweep),
-            solver=args.solver or "", ndim=args.ndim, dtype=args.dtype)
+            solver=args.solver or "", ndim=args.ndim, dtype=args.dtype,
+            kind="calibrate" if args.calibrate else "run")
         print(job_id)
         return 0
     if args.serve:
@@ -140,6 +147,27 @@ def main(argv=None) -> int:
     # rebuilds from the newest manifest-valid checkpoint on later ones.
     if args.auto_resume:
         params.run.auto_resume = True
+
+    # --calibrate (or &CALIBRATION_PARAMS calibrate=.true.): the
+    # namelist describes an *inverse* problem — fit IC/EOS parameters
+    # to a target rollout by gradient descent through the
+    # differentiable step chain (ramses_tpu/diff), resumable from
+    # optimizer-state checkpoints like any forward run
+    if args.calibrate or params.calibration.calibrate:
+        from ramses_tpu.diff.calibrate import run_calibration_job
+        res = run_calibration_job(params, dtype=dtype,
+                                  base_dir=params.output.output_dir)
+        best = (f"gamma_best={res['gamma_best']:.6g} "
+                if "gamma_best" in res else "")
+        print(f"calibrate: {res['iterations']} iters "
+              f"(resumed at {res['start_iter']}) "
+              f"nmember={res['nmember']} "
+              f"quarantined={res['quarantined']} "
+              f"loss {res['loss_first']:.4e} -> "
+              f"{res['loss_final']:.4e} "
+              f"{best}-> {res['checkpoint']}")
+        return 0
+
     supervised = (args.max_attempts > 1 or params.run.auto_resume
                   or params.run.nrestart == -1)
     attempts = max(2, args.max_attempts) if supervised else 1

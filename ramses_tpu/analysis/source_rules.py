@@ -256,3 +256,159 @@ register(Rule(
          "unhashable and explodes only on the rarely-tested "
          "default-argument path.  Flags jitted functions whose "
          "static args default to mutable literals.")))
+
+
+# ---------------------------------------------------------------------
+# differentiability: the double-where gradient hazard
+# ---------------------------------------------------------------------
+# ``jnp.where(p, f(x), g(x))`` evaluates BOTH branches; reverse-mode AD
+# multiplies each branch cotangent by 0/1 *after* differentiating it,
+# so an Inf/NaN in the untaken branch (division by a quantity that can
+# vanish there, fractional powers or sqrt/log at 0) becomes 0 * Inf =
+# NaN and poisons the whole gradient even though the forward value is
+# clamped.  The repaired idiom guards the hazardous sub-expression
+# with a second where that feeds it safe inputs where the branch is
+# unconsumed — which this rule recognizes as a denominator/base/arg
+# that is itself a ``jnp.where`` call, or a name bound to one.
+#
+# Scope: the differentiable step-chain kernels (``hydro/``, ``mhd/``)
+# only — the adjoint rollout (ramses_tpu/diff) differentiates through
+# those; AMR/driver layers run forward-only.
+DIFF_PREFIXES = ("hydro/", "mhd/")
+_SQRT_LIKE = ("sqrt", "rsqrt", "cbrt", "log", "log2", "log10", "log1p")
+
+
+def _is_where_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "where") or \
+        (isinstance(f, ast.Name) and f.id == "where")
+
+
+def _where_bound_names(tree: ast.AST) -> set:
+    """Names assigned from a ``jnp.where(...)`` call anywhere in the
+    module — the hoisted-guard idiom (``den = jnp.where(p, x, 1.0)``;
+    ``jnp.where(p, a / den, 0.0)``)."""
+    bound = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_where_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    bound.add(tgt.id)
+    return bound
+
+
+def _mentions_guard(node: ast.AST, bound: set) -> bool:
+    """True when the expression is visibly guarded: it is (or
+    contains) a where call or a where-bound name."""
+    for sub in ast.walk(node):
+        if _is_where_call(sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in bound:
+            return True
+    return False
+
+
+def _safe_denominator(node: ast.AST, bound: set) -> bool:
+    # literal constants, static config scalars (cfg.smallr, self.dx)
+    # and guarded expressions cannot vanish in the untaken branch
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                     ast.Name):
+        return True
+    return _mentions_guard(node, bound)
+
+
+def _branch_hazards(branch: ast.AST, bound: set):
+    """``kind`` strings for unguarded hazards inline in one where
+    branch (nested where calls own their branches and are skipped —
+    the visitor reaches them separately)."""
+    stack = [branch]
+    while stack:
+        node = stack.pop()
+        if node is not branch and _is_where_call(node):
+            continue                # its branches get their own visit
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            if not _safe_denominator(node.right, bound):
+                yield "div"
+        elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                        ast.Pow):
+            exp = node.right
+            fractional = not (isinstance(exp, ast.Constant)
+                              and isinstance(exp.value, (int, bool)))
+            if fractional and not _mentions_guard(node.left, bound):
+                yield "pow"
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SQRT_LIKE and node.args \
+                and not _mentions_guard(node.args[0], bound):
+            yield node.func.attr
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _DiffVisitor(ast.NodeVisitor):
+    def __init__(self, bound: set):
+        self.bound = bound
+        self.stack: List[ast.AST] = []
+        self.hits: dict = {}        # {(func, kind): count}
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        if _is_where_call(node) and len(node.args) >= 3:
+            func = _enclosing_func(self.stack)
+            for branch in node.args[1:3]:
+                for kind in _branch_hazards(branch, self.bound):
+                    key = (func, kind)
+                    self.hits[key] = self.hits.get(key, 0) + 1
+        self.generic_visit(node)
+
+
+def _check_differentiability(root: Optional[str] = None) -> List[Finding]:
+    root = root or _pkg_root()
+    out: List[Finding] = []
+    for path in _iter_sources(root):
+        rel = _relmod(path, root)
+        if not rel.startswith(DIFF_PREFIXES):
+            continue
+        try:
+            tree = ast.parse(open(path).read(), filename=path)
+        except SyntaxError:
+            continue                # host-sync already reports this
+        v = _DiffVisitor(_where_bound_names(tree))
+        v.visit(tree)
+        for (func, kind), n in sorted(v.hits.items()):
+            out.append(Finding(
+                rule="differentiability", severity=Severity.WARN,
+                program=rel,
+                message=(f"unguarded {kind} inside a where branch in "
+                         f"{rel}:{func} ({n} site(s)) — both where "
+                         "branches are differentiated, so an Inf in "
+                         "the untaken branch turns into 0*Inf = NaN "
+                         "in the cotangent; guard the hazardous "
+                         "sub-expression with a second where "
+                         "(double-where idiom) or baseline it if the "
+                         "kernel is outside the adjoint rollout"),
+                key=f"{func}:{kind}",
+                detail={"function": func, "hazard": kind,
+                        "count": n}))
+    return out
+
+
+register(Rule(
+    id="differentiability", kind="source",
+    check=_check_differentiability,
+    doc=("The adjoint rollout (ramses_tpu/diff) differentiates the "
+         "hydro/mhd step chains; jnp.where evaluates both branches, "
+         "so an unguarded division / fractional power / sqrt-like "
+         "call inline in a where branch NaN-poisons reverse-mode "
+         "gradients (0 * Inf) even when the forward value is "
+         "clamped.  Flags those sites; the accepted remainder "
+         "(forward-only kernels) lives in the baseline.")))

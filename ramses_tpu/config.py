@@ -436,6 +436,57 @@ class EnsembleParams:
 
 
 @dataclass
+class CalibrationParams:
+    """&CALIBRATION_PARAMS (ours: the differentiable calibration service,
+    ramses_tpu/diff — no reference equivalent; fits namelist parameters
+    to a target rollout by Adam gradient descent through the checkpointed
+    adjoint step chain)."""
+    # master switch: run this namelist as a calibration (fit selected
+    # parameters against a target rollout) instead of a forward
+    # simulation; `--calibrate` on the CLI and calibrate-kind queue jobs
+    # take the same path
+    calibrate: bool = False
+    # fit the EOS gamma (traced through the inlined step chain) — the
+    # namelist's &HYDRO_PARAMS gamma is the *truth* used to synthesise
+    # the target, and the optimizer starts from a perturbed guess
+    fit_gamma: bool = True
+    # additionally fit a log-amplitude scale on the initial condition
+    # (one scalar multiplying the whole IC state)
+    fit_ic: bool = False
+    # Courant steps in the target/fit rollout window
+    nsteps: int = 8
+    # physical end time of the rollout; 0 → the last &OUTPUT_PARAMS tout
+    tend: float = 0.0
+    # remat window length of the checkpointed scan;
+    # 0 → ceil(sqrt(nsteps)) (the O(sqrt N) adjoint-memory schedule)
+    inner: int = 0
+    # optimizer iterations
+    niter: int = 60
+    # Adam learning rate
+    lr: float = 2e-2
+    # clip the per-member global gradient norm (0 = off)
+    grad_clip: float = 0.0
+    # batched calibration: B independent members advance in one compiled
+    # vmapped program (cf. &ENSEMBLE_PARAMS nmember)
+    nmember: int = 1
+    # initial gamma guess; 0 → truth * (1 + guess_spread).  With
+    # nmember > 1 the member guesses are spread uniformly over
+    # guess ± truth*guess_spread
+    gamma_guess: float = 0.0
+    guess_spread: float = 0.05
+    # initial IC log-amplitude guess (fit_ic)
+    ic_guess: float = 0.0
+    # divergence screen: a member whose loss is non-finite or exceeds
+    # diverge_loss (0 = non-finite only) is quarantined via the
+    # BatchGuard ladder — its parameters freeze, the batch keeps running
+    diverge_loss: float = 0.0
+    # optimizer-state checkpoint cadence in iterations (0 = final only);
+    # checkpoints are manifest-valid output_NNNNN dirs, so &RUN_PARAMS
+    # auto_resume restarts a killed calibration from the last one
+    checkpoint_every: int = 0
+
+
+@dataclass
 class Params:
     """Full runtime configuration (one object per simulation)."""
     ndim: int = 3               # compile-time in the reference (bin/Makefile:7)
@@ -454,6 +505,8 @@ class Params:
     rt: RtParams = field(default_factory=RtParams)
     units: UnitsParams = field(default_factory=UnitsParams)
     ensemble: EnsembleParams = field(default_factory=EnsembleParams)
+    calibration: CalibrationParams = field(
+        default_factory=CalibrationParams)
     lightcone: LightconeParams = field(
         default_factory=LightconeParams)
     clumpfind: ClumpfindParams = field(
@@ -480,6 +533,7 @@ _GROUP_MAP = {
     "rt_params": "rt",
     "units_params": "units",
     "ensemble_params": "ensemble",
+    "calibration_params": "calibration",
     "lightcone_params": "lightcone",
     "clumpfind_params": "clumpfind",
 }

@@ -1,0 +1,19 @@
+"""Differentiable solver subsystem (ROADMAP item 4).
+
+Gradient-safe step chains (:mod:`ramses_tpu.diff.rollout`), an in-repo
+Adam optimizer (:mod:`ramses_tpu.diff.optim`) and a batched calibration
+service (:mod:`ramses_tpu.diff.calibrate`).  Nothing in the
+undifferentiated drivers imports this package — the adjoint path is
+strictly opt-in (pinned by ``tests/test_diff.py``).
+"""
+
+from ramses_tpu.diff.rollout import (checkpointed_run_steps, default_inner,
+                                     rollout, rollout_loss, rollout_mhd)
+from ramses_tpu.diff.optim import (AdamState, adam_init, adam_update,
+                                   clip_by_global_norm, global_norm)
+
+__all__ = [
+    "checkpointed_run_steps", "default_inner", "rollout", "rollout_loss",
+    "rollout_mhd", "AdamState", "adam_init", "adam_update",
+    "clip_by_global_norm", "global_norm",
+]

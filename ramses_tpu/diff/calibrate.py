@@ -1,0 +1,332 @@
+"""Batched gradient-descent calibration against a target rollout.
+
+The deliverable of the differentiable subsystem: fit an EOS gamma and/or
+an initial-condition amplitude to a target Sedov (or any namelist)
+profile by Adam descent through the checkpointed adjoint rollout.  B
+independent members — each its own parameter guess — advance in ONE
+compiled program (``vmap(value_and_grad(member_loss))``), the inverse
+analog of the forward ensemble engine (``ensemble/batch.py``).
+
+Service shape mirrors the run service:
+
+* ``&CALIBRATION_PARAMS`` namelist block (config.CalibrationParams),
+  ``__main__ --calibrate`` and ``calibrate``-kind jobs through
+  ``ensemble/queue.py`` + ``service.py`` all land in
+  :func:`run_calibration_job`;
+* optimizer-state checkpoints are manifest-valid ``output_NNNNN`` dirs
+  (``resilience/checkpoint.py``), so ``auto_resume`` restarts a killed
+  calibration from the last finalized iterate — the deterministic
+  ``fault_inject`` sigterm@K harness exercises exactly that in CI;
+* diverged members (non-finite or runaway loss) are quarantined via the
+  BatchGuard ladder — parameters and Adam moments freeze, the batch
+  keeps running, telemetry records the eviction;
+* per-iteration loss curve / gradient norm / step time land in telemetry
+  ``calibrate_iter`` records.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramses_tpu.config import Params
+from ramses_tpu.diff import optim
+from ramses_tpu.diff.rollout import rollout_loss
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.grid.uniform import UniformGrid, run_steps
+from ramses_tpu.hydro.core import HydroStatic
+from ramses_tpu.init.regions import condinit
+
+CKPT_NPZ = "calibration.npz"
+CKPT_JSON = "calibration.json"
+
+
+def build_problem(params: Params, dtype):
+    """(grid, u0, tend) for the calibration rollout — the same
+    resolution/IC construction as the uniform driver (driver.Simulation)."""
+    cfg = HydroStatic.from_params(params)
+    lmin = params.amr.levelmin
+    n = 2 ** lmin
+    base = [params.amr.nx, params.amr.ny, params.amr.nz][:params.ndim]
+    shape = tuple(b * n for b in base)
+    dx = params.amr.boxlen / n
+    grid = UniformGrid(cfg=cfg, shape=shape, dx=dx,
+                       bc=bmod.BoundarySpec.from_params(params))
+    u0 = jnp.asarray(condinit(shape, dx, params, cfg), dtype)
+    tend = float(params.calibration.tend)
+    if tend <= 0.0:
+        touts = params.output.tout[:params.output.noutput]
+        if not touts:
+            raise ValueError("calibration needs &CALIBRATION_PARAMS tend "
+                             "or an &OUTPUT_PARAMS tout ladder")
+        tend = float(touts[-1])
+    return grid, u0, tend
+
+
+def make_target(grid: UniformGrid, u0, tend: float, nsteps: int):
+    """The 'observation': a plain (undifferentiated) driver rollout at
+    the namelist's true parameters."""
+    t0 = jnp.zeros((), u0.dtype)
+    u, _, _ = run_steps(grid, u0, t0, jnp.asarray(tend, u0.dtype), nsteps)
+    return u
+
+
+def _init_theta(cal, truth_gamma: float, B: int, dtype):
+    """Per-member initial parameter guesses ``{name: [B]}``."""
+    th = {}
+    if cal.fit_gamma:
+        g0 = (float(cal.gamma_guess) if cal.gamma_guess > 0.0
+              else truth_gamma * (1.0 + float(cal.guess_spread)))
+        if B > 1:
+            # half-width spread around the guess so no member starts on
+            # the truth by construction (g0 - spread/2 > truth)
+            off = (np.linspace(-0.5, 0.5, B)
+                   * float(cal.guess_spread) * truth_gamma)
+            g = g0 + off
+        else:
+            g = np.full((1,), g0)
+        th["gamma"] = jnp.asarray(g, dtype)
+    if cal.fit_ic:
+        th["ic_logamp"] = jnp.full((B,), float(cal.ic_guess), dtype)
+    if not th:
+        raise ValueError("&CALIBRATION_PARAMS: nothing to fit "
+                         "(fit_gamma and fit_ic both off)")
+    return th
+
+
+def _member_loss_fn(grid, u0, target, tend, nsteps, inner):
+    t0 = jnp.zeros((), u0.dtype)
+    tend = jnp.asarray(tend, u0.dtype)
+
+    def member_loss(th):
+        theta = {}
+        if "ic_logamp" in th:
+            theta["ic_scale"] = jnp.exp(th["ic_logamp"])
+        if "gamma" in th:
+            theta["gamma"] = th["gamma"]
+        return rollout_loss(theta, u0, target, grid, t0, tend, nsteps,
+                            inner=inner)
+
+    return member_loss
+
+
+def _make_update(member_loss, lr: float, grad_clip: float):
+    @jax.jit
+    def update(theta, ostate, active):
+        loss, grads = jax.vmap(jax.value_and_grad(member_loss))(theta)
+        # zero quarantined members' gradients FIRST so a frozen-NaN
+        # member cannot poison the clip scale or the Adam moments
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(active, g, 0.0), grads)
+        if grad_clip > 0.0:
+            grads, gnorm = optim.clip_by_global_norm(grads, grad_clip,
+                                                     axis=0)
+        else:
+            gnorm = optim.global_norm(grads, axis=0)
+        theta2, ostate2 = optim.adam_update(grads, ostate, theta, lr=lr)
+        sel = lambda new, old: jnp.where(active, new, old)  # noqa: E731
+        theta2 = jax.tree_util.tree_map(sel, theta2, theta)
+        ostate2 = optim.AdamState(
+            m=jax.tree_util.tree_map(sel, ostate2.m, ostate.m),
+            v=jax.tree_util.tree_map(sel, ostate2.v, ostate.v),
+            count=ostate2.count)
+        return loss, gnorm, theta2, ostate2
+
+    return update
+
+
+def _save_checkpoint(base_dir: str, it: int, theta, ostate, active,
+                     hist, spec, keep: int = 2) -> str:
+    """Optimizer-state checkpoint as a manifest-valid output_NNNNN dir
+    (stage → manifest → atomic rename), resumable by auto_resume."""
+    from ramses_tpu.resilience.checkpoint import (finalize_checkpoint,
+                                                  rotate_checkpoints)
+    stage = os.path.join(base_dir, f"output_{it:05d}.stage")
+    os.makedirs(stage, exist_ok=True)
+    flat = {"active": np.asarray(active),
+            "count": np.asarray(ostate.count),
+            "loss_hist": np.asarray(hist, dtype=np.float64)}
+    for k, v in theta.items():
+        flat[f"theta_{k}"] = np.asarray(v)
+        flat[f"m_{k}"] = np.asarray(ostate.m[k])
+        flat[f"v_{k}"] = np.asarray(ostate.v[k])
+    np.savez(os.path.join(stage, CKPT_NPZ), **flat)
+    with open(os.path.join(stage, CKPT_JSON), "w") as f:
+        json.dump(dict(spec, iter=it), f)
+    final = finalize_checkpoint(
+        stage, os.path.join(base_dir, f"output_{it:05d}"),
+        {"kind": "calibrate", "nstep": it, "t": float(it), "iout": it})
+    if keep:
+        rotate_checkpoints(base_dir, keep)
+    return final
+
+
+def _load_checkpoint(path: str, spec, dtype, log):
+    """Restore (start_iter, theta, ostate, active, hist) from a
+    finalized calibration checkpoint; None on any spec mismatch (a
+    changed problem must not silently continue a stale optimize)."""
+    npz_path = os.path.join(path, CKPT_NPZ)
+    json_path = os.path.join(path, CKPT_JSON)
+    if not (os.path.isfile(npz_path) and os.path.isfile(json_path)):
+        return None
+    with open(json_path) as f:
+        saved = json.load(f)
+    it = int(saved.pop("iter", 0))
+    if {k: saved.get(k) for k in spec} != dict(spec):
+        if log:
+            log(f"calibrate: checkpoint {path} was written for a "
+                "different problem spec; starting fresh")
+        return None
+    data = np.load(npz_path)
+    names = [k[len("theta_"):] for k in data.files
+             if k.startswith("theta_")]
+    theta = {k: jnp.asarray(data[f"theta_{k}"], dtype) for k in names}
+    ostate = optim.AdamState(
+        m={k: jnp.asarray(data[f"m_{k}"], dtype) for k in names},
+        v={k: jnp.asarray(data[f"v_{k}"], dtype) for k in names},
+        count=jnp.asarray(data["count"]))
+    active = np.asarray(data["active"]).astype(bool)
+    hist = list(np.asarray(data["loss_hist"]))
+    return it, theta, ostate, active, hist
+
+
+def run_calibration_job(params: Params, dtype=None,
+                        base_dir: Optional[str] = None,
+                        log: Optional[Callable] = print,
+                        on_iter: Optional[Callable] = None) -> dict:
+    """Run (or resume) one calibration described by a namelist.
+
+    Returns a result dict with the recovered parameters, loss history
+    endpoints, quarantine census and the last checkpoint path.
+    ``on_iter(it, loss[B])`` fires once per optimizer iteration — the
+    queue service uses it to heartbeat the job record.
+    """
+    from ramses_tpu.resilience.checkpoint import resolve_restart_dir
+    from ramses_tpu.resilience.faultinject import FaultInjector
+    from ramses_tpu.resilience.stepguard import BatchGuard
+    from ramses_tpu.telemetry.recorder import make_telemetry
+
+    cal = params.calibration
+    if dtype is None:
+        dtype = (jnp.float64 if jax.config.jax_enable_x64
+                 else jnp.float32)
+    base_dir = base_dir if base_dir is not None else "."
+    os.makedirs(base_dir, exist_ok=True)
+
+    grid, u0, tend = build_problem(params, dtype)
+    nsteps = int(cal.nsteps)
+    inner = int(cal.inner) or None
+    niter = int(cal.niter)
+    truth = float(grid.cfg.gamma)
+    B = max(1, int(cal.nmember))
+    spec = {"niter": niter, "nmember": B, "nsteps": nsteps,
+            "fit_gamma": bool(cal.fit_gamma), "fit_ic": bool(cal.fit_ic),
+            "gamma_truth": truth, "tend": tend}
+
+    target = make_target(grid, u0, tend, nsteps)
+    member_loss = _member_loss_fn(grid, u0, target, tend, nsteps, inner)
+    update = _make_update(member_loss, float(cal.lr),
+                          float(cal.grad_clip))
+
+    theta = _init_theta(cal, truth, B, dtype)
+    ostate = optim.adam_init(theta)
+    active = np.ones(B, dtype=bool)
+    hist: list = []
+    start_iter = 0
+    resumed_from = None
+    rdir = resolve_restart_dir(params, base_dir, log=log)
+    if rdir is not None:
+        loaded = _load_checkpoint(rdir, spec, dtype, log)
+        if loaded is not None:
+            start_iter, theta, ostate, active, hist = loaded
+            resumed_from = start_iter
+            if log:
+                log(f"calibrate: resumed optimizer state at iteration "
+                    f"{start_iter} from {rdir}")
+
+    telemetry = make_telemetry(params, run_info={
+        "driver": "Calibration", "nmember": B, "niter": niter})
+    guard = BatchGuard(max_retries=0, telemetry=telemetry)
+    injector = FaultInjector.from_params(params)
+    ckpt_every = int(cal.checkpoint_every)
+    last_ckpt = rdir
+    loss_h = np.full(B, np.nan)
+
+    for it in range(start_iter, niter):
+        if injector is not None:
+            injector.maybe_signal(it)
+        tic = time.perf_counter()
+        loss, gnorm, theta, ostate = update(theta, ostate,
+                                            jnp.asarray(active))
+        loss_h = np.asarray(loss)
+        gnorm_h = np.asarray(gnorm)
+        dt_it = time.perf_counter() - tic
+
+        bad = ~np.isfinite(loss_h) | ~np.isfinite(gnorm_h)
+        if float(cal.diverge_loss) > 0.0:
+            bad |= loss_h > float(cal.diverge_loss)
+        newly = bad & active
+        if newly.any():
+            guard.trips += int(newly.sum())
+            for m in np.nonzero(newly)[0]:
+                guard.record_quarantine(int(m), {
+                    "reason": "diverged", "nstep": it,
+                    "t": float(loss_h[m])
+                    if np.isfinite(loss_h[m]) else -1.0})
+            active &= ~bad
+        live = loss_h[active] if active.any() else loss_h
+        hist.append(float(np.min(live)))
+        telemetry.record_event(
+            "calibrate_iter", iter=it,
+            loss_min=float(np.min(live)), loss_mean=float(np.mean(live)),
+            grad_norm_max=float(np.max(gnorm_h[active]))
+            if active.any() else float("nan"),
+            step_time_s=dt_it, active=int(active.sum()))
+        if on_iter is not None:
+            on_iter(it, loss_h)
+        if ckpt_every and (it + 1) % ckpt_every == 0:
+            last_ckpt = _save_checkpoint(base_dir, it + 1, theta, ostate,
+                                         active, hist, spec)
+
+    last_ckpt = _save_checkpoint(base_dir, niter, theta, ostate, active,
+                                 hist, spec)
+    result = {
+        "iterations": niter,
+        "start_iter": start_iter,
+        "resumed_from": resumed_from,
+        "nmember": B,
+        "active": int(active.sum()),
+        "quarantined": int(B - int(active.sum())),
+        "loss_first": (hist[0] if hist else None),
+        "loss_final": (hist[-1] if hist else None),
+        "gamma_truth": truth,
+        "checkpoint": last_ckpt,
+    }
+    if "gamma" in theta:
+        g = np.asarray(theta["gamma"])
+        result["gamma"] = [float(x) for x in g]
+        # best member = lowest final loss among the live ones (truth is
+        # unknown in a real calibration)
+        score = np.where(active & np.isfinite(loss_h), loss_h, np.inf)
+        if np.isfinite(score).any():
+            result["gamma_best"] = float(g[int(np.argmin(score))])
+    if "ic_logamp" in theta:
+        result["ic_logamp"] = [float(x)
+                               for x in np.asarray(theta["ic_logamp"])]
+    telemetry.record_event("calibrate_done", **{
+        k: v for k, v in result.items()
+        if isinstance(v, (int, float, str)) and v is not None})
+    telemetry.close()
+    if log:
+        msg = (f"calibrate: {niter - start_iter} iterations, loss "
+               f"{result['loss_first']} -> {result['loss_final']}")
+        if "gamma" in result:
+            msg += (f", gamma {result['gamma']} (truth {truth})")
+        log(msg)
+    return result

@@ -1,0 +1,78 @@
+"""Minimal in-repo Adam optimizer (no external optimizer dependency).
+
+Pure pytree-to-pytree functions: state and parameters are arbitrary
+pytrees of arrays, every update is elementwise, so a batch of B
+independent calibrations is just leaves with a leading ``[B]`` axis —
+no vmap plumbing needed in the optimizer itself (Kingma & Ba 2014,
+arXiv:1412.6980, the standard bias-corrected form).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AdamState(NamedTuple):
+    """First/second moment estimates + shared step counter."""
+    m: Any
+    v: Any
+    count: Any  # int32 scalar
+
+
+def adam_init(theta) -> AdamState:
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, theta)
+    return AdamState(m=zeros,
+                     v=jax.tree_util.tree_map(jnp.zeros_like, theta),
+                     count=jnp.zeros((), jnp.int32))
+
+
+def adam_update(grads, state: AdamState, theta, lr: float = 1e-2,
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """One Adam step.  Returns ``(theta_new, state_new)``."""
+    count = state.count + 1
+    cf = count.astype(jnp.float64 if jax.config.jax_enable_x64
+                      else jnp.float32)
+    m = jax.tree_util.tree_map(
+        lambda mu, g: b1 * mu + (1.0 - b1) * g, state.m, grads)
+    v = jax.tree_util.tree_map(
+        lambda nu, g: b2 * nu + (1.0 - b2) * (g * g), state.v, grads)
+
+    def upd(p, mu, nu):
+        mhat = mu / (1.0 - b1 ** cf)
+        vhat = nu / (1.0 - b2 ** cf)
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps)
+
+    theta = jax.tree_util.tree_map(upd, theta, m, v)
+    return theta, AdamState(m=m, v=v, count=count)
+
+
+def global_norm(grads, axis=None):
+    """sqrt(sum of squares) over every leaf; with ``axis`` kept (e.g. a
+    leading member axis), reduces each leaf over all *other* axes so the
+    result is a per-member gradient norm."""
+    total = 0.0
+    for g in jax.tree_util.tree_leaves(grads):
+        if axis is None:
+            total = total + jnp.sum(g * g)
+        else:
+            red = tuple(a for a in range(g.ndim) if a != axis)
+            total = total + jnp.sum(g * g, axis=red)
+    return jnp.sqrt(total)
+
+
+def clip_by_global_norm(grads, max_norm: float, axis=None):
+    """Scale ``grads`` so the (per-member) global norm is <= max_norm."""
+    norm = global_norm(grads, axis=axis)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-30))
+
+    def apply(g):
+        if axis is None or g.ndim == 0:
+            return g * scale
+        shp = [1] * g.ndim
+        shp[axis] = -1
+        return g * scale.reshape(shp)
+
+    return jax.tree_util.tree_map(apply, grads), norm

@@ -1,0 +1,208 @@
+"""Checkpointed adjoint rollouts over the fused uniform step chains.
+
+Reverse-mode AD through a plain ``lax.scan`` of N steps keeps every
+intermediate state alive for the backward pass — O(N) memory, which is
+exactly the cost profile that makes adjoint CFD impractical on
+accelerators.  Here the scan is split into ``outer x inner`` windows with
+``jax.checkpoint`` (remat) around each inner window: the forward pass
+stores only the ``outer`` window boundaries and recomputes each window of
+``inner`` steps during the backward sweep, so peak memory is
+O(inner + outer) = O(sqrt(N)) at ``inner = ceil(sqrt(N))`` for ~1 extra
+forward pass of compute (cf. Griewank's binomial checkpointing; the JANC
+compressible-flow stack, arXiv:2504.13750, uses the same schedule).
+
+The forward pass of :func:`checkpointed_run_steps` is bitwise-identical
+to :func:`ramses_tpu.grid.uniform.run_steps` on the XLA path: the step
+gating reuses the very same ``cfl_dt``/``step`` callables, and padding
+iterations beyond ``nsteps`` are masked with the same ``active`` pattern
+(``tests/test_diff.py`` pins this).  The fused Pallas TPU kernel has no
+VJP rule, so the differentiable chain always takes the XLA reference path
+(which the Pallas kernel is itself pinned bit-identical to).
+
+An EOS gamma can be a *differentiable input*: ``HydroStatic.gamma`` is
+normally a static jit cache key, so :func:`rollout` rebuilds the config
+with ``dataclasses.replace(cfg, gamma=<traced scalar>)`` inside the
+traced function and inlines the XLA step body (every kernel below the
+step — pad/ctoprim/slopes/trace/riemann — is a plain function, so the
+tracer flows through ``cfg.gamma`` and the derived ``smallp`` floor
+transparently).  Note a weak-typing caveat: the traced gamma is cast to
+the state dtype so the chain's arithmetic dtype is unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ramses_tpu.grid import boundary as bmod
+from ramses_tpu.grid.uniform import (UniformGrid, _pallas_ok, cfl_dt, step)
+from ramses_tpu.hydro import muscl
+from ramses_tpu.hydro.timestep import compute_dt
+
+
+def default_inner(nsteps: int) -> int:
+    """sqrt-schedule window length: O(sqrt(N)) adjoint memory."""
+    return max(1, int(math.ceil(math.sqrt(max(1, nsteps)))))
+
+
+def _xla_step(grid: UniformGrid, cfg, u, dt):
+    """The XLA reference body of :func:`ramses_tpu.grid.uniform.step` with
+    an explicit (possibly gamma-traced) ``cfg``.  Never dispatches to the
+    Pallas kernel — it has no VJP rule."""
+    dt = jnp.asarray(dt, u.dtype)
+    up = bmod.pad(u, grid.bc, cfg, muscl.NGHOST, dx=grid.dx)
+    flux, tmp = muscl.unsplit(up, None, dt, (grid.dx,) * cfg.ndim, cfg)
+    un = muscl.apply_fluxes(up, flux, cfg)
+    if cfg.pressure_fix or cfg.nener:
+        un = muscl.dual_energy_fix(up, un, tmp, dt,
+                                   (grid.dx,) * cfg.ndim, cfg)
+    return bmod.unpad(un, cfg.ndim, muscl.NGHOST)
+
+
+def _scan_windows(one, carry, nsteps: int, inner: int):
+    """outer x inner double scan with remat around each inner window.
+
+    ``one(carry, i)`` advances a single step, masking on the global step
+    index ``i`` so the ``outer*inner - nsteps`` padding iterations are
+    no-ops (identical masking to the plain driver's ``t < tend`` gate for
+    i < nsteps, hence the bitwise pin)."""
+    outer = -(-nsteps // inner)
+
+    @jax.checkpoint
+    def window(c, idx):
+        return jax.lax.scan(one, c, idx)
+
+    idxs = jnp.arange(outer * inner).reshape(outer, inner)
+    carry, _ = jax.lax.scan(window, carry, idxs)
+    return carry
+
+
+@partial(jax.jit, static_argnames=("grid", "nsteps", "inner", "dt_scale"))
+def checkpointed_run_steps(grid: UniformGrid, u, t, tend, nsteps: int,
+                           inner: int | None = None,
+                           dt_scale: float = 1.0):
+    """Differentiable :func:`ramses_tpu.grid.uniform.run_steps`.
+
+    Same contract — advance up to ``nsteps`` Courant steps, clipped to
+    land on ``tend``, returning ``(u, t, ndone)`` — but reverse-mode
+    differentiable with O(sqrt(nsteps)) adjoint memory.  The forward pass
+    is bitwise-identical to ``run_steps`` on the XLA path (pinned by
+    ``tests/test_diff.py``)."""
+    if inner is None:
+        inner = default_inner(nsteps)
+    use_ref = not _pallas_ok(grid, u.dtype)
+
+    def one(carry, i):
+        u, t, ndone = carry
+        dt = cfl_dt(grid, u) * dt_scale
+        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+        active = (t < tend) & (i < nsteps)
+        dt_eff = jnp.where(active, dt, 0.0)
+        if use_ref:
+            un = step(grid, u, dt_eff)
+        else:
+            un = _xla_step(grid, grid.cfg, u, dt_eff)
+        u = jnp.where(active, un, u)
+        t = jnp.where(active, t + dt, t)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, t, ndone), None
+
+    return _scan_windows(one, (u, t, jnp.array(0)), nsteps, inner)
+
+
+@partial(jax.jit, static_argnames=("grid", "nsteps", "inner", "dt_scale"))
+def _rollout_gamma(grid: UniformGrid, u, t, tend, nsteps: int, gamma,
+                   inner: int | None = None, dt_scale: float = 1.0):
+    """Checkpointed rollout with a *traced* EOS gamma (see module doc)."""
+    if inner is None:
+        inner = default_inner(nsteps)
+    cfg = dataclasses.replace(grid.cfg, gamma=jnp.asarray(gamma, u.dtype))
+
+    def one(carry, i):
+        u, t, ndone = carry
+        dt = compute_dt(u, None, grid.dx, cfg) * dt_scale
+        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+        active = (t < tend) & (i < nsteps)
+        un = _xla_step(grid, cfg, u, jnp.where(active, dt, 0.0))
+        u = jnp.where(active, un, u)
+        t = jnp.where(active, t + dt, t)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, t, ndone), None
+
+    return _scan_windows(one, (u, t, jnp.array(0)), nsteps, inner)
+
+
+def rollout(grid: UniformGrid, u, t, tend, nsteps: int, gamma=None,
+            inner: int | None = None, dt_scale: float = 1.0):
+    """Gamma-aware differentiable rollout.
+
+    ``gamma=None`` runs the static-config chain (bitwise pin holds);
+    a scalar ``gamma`` (traced or concrete) runs the inlined chain with
+    the EOS gamma as a differentiable input."""
+    if gamma is None:
+        return checkpointed_run_steps(grid, u, t, tend, nsteps,
+                                      inner=inner, dt_scale=dt_scale)
+    return _rollout_gamma(grid, u, t, tend, nsteps, gamma,
+                          inner=inner, dt_scale=dt_scale)
+
+
+def rollout_loss(theta, u0, target, grid: UniformGrid, t0, tend,
+                 nsteps: int, inner: int | None = None,
+                 dt_scale: float = 1.0):
+    """Scalar data-misfit of a differentiable rollout against ``target``.
+
+    ``theta`` maps parameter names to differentiable overrides:
+      ``"u0"``      full initial-state replacement ``[nvar, *sp]``
+      ``"du0"``     additive IC perturbation (applied to the base IC)
+      ``"ic_scale"``  scalar (or per-channel ``[nvar]``) multiplier on
+                    the base IC
+      ``"gamma"``   scalar EOS gamma (switches to the traced-gamma chain)
+    Returns mean squared error over all cells and channels — the standard
+    calibration objective; wrap for anything fancier.
+    """
+    u = theta.get("u0", u0)
+    if "ic_scale" in theta:
+        s = jnp.asarray(theta["ic_scale"], u.dtype)
+        u = u * (s.reshape((-1,) + (1,) * (u.ndim - 1)) if s.ndim else s)
+    if "du0" in theta:
+        u = u + theta["du0"]
+    uT, _, _ = rollout(grid, u, t0, tend, nsteps,
+                       gamma=theta.get("gamma"), inner=inner,
+                       dt_scale=dt_scale)
+    r = uT - target
+    return jnp.mean(r * r)
+
+
+@partial(jax.jit, static_argnames=("grid", "nsteps", "inner", "dt_scale"))
+def rollout_mhd(grid, u, bf, t, tend, nsteps: int,
+                inner: int | None = None, dt_scale: float = 1.0):
+    """Checkpointed differentiable analog of
+    :func:`ramses_tpu.mhd.uniform.run_steps` (CT chain, carry ``(u, bf)``).
+
+    Same sqrt-schedule remat, same ``cfl_dt``/``step`` callables, same
+    masking.  Unlike the hydro chain (bitwise-pinned), the CT chain
+    matches the plain driver only to ~1 ulp: XLA fuses the step body
+    differently under the nested remat scan (t/ndone stay exact;
+    ``tests/test_diff.py`` pins the tolerance)."""
+    from ramses_tpu.mhd import uniform as mu
+
+    if inner is None:
+        inner = default_inner(nsteps)
+
+    def one(carry, i):
+        u, bf, t, ndone = carry
+        dt = mu.cfl_dt(grid, u, bf) * dt_scale
+        dt = jnp.minimum(dt, jnp.maximum(tend - t, 0.0))
+        active = (t < tend) & (i < nsteps)
+        un, bfn = mu.step(grid, u, bf, jnp.where(active, dt, 0.0))
+        u = jnp.where(active, un, u)
+        bf = jnp.where(active, bfn, bf)
+        t = jnp.where(active, t + dt, t)
+        ndone = ndone + jnp.where(active, 1, 0)
+        return (u, bf, t, ndone), None
+
+    return _scan_windows(one, (u, bf, t, jnp.array(0)), nsteps, inner)

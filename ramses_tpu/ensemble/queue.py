@@ -66,24 +66,36 @@ def _write_record(path: str, record: Dict[str, Any]) -> None:
 def submit(queue_dir: str, namelist: str,
            sweeps: Optional[Dict[str, List[Any]]] = None,
            solver: str = "", ndim: int = 3, dtype: str = "float32",
-           job_id: str = "", meta: Optional[Dict[str, Any]] = None
-           ) -> str:
-    """Enqueue a run: ``namelist`` is the full namelist *text* (the
+           job_id: str = "", meta: Optional[Dict[str, Any]] = None,
+           kind: str = "run") -> str:
+    """Enqueue a job: ``namelist`` is the full namelist *text* (the
     record is self-contained — workers need no shared checkout), plus
-    optional explicit per-member ``sweeps``.  Returns the job id."""
+    optional explicit per-member ``sweeps``.  ``kind`` dispatches the
+    worker-side handler first-class — ``"run"`` (forward ensemble,
+    default) or ``"calibrate"`` (gradient-descent calibration,
+    ramses_tpu/diff) — instead of being sniffed from the payload.
+    Returns the job id."""
     init_queue(queue_dir)
+    if kind not in ("run", "calibrate"):
+        raise ValueError(f"unknown job kind {kind!r}")
     if not job_id:
         job_id = f"job-{time.time_ns():020d}-{os.getpid()}"
     path = os.path.join(queue_dir, "queued", job_id + ".json")
     if os.path.exists(path):
         raise FileExistsError(f"job id '{job_id}' already queued")
     _write_record(path, {
-        "id": job_id, "namelist": namelist,
+        "id": job_id, "kind": kind, "namelist": namelist,
         "sweeps": dict(sweeps or {}), "solver": solver,
         "ndim": int(ndim), "dtype": dtype,
         "submitted_unix": time.time(), "attempts": 0,
         "meta": dict(meta or {})})
     return job_id
+
+
+def job_kind(record: Dict[str, Any]) -> str:
+    """The job's dispatch kind; records written before the field existed
+    default to ``"run"``."""
+    return str(record.get("kind") or "run")
 
 
 def claim(queue_dir: str, worker: str = "",
@@ -130,6 +142,7 @@ def _log_failure(record: Dict[str, Any], error: str,
     the full history instead of only the last error."""
     record.setdefault("failure_log", []).append({
         "error": str(error), "stage": stage,
+        "kind": job_kind(record),
         "attempt": int(record.get("attempts", 0)),
         "worker": record.get("worker", ""),
         "time_unix": time.time()})

@@ -60,6 +60,20 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
     from ramses_tpu.resilience import scrub_checkpoints
     scrub_checkpoints(rdir, log=log)
     dtype = getattr(jnp, rec.get("dtype") or "float32")
+    if jq.job_kind(rec) == "calibrate" or params.calibration.calibrate:
+        # calibrate-kind job: gradient-descent calibration through the
+        # differentiable rollout (ramses_tpu/diff) — same artifact shape
+        # (results dir + telemetry JSONL + resumable output_NNNNN
+        # checkpoints), heartbeating the claim once per optimizer
+        # iteration instead of per fused window
+        from ramses_tpu.diff.calibrate import run_calibration_job
+
+        result = run_calibration_job(
+            params, dtype=dtype, base_dir=rdir, log=log,
+            on_iter=lambda it, loss: jq.heartbeat(job))
+        result["results_dir"] = rdir
+        result["telemetry"] = params.output.telemetry
+        return result
     spec = EnsembleSpec.from_params(params, sweeps=rec.get("sweeps"),
                                     solver=rec.get("solver", ""))
 
@@ -192,7 +206,8 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
         else:
             counts["done"] += 1
             jq.complete(job, result=result)
-            log(f"serve: {job.id} done -> {result['snapshot']}")
+            log(f"serve: {job.id} done -> "
+                f"{result.get('snapshot') or result.get('checkpoint')}")
         if max_jobs and counts["done"] + counts["failed"] >= max_jobs:
             return counts
 
@@ -200,13 +215,13 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
 def submit_namelist(queue_dir: str, namelist_path: str,
                     sweeps: Optional[Dict[str, Any]] = None,
                     solver: str = "", ndim: int = 3,
-                    dtype: str = "float32") -> str:
+                    dtype: str = "float32", kind: str = "run") -> str:
     """CLI submit helper: inline the namelist file into the job record
     so workers need no shared checkout."""
     with open(namelist_path) as f:
         text = f.read()
     return jq.submit(queue_dir, text, sweeps=sweeps, solver=solver,
-                     ndim=ndim, dtype=dtype,
+                     ndim=ndim, dtype=dtype, kind=kind,
                      meta={"namelist_path": os.path.abspath(
                          namelist_path)})
 

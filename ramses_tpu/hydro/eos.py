@@ -25,6 +25,11 @@ def barotropic_eos_temperature(nH, form: str, T2_eos: float,
     if form == "double_polytrope":
         return T2_eos * (1.0 + x ** (polytrope_index - 1.0))
     if form == "custom":
-        return jnp.where(x < 1.0, T2_eos,
-                         T2_eos * x ** (polytrope_index - 1.0))
+        # Double-where: the untaken power-law branch would be evaluated at
+        # x < 1 too, where x -> 0 makes its derivative unbounded for
+        # polytrope_index < 1 and poisons reverse-mode cotangents; feed it
+        # the break density instead (forward value there is masked anyway).
+        lo = x < 1.0
+        hi = T2_eos * jnp.where(lo, 1.0, x) ** (polytrope_index - 1.0)
+        return jnp.where(lo, T2_eos, hi)
     raise ValueError(f"unknown barotropic eos form {form!r}")

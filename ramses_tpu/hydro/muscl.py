@@ -92,8 +92,15 @@ def uslope(q, cfg: HydroStatic, dt=None, dx=None):
             dq.append(jnp.sign(dcen) * jnp.minimum(dlim, jnp.abs(dcen)))
         elif st == 7:  # van Leer harmonic
             prod = dlft * drgt
-            dq.append(jnp.where(prod <= 0.0, 0.0,
-                                2.0 * prod / (dlft + drgt + 1e-300)))
+            # Double-where: at an extremum dlft == -drgt makes the harmonic
+            # mean 0/0-like; the where masks the forward value but reverse-
+            # mode still multiplies the untaken branch's unbounded
+            # derivative by a zero cotangent (inf * 0 = NaN).  Divide by a
+            # guarded denominator instead — bit-identical where consumed.
+            mono = prod > 0.0
+            vl_den = jnp.where(mono, dlft + drgt + 1e-300, 1.0)
+            vl = 2.0 * prod / vl_den
+            dq.append(jnp.where(mono, vl, 0.0))
         elif st == 8:  # generalized moncen/minmod (theta)
             th = cfg.slope_theta
             dcen = 0.5 * (dlft + drgt)

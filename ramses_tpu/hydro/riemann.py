@@ -132,12 +132,24 @@ def riemann_hllc(ql, qr, cfg: HydroStatic):
     ustar = (rcr * ur + rcl * ul + (ptotl - ptotr)) / (rcr + rcl)
     ptotstar = (rcr * ptotl + rcl * ptotr + rcl * rcr * (ul - ur)) / (rcr + rcl)
 
-    rstarl = rl * (SL - ul) / (SL - ustar)
-    etotstarl = ((SL - ul) * etotl - ptotl * ul + ptotstar * ustar) / (SL - ustar)
-    estarl = el * (SL - ul) / (SL - ustar)
-    rstarr = rr * (SR - ur) / (SR - ustar)
-    etotstarr = ((SR - ur) * etotr - ptotr * ur + ptotstar * ustar) / (SR - ustar)
-    estarr = er * (SR - ur) / (SR - ustar)
+    # Gradient-safe star-state denominators.  sel() consumes the *L state
+    # only when SL <= 0 < ustar (so SL - ustar < 0 strictly) and the *R
+    # state only when ustar <= 0 < SR (so SR - ustar > 0 strictly), but an
+    # exactly degenerate wave (ustar == SL or ustar == SR) puts an inf in
+    # the *untaken* branch and reverse-mode where() turns the inf * 0
+    # cotangent product into NaN.  Substitute a finite dummy denominator
+    # wherever the branch is provably unconsumed; consumed values keep the
+    # original denominator bit-for-bit, so the forward pass is unchanged.
+    dSL = SL - ustar
+    dSL = jnp.where(dSL < 0.0, dSL, -1.0)
+    dSR = SR - ustar
+    dSR = jnp.where(dSR > 0.0, dSR, 1.0)
+    rstarl = rl * (SL - ul) / dSL
+    etotstarl = ((SL - ul) * etotl - ptotl * ul + ptotstar * ustar) / dSL
+    estarl = el * (SL - ul) / dSL
+    rstarr = rr * (SR - ur) / dSR
+    etotstarr = ((SR - ur) * etotr - ptotr * ur + ptotstar * ustar) / dSR
+    estarr = er * (SR - ur) / dSR
 
     # sample at x/t = 0: SL>0 → L | ustar>0 → *L | SR>0 → *R | else R
     def sel(a_l, a_sl, a_sr, a_r):
@@ -158,8 +170,8 @@ def riemann_hllc(ql, qr, cfg: HydroStatic):
     for n in range(cfg.nener):
         eradl = ql[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0)
         eradr = qr[2 + cfg.ndim + n] / (cfg.gamma_rad[n] - 1.0)
-        erado = sel(eradl, eradl * (SL - ul) / (SL - ustar),
-                    eradr * (SR - ur) / (SR - ustar), eradr)
+        erado = sel(eradl, eradl * (SL - ul) / dSL,
+                    eradr * (SR - ur) / dSR, eradr)
         flux.append(uo * erado)
     for s in range(cfg.npassive):
         i = 2 + cfg.ndim + cfg.nener + s
@@ -211,24 +223,46 @@ def riemann_approx(ql, qr, cfg: HydroStatic):
     co = jnp.maximum(cfg.smallc, jnp.sqrt(jnp.abs(cfg.gamma * po / ro)))
 
     shock = pstar >= po
-    rstar = jnp.where(
-        shock,
-        ro / (1.0 + ro * (po - pstar) / wo ** 2),
-        ro * jnp.abs(pstar / po) ** (1.0 / cfg.gamma))
+    # Gradient-safe rarefaction density: |pstar/po|**(1/gamma) has an
+    # unbounded derivative as pstar -> 0, so a vacuum-adjacent lane poisons
+    # reverse-mode cotangents even though the forward value (0) is clamped
+    # by smallr below.  Double-where: evaluate the power only where its
+    # input is strictly positive (forward value at 0 is 0 either way).
+    ps_rare = jnp.where(shock, po, pstar)
+    ps_pos = ps_rare > 0.0
+    ps_safe = jnp.where(ps_pos, ps_rare, po)
+    rstar_shock = ro / (1.0 + ro * (po - pstar) / wo ** 2)
+    rstar_rare = ro * jnp.where(
+        ps_pos, jnp.abs(ps_safe / po) ** (1.0 / cfg.gamma), 0.0)
+    rstar = jnp.where(shock, rstar_shock, rstar_rare)
     rstar = jnp.maximum(rstar, cfg.smallr)
-    cstar = jnp.maximum(jnp.sqrt(jnp.abs(cfg.gamma * pstar / rstar)), cfg.smallc)
-    spout = jnp.where(shock, wo / ro - sgnm * uo, co - sgnm * uo)
-    spin = jnp.where(shock, wo / ro - sgnm * uo, cstar - sgnm * ustar)
-    # rarefaction fan interpolation
-    frac = spout / (spout - spin + 1e-300)
+    # sqrt has an infinite derivative at 0; gamma*pstar/rstar >= 0 always,
+    # so guard the exact-zero lane (forward sqrt(0) == 0 is preserved).
+    cs2 = cfg.gamma * pstar / rstar
+    cs2_pos = cs2 > 0.0
+    cstar = jnp.maximum(
+        jnp.where(cs2_pos, jnp.sqrt(jnp.where(cs2_pos, cs2, 1.0)), 0.0),
+        cfg.smallc)
+    wo_ro = wo / ro
+    spout = jnp.where(shock, wo_ro - sgnm * uo, co - sgnm * uo)
+    spin = jnp.where(shock, wo_ro - sgnm * uo, cstar - sgnm * ustar)
+    # rarefaction fan interpolation; the fan values are only consumed when
+    # spout > 0 > spin, and outside the fan spout == spin makes the raw
+    # fraction derivative unbounded — restrict the division to the fan.
+    fan = (spout > 0.0) & (spin < 0.0)
+    fan_den = jnp.where(fan, spout - spin + 1e-300, 1.0)
+    frac = jnp.where(fan, spout / fan_den, 0.0)
     ufan = frac * ustar + (1.0 - frac) * uo
     pfan = frac * pstar + (1.0 - frac) * po
 
     qg_u = jnp.where(spout <= 0.0, uo, jnp.where(spin >= 0.0, ustar, ufan))
     qg_p = jnp.where(spout <= 0.0, po, jnp.where(spin >= 0.0, pstar, pfan))
+    # the fan-branch power is consumed exactly on `fan`, where pfan > 0 is
+    # guaranteed (frac in (0,1), po > 0); feed it po elsewhere.
+    qg_pfan = jnp.where(fan, qg_p, po)
+    fan_r = ro * jnp.abs(qg_pfan / po) ** (1.0 / cfg.gamma)
     qg_r = jnp.where(spout <= 0.0, ro,
-           jnp.where(spin >= 0.0, rstar,
-                     ro * jnp.abs(qg_p / po) ** (1.0 / cfg.gamma)))
+           jnp.where(spin >= 0.0, rstar, fan_r))
 
     fmass = qg_r * qg_u
     fmom = qg_p + qg_r * qg_u ** 2
@@ -272,8 +306,12 @@ def riemann_acoustic(ql, qr, cfg: HydroStatic):
     co = jnp.maximum(cfg.smallc, jnp.sqrt(jnp.abs(cfg.gamma * po / ro)))
     sgnm = jnp.where(left, 1.0, -1.0)
     rstar = jnp.maximum(ro + (pstar - po) / co ** 2, cfg.smallr)
-    cstar = jnp.maximum(cfg.smallc,
-                        jnp.sqrt(jnp.abs(cfg.gamma * pstar / rstar)))
+    # sqrt has an infinite derivative at 0 (acoustic pstar is unclamped and
+    # can cross zero); double-where the exact-zero lane, forward-preserving.
+    acs2 = jnp.abs(cfg.gamma * pstar / rstar)
+    acs2_pos = acs2 > 0.0
+    cstar = jnp.maximum(cfg.smallc, jnp.where(
+        acs2_pos, jnp.sqrt(jnp.where(acs2_pos, acs2, 1.0)), 0.0))
     spout = co - sgnm * uo
     spin = cstar - sgnm * ustar
     ushock = 0.5 * (spin + spout)

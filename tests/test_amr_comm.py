@@ -93,6 +93,7 @@ def test_explicit_comm_deterministic():
 
 
 @pytest.mark.smoke
+@pytest.mark.slow          # ~12s; CI smoke + nightly tiers still run it
 def test_explicit_comm_collective_footprint():
     """Pin the comm footprint of the sharded-AMR coarse step: the
     explicit ppermute schedule must not regress into all-gathers, and

@@ -151,6 +151,7 @@ def test_forced_layout_single_device_invariance():
     _cmp_state(sim0, sim1, rtol=1e-11, atol=1e-12)
 
 
+@pytest.mark.slow          # ~13s; nightly tier on the 1-core box
 def test_forced_layout_gravity_pm_invariance():
     """Layout transform correctness through the gravity maps (nb /
     ghost / mg ladder) and PM deposit maps: particles + CG self-gravity

@@ -322,6 +322,7 @@ def test_mhd_amr_particles_match_hydro_amr():
     assert simm.max_divb() < 1e-11
 
 
+@pytest.mark.slow          # ~13s; nightly tier on the 1-core box
 def test_mhd_amr_particles_feel_blob_and_dt_caps():
     """Particles around a magnetised self-gravitating blob fall toward
     it, the particle/free-fall dt caps enter coarse_dt, and divB stays

@@ -415,6 +415,7 @@ def test_blocked_parity_mhd_ct_3d():
 
 # --------------------------------------------- device-resident regrid
 
+@pytest.mark.slow          # ~19s; nightly tier on the 1-core box
 def test_device_regrid_matches_host(monkeypatch):
     """Changed-tree regrids on the device path must be bitwise-identical
     to the host build_prolong_maps reference — and must construct ZERO

@@ -87,6 +87,7 @@ def _records(path):
 # ---------------------------------------------------------------------
 # JSONL schema roundtrip
 # ---------------------------------------------------------------------
+@pytest.mark.slow          # ~14s; nightly tier on the 1-core box
 def test_jsonl_schema_one_record_per_coarse_step(tmp_path):
     sim = _amr_sim(tmp_path, nstep=5)
     assert sim.telemetry.enabled
