@@ -281,6 +281,110 @@ def bench_ensemble(params, dtype, jnp, hb=lambda *a, **k: None):
     }
 
 
+def bench_ensemble_sharded(params, dtype, jnp,
+                           hb=lambda *a, **k: None):
+    """Two-level parallelism throughput (ensemble/meshplan + the gang
+    service): the same small-job workload served at (members x shards)
+    in {(8,1) vmap, (8,8) packed, (1,8) slab}, against the
+    one-device-at-a-time FIFO baseline — eight single-member jobs
+    claimed and run sequentially on one device, the pre-two-level serve
+    behaviour.  Every config goes through the real queue->claim->
+    run_job->complete path so per-job costs (params expansion, engine
+    build, checkpoint, heartbeat, result record) are in the numbers;
+    the grid is deliberately tiny (BENCH_ENSH_LEVEL, default 2^2^3)
+    because the subject is job-processing amortisation, not FLOPs — on
+    real multi-chip meshes the packed replicas also compute
+    concurrently, which forced-host devices on one core cannot show.
+    Each config is timed over BENCH_ENSH_ROUNDS rounds and reports the
+    minimum (job walls are ~10ms; min-of-rounds is the stable
+    structural cost)."""
+    import tempfile
+
+    import numpy as np
+
+    from ramses_tpu.ensemble import queue as jq
+    from ramses_tpu.ensemble.meshplan import MeshPlan
+    from ramses_tpu.ensemble.service import run_job
+
+    lvl = int(os.environ.get("BENCH_ENSH_LEVEL", "2"))
+    slab_lvl = int(os.environ.get("BENCH_ENSH_SLAB_LEVEL", "4"))
+    nsteps = int(os.environ.get("BENCH_ENSH_STEPS", "4"))
+    rounds = int(os.environ.get("BENCH_ENSH_ROUNDS", "5"))
+    ndev = min(8, len(__import__("jax").devices()))
+
+    def nml(level, nmember):
+        return (
+            "&RUN_PARAMS\nhydro=.true.\nnstepmax=%d\n/\n"
+            "&AMR_PARAMS\nlevelmin=%d\nlevelmax=%d\n/\n"
+            "&OUTPUT_PARAMS\ntend=1e9\n/\n"
+            "&INIT_PARAMS\nd_region=1.0\np_region=1e-5\n/\n"
+            "&ENSEMBLE_PARAMS\nnmember=%d\nperturb_amp=1e-3\n"
+            "perturb_seed=7\nchunk_steps=%d\n/\n"
+            % (nsteps, level, level, nmember, nsteps))
+
+    def serve_round(qd, tag, jobs, device_ids, plan):
+        # jobs: list of (level, nmember); timed region is the worker
+        # side — claim, run, complete — exactly what a serve loop pays
+        ids = [jq.submit(qd, nml(lv, nm), job_id=f"{tag}-{i}",
+                         dtype=str(dtype.__name__))
+               for i, (lv, nm) in enumerate(jobs)]
+        t0 = time.perf_counter()
+        for jid in ids:
+            job = jq.claim(qd, worker="bench", job_id=jid)
+            run_job(qd, job, device_ids=device_ids, plan=plan,
+                    log=lambda *a, **k: None)
+            jq.complete(job, {})
+        return time.perf_counter() - t0
+
+    def measure(qd, name_, jobs, device_ids, plan, rep=1):
+        # rep repeats the job list back-to-back inside one timed round
+        # (wall divided by rep): single-job configs are ~15ms walls and
+        # need the smoothing the 8-job FIFO round gets for free
+        serve_round(qd, f"warm-{name_}", jobs, device_ids, plan)
+        hb(f"warm_{name_}")
+        wall = min(serve_round(qd, f"{name_}-r{r}", jobs * rep,
+                               device_ids, plan) / rep
+                   for r in range(rounds))
+        members = sum(nm for _, nm in jobs)
+        updates = sum((2 ** lv) ** 3 * nsteps * nm for lv, nm in jobs)
+        hb(f"timed_{name_}")
+        return {"scenarios_per_sec": members / wall,
+                "cell_updates_per_sec": updates / wall,
+                "members": members, "n_jobs": len(jobs),
+                "devices": len(device_ids), "wall_s": wall}
+
+    small = [(lvl, 1)] * 8
+    one8 = [(lvl, 8)]
+    all_dev = tuple(range(ndev))
+    per_config = {}
+    with tempfile.TemporaryDirectory() as td:
+        qd = os.path.join(td, "queue")
+        per_config["fifo_1x1"] = measure(
+            qd, "fifo", small, (0,), MeshPlan.single())
+        per_config["8x1"] = measure(
+            qd, "8x1", one8, (0,), MeshPlan.single(), rep=3)
+        per_config["8x8_packed"] = measure(
+            qd, "8x8", one8, all_dev, MeshPlan.packed(all_dev), rep=3)
+        try:
+            per_config["1x8_slab"] = measure(
+                qd, "slab", [(slab_lvl, 1)], all_dev,
+                MeshPlan.slab(all_dev))
+        except Exception as e:  # slab needs nx % ndev == 0, >= NGHOST
+            per_config["1x8_slab"] = {"error": f"{type(e).__name__}: {e}"}
+    packed = per_config["8x8_packed"]
+    fifo = per_config["fifo_1x1"]
+    return {
+        "config": f"two-level 2^{lvl}^3 x {{8x1, 8x8, 1x8@2^{slab_lvl}}} "
+                  f"on {ndev} devices, min of {rounds} rounds",
+        "scenarios_per_sec": packed["scenarios_per_sec"],
+        "cell_updates_per_sec": packed["cell_updates_per_sec"],
+        "n": (2 ** lvl) ** 3,
+        "speedup_packed_vs_fifo": (fifo["wall_s"] / packed["wall_s"]),
+        "per_config": per_config,
+        "tunnel_rtt_s": measure_rtt(jnp),
+    }
+
+
 def bench_amr(params, dtype, jnp, hb=lambda *a, **k: None):
     from ramses_tpu.amr.hierarchy import AmrSim
     from ramses_tpu.utils.timers import Timers
@@ -764,18 +868,19 @@ def bench_grad(dtype, jnp, hb=lambda *a, **k: None):
 # tools/profile_amr.py) and halo (the backend comparison above) are
 # opt-in via BENCH_ONLY — too slow for every protocol run
 DEFAULT_SUBS = ("uniform", "amr", "mg", "amr_poisson", "ensemble")
-SUBS = DEFAULT_SUBS + ("profile_amr", "halo", "offload", "grad")
+SUBS = DEFAULT_SUBS + ("profile_amr", "halo", "offload", "grad",
+                       "ensemble_sharded")
 # ceilings per sub; the GLOBAL budget (BENCH_TOTAL_BUDGET) always wins —
 # four rounds of rc=124 driver kills came from these summing past the
 # driver's wall clock whenever the tunnel hung
 SUB_TIMEOUTS = {"uniform": 300, "amr": 700, "mg": 240, "amr_poisson": 500,
                 "ensemble": 300, "profile_amr": 700, "halo": 300,
-                "offload": 600, "grad": 400}
+                "offload": 600, "grad": 400, "ensemble_sharded": 400}
 # share of the REMAINING budget each sub may claim at launch
 SUB_WEIGHTS = {"uniform": 0.20, "amr": 0.50, "mg": 0.35,
                "amr_poisson": 0.95, "ensemble": 0.95,
                "profile_amr": 0.95, "halo": 0.95, "offload": 0.95,
-               "grad": 0.95}
+               "grad": 0.95, "ensemble_sharded": 0.95}
 
 
 def run_sub_inproc(name):
@@ -790,6 +895,14 @@ def run_sub_inproc(name):
         hb.mark("deliberate_hang")
         while True:
             time.sleep(0.5)
+
+    if name == "ensemble_sharded" and \
+            os.environ.get("BENCH_ENSH_FORCE_CPU", "1") != "0":
+        # the two-level sub runs against 8 forced host devices by
+        # default (its subject is packing/claim amortisation, not
+        # FLOPs); BENCH_ENSH_FORCE_CPU=0 opts into the real backend
+        from ramses_tpu.platform import force_cpu_mesh
+        force_cpu_mesh(8)
 
     import jax
     import jax.numpy as jnp
@@ -813,6 +926,9 @@ def run_sub_inproc(name):
     elif name == "ensemble":
         d = bench_ensemble(load_params(nml, ndim=3), dtype, jnp,
                            hb=hb.mark)
+    elif name == "ensemble_sharded":
+        d = bench_ensemble_sharded(load_params(nml, ndim=3), dtype, jnp,
+                                   hb=hb.mark)
     elif name == "halo":
         d = bench_halo(load_params(nml, ndim=3), dtype, jnp, hb=hb.mark)
     elif name == "offload":

@@ -47,6 +47,12 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-id", default="",
                     help="with --serve: worker name stamped on claimed "
                          "jobs (default host:pid)")
+    ap.add_argument("--claim-order", default="cost",
+                    choices=["cost", "fifo"],
+                    help="with --serve: job claim order — 'cost' "
+                         "(default) gang-schedules by the submit-time "
+                         "cost stamp to fill the local device mesh, "
+                         "'fifo' restores blind oldest-first claiming")
     ap.add_argument("--ndim", type=int, default=3,
                     help="spatial dimensions (compile-time in the reference)")
     ap.add_argument("--dtype", default="float32",
@@ -100,7 +106,7 @@ def main(argv=None) -> int:
                        max_jobs=args.max_jobs, idle_exit=args.idle_exit,
                        stale_s=args.stale_timeout,
                        max_attempts=max(1, args.max_attempts),
-                       verbose=args.verbose)
+                       verbose=args.verbose, order=args.claim_order)
         print(f"serve: done={counts['done']} failed={counts['failed']}")
         return 1 if counts["failed"] else 0
     if not args.namelist:

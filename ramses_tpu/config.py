@@ -426,6 +426,32 @@ class EnsembleParams:
     # mtime is older than queue_stale_s is presumed orphaned and may be
     # reclaimed by another worker
     queue_stale_s: float = 300.0
+    # two-level parallelism (ensemble/meshplan.MeshPlan): a job whose
+    # per-member cell count stays at or below pack_cell_budget packs
+    # members across independent per-device replicas (the member vmap
+    # sharded over a replica mesh axis); above the budget the job is
+    # mesh-wide — members stream through the explicit slab pipeline on
+    # the full local mesh
+    pack_cell_budget: int = 2 ** 21
+    # cap on the replica count a packed job may spread over (0 = every
+    # device the scheduler assigned)
+    pack_max_replicas: int = 0
+    # scheduler demand clamps stamped into the queue record at submit
+    # (0 = auto: min 1 shard, max = the worker's mesh size); a
+    # mesh-wide job effectively pins min_shards to the whole mesh
+    min_shards: int = 0
+    max_shards: int = 0
+    # starvation bound for the cost-aware gang scheduler: a queued
+    # mesh-wide (exclusive) job older than this preempts small-job
+    # bin-packing — the worker drains to exclusive mode and runs it
+    # next regardless of cost order
+    gang_starve_s: float = 600.0
+    # serve-loop default: point the persistent compile cache at a
+    # shared <queue_dir>/compile_cache so fleet workers warm-start each
+    # other (an explicit &RUN_PARAMS compile_cache_dir or
+    # RAMSES_COMPILE_CACHE still wins); .false. restores the PR 12
+    # opt-in behavior
+    shared_compile_cache: bool = True
     # hang watchdog for the batched engine (resilience/watchdog.py):
     # same semantics as the &RUN_PARAMS deadlines, but guarding the
     # engine's per-chunk dispatch fetch; a hang escaping run_job makes

@@ -7,7 +7,9 @@ system absorbing many runs (``python -m ramses_tpu --serve <dir>``)."""
 from ramses_tpu.ensemble.batch import (EnsembleEngine, EnsembleSpec,
                                        apply_override, build_member)
 from ramses_tpu.ensemble import queue
+from ramses_tpu.ensemble.meshplan import MeshPlan, plan_for, stamp_cost
 from ramses_tpu.ensemble.service import serve, submit_namelist
 
-__all__ = ["EnsembleEngine", "EnsembleSpec", "apply_override",
-           "build_member", "queue", "serve", "submit_namelist"]
+__all__ = ["EnsembleEngine", "EnsembleSpec", "MeshPlan",
+           "apply_override", "build_member", "plan_for", "queue",
+           "serve", "stamp_cost", "submit_namelist"]

@@ -22,10 +22,10 @@ idle cheaply until their sub-batch drains.
 
 from __future__ import annotations
 
-import copy
 import hashlib
 import json
 import os
+import pickle
 import re
 import time
 from contextlib import nullcontext
@@ -131,10 +131,19 @@ class EnsembleSpec:
                    perturb_seed=int(e.perturb_seed), solver=solver)
 
     def member_params(self, k: int) -> Params:
-        """Member k's full Params (a deep copy with its sweeps applied)."""
+        """Member k's full Params (a private copy with its sweeps
+        applied).  The clone goes through a pickle round-trip with the
+        serialized base cached on first use — ~6x cheaper than
+        ``copy.deepcopy`` and paid once per member when expanding a
+        batch, so it dominates small-job engine construction.  Mutating
+        ``self.base`` after the first call is not supported."""
         if not 0 <= k < self.nmember:
             raise IndexError(k)
-        p = copy.deepcopy(self.base)
+        blob = self.__dict__.get("_base_blob")
+        if blob is None:
+            blob = pickle.dumps(self.base, pickle.HIGHEST_PROTOCOL)
+            self.__dict__["_base_blob"] = blob
+        p = pickle.loads(blob)
         for key, vals in self.sweeps.items():
             apply_override(p, key, vals[k])
         return p
@@ -196,6 +205,19 @@ def build_member(spec: EnsembleSpec, k: int, dtype=jnp.float64):
     doubles as the jit cache key (and the sub-batch group key)."""
     from ramses_tpu.grid import boundary as bmod
 
+    # no-sweep fast path: every member shares one (grid, ICs, params)
+    # template — cached on the spec — and differs only by the traced
+    # perturbation, so an N-member expansion builds the grid and runs
+    # condinit once instead of N times (this dominates small-job engine
+    # construction).  The shared ``p`` is the same object for every
+    # member; callers treat it as read-only.
+    tmpl = (spec.__dict__.get("_member_template")
+            if not spec.sweeps else None)
+    if tmpl is not None and spec.solver == "hydro":
+        grid, u0, tend, p = tmpl
+        u0k = _perturb(u0, spec, k)
+        return grid, (jnp.asarray(u0k, dtype),), tend, p
+
     p = spec.member_params(k)
     _check_uniform_only(p, spec.solver)
     tend = float(p.output.tout[-1] if p.output.tout else p.output.tend)
@@ -207,8 +229,11 @@ def build_member(spec: EnsembleSpec, k: int, dtype=jnp.float64):
         shape, dx = _uniform_shape(p, cubic=False)
         grid = UniformGrid(cfg=cfg, shape=shape, dx=dx,
                            bc=bmod.BoundarySpec.from_params(p))
-        u0 = _perturb(np.asarray(condinit(shape, dx, p, cfg)), spec, k)
-        return grid, (jnp.asarray(u0, dtype),), tend, p
+        u0 = np.asarray(condinit(shape, dx, p, cfg))
+        if not spec.sweeps:
+            spec.__dict__["_member_template"] = (grid, u0, tend, p)
+        u0k = _perturb(u0, spec, k)
+        return grid, (jnp.asarray(u0k, dtype),), tend, p
     if spec.solver == "mhd":
         from ramses_tpu.mhd.driver import mhd_condinit
         from ramses_tpu.mhd.core import MhdStatic
@@ -277,6 +302,9 @@ class SubBatch:
     t_host: np.ndarray               # [B] host mirror of t (refreshed
     #                                  by the per-dispatch fetch)
     quarantined: np.ndarray          # [B] host bool (evicted members)
+    replicas: int = 1                # packed-mode replica count (the
+    #                                  member axis shards over this
+    #                                  many devices; 1 = single-device)
 
     @property
     def size(self) -> int:
@@ -295,11 +323,22 @@ class EnsembleEngine:
     """
 
     def __init__(self, spec: EnsembleSpec, dtype=jnp.float64,
-                 telemetry=None):
+                 telemetry=None, plan=None):
+        from ramses_tpu.ensemble.meshplan import MeshPlan
         from ramses_tpu.telemetry import make_telemetry
         self.spec = spec
         self.params = spec.base
         self.dtype = dtype
+        #: two-level packing (ensemble/meshplan): how this job's
+        #: sub-batches land on the assigned devices
+        self.plan = plan if plan is not None else MeshPlan.single()
+        self._slab_mesh = None
+        # checkpoint dirty-tracking: save() skips the rewrite when no
+        # step has landed since the last snapshot (run_job's final save
+        # immediately after the last on_chunk beat is otherwise a full
+        # redundant checkpoint — measurable per-job cost for small jobs)
+        self._dirty = True
+        self._last_snap = ""
         tdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         by_key: Dict[Any, Dict[str, list]] = {}
         for k in range(spec.nmember):
@@ -347,6 +386,56 @@ class EnsembleEngine:
         from ramses_tpu.resilience.watchdog import Watchdog
         self._wd = Watchdog.from_params(spec.base, scope="ensemble",
                                         telemetry=self.telemetry)
+        if self.plan.mode == "slab":
+            from ramses_tpu.parallel import halo
+            if spec.solver != "hydro" or any(g.tables is not None
+                                             for g in self.groups):
+                raise NotImplementedError(
+                    "slab-mode ensembles: pure hydro without cooling "
+                    "only (parallel/halo pipeline scope)")
+            if self._bguard is not None:
+                raise NotImplementedError(
+                    "slab-mode ensembles do not support the batched "
+                    "step-guard (run_steps_halo has no summarize/"
+                    "dt_scale surface); disable &RESILIENCE_PARAMS "
+                    "step_guard or run packed/single")
+            self._slab_mesh = halo.make_halo_mesh(self.plan.devices())
+            for g in self.groups:
+                halo._check(g.grid, self._slab_mesh)
+        elif self.plan.mode == "packed":
+            for g in self.groups:
+                self._place_group(g)
+
+    def _place_group(self, g: SubBatch) -> None:
+        """Packed-mode placement: shard one sub-batch's member axis
+        over the replica mesh.  The replica count is the largest
+        divisor of the batch size within the assigned device count
+        (NamedSharding needs an even split — and an even split keeps
+        the per-device replica programs identical, which is what makes
+        packed execution bitwise-equal to single-device).  Called at
+        construction and again after a checkpoint load, so a
+        checkpoint written under any packing restores under any
+        other."""
+        if self.plan.mode != "packed":
+            return
+        from ramses_tpu.ensemble.meshplan import largest_divisor
+        from ramses_tpu.parallel.mesh import (replica_mesh,
+                                              replica_sharding)
+        devs = self.plan.devices()
+        cap = int(self.plan.max_replicas) or len(devs)
+        r = largest_divisor(g.size, min(cap, len(devs)))
+        g.replicas = r
+        if r <= 1:
+            return
+        mesh = replica_mesh(devs[:r])
+        g.state = tuple(
+            jax.device_put(c, replica_sharding(mesh, c.ndim))
+            for c in g.state)
+        g.t = jax.device_put(g.t, replica_sharding(mesh, 1))
+        if g.tables is not None:
+            g.tables = jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    x, replica_sharding(mesh, x.ndim)), g.tables)
 
     # ------------------------------------------------------------------
     # status surface (duck-typed like the solo sims, for the supervisor,
@@ -377,11 +466,19 @@ class EnsembleEngine:
         return len(self.quarantined)
 
     def run_info(self) -> Dict[str, Any]:
-        return {"driver": f"ensemble-{self.spec.solver}"
+        info = {"driver": f"ensemble-{self.spec.solver}"
                 if hasattr(self, "spec") else "ensemble",
                 "nmember": self.spec.nmember,
                 "ngroup": len(getattr(self, "groups", [])),
                 "sweeps": sorted(self.spec.sweeps)}
+        plan = getattr(self, "plan", None)
+        if plan is not None:
+            info["packing"] = plan.describe()
+            groups = getattr(self, "groups", None)
+            if groups:
+                info["packing"]["group_replicas"] = [
+                    int(g.replicas) for g in groups]
+        return info
 
     def _member_pos(self, k: int) -> Tuple[SubBatch, int]:
         for g in self.groups:
@@ -415,26 +512,38 @@ class EnsembleEngine:
 
     # ------------------------------------------------------------------
     def _dispatch(self, g: SubBatch, nsteps: int, eff_tend,
-                  dt_scale: float = 1.0, summarize: bool = False):
+                  dt_scale: float = 1.0, summarize: bool = False,
+                  fetch: bool = True):
         """One fused window for one sub-batch.
 
-        Returns ``(ndone[B], summ)`` with ``summ`` the per-member guard
-        summary ``[B, 3]`` (None unless ``summarize``).  Exactly ONE
-        host<->device fetch per call — ``jax.device_get`` on the
-        ``(ndone, t[, summary])`` tuple — so arming the batched guard
-        widens the existing fetch instead of adding one, and the
-        zero-overhead pin can count ``jax.device_get`` calls honestly.
-        ``g.t_host`` is refreshed from the same fetch."""
+        With ``fetch`` (the default) returns ``(ndone[B], summ)`` with
+        ``summ`` the per-member guard summary ``[B, 3]`` (None unless
+        ``summarize``).  Exactly ONE host<->device fetch per call —
+        ``jax.device_get`` on the ``(ndone, t[, summary])`` tuple — so
+        arming the batched guard widens the existing fetch instead of
+        adding one, and the zero-overhead pin can count
+        ``jax.device_get`` calls honestly.  ``g.t_host`` is refreshed
+        from the same fetch.
+
+        With ``fetch=False`` the window is dispatched asynchronously
+        and the un-fetched device refs ``(ndone, t[, summary])`` are
+        returned instead: the chunk driver stacks every group's refs
+        into a SINGLE ``jax.device_get`` (one host round-trip per
+        chunk regardless of group count) and folds each tuple back via
+        :meth:`_apply_fetch`."""
         tdt = g.t.dtype
         tend = jnp.asarray(eff_tend, tdt)
-        summ = None
-        if self.spec.solver == "hydro" and g.tables is not None:
+        summ_ref = None
+        if self._slab_mesh is not None:
+            t, ndone = self._dispatch_slab(g, nsteps, eff_tend)
+        elif self.spec.solver == "hydro" and g.tables is not None:
             from ramses_tpu.grid.uniform import run_steps_cool_batch
             out = run_steps_cool_batch(
                 g.grid, g.state[0], g.t, tend, nsteps, g.tables,
                 g.cspec, dt_scale=dt_scale, summarize=summarize)
             u, t, ndone = out[:3]
             g.state = (u,)
+            summ_ref = out[-1] if summarize else None
         elif self.spec.solver == "hydro":
             from ramses_tpu.grid.uniform import run_steps_batch
             out = run_steps_batch(
@@ -442,6 +551,7 @@ class EnsembleEngine:
                 dt_scale=dt_scale, summarize=summarize)
             u, t, ndone = out[:3]
             g.state = (u,)
+            summ_ref = out[-1] if summarize else None
         elif self.spec.solver == "mhd":
             from ramses_tpu.mhd.uniform import run_steps_batch
             out = run_steps_batch(
@@ -449,6 +559,7 @@ class EnsembleEngine:
                 dt_scale=dt_scale, summarize=summarize)
             u, bf, t, ndone = out[:4]
             g.state = (u, bf)
+            summ_ref = out[-1] if summarize else None
         else:
             from ramses_tpu.rhd.uniform import run_steps_batch
             out = run_steps_batch(
@@ -456,74 +567,151 @@ class EnsembleEngine:
                 dt_scale=dt_scale, summarize=summarize)
             u, t, ndone = out[:3]
             g.state = (u,)
+            summ_ref = out[-1] if summarize else None
         g.t = t
-        if summarize:
-            ndone_h, t_h, summ = jax.device_get((ndone, t, out[-1]))
-            summ = np.asarray(summ, np.float64)
-        else:
-            ndone_h, t_h = jax.device_get((ndone, t))
-        g.t_host = np.asarray(t_h, np.float64)
-        return np.asarray(ndone_h, np.int64), summ
+        refs = ((ndone, t) if summ_ref is None
+                else (ndone, t, summ_ref))
+        if not fetch:
+            return refs
+        return self._apply_fetch(g, jax.device_get(refs))
+
+    @staticmethod
+    def _apply_fetch(g: SubBatch, vals):
+        """Fold one fetched ``(ndone, t[, summary])`` tuple back into
+        the group's host mirrors; returns ``(ndone[B], summ)``."""
+        g.t_host = np.asarray(vals[1], np.float64)
+        summ = (np.asarray(vals[2], np.float64) if len(vals) > 2
+                else None)
+        return np.asarray(vals[0], np.int64), summ
+
+    def _dispatch_slab(self, g: SubBatch, nsteps: int, eff_tend):
+        """Slab-mode window: stream each active member through the
+        explicit slab pipeline (:func:`ramses_tpu.parallel.halo.
+        run_steps_halo`) on the full assigned mesh, one member at a
+        time.  Per-member arrays, mesh and window sizes are identical
+        to a standalone sharded run — the bitwise parity pin.  Members
+        whose effective tend cannot advance them (done, frozen at the
+        step budget, quarantined) are skipped with state untouched
+        rather than burning a mesh-wide no-op window."""
+        from ramses_tpu.parallel.halo import run_steps_halo
+        eff = np.asarray(eff_tend, np.float64)
+        us, ts, nds = [], [], []
+        for i in range(g.size):
+            if eff[i] <= g.t_host[i]:
+                us.append(g.state[0][i])
+                ts.append(g.t[i])
+                nds.append(jnp.zeros((), jnp.int32))
+                continue
+            u, t, nd = run_steps_halo(g.grid, self._slab_mesh,
+                                      g.state[0][i], g.t[i],
+                                      float(eff[i]), nsteps)
+            us.append(u)
+            ts.append(t)
+            nds.append(nd)
+        g.state = (jnp.stack(us),)
+        return jnp.stack(ts), jnp.stack(nds)
+
+    def begin_chunk(self, chunk: Optional[int] = None,
+                    nstepmax: Optional[int] = None) -> Dict[str, Any]:
+        """Dispatch one fused window for every unfinished sub-batch
+        WITHOUT blocking on the host fetch; returns the chunk context
+        for :meth:`finish_chunk`.
+
+        The begin/finish split exists for the gang driver
+        (``ensemble/service.run_gang``): every co-scheduled job's
+        windows are dispatched back-to-back — all submeshes compute
+        concurrently — before any host thread blocks on results."""
+        chunk = int(chunk or self.params.ensemble.chunk_steps or 16)
+        nmax = int(nstepmax if nstepmax is not None
+                   else self.params.run.nstepmax)
+        guard = self._bguard
+        if self._fault is not None:
+            # top of chunk: the previous chunk's on_chunk beat has
+            # already checkpointed, so a sigterm@K resume restarts
+            # at nstep >= K and strict arming prevents a re-fire
+            self._fault.maybe_signal(self.nstep)
+        t0 = time.perf_counter()
+        pending: List[Tuple[SubBatch, np.ndarray, Any, Any]] = []
+        for g in self.groups:
+            done = self._group_done(g, nmax)
+            if done.all():
+                continue
+            # members at tend idle via the in-scan mask; members at
+            # the step budget (or quarantined) are frozen by
+            # clamping their effective tend below their current t
+            rem = nmax - int(g.nstep[~done].max()) if (~done).any() \
+                else 0
+            n = max(1, min(chunk, rem))
+            if self._fault is not None:
+                n = self._fault.clamp_window_batch(
+                    n, self.nstep,
+                    lambda j, _g=g: int(_g.nstep[_g.members.index(j)])
+                    if j in _g.members else self.nstep)
+            eff_tend = np.where((g.nstep >= nmax) | g.quarantined,
+                                -1.0, g.tend)
+            # the guard's retained pre-window state: plain refs
+            # (run_steps_batch does not donate its inputs)
+            prev = ((g.state, g.t, g.nstep.copy(),
+                     g.t_host.copy()) if guard is not None else None)
+            if self._fault is not None:
+                self._fault.maybe_nan_batch(g)
+            with (self._wd.guard("step") if self._wd is not None
+                    else nullcontext()):
+                if self._fault is not None:
+                    self._fault.maybe_hang_batch(g, self.nstep)
+                refs = self._dispatch(g, n, eff_tend,
+                                      summarize=guard is not None,
+                                      fetch=False)
+            pending.append((g, done, prev, refs))
+        return {"pending": pending, "t0": t0}
+
+    def finish_chunk(self, ctx: Dict[str, Any]) -> int:
+        """Fetch and fold back one chunk's results.
+
+        A SINGLE stacked ``jax.device_get`` over every pending group's
+        ``(ndone, t[, summary])`` refs — one host round-trip per chunk
+        regardless of group count (pinned by the zero-overhead
+        device_get counter tests) — then guard screening/recovery and
+        step accounting per group.  Returns the steps advanced."""
+        guard = self._bguard
+        stepped = 0
+        pending = ctx["pending"]
+        fetched = []
+        if pending:
+            with (self._wd.guard("step") if self._wd is not None
+                    else nullcontext()):
+                fetched = jax.device_get([p[3] for p in pending])
+        for (g, done, prev, _refs), vals in zip(pending, fetched):
+            ndone, summ = self._apply_fetch(g, vals)
+            if self._wd is not None:
+                self._wd.note(nstep=self.nstep, t=self.t)
+            if guard is not None:
+                bad = guard.screen(g.t_host, summ, active=~done)
+                if bad.any():
+                    ndone = self._recover(g, bad, prev, ndone)
+                    self._dirty = True
+            g.nstep = g.nstep + ndone
+            stepped += int(ndone.sum())
+            self.cell_updates += int(ndone.sum()) * g.grid.ncell
+        if stepped > 0 or self._fault is not None:
+            self._dirty = True
+        self.wall_s += time.perf_counter() - ctx["t0"]
+        return stepped
 
     def run(self, chunk: Optional[int] = None,
             nstepmax: Optional[int] = None, verbose: bool = False,
             on_chunk: Optional[Callable[["EnsembleEngine"], None]] = None):
         """Drive every sub-batch until all members complete.
 
-        One host round-trip per group per chunk (the ``ndone`` fetch);
-        ``on_chunk`` (service heartbeats) runs after each sweep over
-        the groups."""
+        ONE stacked host round-trip per chunk (``finish_chunk``),
+        however many sub-batch groups the sweep split into;
+        ``on_chunk`` (service heartbeats) runs after each chunk."""
         chunk = int(chunk or self.params.ensemble.chunk_steps or 16)
         nmax = int(nstepmax if nstepmax is not None
                    else self.params.run.nstepmax)
-        guard = self._bguard
         while not self.run_complete():
-            if self._fault is not None:
-                # top of loop: the previous sweep's on_chunk beat has
-                # already checkpointed, so a sigterm@K resume restarts
-                # at nstep >= K and strict arming prevents a re-fire
-                self._fault.maybe_signal(self.nstep)
-            t0 = time.perf_counter()
-            stepped = 0
-            for g in self.groups:
-                done = self._group_done(g, nmax)
-                if done.all():
-                    continue
-                # members at tend idle via the in-scan mask; members at
-                # the step budget (or quarantined) are frozen by
-                # clamping their effective tend below their current t
-                rem = nmax - int(g.nstep[~done].max()) if (~done).any() \
-                    else 0
-                n = max(1, min(chunk, rem))
-                if self._fault is not None:
-                    n = self._fault.clamp_window_batch(
-                        n, self.nstep,
-                        lambda j, _g=g: int(_g.nstep[_g.members.index(j)])
-                        if j in _g.members else self.nstep)
-                eff_tend = np.where((g.nstep >= nmax) | g.quarantined,
-                                    -1.0, g.tend)
-                # the guard's retained pre-window state: plain refs
-                # (run_steps_batch does not donate its inputs)
-                prev = ((g.state, g.t, g.nstep.copy(),
-                         g.t_host.copy()) if guard is not None else None)
-                if self._fault is not None:
-                    self._fault.maybe_nan_batch(g)
-                with (self._wd.guard("step") if self._wd is not None
-                        else nullcontext()):
-                    if self._fault is not None:
-                        self._fault.maybe_hang_batch(g, self.nstep)
-                    ndone, summ = self._dispatch(
-                        g, n, eff_tend, summarize=guard is not None)
-                if self._wd is not None:
-                    self._wd.note(nstep=self.nstep, t=self.t)
-                if guard is not None:
-                    bad = guard.screen(g.t_host, summ, active=~done)
-                    if bad.any():
-                        ndone = self._recover(g, bad, prev, ndone)
-                g.nstep = g.nstep + ndone
-                stepped += int(ndone.sum())
-                self.cell_updates += int(ndone.sum()) * g.grid.ncell
-            self.wall_s += time.perf_counter() - t0
+            ctx = self.begin_chunk(chunk, nmax)
+            stepped = self.finish_chunk(ctx)
             self.telemetry.record_event(
                 "ensemble_chunk", nmember=self.nmember,
                 ngroup=len(self.groups), steps=stepped,
@@ -689,6 +877,13 @@ class EnsembleEngine:
     # ensemble job resumes exactly like a solo run
     def save(self, base_dir: str, iout: Optional[int] = None) -> str:
         from ramses_tpu.resilience.checkpoint import finalize_checkpoint
+        if (iout is None and not self._dirty and self._last_snap
+                and os.path.dirname(self._last_snap)
+                == os.path.abspath(base_dir)
+                and os.path.isdir(self._last_snap)):
+            # nothing stepped since the last snapshot: the checkpoint
+            # on disk is bit-identical to what a rewrite would produce
+            return self._last_snap
         self._iout = int(iout if iout is not None else self._iout + 1)
         final = os.path.join(base_dir, f"output_{self._iout:05d}")
         stage = final + ".tmp"
@@ -707,6 +902,12 @@ class EnsembleEngine:
                        "solver": self.spec.solver,
                        "groups": [g.members for g in self.groups],
                        "quarantined": census,
+                       # informational: the packing the checkpoint was
+                       # written under.  State arrays are saved
+                       # host-global, so restore is elastic across
+                       # packings — from_checkpoint re-places under
+                       # whatever plan the restoring worker passes.
+                       "packing": self.plan.describe(),
                        "iout": self._iout}, f, indent=1)
         meta = {"kind": "ensemble", "iout": self._iout,
                 "nstep": self.nstep, "t": self.t,
@@ -716,18 +917,25 @@ class EnsembleEngine:
             # durable record (read_quarantine_census) of which members
             # were evicted, with reason/nstep/t
             meta["quarantined"] = census
-        return finalize_checkpoint(stage, final, meta)
+        snap = finalize_checkpoint(stage, final, meta)
+        self._dirty = False
+        self._last_snap = os.path.abspath(snap)
+        return snap
 
     @classmethod
     def from_checkpoint(cls, spec: EnsembleSpec, outdir: str,
-                        dtype=jnp.float64, telemetry=None
+                        dtype=jnp.float64, telemetry=None, plan=None
                         ) -> "EnsembleEngine":
         """Rebuild from an ensemble checkpoint dir (manifest-validated
         by the caller/supervisor); the spec must expand to the same
-        members the checkpoint was written from."""
+        members the checkpoint was written from.  ``plan`` names the
+        packing for the *restored* run — it need not match the one the
+        checkpoint was written under (cross-packing restore: the state
+        arrays are host-global, and the loaded groups are simply
+        re-placed under the new plan)."""
         with open(os.path.join(outdir, "ensemble.json")) as f:
             meta = json.load(f)
-        eng = cls(spec, dtype=dtype, telemetry=telemetry)
+        eng = cls(spec, dtype=dtype, telemetry=telemetry, plan=plan)
         if meta["fingerprint"] != spec.fingerprint():
             raise ValueError(
                 f"checkpoint {outdir} was written by a different "
@@ -746,10 +954,16 @@ class EnsembleEngine:
             g.t = jnp.asarray(data[f"g{gi}_t"], g.t.dtype)
             g.t_host = np.asarray(data[f"g{gi}_t"], np.float64)
             g.nstep = np.asarray(data[f"g{gi}_nstep"], np.int64)
+            # re-place the loaded arrays under THIS engine's plan (the
+            # checkpoint's own packing is irrelevant — elastic restore)
+            eng._place_group(g)
         eng.quarantined = {int(k): dict(v) for k, v in
                            (meta.get("quarantined") or {}).items()}
         for k in eng.quarantined:
             g, i = eng._member_pos(k)
             g.quarantined[i] = True
         eng._iout = int(meta.get("iout", 0))
+        # the restored-from snapshot is current until a step lands
+        eng._dirty = False
+        eng._last_snap = os.path.abspath(outdir)
         return eng

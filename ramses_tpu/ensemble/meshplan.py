@@ -1,0 +1,216 @@
+"""Two-level parallelism: map a job onto the local device mesh.
+
+A worker's mesh can be filled two ways (ROADMAP item 3(b)), and the
+:class:`MeshPlan` is the explicit record of which one a job got:
+
+* **packed** — a *small* job (per-member cell count within
+  ``&ENSEMBLE_PARAMS pack_cell_budget``) shards the leading member axis
+  of each vmapped sub-batch over a replica mesh axis
+  (:func:`ramses_tpu.parallel.mesh.replica_mesh`).  Members are data-
+  parallel — no cross-member collectives exist in the batched step
+  chain — so GSPMD partitions the one compiled program into B/R-member
+  per-device replicas with zero communication, and the per-member
+  ``t < tend`` in-scan mask becomes per-replica completion masking for
+  free.
+* **slab** — a *mesh-wide* job (per-member cells above the budget)
+  streams members one at a time through the explicit slab pipeline on
+  the full assigned mesh (:func:`ramses_tpu.parallel.halo.
+  run_steps_halo` — 1-D leading-axis decomposition, ring halo
+  exchange, ``lax.pmin`` CFL).
+
+``plan_for`` chooses between them from the namelist alone;
+``stamp_cost`` is the submit-time cost model the queue scheduler
+bin-packs on — the job-level analogue of the per-oct cost model in
+:mod:`ramses_tpu.parallel.balance` (cost = members x cells x steps,
+arXiv:2412.15518's work-placement currency).
+
+Plans are JSON-serializable (devices are recorded as indices into
+``jax.devices()``) so a checkpoint can record the packing it was
+written under while restoring under any other — the state arrays are
+saved host-global, which makes every ensemble checkpoint elastic
+across packings by construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ramses_tpu.config import Params, params_from_string
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """How one job lands on the local mesh.
+
+    ``mode``: ``"single"`` (one device, the pre-composition behavior),
+    ``"packed"`` (member vmap sharded over per-device replicas) or
+    ``"slab"`` (members stream over the full-mesh slab pipeline).
+    ``device_ids`` index into ``jax.devices()``; empty means device 0.
+    """
+    mode: str = "single"
+    device_ids: Tuple[int, ...] = ()
+    # packed: cap on replicas (0 = len(device_ids)); the engine picks
+    # the largest divisor of each sub-batch size within the cap so the
+    # member axis shards evenly
+    max_replicas: int = 0
+
+    def __post_init__(self):
+        if self.mode not in ("single", "packed", "slab"):
+            raise ValueError(f"unknown MeshPlan mode {self.mode!r}")
+
+    @property
+    def n_devices(self) -> int:
+        return max(1, len(self.device_ids))
+
+    def devices(self) -> list:
+        """Resolve the device ids against the live backend."""
+        import jax
+        devs = jax.devices()
+        if not self.device_ids:
+            return [devs[0]]
+        return [devs[i] for i in self.device_ids]
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-ready summary for telemetry / checkpoint manifests."""
+        return {"mode": self.mode, "devices": self.n_devices,
+                "device_ids": list(self.device_ids),
+                "max_replicas": int(self.max_replicas)}
+
+    @classmethod
+    def single(cls) -> "MeshPlan":
+        return cls()
+
+    @classmethod
+    def packed(cls, device_ids: Sequence[int],
+               max_replicas: int = 0) -> "MeshPlan":
+        return cls(mode="packed", device_ids=tuple(device_ids),
+                   max_replicas=int(max_replicas))
+
+    @classmethod
+    def slab(cls, device_ids: Sequence[int]) -> "MeshPlan":
+        return cls(mode="slab", device_ids=tuple(device_ids))
+
+
+def member_cells(params: Params) -> int:
+    """Estimated per-member cell count: the uniform base grid, times a
+    worst-case refinement factor for AMR namelists (every level fully
+    refined — an upper bound, which is the right direction for a
+    budget check)."""
+    a = params.amr
+    n = 2 ** a.levelmin
+    base = [a.nx, a.ny, a.nz][:params.ndim]
+    cells = 1
+    for b in base:
+        cells *= b * n
+    depth = max(0, int(a.levelmax) - int(a.levelmin))
+    return cells * (2 ** (params.ndim * depth))
+
+
+def slab_eligible(params: Params, n_devices: int,
+                  solver: str = "") -> bool:
+    """Can this namelist's members run on the explicit uniform slab
+    pipeline over ``n_devices``?  Mirrors ``parallel/halo._check``:
+    hydro without cooling, fully periodic, leading axis divisible into
+    shards at least one stencil halo thick — plus the ensemble
+    engine's own uniform-only scope."""
+    from ramses_tpu.ensemble.batch import solver_from_params
+    from ramses_tpu.grid import boundary as bmod
+    from ramses_tpu.hydro import muscl
+
+    if n_devices <= 1:
+        return False
+    solver = solver or solver_from_params(params)
+    if solver != "hydro" or params.cooling.cooling:
+        return False
+    a = params.amr
+    if a.levelmax > a.levelmin:
+        return False
+    r = params.run
+    if r.poisson or r.pic or r.cosmo or r.rt or r.patch:
+        return False
+    spec_bc = bmod.BoundarySpec.from_params(params)
+    if any(f[0].kind != 0 or f[1].kind != 0 for f in spec_bc.faces):
+        return False
+    nx = a.nx * 2 ** a.levelmin
+    return nx % n_devices == 0 and nx // n_devices >= muscl.NGHOST
+
+
+def plan_for(params: Params, nmember: int,
+             device_ids: Optional[Sequence[int]] = None,
+             n_devices: Optional[int] = None,
+             solver: str = "") -> MeshPlan:
+    """Choose the packing for a job on an assigned device set.
+
+    ``device_ids`` (or just ``n_devices`` for the local mesh prefix)
+    names the submesh the scheduler granted.  Small jobs pack; a job
+    over the cell budget goes mesh-wide on the slab pipeline when
+    eligible, and falls back to a single device otherwise (the
+    pre-composition behavior — correct, just not sharded)."""
+    if device_ids is None:
+        if n_devices is None:
+            import jax
+            n_devices = len(jax.devices())
+        device_ids = tuple(range(n_devices))
+    device_ids = tuple(device_ids)
+    if len(device_ids) <= 1:
+        return MeshPlan.single()
+    e = params.ensemble
+    budget = int(e.pack_cell_budget)
+    if budget > 0 and member_cells(params) > budget:
+        if slab_eligible(params, len(device_ids), solver=solver):
+            return MeshPlan.slab(device_ids)
+        return MeshPlan.single()
+    return MeshPlan.packed(device_ids,
+                           max_replicas=int(e.pack_max_replicas))
+
+
+def largest_divisor(b: int, cap: int) -> int:
+    """Largest divisor of ``b`` that is <= ``cap`` — the replica count
+    a B-member sub-batch shards evenly over."""
+    cap = max(1, min(int(cap), int(b)))
+    for r in range(cap, 0, -1):
+        if b % r == 0:
+            return r
+    return 1
+
+
+# ---------------------------------------------------------------------
+# submit-time cost stamp (queue scheduling currency)
+# ---------------------------------------------------------------------
+#: cap on the steps term so an unbounded nstepmax (the 1e6 default)
+#: still yields finite, comparable costs
+_STEP_CAP = 10 ** 6
+
+
+def stamp_cost(namelist: str, ndim: int = 3,
+               sweeps: Optional[Dict[str, List[Any]]] = None,
+               solver: str = "", kind: str = "run"
+               ) -> Optional[Dict[str, Any]]:
+    """Estimate ``(members x cells x steps)`` plus shard clamps for a
+    job record at submit time.  Returns None when the namelist does
+    not parse into a costable config — the scheduler treats an
+    unstamped record as a small FIFO job, so stamping is strictly
+    best-effort."""
+    try:
+        params = params_from_string(namelist, ndim=ndim)
+        e = params.ensemble
+        nm = int(e.nmember) or \
+            (max(len(v) for v in sweeps.values()) if sweeps else 1)
+        cells = member_cells(params)
+        steps = min(max(1, int(params.run.nstepmax)), _STEP_CAP)
+        exclusive = bool(int(e.pack_cell_budget) > 0
+                         and cells > int(e.pack_cell_budget)
+                         and kind == "run")
+        max_shards = int(e.max_shards)
+        if not max_shards and params.amr.levelmax > params.amr.levelmin:
+            from ramses_tpu.parallel.dense_slab import max_slab_devices
+            max_shards = max_slab_devices(int(params.amr.levelmax),
+                                          params.ndim)
+        return {"members": nm, "cells": int(cells),
+                "steps": int(steps),
+                "cost": int(nm) * int(cells) * int(steps),
+                "min_shards": int(e.min_shards),
+                "max_shards": max_shards, "exclusive": exclusive}
+    except Exception:
+        return None

@@ -21,7 +21,7 @@ import json
 import os
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 STATES = ("queued", "running", "done", "failed")
 
@@ -83,12 +83,25 @@ def submit(queue_dir: str, namelist: str,
     path = os.path.join(queue_dir, "queued", job_id + ".json")
     if os.path.exists(path):
         raise FileExistsError(f"job id '{job_id}' already queued")
-    _write_record(path, {
+    record = {
         "id": job_id, "kind": kind, "namelist": namelist,
         "sweeps": dict(sweeps or {}), "solver": solver,
         "ndim": int(ndim), "dtype": dtype,
         "submitted_unix": time.time(), "attempts": 0,
-        "meta": dict(meta or {})})
+        "meta": dict(meta or {})}
+    # submit-time cost stamp (members x cells x steps + shard clamps):
+    # the currency plan_gang bin-packs on.  Strictly best-effort — an
+    # unparseable namelist submits unstamped and schedules as a small
+    # FIFO job (the failure then surfaces on the worker, with a log).
+    try:
+        from ramses_tpu.ensemble.meshplan import stamp_cost
+        cost = stamp_cost(namelist, ndim=int(ndim), sweeps=sweeps,
+                          solver=solver, kind=kind)
+        if cost is not None:
+            record["cost"] = cost
+    except Exception:
+        pass
+    _write_record(path, record)
     return job_id
 
 
@@ -99,18 +112,24 @@ def job_kind(record: Dict[str, Any]) -> str:
 
 
 def claim(queue_dir: str, worker: str = "",
-          ) -> Optional[Job]:
+          job_id: str = "") -> Optional[Job]:
     """Atomically claim the oldest queued job (rename into
     ``running/``), bump its attempt count and stamp the claim time.
     Returns None when the queue is empty; racing workers each get a
-    distinct job or None."""
+    distinct job or None.  ``job_id`` claims that specific job instead
+    of the FIFO head — the gang scheduler plans from a
+    :func:`peek_queued` snapshot and then claims each planned job by
+    id, dropping any it loses to a racing worker."""
     dirs = _dirs(queue_dir)
     worker = worker or f"{os.uname().nodename}:{os.getpid()}"
-    try:
-        names = sorted(n for n in os.listdir(dirs["queued"])
-                       if n.endswith(".json"))
-    except FileNotFoundError:
-        return None
+    if job_id:
+        names = [job_id + ".json"]
+    else:
+        try:
+            names = sorted(n for n in os.listdir(dirs["queued"])
+                           if n.endswith(".json"))
+        except FileNotFoundError:
+            return None
     for name in names:
         src = os.path.join(dirs["queued"], name)
         dst = os.path.join(dirs["running"], name)
@@ -126,6 +145,118 @@ def claim(queue_dir: str, worker: str = "",
         _write_record(dst, record)
         return Job(id=record["id"], path=dst, record=record)
     return None
+
+
+def peek_queued(queue_dir: str) -> List[Dict[str, Any]]:
+    """Snapshot the queued records in FIFO (file-name = submit) order
+    without claiming anything — the gang scheduler's planning input.
+    Records that vanish or fail to parse mid-listing are skipped (a
+    racing worker claimed them, or a submit is mid-flight)."""
+    dirs = _dirs(queue_dir)
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(n for n in os.listdir(dirs["queued"])
+                       if n.endswith(".json"))
+    except FileNotFoundError:
+        return out
+    for name in names:
+        try:
+            with open(os.path.join(dirs["queued"], name)) as f:
+                out.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def _is_exclusive(record: Dict[str, Any]) -> bool:
+    """Mesh-wide jobs drain the gang and run alone: a cost stamp with
+    ``exclusive`` (per-member cells above the pack budget), or a
+    non-``run`` kind (calibrate drives its own optimizer loop and
+    shares no chunk cadence to gang on)."""
+    cost = record.get("cost") or {}
+    return bool(cost.get("exclusive")) or job_kind(record) != "run"
+
+
+def plan_gang(records: List[Dict[str, Any]], ndev: int,
+              order: str = "cost", now: Optional[float] = None,
+              starve_s: float = 600.0
+              ) -> List[Tuple[Dict[str, Any], int]]:
+    """Pure gang-scheduling decision: which queued jobs to claim next
+    and how many devices each gets.  No filesystem, no jax — the unit-
+    testable core of the cost-aware serve loop.
+
+    ``records`` is a FIFO-ordered :func:`peek_queued` snapshot;
+    ``ndev`` the local device count.  Returns ``[(record, nshard),
+    ...]`` whose nshards sum to at most ``ndev``.
+
+    ``order="cost"`` (the default claim order):
+
+    * an *exclusive* job (cost stamp says mesh-wide, or a calibrate)
+      that has waited longer than ``starve_s`` preempts everything —
+      the starvation bound: bin-packed small jobs can only overtake a
+      big job for so long;
+    * otherwise small jobs are greedily bin-packed cost-ascending
+      (cheapest first — they drain soonest, keeping queue latency
+      low), each granted its ``min_shards`` first and leftover devices
+      spread round-robin up to ``min(max_shards, members)``;
+    * with no packable small jobs, the oldest exclusive job takes the
+      whole mesh.
+
+    ``order="fifo"`` is the fallback knob: strictly the head job, all
+    devices — the pre-scheduler behavior."""
+    if not records:
+        return []
+    ndev = max(1, int(ndev))
+    if order == "fifo":
+        return [(records[0], ndev)]
+    if order != "cost":
+        raise ValueError(f"unknown claim order {order!r}")
+    now = time.time() if now is None else float(now)
+    exclusive = [r for r in records if _is_exclusive(r)]
+    small = [r for r in records if not _is_exclusive(r)]
+    starving = [r for r in exclusive
+                if now - float(r.get("submitted_unix", now))
+                >= float(starve_s)]
+    if starving:
+        return [(starving[0], ndev)]
+    if not small:
+        return [(exclusive[0], ndev)] if exclusive else []
+    small = sorted(small, key=lambda r: int(
+        (r.get("cost") or {}).get("cost") or 0))
+    gang: List[List[Any]] = []
+    avail = ndev
+
+    def _clamps(rec):
+        c = rec.get("cost") or {}
+        lo = max(1, int(c.get("min_shards") or 1))
+        hi = int(c.get("max_shards") or 0) or ndev
+        # packed replicas cannot exceed the member count — extra
+        # devices would idle, so leave them for the next job
+        hi = min(hi, max(1, int(c.get("members") or 1)))
+        return lo, max(lo, hi)
+
+    for rec in small:
+        lo, _hi = _clamps(rec)
+        if lo > avail:
+            continue                   # next gang, once devices free
+        gang.append([rec, lo])
+        avail -= lo
+        if avail <= 0:
+            break
+    if not gang:
+        return [(exclusive[0], ndev)] if exclusive else []
+    grew = True
+    while avail > 0 and grew:
+        grew = False
+        for entry in gang:
+            if avail <= 0:
+                break
+            _lo, hi = _clamps(entry[0])
+            if entry[1] < hi:
+                entry[1] += 1
+                avail -= 1
+                grew = True
+    return [(rec, int(n)) for rec, n in gang]
 
 
 def heartbeat(job: Job) -> None:

@@ -1,14 +1,25 @@
 """Run service: drain the job queue under supervised execution.
 
-``serve(queue_dir)`` is the worker loop: reclaim stale records, claim a
-job, run it through the batched :class:`~ramses_tpu.ensemble.batch.
-EnsembleEngine` under ``resilience/supervisor.supervise`` (auto-resume
-from the newest manifest-valid ensemble checkpoint in the job's results
-dir), and publish telemetry JSONL + checkpoints as the result artifact.
-A single-member job is just an ensemble of one — every job gets the
-same artifact shape.  The engine covers the uniform fused step chains
-(hydro incl. cooling, MHD, RHD); AMR/gravity namelists must run solo
-via ``python -m ramses_tpu run.nml``.
+``serve(queue_dir)`` is the worker loop: reclaim stale records, plan
+which queued jobs to claim next (cost-aware gang scheduling by
+default — :func:`ramses_tpu.ensemble.queue.plan_gang` — with blind
+FIFO as the fallback knob), and run them through the batched
+:class:`~ramses_tpu.ensemble.batch.EnsembleEngine`.
+
+A gang of small jobs is bin-packed onto disjoint submesh slices of the
+local device mesh (each job's :class:`~ramses_tpu.ensemble.meshplan.
+MeshPlan` shards its member axis over its slice) and driven
+concurrently by the interleaved chunk loop in :func:`run_gang` —
+every job's fused windows are dispatched before any host thread blocks
+on results, so all submeshes compute at once.  A mesh-wide job (or a
+calibrate) drains the gang and runs alone through the fully
+supervised :func:`run_job` path (auto-resume from the newest
+manifest-valid checkpoint, hang kill-and-requeue).
+
+Every job defaults its persistent compile cache to the queue's shared
+``<queue_dir>/compile_cache`` dir (``&ENSEMBLE_PARAMS
+shared_compile_cache``), so fleet workers warm-start each other: the
+second worker to claim a known config compiles nothing.
 """
 
 from __future__ import annotations
@@ -17,21 +28,30 @@ import json
 import os
 import time
 import traceback
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ramses_tpu.ensemble import queue as jq
 from ramses_tpu.resilience.watchdog import HangDetected
 
+#: jax.config keys the serve loop snapshots on entry and restores on
+#: exit: defaulting the shared compile cache must not leak persistent-
+#: cache config into whatever the process does after serving
+_JAX_CACHE_KEYS = ("jax_compilation_cache_dir",
+                   "jax_persistent_cache_min_compile_time_secs",
+                   "jax_persistent_cache_min_entry_size_bytes",
+                   "jax_persistent_cache_enable_xla_caches")
 
-def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
-            verbose: bool = False, log=print) -> Dict[str, Any]:
-    """Execute one claimed job; returns the result dict recorded on
-    ``done``.  Raises on failure (caller moves the record)."""
+
+def _job_setup(queue_dir: str, job: "jq.Job", log=print):
+    """Shared per-job setup for both the supervised solo path and the
+    gang driver: materialize the namelist, default the shared compile
+    cache, arm auto-resume, scrub rotten checkpoints.  Returns
+    ``(params, rdir, dtype)``."""
     import jax.numpy as jnp
 
     from ramses_tpu.config import params_from_string
-    from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
-    from ramses_tpu.resilience import supervisor as rsup
+    from ramses_tpu.platform import setup_compile_cache
+    from ramses_tpu.resilience import scrub_checkpoints
 
     rec = job.record
     rdir = jq.results_dir(queue_dir, job.id)
@@ -43,23 +63,92 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
                                 ndim=int(rec.get("ndim", 3)))
     # persistent compile cache before the first trace: a fleet worker
     # re-claiming a known namelist cold-starts in O(load), not
-    # O(compile) (&RUN_PARAMS compile_cache_dir / RAMSES_COMPILE_CACHE)
-    from ramses_tpu.platform import setup_compile_cache
+    # O(compile).  Default: the queue's shared dir, so workers warm-
+    # start EACH OTHER; an explicit &RUN_PARAMS compile_cache_dir or
+    # RAMSES_COMPILE_CACHE env still wins, and
+    # &ENSEMBLE_PARAMS shared_compile_cache=.false. opts out.
+    if (not (params.run.compile_cache_dir or "").strip()
+            and not os.environ.get("RAMSES_COMPILE_CACHE", "").strip()
+            and params.ensemble.shared_compile_cache):
+        params.run.compile_cache_dir = os.path.join(queue_dir,
+                                                    "compile_cache")
     setup_compile_cache(params)
     params.output.output_dir = rdir
     if not params.output.telemetry:
         params.output.telemetry = os.path.join(rdir, "telemetry.jsonl")
     # a re-claimed job (stale worker) must continue from the dead
-    # worker's last checkpoint, so supervise() attempt 1 resolves the
+    # worker's last checkpoint, so the restart resolution picks the
     # newest manifest-valid dir instead of starting fresh
     params.run.auto_resume = True
     # checkpoints can rot between beats (torn shard, truncated file on
     # a dying node): quarantine them NOW so the auto-resume scan below
     # never loops over a dir that validates at scan time but fails at
     # restore time
-    from ramses_tpu.resilience import scrub_checkpoints
     scrub_checkpoints(rdir, log=log)
     dtype = getattr(jnp, rec.get("dtype") or "float32")
+    return params, rdir, dtype
+
+
+def _job_result(eng, rdir: str, params, rec: Dict[str, Any],
+                snap: str, cache0: Dict[str, int],
+                log=print) -> Dict[str, Any]:
+    """The result dict recorded on ``done`` — shared by the solo and
+    gang paths.  ``cache0`` is the ``compile_cache_stats()`` snapshot
+    taken before the job started; the recorded hit/miss counts are the
+    deltas this job (or its gang) produced."""
+    from ramses_tpu.platform import compile_cache_stats
+
+    stats = compile_cache_stats()
+    result = {"results_dir": rdir, "snapshot": snap,
+              "telemetry": params.output.telemetry,
+              "nmember": eng.nmember, "ngroup": len(eng.groups),
+              "t_min": eng.t, "nstep_max": eng.nstep,
+              "cell_updates": eng.cell_updates,
+              "compile_cache_hits":
+                  int(stats["hits"]) - int(cache0.get("hits", 0)),
+              "compile_cache_misses":
+                  int(stats["misses"]) - int(cache0.get("misses", 0)),
+              "packing": eng.run_info().get("packing")}
+    sub = float(rec.get("submitted_unix") or 0.0)
+    claimed = float(rec.get("claimed_unix") or 0.0)
+    if sub and claimed:
+        result["queue_wait_s"] = round(max(0.0, claimed - sub), 3)
+    if eng.wall_s > 0.0:
+        result["scenarios_per_device_s"] = round(
+            eng.nmember / eng.wall_s / eng.plan.n_devices, 4)
+    if eng.quarantined:
+        # partial completion: quarantined members are a property of the
+        # job's *result*, not a worker failure — the job lands in
+        # done/ with the census attached and never burns another queue
+        # attempt on behalf of its healthy members
+        result["partial"] = True
+        result["failed_members"] = [
+            {"member": int(k), **info}
+            for k, info in sorted(eng.quarantined.items())]
+        log(f"serve: {rec.get('id', '?')} partial completion — "
+            f"{eng.quarantined_count}/{eng.nmember} members "
+            f"quarantined")
+    return result
+
+
+def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
+            verbose: bool = False, log=print,
+            device_ids: Optional[Sequence[int]] = None,
+            plan=None) -> Dict[str, Any]:
+    """Execute one claimed job; returns the result dict recorded on
+    ``done``.  Raises on failure (caller moves the record).
+
+    ``device_ids`` is the submesh slice the scheduler assigned (None =
+    every local device); ``plan`` overrides the automatic
+    :func:`~ramses_tpu.ensemble.meshplan.plan_for` packing choice."""
+    from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
+    from ramses_tpu.ensemble.meshplan import plan_for
+    from ramses_tpu.platform import compile_cache_stats
+    from ramses_tpu.resilience import supervisor as rsup
+
+    rec = job.record
+    cache0 = compile_cache_stats()
+    params, rdir, dtype = _job_setup(queue_dir, job, log=log)
     if jq.job_kind(rec) == "calibrate" or params.calibration.calibrate:
         # calibrate-kind job: gradient-descent calibration through the
         # differentiable rollout (ramses_tpu/diff) — same artifact shape
@@ -73,15 +162,24 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
             on_iter=lambda it, loss: jq.heartbeat(job))
         result["results_dir"] = rdir
         result["telemetry"] = params.output.telemetry
+        stats = compile_cache_stats()
+        result["compile_cache_hits"] = (int(stats["hits"])
+                                        - int(cache0["hits"]))
+        result["compile_cache_misses"] = (int(stats["misses"])
+                                          - int(cache0["misses"]))
         return result
     spec = EnsembleSpec.from_params(params, sweeps=rec.get("sweeps"),
                                     solver=rec.get("solver", ""))
+    if plan is None:
+        plan = plan_for(params, spec.nmember, device_ids=device_ids,
+                        solver=spec.solver)
 
     def build(restart):
         if restart:
             return EnsembleEngine.from_checkpoint(spec, restart,
-                                                  dtype=dtype)
-        return EnsembleEngine(spec, dtype=dtype)
+                                                  dtype=dtype,
+                                                  plan=plan)
+        return EnsembleEngine(spec, dtype=dtype, plan=plan)
 
     def drive(eng):
         from ramses_tpu.resilience.checkpoint import rotate_checkpoints
@@ -111,24 +209,140 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
         raise RuntimeError(
             f"job {job.id}: incomplete after {max_attempts} attempts "
             f"(t_min={eng.t:.6g} nstep_max={eng.nstep})")
-    result = {"results_dir": rdir, "snapshot": snap,
-              "telemetry": params.output.telemetry,
-              "nmember": eng.nmember, "ngroup": len(eng.groups),
-              "t_min": eng.t, "nstep_max": eng.nstep,
-              "cell_updates": eng.cell_updates}
-    if eng.quarantined:
-        # partial completion: quarantined members are a property of the
-        # job's *result*, not a worker failure — the job lands in
-        # done/ with the census attached and never burns another queue
-        # attempt on behalf of its healthy members
-        result["partial"] = True
-        result["failed_members"] = [
-            {"member": int(k), **info}
-            for k, info in sorted(eng.quarantined.items())]
-        log(f"serve: {job.id} partial completion — "
-            f"{eng.quarantined_count}/{eng.nmember} members "
-            f"quarantined")
-    return result
+    return _job_result(eng, rdir, params, rec, snap, cache0, log=log)
+
+
+def _dispose(job: "jq.Job", err: BaseException, counts: Dict[str, int],
+             max_attempts: int, telemetry, log, stage: str = "requeue"
+             ) -> None:
+    """Requeue-or-fail one errored job, mirroring the serve loop's
+    attempt accounting."""
+    text = "".join(traceback.format_exception_only(type(err),
+                                                   err)).strip()
+    log(f"serve: {job.id} "
+        f"{'hang' if stage == 'hang' else 'failed'}: {err!r}")
+    if int(job.record.get("attempts", 0)) < max_attempts:
+        counts["requeued"] += 1
+        jq.requeue(job, error=text, telemetry=telemetry, stage=stage)
+    else:
+        counts["failed"] += 1
+        jq.fail(job, error=text, telemetry=telemetry, stage=stage)
+
+
+def run_gang(queue_dir: str,
+             gang: List[Tuple["jq.Job", Tuple[int, ...]]],
+             max_attempts: int = 2, verbose: bool = False, log=print,
+             telemetry=None) -> Dict[str, int]:
+    """Drive a gang of co-scheduled small jobs concurrently, each on
+    its assigned submesh slice.
+
+    The interleaved chunk loop is the whole trick: every live job's
+    fused window is *dispatched* (``EnsembleEngine.begin_chunk`` —
+    async, no host block) before any window's results are *fetched*
+    (``finish_chunk``), so the disjoint submeshes compute at the same
+    time even though one host thread drives them all.  Each job keeps
+    its own heartbeat/checkpoint beat and its own failure handling —
+    one member blowing up requeues that job alone, the rest of the
+    gang keeps running.  Returns done/failed/requeued counts."""
+    import jax
+
+    from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
+    from ramses_tpu.ensemble.meshplan import plan_for
+    from ramses_tpu.platform import compile_cache_stats
+    from ramses_tpu.resilience import (resolve_restart_dir,
+                                       rotate_checkpoints)
+
+    counts = {"done": 0, "failed": 0, "requeued": 0}
+    ndev = len(jax.devices())
+    cache0 = compile_cache_stats()
+    busy = sum(len(d) for _, d in gang)
+    gang_info = {"jobs": len(gang), "busy_devices": int(busy),
+                 "ndev": int(ndev),
+                 "busy_frac": round(busy / max(1, ndev), 3)}
+    active: List[Dict[str, Any]] = []
+    for job, dev_ids in gang:
+        try:
+            params, rdir, dtype = _job_setup(queue_dir, job, log=log)
+            spec = EnsembleSpec.from_params(
+                params, sweeps=job.record.get("sweeps"),
+                solver=job.record.get("solver", ""))
+            plan = plan_for(params, spec.nmember, device_ids=dev_ids,
+                            solver=spec.solver)
+            restart = resolve_restart_dir(params, base_dir=rdir,
+                                          log=log)
+            eng = (EnsembleEngine.from_checkpoint(
+                spec, restart, dtype=dtype, plan=plan) if restart
+                else EnsembleEngine(spec, dtype=dtype, plan=plan))
+        except Exception as e:  # noqa: BLE001 — worker boundary
+            _dispose(job, e, counts, max_attempts, telemetry, log)
+            continue
+        log(f"serve: gang member {job.id} on devices "
+            f"{list(dev_ids)} ({plan.mode})")
+        active.append({"job": job, "rdir": rdir, "params": params,
+                       "eng": eng})
+    if telemetry is not None:
+        try:
+            telemetry.record_event(
+                "gang_schedule",
+                job_ids=[st["job"].id for st in active], **gang_info)
+        except Exception:
+            pass
+    while active:
+        begun: List[Tuple[Dict[str, Any], Any]] = []
+        for st in list(active):
+            try:
+                begun.append((st, st["eng"].begin_chunk()))
+            except BaseException as e:  # noqa: BLE001
+                stage = "hang" if isinstance(e, HangDetected) \
+                    else "requeue"
+                _dispose(st["job"], e, counts, max_attempts,
+                         telemetry, log, stage=stage)
+                active.remove(st)
+        for st, ctx in begun:
+            if st not in active:
+                continue
+            try:
+                eng = st["eng"]
+                stepped = eng.finish_chunk(ctx)
+                eng.telemetry.record_event(
+                    "ensemble_chunk", nmember=eng.nmember,
+                    ngroup=len(eng.groups), steps=stepped,
+                    t_min=eng.t, nstep_max=eng.nstep,
+                    quarantined=eng.quarantined_count,
+                    wall_s=round(eng.wall_s, 6))
+                jq.heartbeat(st["job"])
+                st["eng"].save(st["rdir"])
+                rotate_checkpoints(st["rdir"], keep=2)
+                if stepped == 0 and not st["eng"].run_complete():
+                    raise RuntimeError(
+                        f"job {st['job'].id}: no progress in a chunk "
+                        "(inconsistent tend/nstepmax)")
+            except BaseException as e:  # noqa: BLE001
+                stage = "hang" if isinstance(e, HangDetected) \
+                    else "requeue"
+                _dispose(st["job"], e, counts, max_attempts,
+                         telemetry, log, stage=stage)
+                active.remove(st)
+        for st in list(active):
+            eng = st["eng"]
+            if not eng.run_complete():
+                continue
+            snap = eng.save(st["rdir"])
+            eng.telemetry.record_event(
+                "ensemble_done", nmember=eng.nmember,
+                ngroup=len(eng.groups), t_min=eng.t,
+                nstep_max=eng.nstep, snapshot=snap,
+                quarantined=eng.quarantined_count)
+            eng.telemetry.close(eng, print_timers=False)
+            result = _job_result(eng, st["rdir"], st["params"],
+                                 st["job"].record, snap, cache0,
+                                 log=log)
+            result["gang"] = gang_info
+            counts["done"] += 1
+            jq.complete(st["job"], result=result)
+            log(f"serve: {st['job'].id} done -> {snap}")
+            active.remove(st)
+    return counts
 
 
 def _counts_line(queue_dir: str) -> str:
@@ -141,75 +355,123 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
           idle_exit: bool = False, poll_s: float = 1.0,
           stale_s: Optional[float] = None, max_attempts: int = 2,
           verbose: bool = False, log=print, beat_s: float = 30.0,
-          telemetry=None) -> Dict[str, int]:
+          telemetry=None, order: str = "cost",
+          gang_starve_s: float = 600.0) -> Dict[str, int]:
     """Worker loop: claim and run jobs until the queue is drained
     (``idle_exit``) or ``max_jobs`` jobs have been processed
     (0 = unbounded).  Returns done/failed counts for this worker.
 
+    ``order`` is the claim order: ``"cost"`` (default) plans each
+    claim with the cost-aware gang scheduler — bin-packing small jobs
+    concurrently onto submesh slices, draining to exclusive mode for
+    mesh-wide jobs, with ``gang_starve_s`` bounding how long a big job
+    can be overtaken — while ``"fifo"`` restores the blind
+    oldest-first single-job behavior.
+
     While idle-polling, a ``queue_counts()`` heartbeat line is printed
     every ``beat_s`` seconds so a stuck fleet is visible from any
     worker's log; ``telemetry`` (optional) receives the queue
-    lifecycle events (requeue/fail/reclaim)."""
+    lifecycle events (requeue/fail/reclaim/gang_schedule)."""
     jq.init_queue(queue_dir)
     counts = {"done": 0, "failed": 0, "requeued": 0}
     last_beat = 0.0
-    while True:
-        # default staleness from the first job's namelist is unknowable
-        # before claiming — use the CLI/default value for the sweep
-        jq.reclaim_stale(queue_dir, stale_s=stale_s or 300.0,
-                         max_attempts=max_attempts, log=log,
-                         telemetry=telemetry)
-        job = jq.claim(queue_dir, worker=worker)
-        if job is None:
-            if idle_exit:
-                if log is not None:
-                    log(f"serve: idle, exiting — "
-                        f"{_counts_line(queue_dir)}")
+    # the shared-compile-cache default mutates process-global jax
+    # config; snapshot it so an in-process caller (tests, a notebook)
+    # gets its compilation-cache settings back when serve returns
+    cache_snap = None
+    try:
+        while True:
+            # default staleness from the first job's namelist is
+            # unknowable before claiming — use the CLI/default value
+            jq.reclaim_stale(queue_dir, stale_s=stale_s or 300.0,
+                             max_attempts=max_attempts, log=log,
+                             telemetry=telemetry)
+            records = jq.peek_queued(queue_dir)
+            if not records:
+                if idle_exit:
+                    if log is not None:
+                        log(f"serve: idle, exiting — "
+                            f"{_counts_line(queue_dir)}")
+                    return counts
+                now = time.monotonic()
+                if log is not None and now - last_beat >= beat_s:
+                    log(f"serve: idle — {_counts_line(queue_dir)}")
+                    last_beat = now
+                time.sleep(poll_s)
+                continue
+            import jax
+            if cache_snap is None:
+                from ramses_tpu import platform as _plat
+                cache_snap = ({k: getattr(jax.config, k)
+                               for k in _JAX_CACHE_KEYS},
+                              _plat._CACHE_STATS["dir"])
+            ndev = len(jax.devices())
+            planned = jq.plan_gang(records, ndev, order=order,
+                                   starve_s=gang_starve_s)
+            if max_jobs:
+                # cap the gang by the remaining job budget so
+                # max_jobs=N never over-claims inside one gang round
+                left = max_jobs - counts["done"] - counts["failed"]
+                planned = planned[:max(0, left)]
+            gang: List[Tuple[jq.Job, Tuple[int, ...]]] = []
+            offset = 0
+            for rec, n in planned:
+                job = jq.claim(queue_dir, worker=worker,
+                               job_id=rec["id"])
+                if job is None:
+                    continue           # lost the race to a peer worker
+                gang.append((job, tuple(range(offset, offset + n))))
+                offset += n
+            if not gang:
+                time.sleep(poll_s * 0.1)
+                continue
+            if len(gang) == 1:
+                # solo claim (mesh-wide, calibrate, fifo mode, or just
+                # a one-job queue): the fully supervised path
+                job, dev_ids = gang[0]
+                log(f"serve: claimed {job.id} "
+                    f"(attempt {job.record['attempts']}/{max_attempts},"
+                    f" devices {list(dev_ids)})")
+                try:
+                    result = run_job(queue_dir, job,
+                                     max_attempts=max_attempts,
+                                     verbose=verbose, log=log,
+                                     device_ids=dev_ids)
+                except HangDetected as e:
+                    # serve-loop liveness: a deadline-expired chunk
+                    # comes back HERE (run_job runs hang_retries=0) —
+                    # the wedged job is killed-and-requeued with
+                    # stage="hang" immediately instead of zombifying
+                    # this worker until stale-reclaim
+                    _dispose(job, e, counts, max_attempts, telemetry,
+                             log, stage="hang")
+                except Exception as e:  # noqa: BLE001 — worker boundary
+                    _dispose(job, e, counts, max_attempts, telemetry,
+                             log)
+                else:
+                    counts["done"] += 1
+                    jq.complete(job, result=result)
+                    log(f"serve: {job.id} done -> "
+                        f"{result.get('snapshot') or result.get('checkpoint')}")
+            else:
+                log(f"serve: gang of {len(gang)} jobs over "
+                    f"{sum(len(d) for _, d in gang)}/{ndev} devices")
+                gc = run_gang(queue_dir, gang,
+                              max_attempts=max_attempts,
+                              verbose=verbose, log=log,
+                              telemetry=telemetry)
+                for k in counts:
+                    counts[k] += gc[k]
+            if max_jobs and counts["done"] + counts["failed"] >= max_jobs:
                 return counts
-            now = time.monotonic()
-            if log is not None and now - last_beat >= beat_s:
-                log(f"serve: idle — {_counts_line(queue_dir)}")
-                last_beat = now
-            time.sleep(poll_s)
-            continue
-        log(f"serve: claimed {job.id} "
-            f"(attempt {job.record['attempts']}/{max_attempts})")
-        try:
-            result = run_job(queue_dir, job, max_attempts=max_attempts,
-                             verbose=verbose, log=log)
-        except HangDetected as e:
-            # serve-loop liveness: a deadline-expired chunk comes back
-            # HERE (run_job runs with hang_retries=0) — the wedged job
-            # is killed-and-requeued with stage="hang" immediately
-            # instead of zombifying this worker until stale-reclaim
-            log(f"serve: {job.id} hang: {e!r}")
-            err = "".join(traceback.format_exception_only(type(e), e))
-            if int(job.record.get("attempts", 0)) < max_attempts:
-                counts["requeued"] += 1
-                jq.requeue(job, error=err.strip(), telemetry=telemetry,
-                           stage="hang")
-            else:
-                counts["failed"] += 1
-                jq.fail(job, error=err.strip(), telemetry=telemetry,
-                        stage="hang")
-        except Exception as e:   # noqa: BLE001 — worker boundary
-            log(f"serve: {job.id} failed: {e!r}")
-            err = "".join(traceback.format_exception_only(type(e), e))
-            if int(job.record.get("attempts", 0)) < max_attempts:
-                # hand it back for another worker/attempt; a requeue is
-                # not a processed job (max_jobs counts final outcomes)
-                counts["requeued"] += 1
-                jq.requeue(job, error=err.strip(), telemetry=telemetry)
-            else:
-                counts["failed"] += 1
-                jq.fail(job, error=err.strip(), telemetry=telemetry)
-        else:
-            counts["done"] += 1
-            jq.complete(job, result=result)
-            log(f"serve: {job.id} done -> "
-                f"{result.get('snapshot') or result.get('checkpoint')}")
-        if max_jobs and counts["done"] + counts["failed"] >= max_jobs:
-            return counts
+    finally:
+        if cache_snap is not None:
+            import jax
+
+            from ramses_tpu import platform as _plat
+            for k, v in cache_snap[0].items():
+                jax.config.update(k, v)
+            _plat._CACHE_STATS["dir"] = cache_snap[1]
 
 
 def submit_namelist(queue_dir: str, namelist_path: str,

@@ -75,6 +75,16 @@ class SlabSpec(NamedTuple):
     backend: str = "ppermute"
 
 
+def max_slab_devices(lvl: int, ndim: int) -> int:
+    """Largest power-of-two device count a complete level at ``lvl``
+    can shard over under the eligibility rules of
+    :func:`build_slab_spec` (``mbits <= ndim*(lvl-1)``, which also
+    keeps every local extent >= the MUSCL stencil halo).  The job-level
+    scheduler (ensemble/meshplan) uses this as the ``max_shards`` stamp
+    for mesh-wide AMR namelists."""
+    return 1 << max(0, ndim * (lvl - 1))
+
+
 def build_slab_spec(mesh: Mesh, lvl: int, ndim: int,
                     shape: Tuple[int, ...], ncell_pad: int,
                     bc_kinds, halo_backend: str = "auto"

@@ -62,6 +62,27 @@ def spatial_sharding(mesh: Mesh, n_leading: int = 1) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+REPLICA_AXIS = "rep"
+
+
+def replica_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over independent ensemble replicas: the two-level
+    parallel composition (ensemble/meshplan) shards the leading member
+    axis of a packed sub-batch over this axis — members are data-
+    parallel (no cross-member collectives), so GSPMD partitions the
+    vmapped step chain into per-device replica programs with zero
+    communication."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (REPLICA_AXIS,))
+
+
+def replica_sharding(mesh: Mesh, ndim_total: int) -> NamedSharding:
+    """Sharding for a ``[B, ...]`` batched array: member axis on the
+    replica mesh, everything else replicated per device."""
+    return NamedSharding(
+        mesh, P(REPLICA_AXIS, *([None] * (ndim_total - 1))))
+
+
 OCT_AXIS = "oct"
 
 

@@ -20,9 +20,14 @@ _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 # persistent-compile-cache hit/miss counters (best-effort, via
 # jax.monitoring): "hits" counts executables served from the on-disk
-# cache, "misses" counts real backend compiles.  Surfaced in the
+# cache, "compiles" counts every pass through the backend-compile
+# timer — which wraps ``compile_or_get_cached`` and so fires on disk
+# hits too (the load is timed like a compile).  Misses are therefore
+# derived as ``compiles - hits``: both counters are monotone and fire
+# exactly once per compile request, so deltas stay consistent even
+# when the cache engages midway through a process.  Surfaced in the
 # telemetry run header so worker cold-start economics are observable.
-_CACHE_STATS = {"hits": 0, "misses": 0, "dir": ""}
+_CACHE_STATS = {"hits": 0, "compiles": 0, "dir": ""}
 _cache_listener_installed = False
 
 
@@ -35,12 +40,13 @@ def _install_cache_listener():
 
         def _on_event(name, **kw):
             if "persistent_cache_hit" in name \
-                    or ("compilation_cache" in name and "hit" in name):
+                    or ("compilation_cache" in name and "hit" in name
+                        and "requests" not in name):
                 _CACHE_STATS["hits"] += 1
 
         def _on_duration(name, secs, **kw):
             if name.endswith("backend_compile_duration"):
-                _CACHE_STATS["misses"] += 1
+                _CACHE_STATS["compiles"] += 1
 
         monitoring.register_event_listener(_on_event)
         monitoring.register_event_duration_secs_listener(_on_duration)
@@ -50,8 +56,13 @@ def _install_cache_listener():
 
 
 def compile_cache_stats() -> dict:
-    """Snapshot of {hits, misses, dir} for telemetry headers."""
-    return dict(_CACHE_STATS)
+    """Snapshot of {hits, misses, dir, ...} for telemetry headers.
+
+    ``misses`` = compile requests not served from disk (real backend
+    compiles); with no cache engaged that is every compile."""
+    s = dict(_CACHE_STATS)
+    s["misses"] = max(0, s["compiles"] - s["hits"])
+    return s
 
 
 def setup_compile_cache(params) -> str:

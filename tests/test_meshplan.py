@@ -1,0 +1,230 @@
+"""Two-level packing plans + cost-aware gang scheduler
+(``ramses_tpu/ensemble/meshplan.py``, ``queue.plan_gang``).
+
+Pins the scheduling contracts of the ensemble x slab composition:
+
+  * submit stamps each record with the ``members x cells x steps``
+    cost plus shard clamps (best-effort: unparseable -> unstamped);
+  * ``plan_gang`` bin-packs small jobs cost-ascending onto the mesh,
+    drains to exclusive mode for mesh-wide jobs, honors min/max shard
+    clamps, and bounds starvation (a big job waiting past
+    ``starve_s`` preempts the packers);
+  * ``plan_for`` picks packed / slab / single from the namelist and
+    the granted submesh alone.
+"""
+
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+from ramses_tpu.config import params_from_dict
+from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.ensemble.meshplan import (MeshPlan, largest_divisor,
+                                          member_cells, plan_for,
+                                          slab_eligible, stamp_cost)
+
+pytestmark = pytest.mark.smoke
+
+
+def _hydro_nml(nmember=1, lvl=4, nstepmax=6):
+    return (
+        "&RUN_PARAMS\nhydro=.true.\nnstepmax=%d\n/\n"
+        "&AMR_PARAMS\nlevelmin=%d\nlevelmax=%d\n/\n"
+        "&OUTPUT_PARAMS\ntend=1e9\n/\n"
+        "&INIT_PARAMS\nd_region=1.0\np_region=1e-5\n/\n"
+        "&ENSEMBLE_PARAMS\nnmember=%d\nperturb_amp=1e-3\n/\n"
+        % (nstepmax, lvl, lvl, nmember))
+
+
+def _params(lvl=4, lmax=None, nmember=1, ndim=3, **ens):
+    return params_from_dict({
+        "run_params": {"hydro": True, "nstepmax": 6},
+        "amr_params": {"levelmin": lvl, "levelmax": lmax or lvl},
+        "output_params": {"tend": 1e9},
+        "init_params": {"d_region": [1.0], "p_region": [1e-5]},
+        "ensemble_params": dict({"nmember": nmember}, **ens),
+    }, ndim=ndim)
+
+
+# ---------------------------------------------------------------------
+# cost stamp
+# ---------------------------------------------------------------------
+def test_stamp_cost_fields():
+    c = stamp_cost(_hydro_nml(nmember=4, lvl=4, nstepmax=6), ndim=3)
+    assert c["members"] == 4
+    assert c["cells"] == 16 ** 3
+    assert c["steps"] == 6
+    assert c["cost"] == 4 * 16 ** 3 * 6
+    assert c["min_shards"] == 0 and c["max_shards"] == 0
+    assert c["exclusive"] is False
+
+
+def test_stamp_cost_exclusive_over_budget():
+    nml = _hydro_nml(nmember=1, lvl=5) + \
+        "&ENSEMBLE_PARAMS\npack_cell_budget=64\n/\n"
+    c = stamp_cost(nml, ndim=3)
+    assert c["cells"] == 32 ** 3 and c["exclusive"] is True
+    # a calibrate job is exclusive by kind, not by size — but the
+    # size bit in the stamp stays a pure cell-budget statement
+    c2 = stamp_cost(_hydro_nml(), ndim=3, kind="calibrate")
+    assert c2["exclusive"] is False
+    rec = {"kind": "calibrate", "cost": c2}
+    assert jq._is_exclusive(rec)
+
+
+def test_stamp_cost_amr_worst_case_and_shard_cap():
+    nml = ("&RUN_PARAMS\nhydro=.true.\nnstepmax=10\n/\n"
+           "&AMR_PARAMS\nlevelmin=4\nlevelmax=6\n/\n"
+           "&OUTPUT_PARAMS\ntend=1e9\n/\n")
+    c = stamp_cost(nml, ndim=3)
+    # worst-case refinement: base cells x 2^(ndim * depth)
+    assert c["cells"] == 16 ** 3 * 2 ** (3 * 2)
+    # AMR namelists inherit the dense-slab device ceiling
+    from ramses_tpu.parallel.dense_slab import max_slab_devices
+    assert c["max_shards"] == max_slab_devices(6, 3)
+
+
+def test_stamp_cost_uncostable_is_none():
+    # the namelist parser is lenient, so the guard is around the whole
+    # estimate: a config that can't be costed submits unstamped
+    assert stamp_cost("&AMR_PARAMS\nlevelmin=potato\n/\n",
+                      ndim=3) is None
+
+
+def test_submit_stamps_cost(tmp_path):
+    qd = str(tmp_path / "q")
+    jid = jq.submit(qd, _hydro_nml(nmember=3), job_id="stamped")
+    recs = jq.peek_queued(qd)
+    assert [r["id"] for r in recs] == [jid]
+    assert recs[0]["cost"]["members"] == 3
+    assert recs[0]["cost"]["cost"] > 0
+
+
+def test_claim_by_job_id(tmp_path):
+    qd = str(tmp_path / "q")
+    jq.submit(qd, _hydro_nml(), job_id="a")
+    jq.submit(qd, _hydro_nml(), job_id="b")
+    job = jq.claim(qd, worker="w", job_id="b")
+    assert job.id == "b"
+    assert [r["id"] for r in jq.peek_queued(qd)] == ["a"]
+    # a lost race (id already claimed) returns None, not an error
+    assert jq.claim(qd, worker="w2", job_id="b") is None
+
+
+# ---------------------------------------------------------------------
+# gang planning (pure decisions — no fs, no jax)
+# ---------------------------------------------------------------------
+def _rec(jid, members=1, cells=64, steps=4, submitted=1000.0,
+         exclusive=False, min_shards=0, max_shards=0, kind="run"):
+    return {"id": jid, "kind": kind, "submitted_unix": submitted,
+            "cost": {"members": members, "cells": cells,
+                     "steps": steps,
+                     "cost": members * cells * steps,
+                     "min_shards": min_shards,
+                     "max_shards": max_shards,
+                     "exclusive": exclusive}}
+
+
+def test_plan_gang_binpacks_cost_ascending():
+    a = _rec("a", members=8, cells=64)      # cost 2048
+    b = _rec("b", members=4, cells=64)      # cost 1024 (cheapest)
+    gang = jq.plan_gang([a, b], ndev=8, now=1001.0)
+    assert [(r["id"], n) for r, n in gang] == [("b", 4), ("a", 4)]
+    assert sum(n for _, n in gang) <= 8
+
+
+def test_plan_gang_shard_clamps():
+    a = _rec("a", members=8, max_shards=2)
+    b = _rec("b", members=8, min_shards=4)
+    gang = dict((r["id"], n) for r, n in
+                jq.plan_gang([a, b], ndev=8, now=1001.0))
+    assert gang["a"] <= 2
+    assert gang["b"] >= 4
+    # a lone 1-member job never gets more than 1 device — extra
+    # replicas would idle
+    solo = jq.plan_gang([_rec("s", members=1)], ndev=8, now=1001.0)
+    assert [(r["id"], n) for r, n in solo] == [("s", 1)]
+
+
+def test_plan_gang_exclusive_drains():
+    big = _rec("big", members=1, cells=10 ** 7, exclusive=True)
+    small = _rec("small", members=4)
+    # smalls present: they pack first, the big job waits
+    gang = jq.plan_gang([big, small], ndev=8, now=1001.0)
+    assert [r["id"] for r, _ in gang] == ["small"]
+    # only the big job left: it takes the whole mesh
+    gang = jq.plan_gang([big], ndev=8, now=1001.0)
+    assert [(r["id"], n) for r, n in gang] == [("big", 8)]
+
+
+def test_plan_gang_starvation_bound():
+    big = _rec("big", exclusive=True, submitted=0.0)
+    small = _rec("small", members=4, submitted=999.0)
+    # waited past starve_s: the exclusive job preempts the packers
+    gang = jq.plan_gang([big, small], ndev=8, now=1000.0,
+                        starve_s=600.0)
+    assert [(r["id"], n) for r, n in gang] == [("big", 8)]
+    # not yet starving: smalls pack as usual
+    gang = jq.plan_gang([big, small], ndev=8, now=500.0,
+                        starve_s=600.0)
+    assert [r["id"] for r, _ in gang] == ["small"]
+
+
+def test_plan_gang_fifo_fallback():
+    a = _rec("a", members=8, cells=10 ** 7, exclusive=True)
+    b = _rec("b", members=1)
+    gang = jq.plan_gang([a, b], ndev=8, order="fifo")
+    assert [(r["id"], n) for r, n in gang] == [("a", 8)]
+    with pytest.raises(ValueError, match="claim order"):
+        jq.plan_gang([a], ndev=8, order="nope")
+
+
+def test_plan_gang_unstamped_is_small_fifo_job():
+    bare = {"id": "old", "submitted_unix": 1000.0}   # pre-stamp record
+    gang = jq.plan_gang([bare], ndev=8, now=1001.0)
+    assert [(r["id"], n) for r, n in gang] == [("old", 1)]
+
+
+# ---------------------------------------------------------------------
+# plan_for mode selection
+# ---------------------------------------------------------------------
+def test_plan_for_modes():
+    p = _params(lvl=4, nmember=8)
+    assert plan_for(p, 8, n_devices=1).mode == "single"
+    plan = plan_for(p, 8, device_ids=(0, 1, 2, 3))
+    assert plan.mode == "packed" and plan.device_ids == (0, 1, 2, 3)
+    # over the pack budget + slab-eligible (periodic uniform hydro,
+    # nx divisible): mesh-wide slab
+    p2 = _params(lvl=5, nmember=1, pack_cell_budget=64)
+    assert slab_eligible(p2, 8)
+    assert plan_for(p2, 1, n_devices=8).mode == "slab"
+    # over budget but NOT eligible (AMR): fall back to single
+    p3 = _params(lvl=4, lmax=6, nmember=1, pack_cell_budget=64)
+    assert not slab_eligible(p3, 8)
+    assert plan_for(p3, 1, n_devices=8).mode == "single"
+
+
+def test_member_cells_worst_case():
+    assert member_cells(_params(lvl=4, ndim=3)) == 16 ** 3
+    assert member_cells(_params(lvl=4, lmax=5, ndim=2)) == \
+        16 ** 2 * 2 ** (2 * 1)
+
+
+def test_largest_divisor():
+    assert largest_divisor(8, 8) == 8
+    assert largest_divisor(8, 3) == 2
+    assert largest_divisor(6, 4) == 3
+    assert largest_divisor(5, 4) == 1
+    assert largest_divisor(1, 8) == 1
+
+
+def test_meshplan_validation_and_describe():
+    with pytest.raises(ValueError, match="mode"):
+        MeshPlan(mode="weird")
+    plan = MeshPlan.packed((0, 1), max_replicas=2)
+    d = plan.describe()
+    assert d == {"mode": "packed", "devices": 2,
+                 "device_ids": [0, 1], "max_replicas": 2}
+    assert MeshPlan.single().n_devices == 1
