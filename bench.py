@@ -69,11 +69,27 @@ import subprocess
 import sys
 import time
 import traceback
+import uuid
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 MARKER = "##BENCH_SUB##"
+
+# one trace id for the whole protocol run (same env contract as
+# ramses_tpu/obs/trace, duplicated because this parent never imports
+# ramses_tpu): every child heartbeat line and BENCH_RESULT_* sidecar
+# carries it, so hang-classified sub-benches join worker telemetry
+TRACE_ID = (os.environ.get("RAMSES_TRACE_ID", "").strip()
+            or uuid.uuid4().hex)
+
+
+def _stamp_ids(d):
+    """trace_id + worker_id (host:pid) onto a result dict, in place."""
+    d.setdefault("trace_id",
+                 os.environ.get("RAMSES_TRACE_ID", "") or TRACE_ID)
+    d.setdefault("worker_id", f"{os.uname().nodename}:{os.getpid()}")
+    return d
 
 
 def _hb_path(name):
@@ -943,13 +959,15 @@ def run_sub_inproc(name):
         from tools.profile_amr import collect
         os.environ.setdefault("PROF_PROBE_DEADLINE_S", "120")
         d = collect(hb=hb.mark,
-                    emit=lambda r: _write_result(name, dict(r)))
+                    emit=lambda r: _write_result(name,
+                                                 _stamp_ids(dict(r))))
         d["tunnel_rtt_s"] = measure_rtt(jnp)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
     hb.mark("done")
     d["_device"] = str(jax.devices()[0].platform)
     d["_dtype"] = str(dtype.__name__)
+    _stamp_ids(d)
     _write_result(name, d)
     print(MARKER + json.dumps(d), flush=True)
 
@@ -1015,7 +1033,10 @@ def run_sub(name, deadline, weight=None, reserve=0.0):
     if weight is None:
         weight = SUB_WEIGHTS.get(name, 0.5)
     hb_path = _hb_path(name)
-    env = dict(os.environ, BENCH_HEARTBEAT_PATH=hb_path)
+    # RAMSES_TRACE_ID: the child's Heartbeat.from_env stamps it (plus
+    # its host:pid) onto every sidecar marker and result JSON
+    env = dict(os.environ, BENCH_HEARTBEAT_PATH=hb_path,
+               RAMSES_TRACE_ID=TRACE_ID)
 
     def _hb_diag():
         """phase_at_timeout + recent phase trail from the child's
@@ -1200,6 +1221,7 @@ def main():
            and "vcycles_per_sec" in head else None))
     out = {
         "tunnel": tunnel,
+        "trace_id": TRACE_ID,
         "metric": (f"cell-updates/sec/chip {head['config']}" if hydro_head
                    else (f"vcycles/sec/chip {head['config']}"
                          if "vcycles_per_sec" in head

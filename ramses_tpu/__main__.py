@@ -47,6 +47,19 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-id", default="",
                     help="with --serve: worker name stamped on claimed "
                          "jobs (default host:pid)")
+    ap.add_argument("--obs", metavar="QUEUE_DIR", default=None,
+                    help="standalone observability server: serve the "
+                         "streaming results API + Prometheus /metrics "
+                         "over this queue dir (ramses_tpu/obs) without "
+                         "running any jobs; Ctrl-C to stop")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="with --serve or --obs: TCP port for the "
+                         "observability HTTP server (0 = pick an "
+                         "ephemeral port; default with --obs: 9100, "
+                         "with --serve: off)")
+    ap.add_argument("--obs-bind", default="127.0.0.1",
+                    help="bind address for the observability server "
+                         "(default loopback; 0.0.0.0 exposes it)")
     ap.add_argument("--claim-order", default="cost",
                     choices=["cost", "fifo"],
                     help="with --serve: job claim order — 'cost' "
@@ -100,13 +113,33 @@ def main(argv=None) -> int:
             kind="calibrate" if args.calibrate else "run")
         print(job_id)
         return 0
+    if args.obs:
+        # artifacts-only observability: no jobs run, no devices touched
+        # — consumers hit the queue dir's records/telemetry/checkpoints
+        import time as _time
+
+        from ramses_tpu.obs.server import ObsServer
+        port = 9100 if args.obs_port is None else args.obs_port
+        srv = ObsServer(args.obs, port=port, bind=args.obs_bind,
+                        log=print if args.verbose else None).start()
+        print(f"obs: serving {srv.root} on {srv.url} (Ctrl-C to stop)",
+              flush=True)
+        try:
+            while True:
+                _time.sleep(3600.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.close()
+        return 0
     if args.serve:
         from ramses_tpu.ensemble.service import serve
         counts = serve(args.serve, worker=args.worker_id,
                        max_jobs=args.max_jobs, idle_exit=args.idle_exit,
                        stale_s=args.stale_timeout,
                        max_attempts=max(1, args.max_attempts),
-                       verbose=args.verbose, order=args.claim_order)
+                       verbose=args.verbose, order=args.claim_order,
+                       obs_port=args.obs_port, obs_bind=args.obs_bind)
         print(f"serve: done={counts['done']} failed={counts['failed']}")
         return 1 if counts["failed"] else 0
     if not args.namelist:
@@ -123,6 +156,21 @@ def main(argv=None) -> int:
     # RAMSES_COMPILE_CACHE): must land before the first trace
     from ramses_tpu.platform import setup_compile_cache
     setup_compile_cache(params)
+
+    # &OUTPUT_PARAMS obs_port: a solo run serves its own output dir
+    # over HTTP as pseudo-job "run" — telemetry tail + artifact files,
+    # same endpoints as the fleet server (daemon thread, dies with the
+    # process)
+    if params.output.obs_port:
+        import os as _os
+
+        from ramses_tpu.obs.server import ObsServer
+        _os.makedirs(params.output.output_dir, exist_ok=True)
+        obs_srv = ObsServer(params.output.output_dir,
+                            port=params.output.obs_port,
+                            bind=params.output.obs_bind).start()
+        print(f"obs: serving {params.output.output_dir} "
+              f"on {obs_srv.url}")
 
     if params.run.debug_nan:
         # jit-level NaN trap (SURVEY.md §5.2): every compiled program

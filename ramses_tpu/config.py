@@ -207,6 +207,16 @@ class OutputParams:
     # written concurrently (0/1 = one shard per process; >1 exercises
     # the per-shard decomposition on a single-host test mesh)
     pario_split_hosts: int = 0
+    # observability HTTP server (ramses_tpu/obs): TCP port for the
+    # streaming results/metrics endpoints (/healthz /jobs /metrics,
+    # resumable telemetry tails, manifest-validated artifact files).
+    # 0 = off.  Serve workers usually arm it with --obs-port instead;
+    # set here, a solo run serves its own output dir as a single-run
+    # view.  Scrapes read artifacts only — zero added device fetches.
+    obs_port: int = 0
+    # bind address for the observability server (default loopback;
+    # 0.0.0.0 exposes it on all interfaces)
+    obs_bind: str = "127.0.0.1"
 
 
 @dataclass

@@ -374,6 +374,10 @@ class EnsembleEngine:
         #: member isolation ladder state: {member: {reason, nstep, t,
         #: dump}} for members evicted by the batched step-guard
         self.quarantined: Dict[int, Dict[str, Any]] = {}
+        #: correlation fields (trace_id/job/worker — ramses_tpu/obs)
+        #: the serve loop sets after construction; folded into every
+        #: checkpoint manifest meta so artifacts join the job's trace
+        self.trace_meta: Dict[str, Any] = {}
         self.telemetry = (telemetry if telemetry is not None
                           else make_telemetry(spec.base,
                                               run_info=self.run_info()))
@@ -870,7 +874,8 @@ class EnsembleEngine:
             stage, final, meta={"kind": "quarantine_member",
                                 "member": k,
                                 "reason": "nonfinite_state",
-                                "nstep": nstep0, "t": t0})
+                                "nstep": nstep0, "t": t0,
+                                **self.trace_meta})
 
     # ------------------------------------------------------------------
     # manifest-valid checkpoints (resilience/checkpoint) so a supervised
@@ -911,7 +916,7 @@ class EnsembleEngine:
                        "iout": self._iout}, f, indent=1)
         meta = {"kind": "ensemble", "iout": self._iout,
                 "nstep": self.nstep, "t": self.t,
-                "nmember": self.nmember}
+                "nmember": self.nmember, **self.trace_meta}
         if census:
             # per-member quarantine census in the manifest meta: the
             # durable record (read_quarantine_census) of which members

@@ -83,11 +83,16 @@ def submit(queue_dir: str, namelist: str,
     path = os.path.join(queue_dir, "queued", job_id + ".json")
     if os.path.exists(path):
         raise FileExistsError(f"job id '{job_id}' already queued")
+    from ramses_tpu.obs.trace import new_trace_id
     record = {
         "id": job_id, "kind": kind, "namelist": namelist,
         "sweeps": dict(sweeps or {}), "solver": solver,
         "ndim": int(ndim), "dtype": dtype,
         "submitted_unix": time.time(), "attempts": 0,
+        # end-to-end correlation id (ramses_tpu/obs/trace): stamped
+        # here once, then propagated into every telemetry record,
+        # failure_log entry and checkpoint manifest this job produces
+        "trace_id": new_trace_id(),
         "meta": dict(meta or {})}
     # submit-time cost stamp (members x cells x steps + shard clamps):
     # the currency plan_gang bin-packs on.  Strictly best-effort — an
@@ -276,6 +281,7 @@ def _log_failure(record: Dict[str, Any], error: str,
         "kind": job_kind(record),
         "attempt": int(record.get("attempts", 0)),
         "worker": record.get("worker", ""),
+        "trace_id": record.get("trace_id", ""),
         "time_unix": time.time()})
     record["error"] = str(error)
 
@@ -305,6 +311,7 @@ def fail(job: Job, error: str = "",
     if error:
         _log_failure(job.record, error, stage)
     _emit(telemetry, "queue_fail", job=job.id,
+          trace_id=job.record.get("trace_id", ""),
           attempts=int(job.record.get("attempts", 0)), error=error,
           stage=stage)
     return _finish(job, "failed", result=result, error=error)
@@ -320,6 +327,7 @@ def requeue(job: Job, error: str = "", telemetry=None,
     if error:
         _log_failure(job.record, error, stage)
     _emit(telemetry, "queue_requeue", job=job.id,
+          trace_id=job.record.get("trace_id", ""),
           attempts=int(job.record.get("attempts", 0)), error=error,
           stage=stage)
     _write_record(job.path, job.record)
@@ -389,6 +397,7 @@ def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
             continue
         moved += 1
         _emit(telemetry, "queue_reclaim", job=record.get("id", name),
+              trace_id=record.get("trace_id", ""),
               attempts=attempts, to=state, heartbeat_age_s=round(age, 1))
         if log is not None:
             log(f"queue: reclaimed {record.get('id', name)} -> {state} "

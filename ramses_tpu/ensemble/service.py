@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -89,13 +90,31 @@ def _job_setup(queue_dir: str, job: "jq.Job", log=print):
     return params, rdir, dtype
 
 
+def _bind_trace(eng, rec: Dict[str, Any]) -> None:
+    """Correlate the engine's artifacts with the job's trace: the
+    submit-time ``trace_id`` (plus job/worker ids) lands in every
+    telemetry record (:meth:`Telemetry.bind`) and every checkpoint
+    manifest meta (``EnsembleEngine.trace_meta``) — one id joins
+    submit -> claim -> telemetry -> failure_log -> manifest however
+    many workers the job bounces through."""
+    fields = {"trace_id": str(rec.get("trace_id") or ""),
+              "job": str(rec.get("id") or ""),
+              "worker": str(rec.get("worker") or "")}
+    eng.trace_meta = {k: v for k, v in fields.items() if v}
+    eng.telemetry.bind(**eng.trace_meta)
+
+
 def _job_result(eng, rdir: str, params, rec: Dict[str, Any],
                 snap: str, cache0: Dict[str, int],
-                log=print) -> Dict[str, Any]:
+                log=print, gang_info: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
     """The result dict recorded on ``done`` — shared by the solo and
     gang paths.  ``cache0`` is the ``compile_cache_stats()`` snapshot
     taken before the job started; the recorded hit/miss counts are the
-    deltas this job (or its gang) produced."""
+    deltas this job (or its gang) produced.  Must run before the job's
+    telemetry closes: the summary is also emitted as a ``job_summary``
+    event so the packing economics (queue wait, gang busy_frac,
+    scenarios/device/s) are tailable without opening the queue record."""
     from ramses_tpu.platform import compile_cache_stats
 
     stats = compile_cache_stats()
@@ -128,6 +147,20 @@ def _job_result(eng, rdir: str, params, rec: Dict[str, Any],
         log(f"serve: {rec.get('id', '?')} partial completion — "
             f"{eng.quarantined_count}/{eng.nmember} members "
             f"quarantined")
+    summary = {k: result[k] for k in
+               ("queue_wait_s", "scenarios_per_device_s",
+                "compile_cache_hits", "compile_cache_misses",
+                "nmember", "cell_updates") if k in result}
+    if gang_info:
+        result["gang"] = gang_info
+        summary["busy_frac"] = gang_info.get("busy_frac")
+        summary["gang_jobs"] = gang_info.get("jobs")
+    if eng.quarantined:
+        summary["quarantined"] = eng.quarantined_count
+    try:
+        eng.telemetry.record_event("job_summary", **summary)
+    except Exception:           # noqa: BLE001 — reporting only
+        pass
     return result
 
 
@@ -176,10 +209,16 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
 
     def build(restart):
         if restart:
-            return EnsembleEngine.from_checkpoint(spec, restart,
-                                                  dtype=dtype,
-                                                  plan=plan)
-        return EnsembleEngine(spec, dtype=dtype, plan=plan)
+            eng = EnsembleEngine.from_checkpoint(spec, restart,
+                                                 dtype=dtype,
+                                                 plan=plan)
+        else:
+            eng = EnsembleEngine(spec, dtype=dtype, plan=plan)
+        _bind_trace(eng, rec)
+        return eng
+
+    from ramses_tpu.obs.profile import ProfileRequestWatcher
+    watcher = ProfileRequestWatcher(rdir, log=log)
 
     def drive(eng):
         from ramses_tpu.resilience.checkpoint import rotate_checkpoints
@@ -191,25 +230,35 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
             jq.heartbeat(job)
             e.save(rdir)
             rotate_checkpoints(rdir, keep=2)
+            # on-demand profiling (ramses_tpu/obs/profile): the chunk
+            # boundary is the one point with no fused window in flight
+            watcher.poll(telemetry=e.telemetry)
         eng.run(verbose=verbose, on_chunk=beat)
 
     # hang_retries=0: a deadline-expired chunk escapes immediately so
     # the serve loop can kill-and-requeue with stage="hang" instead of
     # retrying inside a worker the queue already believes is live
-    eng = rsup.supervise(build, drive, params, base_dir=rdir,
-                         max_attempts=max_attempts, log=log,
-                         hang_retries=0)
+    try:
+        eng = rsup.supervise(build, drive, params, base_dir=rdir,
+                             max_attempts=max_attempts, log=log,
+                             hang_retries=0)
+    finally:
+        # never leave a device trace open across attempts/errors —
+        # jax.profiler allows one active trace per process
+        watcher.stop()
     snap = eng.save(rdir)
     eng.telemetry.record_event("ensemble_done", nmember=eng.nmember,
                                ngroup=len(eng.groups), t_min=eng.t,
                                nstep_max=eng.nstep, snapshot=snap,
                                quarantined=eng.quarantined_count)
-    eng.telemetry.close(eng, print_timers=False)
     if not eng.run_complete():
+        eng.telemetry.close(eng, print_timers=False)
         raise RuntimeError(
             f"job {job.id}: incomplete after {max_attempts} attempts "
             f"(t_min={eng.t:.6g} nstep_max={eng.nstep})")
-    return _job_result(eng, rdir, params, rec, snap, cache0, log=log)
+    result = _job_result(eng, rdir, params, rec, snap, cache0, log=log)
+    eng.telemetry.close(eng, print_timers=False)
+    return result
 
 
 def _dispose(job: "jq.Job", err: BaseException, counts: Dict[str, int],
@@ -252,6 +301,8 @@ def run_gang(queue_dir: str,
     from ramses_tpu.resilience import (resolve_restart_dir,
                                        rotate_checkpoints)
 
+    from ramses_tpu.obs.profile import ProfileRequestWatcher
+
     counts = {"done": 0, "failed": 0, "requeued": 0}
     ndev = len(jax.devices())
     cache0 = compile_cache_stats()
@@ -276,10 +327,12 @@ def run_gang(queue_dir: str,
         except Exception as e:  # noqa: BLE001 — worker boundary
             _dispose(job, e, counts, max_attempts, telemetry, log)
             continue
+        _bind_trace(eng, job.record)
         log(f"serve: gang member {job.id} on devices "
             f"{list(dev_ids)} ({plan.mode})")
         active.append({"job": job, "rdir": rdir, "params": params,
-                       "eng": eng})
+                       "eng": eng,
+                       "watch": ProfileRequestWatcher(rdir, log=log)})
     if telemetry is not None:
         try:
             telemetry.record_event(
@@ -295,6 +348,7 @@ def run_gang(queue_dir: str,
             except BaseException as e:  # noqa: BLE001
                 stage = "hang" if isinstance(e, HangDetected) \
                     else "requeue"
+                st["watch"].stop()
                 _dispose(st["job"], e, counts, max_attempts,
                          telemetry, log, stage=stage)
                 active.remove(st)
@@ -313,6 +367,7 @@ def run_gang(queue_dir: str,
                 jq.heartbeat(st["job"])
                 st["eng"].save(st["rdir"])
                 rotate_checkpoints(st["rdir"], keep=2)
+                st["watch"].poll(telemetry=eng.telemetry)
                 if stepped == 0 and not st["eng"].run_complete():
                     raise RuntimeError(
                         f"job {st['job'].id}: no progress in a chunk "
@@ -320,6 +375,7 @@ def run_gang(queue_dir: str,
             except BaseException as e:  # noqa: BLE001
                 stage = "hang" if isinstance(e, HangDetected) \
                     else "requeue"
+                st["watch"].stop()
                 _dispose(st["job"], e, counts, max_attempts,
                          telemetry, log, stage=stage)
                 active.remove(st)
@@ -327,17 +383,17 @@ def run_gang(queue_dir: str,
             eng = st["eng"]
             if not eng.run_complete():
                 continue
+            st["watch"].stop(telemetry=eng.telemetry)
             snap = eng.save(st["rdir"])
             eng.telemetry.record_event(
                 "ensemble_done", nmember=eng.nmember,
                 ngroup=len(eng.groups), t_min=eng.t,
                 nstep_max=eng.nstep, snapshot=snap,
                 quarantined=eng.quarantined_count)
-            eng.telemetry.close(eng, print_timers=False)
             result = _job_result(eng, st["rdir"], st["params"],
                                  st["job"].record, snap, cache0,
-                                 log=log)
-            result["gang"] = gang_info
+                                 log=log, gang_info=gang_info)
+            eng.telemetry.close(eng, print_timers=False)
             counts["done"] += 1
             jq.complete(st["job"], result=result)
             log(f"serve: {st['job'].id} done -> {snap}")
@@ -351,12 +407,36 @@ def _counts_line(queue_dir: str) -> str:
             f"done={c['done']} failed={c['failed']}")
 
 
+def _worker_telemetry(queue_dir: str, worker: str):
+    """Per-worker telemetry sink at ``<queue_dir>/workers/<worker>
+    .jsonl``: queue lifecycle events (serve_start/serve_idle/requeue/
+    fail/reclaim/gang_schedule) in the same JSONL schema as run
+    telemetry, so ``tools/telemetry_report.py`` renders it and the obs
+    ``/metrics`` scrape reads the file's mtime as worker liveness."""
+    from ramses_tpu.obs.metrics import WORKERS_DIR
+    from ramses_tpu.telemetry.recorder import Telemetry, TelemetrySpec
+
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", worker) or "worker"
+    path = os.path.join(queue_dir, WORKERS_DIR, safe + ".jsonl")
+    tel = Telemetry(TelemetrySpec(path=path),
+                    run_info={"driver": "serve-worker",
+                              "worker": worker,
+                              "queue_dir": os.path.abspath(queue_dir)})
+    # a restarted worker of the same name extends its history instead
+    # of truncating it — the sink is a fleet log, not a run log
+    tel._append = True
+    tel.bind(worker=worker)
+    return tel
+
+
 def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
           idle_exit: bool = False, poll_s: float = 1.0,
           stale_s: Optional[float] = None, max_attempts: int = 2,
           verbose: bool = False, log=print, beat_s: float = 30.0,
           telemetry=None, order: str = "cost",
-          gang_starve_s: float = 600.0) -> Dict[str, int]:
+          gang_starve_s: float = 600.0,
+          obs_port: Optional[int] = None,
+          obs_bind: str = "127.0.0.1") -> Dict[str, int]:
     """Worker loop: claim and run jobs until the queue is drained
     (``idle_exit``) or ``max_jobs`` jobs have been processed
     (0 = unbounded).  Returns done/failed counts for this worker.
@@ -368,18 +448,36 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
     can be overtaken — while ``"fifo"`` restores the blind
     oldest-first single-job behavior.
 
-    While idle-polling, a ``queue_counts()`` heartbeat line is printed
-    every ``beat_s`` seconds so a stuck fleet is visible from any
-    worker's log; ``telemetry`` (optional) receives the queue
-    lifecycle events (requeue/fail/reclaim/gang_schedule)."""
+    Observability: ``telemetry`` defaults to a per-worker sink under
+    ``<queue_dir>/workers/`` receiving the queue lifecycle events
+    (requeue/fail/reclaim/gang_schedule) plus a structured
+    ``serve_idle`` heartbeat with queue counts every ``beat_s``
+    seconds while idle — fleet idleness is scrapeable, not just
+    greppable.  ``obs_port`` (0 = ephemeral) arms the streaming
+    results/metrics HTTP server (ramses_tpu/obs) over the queue dir
+    for the lifetime of the loop."""
     jq.init_queue(queue_dir)
+    worker = worker or f"{os.uname().nodename}:{os.getpid()}"
     counts = {"done": 0, "failed": 0, "requeued": 0}
+    own_tel = None
+    if telemetry is None:
+        telemetry = own_tel = _worker_telemetry(queue_dir, worker)
+    obs = None
+    if obs_port is not None:
+        from ramses_tpu.obs.server import ObsServer
+        obs = ObsServer(queue_dir, port=int(obs_port), bind=obs_bind,
+                        log=log if verbose else None).start()
+        if log is not None:
+            log(f"serve: obs server on {obs.url}")
     last_beat = 0.0
     # the shared-compile-cache default mutates process-global jax
     # config; snapshot it so an in-process caller (tests, a notebook)
     # gets its compilation-cache settings back when serve returns
     cache_snap = None
     try:
+        telemetry.record_event("serve_start", worker=worker,
+                               obs_url=obs.url if obs else "",
+                               **jq.queue_counts(queue_dir))
         while True:
             # default staleness from the first job's namelist is
             # unknowable before claiming — use the CLI/default value
@@ -389,13 +487,20 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
             records = jq.peek_queued(queue_dir)
             if not records:
                 if idle_exit:
+                    telemetry.record_event("serve_idle", exiting=True,
+                                           **jq.queue_counts(queue_dir))
                     if log is not None:
                         log(f"serve: idle, exiting — "
                             f"{_counts_line(queue_dir)}")
                     return counts
                 now = time.monotonic()
-                if log is not None and now - last_beat >= beat_s:
-                    log(f"serve: idle — {_counts_line(queue_dir)}")
+                if now - last_beat >= beat_s:
+                    # structured idle heartbeat through the telemetry
+                    # sink (not a bare print): the obs /metrics scrape
+                    # reads the sink's mtime as worker liveness and
+                    # the event carries the queue census
+                    telemetry.record_event(
+                        "serve_idle", **jq.queue_counts(queue_dir))
                     last_beat = now
                 time.sleep(poll_s)
                 continue
@@ -465,6 +570,15 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
             if max_jobs and counts["done"] + counts["failed"] >= max_jobs:
                 return counts
     finally:
+        if own_tel is not None:
+            try:
+                own_tel.record_event("serve_exit", worker=worker,
+                                     **counts)
+            except Exception:   # noqa: BLE001
+                pass
+            own_tel.close(print_timers=False)
+        if obs is not None:
+            obs.close()
         if cache_snap is not None:
             import jax
 

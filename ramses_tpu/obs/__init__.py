@@ -1,0 +1,45 @@
+"""Fleet observability plane over the run-service queue directory.
+
+Four pieces, all reading artifacts the queue machinery already writes
+(zero added device fetches):
+
+  * :mod:`ramses_tpu.obs.server` — streaming results/metrics HTTP
+    service (``--obs-port`` on a serve worker, or standalone
+    ``python -m ramses_tpu --obs <queue_dir>``);
+  * :mod:`ramses_tpu.obs.metrics` — Prometheus text exposition
+    scraped from queue records + worker telemetry sinks;
+  * :mod:`ramses_tpu.obs.trace` — the ``trace_id`` stamped at submit
+    and propagated into telemetry, failure logs, heartbeat sidecars
+    and checkpoint manifests;
+  * :mod:`ramses_tpu.obs.profile` — on-demand jax.profiler captures
+    armed by flag file / POST and picked up at chunk boundaries.
+
+Only :mod:`~ramses_tpu.obs.trace` is imported eagerly — it is the one
+piece the jax-free submit path (``ensemble/queue.py``) needs, and it
+must stay a leaf.  Server/metrics/profile resolve lazily.
+"""
+
+from __future__ import annotations
+
+from ramses_tpu.obs.trace import new_trace_id, worker_id  # noqa: F401
+
+_LAZY = {
+    "ObsServer": ("ramses_tpu.obs.server", "ObsServer"),
+    "ProfileRequestWatcher": ("ramses_tpu.obs.profile",
+                              "ProfileRequestWatcher"),
+    "request_profile": ("ramses_tpu.obs.profile", "request_profile"),
+    "render_queue_metrics": ("ramses_tpu.obs.metrics",
+                             "render_queue_metrics"),
+}
+
+
+def __getattr__(name):
+    try:
+        modname, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+    return getattr(importlib.import_module(modname), attr)
+
+
+__all__ = ["new_trace_id", "worker_id", *sorted(_LAZY)]

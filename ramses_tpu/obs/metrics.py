@@ -1,0 +1,254 @@
+"""Prometheus text-format metrics over a queue directory.
+
+Everything here is derived from artifacts already on disk — queue
+record JSONs, running-record heartbeat mtimes, the per-worker
+telemetry JSONL under ``<queue_dir>/workers/`` — so a scrape NEVER
+touches a device or a worker process (the PR 3 zero-added-fetch
+contract extends to the whole observability plane).  Stdlib-only
+(plus the jax-free ``ensemble/queue``): a scrape allocates nothing on
+any accelerator and works with no worker process alive at all.
+
+Counters are *reconstructed* from the durable records on every scrape
+(failure_log entries, attempt counts, quarantine censuses), so they
+are monotone for as long as the records exist — a restarted obs
+server resumes the same counter values, which is exactly the Prometheus
+counter contract (resets are handled by ``rate()`` anyway).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ramses_tpu.ensemble import queue as jq
+
+#: subdir where serve workers keep their own telemetry JSONL; the file
+#: mtime doubles as the worker liveness signal scraped below
+WORKERS_DIR = "workers"
+
+_LABEL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _esc(v: str) -> str:
+    return "".join(_LABEL_ESC.get(ch, ch) for ch in str(v))
+
+
+class Family:
+    """One metric family: name/type/help + labelled samples."""
+
+    def __init__(self, name: str, typ: str, help_: str):
+        self.name, self.typ, self.help = name, typ, help_
+        self.samples: List[Tuple[Dict[str, str], float]] = []
+
+    def add(self, value, **labels) -> "Family":
+        self.samples.append((dict(labels), float(value)))
+        return self
+
+
+def _iter_records(queue_dir: str) -> Iterator[Tuple[str, Dict[str, Any]]]:
+    for state in jq.STATES:
+        d = os.path.join(queue_dir, state)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            continue
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    yield state, json.load(f)
+            except (OSError, ValueError):
+                continue        # claimed under us / submit mid-flight
+
+
+def _tail_events(path: str, kinds: Tuple[str, ...],
+                 max_bytes: int = 1 << 18) -> Dict[str, Dict[str, Any]]:
+    """Last record of each ``kind`` near the end of a JSONL file (one
+    bounded read — scrapes stay O(1) however long the log grows)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - max_bytes))
+            data = f.read(max_bytes)
+    except OSError:
+        return out
+    for line in data.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue            # torn first line of the window
+        if rec.get("kind") in kinds:
+            out[rec["kind"]] = rec
+    return out
+
+
+def collect(queue_dir: str, now: Optional[float] = None) -> List[Family]:
+    """One scan of the queue directory into metric families."""
+    now = time.time() if now is None else float(now)
+    counts = jq.queue_counts(queue_dir)
+
+    depth = Family("ramses_queue_jobs", "gauge",
+                   "Jobs per queue lifecycle directory.")
+    for state in jq.STATES:
+        depth.add(counts.get(state, 0), state=state)
+
+    attempts = Family("ramses_job_attempts_total", "counter",
+                      "Claim attempts accumulated across all job "
+                      "records still on disk.")
+    failures = Family("ramses_failure_events_total", "counter",
+                      "failure_log entries by stage (requeue, hang, "
+                      "stale, fail).")
+    quarantined = Family("ramses_quarantined_members_total", "counter",
+                         "Ensemble members evicted by the member "
+                         "isolation ladder (from done-record censuses).")
+    partial = Family("ramses_jobs_partial_total", "counter",
+                     "Completed jobs with at least one quarantined "
+                     "member.")
+    cache_hits = Family("ramses_compile_cache_hits_total", "counter",
+                        "Persistent compile-cache hits recorded on "
+                        "completed jobs.")
+    cache_miss = Family("ramses_compile_cache_misses_total", "counter",
+                        "Persistent compile-cache misses recorded on "
+                        "completed jobs.")
+    cells = Family("ramses_cell_updates_total", "counter",
+                   "Subcycle-weighted cell updates summed over "
+                   "completed jobs.")
+    qwait = Family("ramses_queue_wait_seconds_sum", "counter",
+                   "Summed submit->claim latency of completed jobs.")
+    qwait_n = Family("ramses_queue_wait_seconds_count", "counter",
+                     "Completed jobs with a queue_wait_s sample.")
+    spd = Family("ramses_scenarios_per_device_seconds", "gauge",
+                 "scenarios_per_device_s of the most recently "
+                 "finished job.")
+    hb = Family("ramses_job_heartbeat_age_seconds", "gauge",
+                "Age of each running job's claim heartbeat (stale "
+                "workers are reclaimed past the staleness timeout).")
+
+    n_attempts = n_quar = n_partial = n_hits = n_miss = 0
+    n_cells = 0
+    wait_sum, wait_n = 0.0, 0
+    by_stage: Dict[str, int] = {}
+    last_spd: Optional[Tuple[float, float]] = None   # (finished, value)
+    for state, rec in _iter_records(queue_dir):
+        n_attempts += int(rec.get("attempts", 0) or 0)
+        for entry in rec.get("failure_log") or []:
+            stage = str(entry.get("stage") or "unknown")
+            by_stage[stage] = by_stage.get(stage, 0) + 1
+        result = rec.get("result") or {}
+        if state == "done":
+            failed = result.get("failed_members") or []
+            n_quar += len(failed)
+            n_partial += 1 if result.get("partial") else 0
+            n_hits += int(result.get("compile_cache_hits") or 0)
+            n_miss += int(result.get("compile_cache_misses") or 0)
+            n_cells += int(result.get("cell_updates") or 0)
+            w = result.get("queue_wait_s")
+            if w is not None:
+                wait_sum += float(w)
+                wait_n += 1
+            v = result.get("scenarios_per_device_s")
+            fin = float(rec.get("finished_unix") or 0.0)
+            if v is not None and (last_spd is None or fin > last_spd[0]):
+                last_spd = (fin, float(v))
+        if state == "running":
+            path = os.path.join(queue_dir, "running",
+                                str(rec.get("id", "?")) + ".json")
+            try:
+                hb.add(round(now - os.path.getmtime(path), 3),
+                       job=str(rec.get("id", "?")))
+            except OSError:
+                pass
+    attempts.add(n_attempts)
+    for stage in sorted(by_stage):
+        failures.add(by_stage[stage], stage=stage)
+    quarantined.add(n_quar)
+    partial.add(n_partial)
+    cache_hits.add(n_hits)
+    cache_miss.add(n_miss)
+    cells.add(n_cells)
+    qwait.add(round(wait_sum, 3))
+    qwait_n.add(wait_n)
+    if last_spd is not None:
+        spd.add(last_spd[1])
+
+    whb = Family("ramses_worker_heartbeat_age_seconds", "gauge",
+                 "Age of each serve worker's telemetry sink (workers "
+                 "write serve_idle/queue events through it).")
+    busy = Family("ramses_gang_busy_frac", "gauge",
+                  "Device-busy fraction of each worker's most recent "
+                  "gang schedule.")
+    wdir = os.path.join(queue_dir, WORKERS_DIR)
+    try:
+        wnames = sorted(n for n in os.listdir(wdir)
+                        if n.endswith(".jsonl"))
+    except OSError:
+        wnames = []
+    for name in wnames:
+        path = os.path.join(wdir, name)
+        worker = name[:-len(".jsonl")]
+        try:
+            whb.add(round(now - os.path.getmtime(path), 3),
+                    worker=worker)
+        except OSError:
+            continue
+        ev = _tail_events(path, ("gang_schedule",))
+        gs = ev.get("gang_schedule")
+        if gs is not None and gs.get("busy_frac") is not None:
+            busy.add(float(gs["busy_frac"]), worker=worker)
+
+    fams = [depth, attempts, failures, quarantined, partial,
+            cache_hits, cache_miss, cells, qwait, qwait_n, spd,
+            hb, whb, busy]
+    return [f for f in fams if f.samples]
+
+
+def render(families: List[Family]) -> str:
+    """Prometheus text exposition format, version 0.0.4."""
+    out: List[str] = []
+    for fam in families:
+        out.append(f"# HELP {fam.name} {fam.help}")
+        out.append(f"# TYPE {fam.name} {fam.typ}")
+        for labels, value in fam.samples:
+            lab = ",".join(f'{k}="{_esc(v)}"'
+                           for k, v in sorted(labels.items()))
+            text = f"{value:.10g}"
+            out.append(f"{fam.name}{{{lab}}} {text}" if lab
+                       else f"{fam.name} {text}")
+    return "\n".join(out) + "\n"
+
+
+def render_queue_metrics(queue_dir: str,
+                         now: Optional[float] = None) -> str:
+    return render(collect(queue_dir, now=now))
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                             float]:
+    """Parse an exposition back into ``{(name, ((k, v), ...)): value}``
+    — the round-trip half the tests and the CI smoke assert through."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparsable metrics line: {line!r}")
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        key = tuple(sorted(
+            (k, re.sub(r"\\(.)",
+                       lambda m: "\n" if m.group(1) == "n"
+                       else m.group(1), v))
+            for k, v in _LABEL_RE.findall(labels)))
+        out[(name, key)] = float(value)
+    return out

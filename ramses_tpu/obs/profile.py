@@ -1,0 +1,139 @@
+"""On-demand device profiling picked up at chunk boundaries.
+
+A consumer arms a job by writing ``profile_request.json`` into its
+results dir (``POST /jobs/<id>/profile`` does exactly this); the serve
+loop polls the flag at every chunk boundary — the one safe point where
+no fused window is in flight — wraps the next N chunks in the existing
+``utils/timers.profile_trace`` jax.profiler hook, then writes a
+manifest over the trace dir so it shows up as a validated artifact
+under ``/jobs/<id>/artifacts``.  An idle worker pays one ``os.path
+.isfile`` per chunk; nothing else changes when no request is pending.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ramses_tpu.utils.timers import profile_trace
+
+#: flag-file name inside a job's results dir; one request = one capture
+PROFILE_FLAG = "profile_request.json"
+
+
+def request_profile(results_dir: str, chunks: int = 1) -> str:
+    """Arm a profile capture of the next ``chunks`` chunk boundaries
+    (the filesystem-level equivalent of ``POST /jobs/<id>/profile``).
+    Returns the flag path."""
+    os.makedirs(results_dir, exist_ok=True)
+    flag = os.path.join(results_dir, PROFILE_FLAG)
+    tmp = flag + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"chunks": max(1, int(chunks)),
+                   "requested_unix": time.time()}, f)
+    os.replace(tmp, flag)
+    return flag
+
+
+class ProfileRequestWatcher:
+    """Per-job profiling state machine driven from the chunk loop.
+
+    ``poll(telemetry)`` is called after every finished chunk: it opens
+    a device trace when a request flag appears, counts armed chunks
+    down, and closes/registers the trace dir when they are spent.
+    ``_profile_cm`` is the capture hook (``profile_trace`` in
+    production) — a staticmethod so tests swap in a fake profiler
+    without touching jax.
+    """
+
+    _profile_cm = staticmethod(profile_trace)
+
+    def __init__(self, results_dir: str, log=None):
+        self.results_dir = results_dir
+        self.log = log
+        self._cm = None
+        self._chunks_left = 0
+        self._seq = 0
+        self.trace_dir = ""
+
+    @property
+    def active(self) -> bool:
+        return self._cm is not None
+
+    def poll(self, telemetry=None) -> None:
+        """One chunk boundary: pick up a pending request, or advance /
+        close an active capture."""
+        if self._cm is not None:
+            self._chunks_left -= 1
+            if self._chunks_left <= 0:
+                self._finish(telemetry)
+            return
+        flag = os.path.join(self.results_dir, PROFILE_FLAG)
+        if not os.path.isfile(flag):
+            return
+        try:
+            with open(flag) as f:
+                req: Dict[str, Any] = json.load(f) or {}
+        except (OSError, ValueError):
+            req = {}
+        try:
+            os.remove(flag)     # consume exactly one request
+        except OSError:
+            return              # a racing attempt consumed it first
+        self._seq += 1
+        tdir = os.path.join(self.results_dir,
+                            f"profile_{self._seq:04d}")
+        try:
+            cm = self._profile_cm(tdir)
+            cm.__enter__()
+        except Exception as e:  # noqa: BLE001 — profiling is optional
+            self._event(telemetry, "profile_error", error=repr(e))
+            if self.log is not None:
+                self.log(f"obs: profile request failed: {e!r}")
+            return
+        self._cm = cm
+        self._chunks_left = max(1, int(req.get("chunks", 1) or 1))
+        self.trace_dir = tdir
+        self._event(telemetry, "profile_start", trace_dir=tdir,
+                    chunks=self._chunks_left)
+        if self.log is not None:
+            self.log(f"obs: profiling {self._chunks_left} chunk(s) "
+                     f"-> {tdir}")
+
+    def stop(self, telemetry=None) -> None:
+        """End-of-job safety: close a capture the chunk countdown never
+        finished (job completed or errored mid-capture)."""
+        if self._cm is not None:
+            self._finish(telemetry)
+
+    def _finish(self, telemetry) -> None:
+        cm, self._cm = self._cm, None
+        try:
+            cm.__exit__(None, None, None)
+        except Exception as e:  # noqa: BLE001
+            self._event(telemetry, "profile_error", error=repr(e),
+                        trace_dir=self.trace_dir)
+            return
+        # manifest over the trace dir: /jobs/<id>/artifacts lists it as
+        # a validated artifact like any checkpoint
+        try:
+            from ramses_tpu.resilience.checkpoint import write_manifest
+            write_manifest(self.trace_dir,
+                           meta={"kind": "profile",
+                                 "captured_unix": time.time()})
+        except Exception:       # noqa: BLE001 — listing-only nicety
+            pass
+        self._event(telemetry, "profile_captured",
+                    trace_dir=self.trace_dir)
+        if self.log is not None:
+            self.log(f"obs: profile captured -> {self.trace_dir}")
+
+    @staticmethod
+    def _event(telemetry, kind: str, **fields) -> None:
+        if telemetry is not None:
+            try:
+                telemetry.record_event(kind, **fields)
+            except Exception:   # noqa: BLE001
+                pass

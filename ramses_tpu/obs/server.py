@@ -1,0 +1,407 @@
+"""Streaming results/metrics HTTP service over a queue directory.
+
+The fleet-facing half of ROADMAP item 3(d): consumers hit *artifacts*
+— record JSONs, telemetry JSONL tails, manifest-validated checkpoint
+files — never devices.  The server is a daemon-threaded stdlib
+``http.server`` reading the same files the queue machinery writes, so
+arming it adds zero device fetches to a running worker (pinned in
+``tests/test_obs.py``).
+
+Endpoints::
+
+    GET  /healthz                     liveness + queue counts
+    GET  /metrics                     Prometheus text exposition
+    GET  /jobs                        queue census (per-state summaries)
+    GET  /jobs/<id>                   full record (failure_log included)
+    GET  /jobs/<id>/telemetry?offset=N   resumable JSONL tail
+    GET  /jobs/<id>/artifacts         manifest-validated listing
+    GET  /jobs/<id>/artifacts/<path>  file bytes (Range supported)
+    POST /jobs/<id>/profile           arm on-demand device profiling
+
+The telemetry tail serves whole lines only from byte ``offset`` and
+returns the next offset in ``X-Telemetry-Offset`` — a consumer that
+always resumes from the returned offset sees every record exactly
+once.  ``offset`` beyond the current size means the file was rotated
+(a fresh attempt truncated it): the tail restarts from 0 with
+``X-Telemetry-Rotated: 1``.
+
+Started with ``--obs-port`` on a serve worker, or standalone via
+``python -m ramses_tpu --obs <queue_dir>`` (scraping a queue needs no
+worker at all).  Pointed at a plain run output dir (no ``queued/``)
+it serves that single run as pseudo-job ``run``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.obs import metrics as om
+from ramses_tpu.obs.profile import PROFILE_FLAG
+from ramses_tpu.resilience.checkpoint import (MANIFEST_NAME,
+                                              read_manifest_meta,
+                                              validate_checkpoint)
+
+#: cap on one telemetry-tail response; a consumer catches up across
+#: requests by resuming from X-Telemetry-Offset
+MAX_TAIL_BYTES = 4 << 20
+
+_JOB_ID_RE = re.compile(r"^[A-Za-z0-9_.:-]{1,200}$")
+
+
+def tail_jsonl(path: str, offset: int,
+               max_bytes: int = MAX_TAIL_BYTES
+               ) -> Tuple[bytes, int, bool]:
+    """Whole-line window of ``path`` from byte ``offset``.  Returns
+    ``(data, next_offset, rotated)`` — exactly-once semantics when the
+    caller always resumes from ``next_offset``."""
+    size = os.path.getsize(path)
+    rotated = False
+    if offset > size or offset < 0:
+        offset, rotated = 0, True
+    with open(path, "rb") as f:
+        f.seek(offset)
+        data = f.read(max_bytes)
+    cut = data.rfind(b"\n")
+    data = data[:cut + 1] if cut >= 0 else b""
+    return data, offset + len(data), rotated
+
+
+class ObsServer:
+    """Threaded observability server over ``root`` (a queue dir, or
+    any run output dir in single-run mode)."""
+
+    def __init__(self, root: str, port: int = 0,
+                 bind: str = "127.0.0.1", log=None):
+        self.root = os.path.abspath(root)
+        self.bind = bind
+        self.log = log
+        # queue mode iff the directory has (or can be) a queue layout;
+        # a plain output dir is served as single pseudo-job "run"
+        self.queue_mode = os.path.isdir(os.path.join(self.root,
+                                                     "queued"))
+        self._httpd = ThreadingHTTPServer((bind, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.obs = self          # handler back-reference
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def port(self) -> int:
+        return int(self._httpd.server_address[1])
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.bind}:{self.port}"
+
+    def start(self) -> "ObsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ramses-obs",
+            daemon=True)
+        self._thread.start()
+        if self.log is not None:
+            self.log(f"obs: serving {self.root} on {self.url}")
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- data access ---------------------------------------------------
+    def job_states(self) -> List[Tuple[str, str]]:
+        """``[(job_id, state), ...]`` across the lifecycle dirs."""
+        if not self.queue_mode:
+            return [("run", "running")]
+        out: List[Tuple[str, str]] = []
+        for state in jq.STATES:
+            d = os.path.join(self.root, state)
+            try:
+                names = sorted(os.listdir(d))
+            except OSError:
+                continue
+            out.extend((n[:-len(".json")], state) for n in names
+                       if n.endswith(".json"))
+        return out
+
+    def job_record(self, job_id: str) -> Optional[Dict[str, Any]]:
+        if not self.queue_mode:
+            return {"id": "run", "kind": "run"} \
+                if job_id == "run" else None
+        job = jq.job_status(self.root, job_id)
+        if job is None:
+            return None
+        rec = dict(job.record)
+        rec["state"] = job.state
+        try:
+            rec["heartbeat_age_s"] = round(
+                time.time() - os.path.getmtime(job.path), 3)
+        except OSError:
+            pass
+        return rec
+
+    def results_dir(self, job_id: str) -> str:
+        if not self.queue_mode:
+            return self.root
+        return jq.results_dir(self.root, job_id)
+
+    def telemetry_path(self, job_id: str) -> str:
+        rdir = self.results_dir(job_id)
+        path = os.path.join(rdir, "telemetry.jsonl")
+        if not self.queue_mode and not os.path.isfile(path):
+            # single-run mode: any telemetry JSONL in the output dir
+            try:
+                cand = sorted(n for n in os.listdir(rdir)
+                              if n.endswith(".jsonl"))
+            except OSError:
+                cand = []
+            if cand:
+                path = os.path.join(rdir, cand[0])
+        return path
+
+    def artifacts(self, job_id: str) -> Dict[str, Any]:
+        """Manifest-validated checkpoint/profile dirs + loose files in
+        the job's results dir.  Validation is the cheap existence+size
+        scan — a byte-level audit is the consumer's call (the manifest
+        carries the sha256 table)."""
+        rdir = self.results_dir(job_id)
+        dirs: List[Dict[str, Any]] = []
+        loose: List[Dict[str, Any]] = []
+        try:
+            names = sorted(os.listdir(rdir))
+        except OSError:
+            names = []
+        for name in names:
+            p = os.path.join(rdir, name)
+            if os.path.isdir(p):
+                if not os.path.isfile(os.path.join(p, MANIFEST_NAME)):
+                    continue   # staging dir / pre-atomic dump: not served
+                ok, reason = validate_checkpoint(p, verify_hash=False)
+                files = []
+                for root, _d, fnames in os.walk(p):
+                    for fn in sorted(fnames):
+                        fp = os.path.join(root, fn)
+                        files.append({
+                            "path": os.path.relpath(fp, rdir),
+                            "size": os.path.getsize(fp)})
+                dirs.append({"name": name, "valid": bool(ok),
+                             "reason": reason,
+                             "meta": read_manifest_meta(p),
+                             "files": files})
+            elif os.path.isfile(p):
+                loose.append({"path": name, "size": os.path.getsize(p)})
+        return {"job": job_id, "results_dir": rdir,
+                "checkpoints": dirs, "files": loose}
+
+    def artifact_file(self, job_id: str, rel: str) -> Optional[str]:
+        """Resolve one served file, refusing any path that escapes the
+        job's results dir (symlinks included)."""
+        rdir = os.path.realpath(self.results_dir(job_id))
+        path = os.path.realpath(os.path.join(rdir, rel))
+        if path != rdir and not path.startswith(rdir + os.sep):
+            return None
+        return path if os.path.isfile(path) else None
+
+    def arm_profile(self, job_id: str,
+                    req: Dict[str, Any]) -> Dict[str, Any]:
+        """Write the ``profile_request.json`` flag the worker's chunk
+        loop polls (ramses_tpu/obs/profile.py)."""
+        rdir = self.results_dir(job_id)
+        os.makedirs(rdir, exist_ok=True)
+        chunks = max(1, int(req.get("chunks", 1)))
+        flag = os.path.join(rdir, PROFILE_FLAG)
+        tmp = flag + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"chunks": chunks,
+                       "requested_unix": time.time()}, f)
+        os.replace(tmp, flag)
+        return {"armed": True, "job": job_id, "chunks": chunks,
+                "flag": flag}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ramses-obs/1"
+    protocol_version = "HTTP/1.1"
+
+    # route table kept in one place so OPTIONS/errors stay honest
+    def do_GET(self):          # noqa: N802 — http.server API
+        self._route("GET")
+
+    def do_POST(self):         # noqa: N802
+        self._route("POST")
+
+    def log_message(self, fmt, *args):
+        log = self.server.obs.log
+        if log is not None:
+            log(f"obs: {self.address_string()} {fmt % args}")
+
+    # -- responses -----------------------------------------------------
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: Optional[Dict[str, str]] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj: Any, code: int = 200,
+              headers: Optional[Dict[str, str]] = None):
+        body = (json.dumps(obj, indent=1) + "\n").encode()
+        self._send(code, body, "application/json", headers)
+
+    def _error(self, code: int, msg: str):
+        self._json({"error": msg}, code=code)
+
+    # -- routing -------------------------------------------------------
+    def _route(self, method: str):
+        obs: ObsServer = self.server.obs
+        try:
+            url = urlsplit(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+            if method == "GET" and parts == ["healthz"]:
+                return self._healthz(obs)
+            if method == "GET" and parts == ["metrics"]:
+                return self._metrics(obs)
+            if method == "GET" and parts == ["jobs"]:
+                return self._jobs(obs)
+            if len(parts) >= 2 and parts[0] == "jobs":
+                job_id = parts[1]
+                if not _JOB_ID_RE.match(job_id):
+                    return self._error(400, "bad job id")
+                if obs.job_record(job_id) is None:
+                    return self._error(404, f"unknown job {job_id}")
+                rest = parts[2:]
+                if method == "GET" and not rest:
+                    return self._json(obs.job_record(job_id))
+                if method == "GET" and rest == ["telemetry"]:
+                    return self._telemetry(obs, job_id, query)
+                if method == "GET" and rest == ["artifacts"]:
+                    return self._json(obs.artifacts(job_id))
+                if method == "GET" and rest \
+                        and rest[0] == "artifacts":
+                    return self._file(obs, job_id, "/".join(rest[1:]))
+                if method == "POST" and rest == ["profile"]:
+                    return self._profile(obs, job_id, query)
+            self._error(404, f"no route for {method} {url.path}")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — server must not die
+            try:
+                self._error(500, repr(e))
+            except Exception:
+                pass
+
+    # -- endpoints -----------------------------------------------------
+    def _healthz(self, obs: ObsServer):
+        out = {"ok": True, "root": obs.root,
+               "mode": "queue" if obs.queue_mode else "results",
+               "time_unix": time.time()}
+        if obs.queue_mode:
+            out["queue"] = jq.queue_counts(obs.root)
+        self._json(out)
+
+    def _metrics(self, obs: ObsServer):
+        if obs.queue_mode:
+            text = om.render_queue_metrics(obs.root)
+        else:
+            text = om.render([om.Family(
+                "ramses_obs_results_mode", "gauge",
+                "Server is in single-run results mode.").add(1)])
+        self._send(200, text.encode(),
+                   "text/plain; version=0.0.4; charset=utf-8")
+
+    def _jobs(self, obs: ObsServer):
+        jobs = []
+        for job_id, state in obs.job_states():
+            rec = obs.job_record(job_id) or {}
+            entry = {"id": job_id, "state": state,
+                     "kind": rec.get("kind", "run"),
+                     "attempts": rec.get("attempts", 0),
+                     "trace_id": rec.get("trace_id", ""),
+                     "worker": rec.get("worker", ""),
+                     "failures": len(rec.get("failure_log") or [])}
+            result = rec.get("result") or {}
+            if result.get("partial"):
+                entry["quarantined"] = len(
+                    result.get("failed_members") or [])
+            jobs.append(entry)
+        out: Dict[str, Any] = {"jobs": jobs}
+        if obs.queue_mode:
+            out["counts"] = jq.queue_counts(obs.root)
+        self._json(out)
+
+    def _telemetry(self, obs: ObsServer, job_id: str,
+                   query: Dict[str, str]):
+        path = obs.telemetry_path(job_id)
+        try:
+            offset = int(query.get("offset", "0"))
+        except ValueError:
+            return self._error(400, "offset must be an integer")
+        if not os.path.isfile(path):
+            # a queued job has no telemetry yet: an empty tail at
+            # offset 0 lets consumers poll one loop from submit on
+            return self._send(204, b"", "application/x-ndjson",
+                              {"X-Telemetry-Offset": "0"})
+        data, next_off, rotated = tail_jsonl(path, offset)
+        headers = {"X-Telemetry-Offset": str(next_off),
+                   "X-Telemetry-Records":
+                       str(data.count(b"\n"))}
+        if rotated:
+            headers["X-Telemetry-Rotated"] = "1"
+        self._send(200, data, "application/x-ndjson", headers)
+
+    def _file(self, obs: ObsServer, job_id: str, rel: str):
+        path = obs.artifact_file(job_id, rel)
+        if path is None:
+            return self._error(404, f"no artifact {rel!r}")
+        size = os.path.getsize(path)
+        start, end = 0, size - 1
+        status = 200
+        rng = self.headers.get("Range", "")
+        m = re.match(r"bytes=(\d*)-(\d*)$", rng) if rng else None
+        if m and (m.group(1) or m.group(2)):
+            if m.group(1):
+                start = int(m.group(1))
+                end = int(m.group(2)) if m.group(2) else size - 1
+            else:               # suffix range: last N bytes
+                start = max(0, size - int(m.group(2)))
+            end = min(end, size - 1)
+            if start > end or start >= size:
+                return self._error(416, "unsatisfiable range")
+            status = 206
+        with open(path, "rb") as f:
+            f.seek(start)
+            body = f.read(end - start + 1)
+        headers = {"Accept-Ranges": "bytes"}
+        if status == 206:
+            headers["Content-Range"] = f"bytes {start}-{end}/{size}"
+        self._send(status, body, "application/octet-stream", headers)
+
+    def _profile(self, obs: ObsServer, job_id: str,
+                 query: Dict[str, str]):
+        length = int(self.headers.get("Content-Length") or 0)
+        req: Dict[str, Any] = {}
+        if length:
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                return self._error(400, "body must be JSON")
+        if "chunks" in query:
+            req["chunks"] = query["chunks"]
+        try:
+            req["chunks"] = int(req.get("chunks", 1))
+        except (TypeError, ValueError):
+            return self._error(400, "chunks must be an integer")
+        self._json(obs.arm_profile(job_id, req), code=202)

@@ -1,0 +1,34 @@
+"""Trace correlation: one id joins a job's whole history.
+
+A ``trace_id`` is stamped onto the job record at submit time and rides
+the record file through claim/requeue/stale-reclaim/complete; the
+worker binds it into the job's telemetry recorder (every JSONL record),
+every ``failure_log`` entry, the bench heartbeat sidecars and the
+checkpoint manifest meta — so one grep (or ``tools/trace_report.py``)
+joins submit -> claim -> telemetry -> failure -> artifact across
+however many workers the job bounced through.
+
+Deliberately stdlib-only and leaf-level: ``ensemble/queue.py`` (which
+must stay jax-free) imports this at submit time, and the bench parent
+reads the same env contract without importing ramses_tpu at all.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+#: env override: a driving process (bench parent, CI harness) exports
+#: this so every child it launches lands under ONE pre-known trace id
+ENV_VAR = "RAMSES_TRACE_ID"
+
+
+def new_trace_id() -> str:
+    """A 16-byte random hex id (W3C trace-id width).  :data:`ENV_VAR`
+    wins when set, so a parent can pre-correlate its children."""
+    return os.environ.get(ENV_VAR, "").strip() or uuid.uuid4().hex
+
+
+def worker_id() -> str:
+    """``host:pid`` — the worker identity stamped beside trace ids."""
+    return f"{os.uname().nodename}:{os.getpid()}"

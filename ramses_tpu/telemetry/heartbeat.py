@@ -23,21 +23,37 @@ from typing import Any, Dict, List, Optional
 
 
 class Heartbeat:
-    """Append phase markers to ``path``; no-op when ``path`` is falsy."""
+    """Append phase markers to ``path``; no-op when ``path`` is falsy.
 
-    def __init__(self, path: Optional[str]):
+    ``context`` keyword fields (``trace_id``, ``worker_id``, ...) are
+    merged into every marker, so a sidecar is joinable with the rest
+    of a trace's records (worker telemetry, failure logs) by one id.
+    """
+
+    def __init__(self, path: Optional[str], **context: Any):
         self.path = path or ""
+        self.context = {k: v for k, v in context.items() if v}
         self._t0 = time.monotonic()
 
     @classmethod
-    def from_env(cls, var: str = "BENCH_HEARTBEAT_PATH") -> "Heartbeat":
-        return cls(os.environ.get(var, ""))
+    def from_env(cls, var: str = "BENCH_HEARTBEAT_PATH",
+                 trace_var: str = "RAMSES_TRACE_ID") -> "Heartbeat":
+        """Sidecar path from the parent's env; when the parent also
+        exported a trace id (bench does since the obs plane landed),
+        every marker carries it plus this child's host:pid."""
+        ctx: Dict[str, Any] = {}
+        trace_id = os.environ.get(trace_var, "").strip()
+        if trace_id:
+            ctx["trace_id"] = trace_id
+            ctx["worker_id"] = f"{os.uname().nodename}:{os.getpid()}"
+        return cls(os.environ.get(var, ""), **ctx)
 
     def mark(self, phase: str, **fields: Any):
         if not self.path:
             return
         rec = {"phase": str(phase),
                "t_s": round(time.monotonic() - self._t0, 3)}
+        rec.update(self.context)
         rec.update(fields)
         try:
             with open(self.path, "a") as f:

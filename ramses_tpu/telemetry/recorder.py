@@ -172,6 +172,9 @@ class NullTelemetry:
     def record_event(self, kind, **fields):
         pass
 
+    def bind(self, **fields):
+        pass
+
     def mark_resumed(self, outdir, attempt=1):
         pass
 
@@ -219,6 +222,9 @@ class Telemetry:
         self._prev_showwarning = None
         self._append = False           # resume: keep prior attempts' log
         self._event_counts: Dict[str, int] = {}
+        # correlation fields (trace_id/job/worker — ramses_tpu/obs)
+        # stamped onto every record via setdefault; see bind()
+        self._bound: Dict[str, Any] = {}
         # out-of-core residency totals (&AMR_PARAMS offload) — summed
         # from per-step stats, surfaced flat in the run footer
         self._off_totals: Dict[str, int] = {
@@ -245,14 +251,19 @@ class Telemetry:
             # are populated by now (they are zero at sim construction)
             from ramses_tpu.parallel import dma_halo
             self.run_info.update(dma_halo.traffic_snapshot())
-            self._fh.write(json.dumps({
+            header = {
                 "kind": "run_header",
                 "schema_version": SCHEMA_VERSION,
                 "time_unix": time.time(),
                 "pid": os.getpid(),
                 "telemetry_interval": self.spec.interval,
                 "run_info": self.run_info,
-            }) + "\n")
+            }
+            for k, v in self._bound.items():
+                header.setdefault(k, v)
+            self._fh.write(json.dumps(header) + "\n")
+        for k, v in self._bound.items():
+            rec.setdefault(k, v)
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()               # a killed run still leaves records
 
@@ -473,6 +484,14 @@ class Telemetry:
         rec = {"kind": k}
         rec.update(fields)
         self._write(rec)
+
+    def bind(self, **fields):
+        """Stamp correlation fields (``trace_id``, ``job``,
+        ``worker`` — ramses_tpu/obs) onto every subsequent record:
+        header, steps, events and footer alike.  Applied via
+        ``setdefault`` so an explicit field on any record wins; falsy
+        values are dropped so an unstamped legacy job binds nothing."""
+        self._bound.update({k: v for k, v in fields.items() if v})
 
     def mark_resumed(self, outdir: str, attempt: int = 1):
         """Flip the sink to append mode (must run before the first

@@ -1,0 +1,657 @@
+"""Fleet observability plane (ramses_tpu/obs): streaming results API,
+Prometheus metrics, trace correlation, on-demand profiling.
+
+Covers the PR 19 acceptance pins:
+
+  * submit stamps a trace_id that survives requeue, stale reclaim and
+    every failure_log entry;
+  * /metrics renders valid Prometheus text on a live queue and the
+    reconstructed counters are monotone;
+  * the telemetry tail delivers every record exactly once across
+    incremental writes and detects rotation;
+  * a profile request is consumed exactly once at a chunk boundary and
+    the trace dir becomes a manifest-validated artifact;
+  * arming the whole plane against a drained queue performs ZERO
+    device fetches;
+  * one trace_id joins submit -> claim -> telemetry -> failure_log ->
+    checkpoint manifest across a forced requeue (end-to-end).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.obs import metrics as om
+from ramses_tpu.obs.profile import (PROFILE_FLAG, ProfileRequestWatcher,
+                                    request_profile)
+from ramses_tpu.obs.server import MAX_TAIL_BYTES, ObsServer, tail_jsonl
+from ramses_tpu.obs.trace import ENV_VAR, new_trace_id, worker_id
+from ramses_tpu.resilience.checkpoint import (read_manifest_meta,
+                                              validate_checkpoint,
+                                              write_manifest)
+
+pytestmark = pytest.mark.smoke
+
+HEX32 = set("0123456789abcdef")
+
+
+def _is_trace_id(s):
+    return isinstance(s, str) and len(s) == 32 and set(s) <= HEX32
+
+
+def _get(url, method="GET", data=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.getcode(), dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+class _CapTel:
+    """Telemetry stand-in capturing record_event calls."""
+
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+# ---------------------------------------------------------------------
+# trace correlation (no jax)
+# ---------------------------------------------------------------------
+def test_submit_stamps_trace_id(tmp_path, monkeypatch):
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, "&RUN_PARAMS\n/")
+    rec = jq.job_status(q, jid).record
+    assert _is_trace_id(rec["trace_id"])
+    # two submits never share an id
+    jid2 = jq.submit(q, "&RUN_PARAMS\n/")
+    assert jq.job_status(q, jid2).record["trace_id"] != rec["trace_id"]
+    # a parent pipeline pre-correlates children through the env var
+    monkeypatch.setenv(ENV_VAR, "cafe" * 8)
+    assert new_trace_id() == "cafe" * 8
+    jid3 = jq.submit(q, "&RUN_PARAMS\n/")
+    assert jq.job_status(q, jid3).record["trace_id"] == "cafe" * 8
+    assert ":" in worker_id()
+
+
+def test_trace_id_survives_requeue_and_reclaim(tmp_path):
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-trace")
+    tid = jq.job_status(q, jid).record["trace_id"]
+    tel = _CapTel()
+
+    job = jq.claim(q, worker="w1")
+    jq.requeue(job, error="boom", telemetry=tel)
+    job = jq.claim(q, worker="w2")
+    old = time.time() - 3600
+    os.utime(job.path, (old, old))
+    assert jq.reclaim_stale(q, stale_s=300.0, max_attempts=3,
+                            log=None, telemetry=tel) == 1
+    job = jq.claim(q, worker="w3")
+    jq.fail(job, error="gave up", telemetry=tel)
+
+    rec = jq.job_status(q, jid).record
+    assert rec["trace_id"] == tid
+    stages = [e["stage"] for e in rec["failure_log"]]
+    assert stages == ["requeue", "stale", "fail"]
+    assert all(e["trace_id"] == tid for e in rec["failure_log"])
+    # the queue lifecycle events carry the id too
+    kinds = [k for k, _ in tel.events]
+    assert kinds == ["queue_requeue", "queue_reclaim", "queue_fail"]
+    assert all(f["trace_id"] == tid for _, f in tel.events)
+
+
+# ---------------------------------------------------------------------
+# metrics (no jax)
+# ---------------------------------------------------------------------
+def _synthetic_queue(tmp_path):
+    q = str(tmp_path / "q")
+    jid_done = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-done")
+    job = jq.claim(q, worker="w1")
+    jq.complete(job, result={
+        "queue_wait_s": 1.5, "scenarios_per_device_s": 4.0,
+        "compile_cache_hits": 3, "compile_cache_misses": 1,
+        "cell_updates": 4096, "partial": True,
+        "failed_members": [1], "nmember": 2})
+    jid_run = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-run")
+    running = jq.claim(q, worker="w2")
+    jid_fail = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-fail")
+    job = jq.claim(q, worker="w3")
+    jq.requeue(job, error="flaky")
+    job = jq.claim(q, worker="w3")
+    jq.fail(job, error="dead")
+    jq.submit(q, "&RUN_PARAMS\n/", job_id="job-waiting")
+    # a worker sink whose mtime is the liveness signal
+    wdir = os.path.join(q, om.WORKERS_DIR)
+    os.makedirs(wdir, exist_ok=True)
+    with open(os.path.join(wdir, "w2.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "serve_start", "worker": "w2"}) + "\n")
+        f.write(json.dumps({"kind": "gang_schedule", "jobs": 2,
+                            "busy_frac": 0.75}) + "\n")
+    return q, (jid_done, jid_run, jid_fail), running
+
+
+def test_metrics_roundtrip_and_monotonic(tmp_path):
+    q, _ids, running = _synthetic_queue(tmp_path)
+    text = om.render_queue_metrics(q)
+    assert "# HELP ramses_queue_jobs" in text
+    assert "# TYPE ramses_queue_jobs gauge" in text
+    m = om.parse(text)
+
+    def val(name, **labels):
+        return m[(name, tuple(sorted(labels.items())))]
+
+    assert val("ramses_queue_jobs", state="queued") == 1
+    assert val("ramses_queue_jobs", state="running") == 1
+    assert val("ramses_queue_jobs", state="done") == 1
+    assert val("ramses_queue_jobs", state="failed") == 1
+    assert val("ramses_job_attempts_total") == 4   # 1 + 1 + 2
+    assert val("ramses_failure_events_total", stage="requeue") == 1
+    assert val("ramses_failure_events_total", stage="fail") == 1
+    assert val("ramses_quarantined_members_total") == 1
+    assert val("ramses_jobs_partial_total") == 1
+    assert val("ramses_compile_cache_hits_total") == 3
+    assert val("ramses_compile_cache_misses_total") == 1
+    assert val("ramses_cell_updates_total") == 4096
+    assert val("ramses_queue_wait_seconds_sum") == 1.5
+    assert val("ramses_queue_wait_seconds_count") == 1
+    assert val("ramses_scenarios_per_device_seconds") == 4.0
+    assert val("ramses_job_heartbeat_age_seconds", job="job-run") >= 0
+    assert val("ramses_worker_heartbeat_age_seconds", worker="w2") >= 0
+    assert val("ramses_gang_busy_frac", worker="w2") == 0.75
+
+    # counters reconstructed from durable records are monotone: more
+    # failures can only raise them
+    jq.requeue(running, error="flaky too")
+    m2 = om.parse(om.render_queue_metrics(q))
+    for key, v in m.items():
+        name = key[0]
+        if name.endswith("_total") or name.endswith("_sum") \
+                or name.endswith("_count"):
+            assert m2.get(key, 0.0) >= v, key
+    assert m2[("ramses_failure_events_total",
+               (("stage", "requeue"),))] == 2
+
+
+def test_metrics_label_escaping():
+    fam = om.Family("x_total", "counter", "h")
+    fam.add(1, job='we"ird\\name')
+    text = om.render([fam])
+    parsed = om.parse(text)
+    assert parsed[("x_total", (("job", 'we"ird\\name'),))] == 1.0
+
+
+# ---------------------------------------------------------------------
+# HTTP server (no jax)
+# ---------------------------------------------------------------------
+def test_obs_endpoints(tmp_path):
+    q, (jid_done, jid_run, _), _run = _synthetic_queue(tmp_path)
+    srv = ObsServer(q, port=0).start()
+    try:
+        code, _h, body = _get(srv.url + "/healthz")
+        health = json.loads(body)
+        assert code == 200 and health["ok"] and health["mode"] == "queue"
+        assert health["queue"]["done"] == 1
+
+        code, h, body = _get(srv.url + "/metrics")
+        assert code == 200
+        assert h["Content-Type"].startswith("text/plain; version=0.0.4")
+        assert ("ramses_queue_jobs",
+                (("state", "done"),)) in om.parse(body.decode())
+
+        code, _h, body = _get(srv.url + "/jobs")
+        jobs = {j["id"]: j for j in json.loads(body)["jobs"]}
+        assert code == 200 and len(jobs) == 4
+        assert jobs[jid_done]["state"] == "done"
+        assert jobs[jid_done]["quarantined"] == 1
+        assert _is_trace_id(jobs[jid_run]["trace_id"])
+
+        code, _h, body = _get(srv.url + f"/jobs/{jid_done}")
+        rec = json.loads(body)
+        assert code == 200 and rec["state"] == "done"
+        assert rec["result"]["nmember"] == 2
+
+        assert _get(srv.url + "/jobs/nope")[0] == 404
+        assert _get(srv.url + "/jobs/bad%20id")[0] == 400
+        assert _get(srv.url + "/nothing")[0] == 404
+    finally:
+        srv.close()
+
+
+def test_artifacts_listing_and_range(tmp_path):
+    q, (jid_done, _, _), _run = _synthetic_queue(tmp_path)
+    rdir = jq.results_dir(q, jid_done)
+    ckpt = os.path.join(rdir, "ckpt_000004")
+    os.makedirs(ckpt)
+    with open(os.path.join(ckpt, "state.bin"), "wb") as f:
+        f.write(b"0123456789")
+    write_manifest(ckpt, meta={"kind": "ensemble", "trace_id": "t" * 32})
+    with open(os.path.join(rdir, "run.nml"), "w") as f:
+        f.write("&RUN_PARAMS\n/\n")
+    os.makedirs(os.path.join(rdir, "staging"))   # manifest-less: hidden
+
+    srv = ObsServer(q, port=0).start()
+    try:
+        code, _h, body = _get(srv.url + f"/jobs/{jid_done}/artifacts")
+        art = json.loads(body)
+        assert code == 200
+        assert [d["name"] for d in art["checkpoints"]] == ["ckpt_000004"]
+        d = art["checkpoints"][0]
+        assert d["valid"] and d["meta"]["trace_id"] == "t" * 32
+        assert {f["path"] for f in d["files"]} == {
+            "ckpt_000004/state.bin", "ckpt_000004/manifest.json"}
+        assert {f["path"] for f in art["files"]} == {"run.nml"}
+
+        url = srv.url + f"/jobs/{jid_done}/artifacts/ckpt_000004/state.bin"
+        code, _h, body = _get(url)
+        assert (code, body) == (200, b"0123456789")
+        req = urllib.request.Request(url)
+        req.add_header("Range", "bytes=2-5")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.getcode() == 206 and r.read() == b"2345"
+            assert r.headers["Content-Range"] == "bytes 2-5/10"
+        req = urllib.request.Request(url)
+        req.add_header("Range", "bytes=-3")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.getcode() == 206 and r.read() == b"789"
+        req = urllib.request.Request(url)
+        req.add_header("Range", "bytes=10-")
+        assert _get_req(req)[0] == 416
+        assert _get(srv.url + f"/jobs/{jid_done}/artifacts/none")[0] == 404
+        # traversal out of the results dir is refused at resolution
+        assert srv.artifact_file(jid_done, "../../queued") is None
+        assert srv.artifact_file(
+            jid_done, "../" + jid_done + "/run.nml") is not None
+    finally:
+        srv.close()
+
+
+def _get_req(req):
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.getcode(), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_telemetry_tail_exactly_once(tmp_path):
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-tail")
+    rdir = jq.results_dir(q, jid)
+    os.makedirs(rdir, exist_ok=True)
+    path = os.path.join(rdir, "telemetry.jsonl")
+
+    srv = ObsServer(q, port=0).start()
+    try:
+        # no file yet: 204 with a resumable zero offset
+        code, h, body = _get(srv.url + f"/jobs/{jid}/telemetry")
+        assert code == 204 and h["X-Telemetry-Offset"] == "0"
+
+        lines = [json.dumps({"kind": "step", "nstep": i}) + "\n"
+                 for i in range(5)]
+        with open(path, "w") as f:
+            f.write("".join(lines[:2]))
+        code, h, body = _get(srv.url + f"/jobs/{jid}/telemetry?offset=0")
+        assert code == 200 and h["X-Telemetry-Records"] == "2"
+        off = int(h["X-Telemetry-Offset"])
+        assert body.decode() == "".join(lines[:2]) and off > 0
+
+        # a torn (unterminated) line is withheld until complete
+        with open(path, "a") as f:
+            f.write(lines[2] + '{"kind": "ste')
+        code, h, body = _get(srv.url
+                             + f"/jobs/{jid}/telemetry?offset={off}")
+        assert body.decode() == lines[2]
+        assert "X-Telemetry-Rotated" not in h
+        off = int(h["X-Telemetry-Offset"])
+        with open(path, "a") as f:
+            f.write('p"}\n' + lines[3])
+        code, h, body = _get(srv.url
+                             + f"/jobs/{jid}/telemetry?offset={off}")
+        assert body.decode() == '{"kind": "step"}\n' + lines[3]
+        off = int(h["X-Telemetry-Offset"])
+
+        # rotation (a fresh attempt truncated the file): offset beyond
+        # EOF restarts from 0 and says so
+        with open(path, "w") as f:
+            f.write(lines[4])
+        code, h, body = _get(srv.url
+                             + f"/jobs/{jid}/telemetry?offset={off}")
+        assert h.get("X-Telemetry-Rotated") == "1"
+        assert body.decode() == lines[4]
+
+        assert _get(srv.url + f"/jobs/{jid}/telemetry?offset=x")[0] == 400
+    finally:
+        srv.close()
+
+
+def test_tail_jsonl_respects_max_bytes(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        for i in range(100):
+            f.write(json.dumps({"i": i, "pad": "x" * 64}) + "\n")
+    assert MAX_TAIL_BYTES >= 1 << 20
+    seen, off = [], 0
+    while True:
+        data, off, rot = tail_jsonl(path, off, max_bytes=256)
+        assert not rot
+        if not data:
+            break
+        seen.extend(json.loads(ln)["i"]
+                    for ln in data.decode().splitlines())
+    assert seen == list(range(100))   # exactly once, in order
+
+
+# ---------------------------------------------------------------------
+# on-demand profiling (fake capture hook; no jax profiler)
+# ---------------------------------------------------------------------
+class _FakeProfile:
+    opened = []
+
+    def __init__(self, outdir):
+        self.outdir = outdir
+
+    def __enter__(self):
+        os.makedirs(self.outdir, exist_ok=True)
+        with open(os.path.join(self.outdir, "trace.pb"), "wb") as f:
+            f.write(b"fake-trace")
+        _FakeProfile.opened.append(self.outdir)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_profile_watcher_chunk_boundary(tmp_path, monkeypatch):
+    monkeypatch.setattr(ProfileRequestWatcher, "_profile_cm",
+                        staticmethod(_FakeProfile))
+    _FakeProfile.opened = []
+    rdir = str(tmp_path / "results")
+    tel = _CapTel()
+    w = ProfileRequestWatcher(rdir)
+    w.poll(tel)                       # no request pending: no-op
+    assert not w.active and tel.events == []
+
+    flag = request_profile(rdir, chunks=2)
+    assert os.path.basename(flag) == PROFILE_FLAG
+    w.poll(tel)                       # chunk boundary: capture opens
+    assert w.active and not os.path.exists(flag)   # consumed once
+    assert tel.events[-1][0] == "profile_start"
+    assert tel.events[-1][1]["chunks"] == 2
+    w.poll(tel)                       # armed chunk 1 of 2
+    assert w.active
+    w.poll(tel)                       # chunk 2: capture closes
+    assert not w.active
+    assert tel.events[-1][0] == "profile_captured"
+    tdir = tel.events[-1][1]["trace_dir"]
+    assert _FakeProfile.opened == [tdir]
+    # the trace dir is a manifest-validated artifact
+    ok, why = validate_checkpoint(tdir, verify_hash=True)
+    assert ok, why
+    assert read_manifest_meta(tdir)["kind"] == "profile"
+    # one request = one capture: nothing re-arms
+    w.poll(tel)
+    assert not w.active and len(_FakeProfile.opened) == 1
+
+
+def test_profile_stop_closes_midflight_capture(tmp_path, monkeypatch):
+    monkeypatch.setattr(ProfileRequestWatcher, "_profile_cm",
+                        staticmethod(_FakeProfile))
+    rdir = str(tmp_path / "results")
+    w = ProfileRequestWatcher(rdir)
+    request_profile(rdir, chunks=100)
+    w.poll()
+    assert w.active
+    w.stop()                          # job ended mid-capture
+    assert not w.active
+    assert validate_checkpoint(w.trace_dir, verify_hash=False)[0]
+
+
+def test_profile_post_arms_flag(tmp_path):
+    q, (jid_done, _, _), _run = _synthetic_queue(tmp_path)
+    srv = ObsServer(q, port=0).start()
+    try:
+        code, _h, body = _get(srv.url + f"/jobs/{jid_done}/profile",
+                              method="POST",
+                              data=json.dumps({"chunks": 3}).encode())
+        assert code == 202 and json.loads(body)["armed"]
+        flag = os.path.join(jq.results_dir(q, jid_done), PROFILE_FLAG)
+        with open(flag) as f:
+            assert json.load(f)["chunks"] == 3
+        assert _get(srv.url + f"/jobs/{jid_done}/profile?chunks=x",
+                    method="POST")[0] == 400
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# worker sink + heartbeat sidecar
+# ---------------------------------------------------------------------
+def test_serve_idle_worker_sink(tmp_path):
+    from ramses_tpu.ensemble.service import serve
+    q = str(tmp_path / "q")
+    counts = serve(q, worker="idle:w", idle_exit=True,
+                   log=lambda *a: None)
+    assert counts == {"done": 0, "failed": 0, "requeued": 0}
+    path = os.path.join(q, om.WORKERS_DIR, "idle_w.jsonl")
+    recs = [json.loads(ln) for ln in open(path)]
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "run_header"
+    assert "serve_start" in kinds and "serve_exit" in kinds
+    idle = next(r for r in recs if r["kind"] == "serve_idle")
+    assert idle["exiting"] and idle["queued"] == 0
+    # every record is stamped with the worker identity (bind())
+    assert all(r.get("worker") == "idle:w" for r in recs)
+
+
+def test_bench_heartbeat_from_env_trace(tmp_path, monkeypatch):
+    from ramses_tpu.telemetry.heartbeat import Heartbeat
+    hb_path = str(tmp_path / "hb.jsonl")
+    monkeypatch.setenv("BENCH_HEARTBEAT_PATH", hb_path)
+    monkeypatch.setenv(ENV_VAR, "beef" * 8)
+    hb = Heartbeat.from_env()
+    hb.mark("lower", name="sedov3d")
+    rec = json.loads(open(hb_path).read().splitlines()[-1])
+    assert rec["trace_id"] == "beef" * 8
+    assert ":" in rec["worker_id"]
+    assert rec["phase"] == "lower" and rec["name"] == "sedov3d"
+
+
+# ---------------------------------------------------------------------
+# report tooling
+# ---------------------------------------------------------------------
+def test_telemetry_report_service_offload_sections(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+    recs = [
+        {"kind": "run_header", "schema_version": 3, "time_unix": 100.0,
+         "trace_id": "ab" * 16, "job": "job-x", "worker": "w1",
+         "run_info": {"driver": "ensemble", "ndev": 8, "nmember": 4}},
+        {"kind": "gang_schedule", "jobs": 2, "busy_devices": 6,
+         "ndev": 8, "busy_frac": 0.75},
+        {"kind": "serve_idle", "queued": 1, "running": 2, "done": 3,
+         "failed": 0},
+        {"kind": "job_summary", "queue_wait_s": 2.5,
+         "scenarios_per_device_s": 1.25, "busy_frac": 0.75,
+         "nmember": 4, "compile_cache_hits": 7},
+        {"kind": "run_footer", "wall_s": 9.0, "offload_stalls": 2,
+         "offload_prefetches": 11, "offload_overlap_frac": 0.8,
+         "offload_bytes_parked": 1024},
+    ]
+    md = telemetry_report.render(recs)
+    assert "| trace_id | " + "ab" * 16 in md
+    assert "## Service" in md
+    assert "| queue_wait_s | 2.5 |" in md
+    assert "| scenarios_per_device_s | 1.25 |" in md
+    assert "busy_frac=0.75" in md
+    assert "idle beats | 1" in md and "queued=1" in md
+    assert "## Offload" in md
+    assert "| offload_stalls | 2 |" in md
+
+
+def test_trace_report_timeline(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import trace_report
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-span")
+    tid = jq.job_status(q, jid).record["trace_id"]
+    job = jq.claim(q, worker="w1")
+    jq.requeue(job, error="first try")
+    job = jq.claim(q, worker="w2")
+    rdir = jq.results_dir(q, jid)
+    os.makedirs(rdir, exist_ok=True)
+    t0 = job.record["claimed_unix"]
+    with open(os.path.join(rdir, "telemetry.jsonl"), "w") as f:
+        for rec in [
+                {"kind": "run_header", "time_unix": t0, "trace_id": tid},
+                {"kind": "ensemble_chunk", "nstep_max": 2, "wall_s": 1.0},
+                {"kind": "ensemble_chunk", "nstep_max": 4, "wall_s": 2.5},
+                {"kind": "ensemble_done"}]:
+            f.write(json.dumps(rec) + "\n")
+    ckpt = os.path.join(rdir, "ckpt_000004")
+    os.makedirs(ckpt)
+    write_manifest(ckpt, meta={"kind": "ensemble", "trace_id": tid})
+    jq.complete(job, result={"ok": True})
+
+    md = trace_report.render(
+        trace_report._find_record(q, jid),
+        trace_report._load_jsonl(os.path.join(rdir, "telemetry.jsonl")),
+        trace_report._manifest_traces(rdir))
+    assert f"`{tid}`" in md
+    assert "queue wait" in md and "## Timeline" in md
+    assert "a1 chunk -> nstep 2 (incl. compile)" in md
+    assert "a1 chunk -> nstep 4" in md
+    assert "continuity: one id across 3 source(s)" in md
+    assert "requeue (attempt 1)" in md
+    # a foreign manifest id flips the audit to a mismatch
+    write_manifest(ckpt, meta={"kind": "ensemble", "trace_id": "f" * 32})
+    md = trace_report.render(
+        trace_report._find_record(q, jid),
+        trace_report._load_jsonl(os.path.join(rdir, "telemetry.jsonl")),
+        trace_report._manifest_traces(rdir))
+    assert "TRACE MISMATCH" in md
+
+
+# ---------------------------------------------------------------------
+# end-to-end: one trace id across a forced requeue (jax, 2D hydro)
+# ---------------------------------------------------------------------
+SERVICE_NML = "\n".join([
+    "&RUN_PARAMS", "hydro=.true.", "nstepmax=4", "/",
+    "&AMR_PARAMS", "levelmin=4", "levelmax=4", "boxlen=1.0", "/",
+    "&INIT_PARAMS", "nregion=2",
+    "region_type(1)='square'", "region_type(2)='point'",
+    "x_center=0.5,0.5", "y_center=0.5,0.5",
+    "length_x=10.0,1.0", "length_y=10.0,1.0",
+    "exp_region=10.0,10.0", "d_region=1.0,0.0", "p_region=1e-5,0.1", "/",
+    "&HYDRO_PARAMS", "gamma=1.4", "riemann='hllc'", "/",
+    "&OUTPUT_PARAMS", "tend=1e9", "/",
+    "&ENSEMBLE_PARAMS", "nmember=2", "perturb_amp=0.01",
+    "chunk_steps=2", "/",
+])
+
+
+def test_end_to_end_trace_joins_all_artifacts(tmp_path):
+    from ramses_tpu.ensemble.service import serve
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, SERVICE_NML, ndim=2, dtype="float64")
+    tid = jq.job_status(q, jid).record["trace_id"]
+    assert _is_trace_id(tid)
+
+    # force one failed attempt before the real run: claim + requeue
+    job = jq.claim(q, worker="flaky")
+    jq.requeue(job, error="injected: worker evicted")
+
+    counts = serve(q, worker="steady", idle_exit=True, max_attempts=3,
+                   log=lambda *a: None)
+    assert counts["done"] == 1
+
+    job = jq.job_status(q, jid)
+    assert job.state == "done"
+    rec = job.record
+    assert rec["trace_id"] == tid
+    assert [e["stage"] for e in rec["failure_log"]] == ["requeue"]
+    assert rec["failure_log"][0]["trace_id"] == tid
+
+    # every telemetry record carries the bound id
+    res = rec["result"]
+    recs = [json.loads(ln) for ln in open(res["telemetry"])]
+    assert recs and all(r.get("trace_id") == tid for r in recs)
+    assert all(r.get("job") == jid for r in recs)
+    kinds = [r["kind"] for r in recs]
+    assert "run_header" in kinds and "job_summary" in kinds
+    summary = next(r for r in recs if r["kind"] == "job_summary")
+    assert summary["queue_wait_s"] >= 0
+    assert summary["scenarios_per_device_s"] > 0
+
+    # the checkpoint manifest meta carries it too
+    meta = read_manifest_meta(res["snapshot"])
+    assert meta["trace_id"] == tid and meta["job"] == jid
+
+    # serve produced the worker sink with lifecycle events
+    wpath = os.path.join(q, om.WORKERS_DIR, "steady.jsonl")
+    wkinds = [json.loads(ln)["kind"] for ln in open(wpath)]
+    assert "serve_start" in wkinds and "serve_exit" in wkinds
+
+    # ---- zero-added-device-fetch pin: arm the whole plane against
+    # this live queue dir and count device transfers
+    import jax
+    fetches = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        fetches["n"] += 1
+        return real(x)
+
+    srv = ObsServer(q, port=0).start()
+    try:
+        jax.device_get = counting
+        assert _get(srv.url + "/healthz")[0] == 200
+        assert _get(srv.url + "/metrics")[0] == 200
+        assert _get(srv.url + "/jobs")[0] == 200
+        assert _get(srv.url + f"/jobs/{jid}")[0] == 200
+        assert _get(srv.url + f"/jobs/{jid}/telemetry")[0] == 200
+        assert _get(srv.url + f"/jobs/{jid}/artifacts")[0] == 200
+    finally:
+        jax.device_get = real
+        srv.close()
+    assert fetches["n"] == 0
+
+    # the scrape sees the forced requeue and the completed job
+    m = om.parse(om.render_queue_metrics(q))
+    assert m[("ramses_failure_events_total",
+              (("stage", "requeue"),))] == 1
+    assert m[("ramses_queue_jobs", (("state", "done"),))] == 1
+
+
+def test_results_mode_serves_single_run(tmp_path):
+    """Pointed at a plain output dir the server exposes pseudo-job
+    ``run`` (covers ``&OUTPUT_PARAMS obs_port`` on a solo run)."""
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    with open(os.path.join(out, "run.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "run_header"}) + "\n")
+    srv = ObsServer(out, port=0).start()
+    try:
+        code, _h, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["mode"] == "results"
+        code, _h, body = _get(srv.url + "/jobs")
+        assert [j["id"] for j in json.loads(body)["jobs"]] == ["run"]
+        code, h, body = _get(srv.url + "/jobs/run/telemetry")
+        assert code == 200 and h["X-Telemetry-Records"] == "1"
+        code, _h, body = _get(srv.url + "/metrics")
+        assert b"ramses_obs_results_mode 1" in body
+    finally:
+        srv.close()

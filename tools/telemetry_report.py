@@ -64,10 +64,19 @@ def render(recs: List[Dict[str, Any]], source: str = "") -> str:
     out.append("| key | value |")
     out.append("|---|---|")
     out.append(f"| schema | {header.get('schema_version', '-')} |")
+    for k in ("trace_id", "job", "worker"):
+        if header.get(k):
+            out.append(f"| {k} | {header[k]} |")
     for k in ("driver", "ndev", "ndim", "levelmin", "levelmax",
-              "boxlen", "nvar"):
+              "boxlen", "nvar", "nmember", "ngroup", "halo_backend",
+              "halo_bytes", "halo_exchanges", "halo_overlap_frac",
+              "offload", "offload_hbm_budget_mb"):
         if k in info:
             out.append(f"| {k} | {info[k]} |")
+    packing = info.get("packing")
+    if isinstance(packing, dict):
+        out.append(f"| packing | {packing.get('mode', '-')} over "
+                   f"{len(packing.get('device_ids') or [])} device(s) |")
     out.append(f"| interval | {header.get('telemetry_interval', '-')} |")
     out.append(f"| step records | {len(steps)} |")
     if footer:
@@ -139,6 +148,55 @@ def render(recs: List[Dict[str, Any]], source: str = "") -> str:
         for w in warns[:50]:
             src = f" ({w['source']})" if w.get("source") else ""
             out.append(f"- {w.get('msg', '')}{src}")
+        out.append("")
+
+    # run-service economics (PR 18 packing fields): the job_summary
+    # event each completed queue job emits, plus the worker's last
+    # gang_schedule and the idle-heartbeat census
+    summaries = [r for r in events if r.get("kind") == "job_summary"]
+    gangs = [r for r in events if r.get("kind") == "gang_schedule"]
+    idles = [r for r in events if r.get("kind") == "serve_idle"]
+    if summaries or gangs or idles:
+        out.append("## Service")
+        out.append("")
+        out.append("| key | value |")
+        out.append("|---|---|")
+        if summaries:
+            s = summaries[-1]
+            for k in ("queue_wait_s", "scenarios_per_device_s",
+                      "busy_frac", "gang_jobs", "nmember",
+                      "quarantined", "compile_cache_hits",
+                      "compile_cache_misses"):
+                if k in s:
+                    out.append(f"| {k} | {_fmt(s[k])} |")
+        if gangs:
+            g = gangs[-1]
+            out.append(f"| last gang | {_fmt(g.get('jobs'))} job(s), "
+                       f"{_fmt(g.get('busy_devices'))}/"
+                       f"{_fmt(g.get('ndev'))} devices, "
+                       f"busy_frac={_fmt(g.get('busy_frac'))} |")
+        if idles:
+            last = idles[-1]
+            out.append(f"| idle beats | {len(idles)} (last census: "
+                       f"queued={_fmt(last.get('queued'))} "
+                       f"running={_fmt(last.get('running'))} "
+                       f"done={_fmt(last.get('done'))} "
+                       f"failed={_fmt(last.get('failed'))}) |")
+        out.append("")
+
+    # out-of-core residency footer totals (&AMR_PARAMS offload)
+    if any(k.startswith("offload_") for k in footer):
+        out.append("## Offload")
+        out.append("")
+        out.append("| key | value |")
+        out.append("|---|---|")
+        for k in ("offload_stalls", "offload_prefetches",
+                  "offload_fetches", "offload_overlapped",
+                  "offload_overlap_frac", "offload_bytes_parked",
+                  "offload_bytes_fetched",
+                  "offload_device_hwm_bytes"):
+            if k in footer:
+                out.append(f"| {k} | {_fmt(footer[k])} |")
         out.append("")
 
     if events:

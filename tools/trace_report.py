@@ -1,0 +1,265 @@
+"""Per-job span timeline from one trace id's artifacts.
+
+Joins everything a ``trace_id`` (ramses_tpu/obs/trace) was stamped
+into — the queue record (submit/claim/finish times, failure_log), the
+job's telemetry JSONL (attempt headers, chunk cadence, resilience and
+profile events) and its checkpoint manifests — into one markdown
+timeline: queue wait, per-attempt chunk spans (the first chunk carries
+the compile), hang/requeue/stale point events, quarantines, profile
+captures.  Stdlib-only so CI and jax-free consoles can run it.
+
+Usage::
+
+    python tools/trace_report.py QUEUE_DIR JOB_ID [-o REPORT.md]
+    python tools/trace_report.py --jsonl RUN.jsonl [--record REC.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+BAR_WIDTH = 50
+
+
+def _load_jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _find_record(queue_dir: str, job_id: str
+                 ) -> Optional[Dict[str, Any]]:
+    for state in ("queued", "running", "done", "failed"):
+        path = os.path.join(queue_dir, state, job_id + ".json")
+        if os.path.isfile(path):
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                rec["_state"] = state
+                return rec
+            except (OSError, ValueError):
+                return None
+    return None
+
+
+def _manifest_traces(rdir: str) -> List[Tuple[str, str]]:
+    """``[(checkpoint_name, trace_id), ...]`` from manifest metas."""
+    out: List[Tuple[str, str]] = []
+    try:
+        names = sorted(os.listdir(rdir))
+    except OSError:
+        return out
+    for name in names:
+        mpath = os.path.join(rdir, name, "manifest.json")
+        if not os.path.isfile(mpath):
+            continue
+        try:
+            with open(mpath) as f:
+                meta = dict(json.load(f).get("meta") or {})
+        except (OSError, ValueError):
+            continue
+        out.append((name, str(meta.get("trace_id", ""))))
+    return out
+
+
+def build_spans(record: Optional[Dict[str, Any]],
+                recs: List[Dict[str, Any]]
+                ) -> Tuple[List[Dict[str, Any]],
+                           List[Dict[str, Any]], float]:
+    """(spans, point_events, t0_unix).  Spans/events carry start/dur
+    (or t) in seconds relative to t0 — the submit time when known,
+    else the first telemetry header."""
+    headers = [r for r in recs if r.get("kind") == "run_header"]
+    t0 = None
+    if record and record.get("submitted_unix"):
+        t0 = float(record["submitted_unix"])
+    elif headers:
+        t0 = float(headers[0].get("time_unix") or 0.0)
+    if not t0:
+        t0 = 0.0
+    spans: List[Dict[str, Any]] = []
+    points: List[Dict[str, Any]] = []
+
+    if record:
+        sub = float(record.get("submitted_unix") or 0.0)
+        claimed = float(record.get("claimed_unix") or 0.0)
+        if sub and claimed:
+            spans.append({"label": "queue wait", "start": sub - t0,
+                          "dur": max(0.0, claimed - sub)})
+        fin = float(record.get("finished_unix") or 0.0)
+        if claimed and fin:
+            spans.append({"label": f"claimed -> {record.get('_state', 'finished')}",
+                          "start": claimed - t0,
+                          "dur": max(0.0, fin - claimed)})
+        for entry in record.get("failure_log") or []:
+            tu = float(entry.get("time_unix") or 0.0)
+            if tu:
+                points.append({"label": f"{entry.get('stage', '?')} "
+                                        f"(attempt {entry.get('attempt')})",
+                               "t": tu - t0})
+
+    # attempts = header-delimited segments of the (append-mode) JSONL;
+    # chunk spans come from the cumulative engine wall_s each
+    # ensemble_chunk carries
+    attempt = 0
+    head_t = None
+    prev_wall = 0.0
+    for r in recs:
+        kind = r.get("kind")
+        if kind == "run_header":
+            attempt += 1
+            head_t = float(r.get("time_unix") or 0.0)
+            prev_wall = 0.0
+            continue
+        if head_t is None:
+            continue
+        if kind == "ensemble_chunk":
+            wall = float(r.get("wall_s") or 0.0)
+            dur = max(0.0, wall - prev_wall)
+            label = (f"a{attempt} chunk -> nstep "
+                     f"{r.get('nstep_max', '?')}")
+            if prev_wall == 0.0:
+                label += " (incl. compile)"
+            spans.append({"label": label,
+                          "start": head_t - t0 + prev_wall,
+                          "dur": dur})
+            prev_wall = wall
+        elif kind in ("resume", "rollback", "hang", "fault",
+                      "quarantine", "profile_start",
+                      "profile_captured", "ensemble_done",
+                      "job_summary"):
+            points.append({"label": f"a{attempt} {kind}",
+                           "t": head_t - t0 + prev_wall})
+    return spans, points, t0
+
+
+def _bar(start: float, dur: float, total: float) -> str:
+    if total <= 0.0:
+        return ""
+    a = int(round(BAR_WIDTH * max(0.0, start) / total))
+    b = max(1, int(round(BAR_WIDTH * dur / total)))
+    return "." * min(a, BAR_WIDTH - 1) \
+        + "#" * min(b, BAR_WIDTH - min(a, BAR_WIDTH - 1))
+
+
+def render(record: Optional[Dict[str, Any]],
+           recs: List[Dict[str, Any]],
+           manifests: List[Tuple[str, str]],
+           source: str = "") -> str:
+    spans, points, _t0 = build_spans(record, recs)
+    trace_rec = str((record or {}).get("trace_id", ""))
+    trace_tel = next((str(r.get("trace_id")) for r in recs
+                      if r.get("trace_id")), "")
+    trace_id = trace_rec or trace_tel
+
+    out = ["# Trace report", ""]
+    if source:
+        out.append(f"Source: `{source}`")
+        out.append("")
+    out.append(f"- trace_id: `{trace_id or '(unstamped)'}`")
+    if record:
+        out.append(f"- job: `{record.get('id', '?')}` "
+                   f"[{record.get('_state', '?')}] "
+                   f"attempts={record.get('attempts', 0)} "
+                   f"worker=`{record.get('worker', '')}`")
+    # continuity audit: every artifact that carries a trace id must
+    # carry THE id — a mismatch means a results dir was reused or a
+    # worker dropped the binding
+    sources = {"record": trace_rec, "telemetry": trace_tel}
+    for name, tid in manifests:
+        sources[f"manifest:{name}"] = tid
+    stamped = {k: v for k, v in sources.items() if v}
+    distinct = set(stamped.values())
+    if len(distinct) > 1:
+        out.append(f"- **TRACE MISMATCH** across {sorted(stamped)}: "
+                   f"{sorted(distinct)}")
+    elif stamped:
+        out.append(f"- continuity: one id across "
+                   f"{len(stamped)} source(s) "
+                   f"({', '.join(sorted(stamped))})")
+    out.append("")
+
+    if spans:
+        end = max(s["start"] + s["dur"] for s in spans)
+        out.append("## Timeline")
+        out.append("")
+        out.append("| span | start [s] | dur [s] | |")
+        out.append("|---|---|---|---|")
+        for s in sorted(spans, key=lambda s: s["start"]):
+            out.append(f"| {s['label']} | {s['start']:.3f} "
+                       f"| {s['dur']:.3f} "
+                       f"| `{_bar(s['start'], s['dur'], end)}` |")
+        out.append("")
+    if points:
+        out.append("## Events")
+        out.append("")
+        for p in sorted(points, key=lambda p: p["t"]):
+            out.append(f"- t={p['t']:.3f}s {p['label']}")
+        out.append("")
+    if not spans and not points:
+        out.append("(no spans — job not yet claimed, or telemetry "
+                   "missing)")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("queue_dir", nargs="?", default=None,
+                    help="queue directory (with JOB_ID)")
+    ap.add_argument("job_id", nargs="?", default=None)
+    ap.add_argument("--jsonl", default=None,
+                    help="render a telemetry JSONL directly")
+    ap.add_argument("--record", default=None,
+                    help="with --jsonl: the job record JSON")
+    ap.add_argument("-o", "--out", default=None)
+    args = ap.parse_args(argv)
+
+    record = None
+    manifests: List[Tuple[str, str]] = []
+    if args.jsonl:
+        recs = _load_jsonl(args.jsonl)
+        source = args.jsonl
+        if args.record:
+            try:
+                with open(args.record) as f:
+                    record = json.load(f)
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"{args.record}: {e}")
+    else:
+        if not (args.queue_dir and args.job_id):
+            ap.error("QUEUE_DIR JOB_ID (or --jsonl) required")
+        record = _find_record(args.queue_dir, args.job_id)
+        if record is None:
+            raise SystemExit(f"{args.queue_dir}: no job {args.job_id}")
+        rdir = os.path.join(args.queue_dir, "results", args.job_id)
+        recs = _load_jsonl(os.path.join(rdir, "telemetry.jsonl"))
+        manifests = _manifest_traces(rdir)
+        source = f"{args.queue_dir} :: {args.job_id}"
+    md = render(record, recs, manifests, source=source)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md + "\n")
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(md + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
