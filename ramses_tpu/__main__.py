@@ -21,7 +21,10 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", metavar="QUEUE_DIR", default=None,
                     help="run-service worker: claim jobs from this "
                          "queue dir and run them under the supervised "
-                         "ensemble engine (ramses_tpu/ensemble)")
+                         "ensemble engine (ramses_tpu/ensemble); "
+                         "SIGTERM drains gracefully — finish the "
+                         "chunk, checkpoint, requeue held jobs with "
+                         "stage=drain, exit 0")
     ap.add_argument("--submit", metavar="QUEUE_DIR", default=None,
                     help="enqueue the namelist as a job instead of "
                          "running it; prints the job id")

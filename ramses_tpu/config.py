@@ -469,6 +469,15 @@ class EnsembleParams:
     compile_deadline_s: float = 0.0
     step_deadline_s: float = 0.0
     io_deadline_s: float = 0.0
+    # disk-pressure degradation (resilience/diskguard): free-space
+    # watermarks [MiB] on the job's results filesystem.  Below
+    # disk_soft_free_mb the per-chunk checkpoint beat is shed (the run
+    # keeps stepping; an io_degraded event + Prometheus gauge say so);
+    # the worker-level hard watermark additionally pauses new claims.
+    # 0 disables; RAMSES_DISK_SOFT_MB / RAMSES_DISK_HARD_MB env vars
+    # override per worker
+    disk_soft_free_mb: float = 0.0
+    disk_hard_free_mb: float = 0.0
 
 
 @dataclass

@@ -634,6 +634,10 @@ class EnsembleEngine:
             # already checkpointed, so a sigterm@K resume restarts
             # at nstep >= K and strict arming prevents a re-fire
             self._fault.maybe_signal(self.nstep)
+            # zombie@K: stall the host thread past stale_timeout,
+            # then resume — the queue's fencing token must refuse
+            # this worker's writes from here on
+            self._fault.maybe_zombie(self.nstep)
         t0 = time.perf_counter()
         pending: List[Tuple[SubBatch, np.ndarray, Any, Any]] = []
         for g in self.groups:
@@ -893,36 +897,51 @@ class EnsembleEngine:
         final = os.path.join(base_dir, f"output_{self._iout:05d}")
         stage = final + ".tmp"
         os.makedirs(stage, exist_ok=True)
-        arrays: Dict[str, np.ndarray] = {}
-        for gi, g in enumerate(self.groups):
-            for ci, comp in enumerate(g.state):
-                arrays[f"g{gi}_s{ci}"] = np.asarray(comp)
-            arrays[f"g{gi}_t"] = np.asarray(g.t)
-            arrays[f"g{gi}_nstep"] = g.nstep
-        np.savez(os.path.join(stage, "ensemble_state.npz"), **arrays)
-        census = {str(k): v for k, v in sorted(self.quarantined.items())}
-        with open(os.path.join(stage, "ensemble.json"), "w") as f:
-            json.dump({"fingerprint": self.spec.fingerprint(),
-                       "nmember": self.nmember,
-                       "solver": self.spec.solver,
-                       "groups": [g.members for g in self.groups],
-                       "quarantined": census,
-                       # informational: the packing the checkpoint was
-                       # written under.  State arrays are saved
-                       # host-global, so restore is elastic across
-                       # packings — from_checkpoint re-places under
-                       # whatever plan the restoring worker passes.
-                       "packing": self.plan.describe(),
-                       "iout": self._iout}, f, indent=1)
-        meta = {"kind": "ensemble", "iout": self._iout,
-                "nstep": self.nstep, "t": self.t,
-                "nmember": self.nmember, **self.trace_meta}
-        if census:
-            # per-member quarantine census in the manifest meta: the
-            # durable record (read_quarantine_census) of which members
-            # were evicted, with reason/nstep/t
-            meta["quarantined"] = census
-        snap = finalize_checkpoint(stage, final, meta)
+        try:
+            if self._fault is not None:
+                # enospc@K: the staging write raises OSError(ENOSPC)
+                # — diskguard absorbs it one layer up
+                self._fault.maybe_enospc(self.nstep)
+            arrays: Dict[str, np.ndarray] = {}
+            for gi, g in enumerate(self.groups):
+                for ci, comp in enumerate(g.state):
+                    arrays[f"g{gi}_s{ci}"] = np.asarray(comp)
+                arrays[f"g{gi}_t"] = np.asarray(g.t)
+                arrays[f"g{gi}_nstep"] = g.nstep
+            np.savez(os.path.join(stage, "ensemble_state.npz"),
+                     **arrays)
+            census = {str(k): v
+                      for k, v in sorted(self.quarantined.items())}
+            with open(os.path.join(stage, "ensemble.json"), "w") as f:
+                json.dump({"fingerprint": self.spec.fingerprint(),
+                           "nmember": self.nmember,
+                           "solver": self.spec.solver,
+                           "groups": [g.members for g in self.groups],
+                           "quarantined": census,
+                           # informational: the packing the checkpoint
+                           # was written under.  State arrays are saved
+                           # host-global, so restore is elastic across
+                           # packings — from_checkpoint re-places under
+                           # whatever plan the restoring worker passes.
+                           "packing": self.plan.describe(),
+                           "iout": self._iout}, f, indent=1)
+            meta = {"kind": "ensemble", "iout": self._iout,
+                    "nstep": self.nstep, "t": self.t,
+                    "nmember": self.nmember, **self.trace_meta}
+            if census:
+                # per-member quarantine census in the manifest meta:
+                # the durable record (read_quarantine_census) of which
+                # members were evicted, with reason/nstep/t
+                meta["quarantined"] = census
+            snap = finalize_checkpoint(stage, final, meta)
+        except OSError:
+            # a failed staging write (ENOSPC, dying disk) must not
+            # leave a half-staged output_NNNNN.tmp behind — remove it
+            # and retract the iout bump so the next save reuses it
+            import shutil
+            shutil.rmtree(stage, ignore_errors=True)
+            self._iout -= 1
+            raise
         self._dirty = False
         self._last_snap = os.path.abspath(snap)
         return snap

@@ -1,0 +1,366 @@
+"""Poison-config circuit breaker for the run-service queue.
+
+Member quarantine (the isolation ladder) contains bad *sweep points*
+inside a job; nothing contains a job whose frozen config kills the
+worker *process* — every worker in the fleet burns an attempt on it,
+the job bounces with backoff, and the fleet spends its life
+crash-looping one namelist.  The breaker closes that hole with the
+classic pattern: failures are counted per **frozen-config
+fingerprint** (namelist text + sweeps + solver + ndim + dtype + kind),
+and after N failures at the same normalized stage (``"crash"`` vs
+``"hang"``) across at least ``min_workers`` distinct workers, the
+breaker **trips**: matching queued jobs are parked (``parked/`` state
+dir) with the breaker verdict appended to their ``failure_log``, and
+no worker claims them.
+
+State machine per fingerprint, stored as
+``<queue_dir>/breakers/<fp>.json``:
+
+* ``closed`` — counting; trips at the threshold.
+* ``open`` — matching jobs are parked on sight.  After ``ttl_s`` the
+  sweeper **half-opens** it.
+* ``half_open`` — exactly one parked probe job is released back to
+  ``queued/``.  If the probe fails, the breaker snaps back open (fresh
+  TTL); if any matching job completes, the breaker closes and all
+  remaining parked twins are released.
+
+Operator override: ``tools/queue_fsck.py --reset-breaker <fp|all>``
+half-opens immediately.  Knobs (worker-side env):
+``RAMSES_BREAKER_N`` (failure threshold, default 3, ``0`` disables),
+``RAMSES_BREAKER_MIN_WORKERS`` (default 2 — a single flaky host can't
+trip it alone), ``RAMSES_BREAKER_TTL_S`` (default 3600).
+
+Everything is stdlib + the jax-free queue module; state writes go
+through the queue's tmp+fsync+replace so a torn breaker file can't
+exist (and fsck sweeps the tmps if the process dies mid-write).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ramses_tpu.ensemble import queue as jq
+
+BREAKERS_DIR = "breakers"
+
+DEFAULT_FAILURES = 3
+DEFAULT_MIN_WORKERS = 2
+DEFAULT_TTL_S = 3600.0
+
+#: gauge encoding shared with obs/metrics: closed=0 half_open=1 open=2
+STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _env_num(name: str, default, cast):
+    try:
+        raw = os.environ.get(name)
+        return cast(raw) if raw not in (None, "") else default
+    except (TypeError, ValueError):
+        return default
+
+
+def _knobs(failures=None, min_workers=None, ttl_s=None):
+    if failures is None:
+        failures = _env_num("RAMSES_BREAKER_N", DEFAULT_FAILURES, int)
+    if min_workers is None:
+        min_workers = _env_num("RAMSES_BREAKER_MIN_WORKERS",
+                               DEFAULT_MIN_WORKERS, int)
+    if ttl_s is None:
+        ttl_s = _env_num("RAMSES_BREAKER_TTL_S", DEFAULT_TTL_S, float)
+    return int(failures), max(1, int(min_workers)), float(ttl_s)
+
+
+def config_fingerprint(record: Dict[str, Any]) -> str:
+    """Stable fingerprint of everything that makes two jobs the *same
+    run configuration*: namelist text, explicit sweeps, solver, ndim,
+    dtype, kind.  Worker identity, attempts, ids and timestamps are
+    deliberately excluded — the breaker asks "is this CONFIG poison",
+    not "is this job unlucky"."""
+    h = hashlib.sha256()
+    for part in (str(record.get("namelist", "")),
+                 json.dumps(record.get("sweeps") or {}, sort_keys=True),
+                 str(record.get("solver", "")),
+                 str(int(record.get("ndim", 3) or 3)),
+                 str(record.get("dtype", "")),
+                 jq.job_kind(record)):
+        h.update(part.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def fingerprint_of(record: Dict[str, Any]) -> str:
+    """The record's stamped fingerprint (submit-time) or a recomputed
+    one for records that predate the field."""
+    return str(record.get("config_fp") or config_fingerprint(record))
+
+
+def breaker_stage(stage: str) -> str:
+    """Normalize failure_log stages to the breaker's two failure
+    classes: the serve loop labels hang-kills ``"hang"`` and
+    everything else (``requeue``/``fail``/exceptions) is a crash.
+    Counting on the raw disposition would never accumulate — a job's
+    first failures are ``requeue`` and its last is ``fail``."""
+    return "hang" if stage == "hang" else "crash"
+
+
+def _path(queue_dir: str, fp: str) -> str:
+    return os.path.join(queue_dir, BREAKERS_DIR, fp + ".json")
+
+
+def load(queue_dir: str, fp: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_path(queue_dir, fp)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _store(queue_dir: str, b: Dict[str, Any]) -> None:
+    os.makedirs(os.path.join(queue_dir, BREAKERS_DIR), exist_ok=True)
+    jq._write_record(_path(queue_dir, b["fp"]), b)
+
+
+def list_breakers(queue_dir: str) -> List[Dict[str, Any]]:
+    d = os.path.join(queue_dir, BREAKERS_DIR)
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(n for n in os.listdir(d) if n.endswith(".json"))
+    except OSError:
+        return out
+    for name in names:
+        try:
+            with open(os.path.join(d, name)) as f:
+                out.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def open_fingerprints(queue_dir: str) -> Dict[str, str]:
+    """``{fp: verdict}`` for every breaker currently open — the serve
+    loop's pre-claim parking filter (one directory read per poll, not
+    one per record)."""
+    return {str(b.get("fp", "")): str(b.get("verdict", "breaker open"))
+            for b in list_breakers(queue_dir)
+            if b.get("state") == "open"}
+
+
+def record_failure(queue_dir: str, record: Dict[str, Any], stage: str,
+                   failures: Optional[int] = None,
+                   min_workers: Optional[int] = None,
+                   ttl_s: Optional[float] = None,
+                   telemetry=None, log=None) -> bool:
+    """Count one worker-attributable failure against the record's
+    config fingerprint; trip the breaker (and park matching queued
+    jobs) when the cross-worker threshold is crossed.  A failure while
+    half-open snaps the breaker back to open — the probe failed.
+    Returns True when this call tripped/re-tripped the breaker."""
+    n_trip, min_w, ttl = _knobs(failures, min_workers, ttl_s)
+    if n_trip <= 0:
+        return False                   # breaker disabled
+    fp = fingerprint_of(record)
+    now = time.time()
+    b = load(queue_dir, fp) or {
+        "fp": fp, "state": "closed", "failures": [],
+        "kind": jq.job_kind(record)}
+    stage_b = breaker_stage(stage)
+    b.setdefault("failures", []).append({
+        "stage": stage_b, "worker": str(record.get("worker", "")),
+        "job": str(record.get("id", "")), "time_unix": now})
+    b["failures"] = b["failures"][-50:]
+    tripped = False
+    if b.get("state") == "half_open":
+        # the released probe failed: no counting debate, snap open
+        tripped = True
+        _trip(queue_dir, b, stage_b, ttl, now,
+              verdict=(f"half-open probe failed again at stage "
+                       f"'{stage_b}' (job {record.get('id', '?')})"),
+              telemetry=telemetry, log=log)
+    elif b.get("state") == "closed":
+        same = [f for f in b["failures"] if f.get("stage") == stage_b]
+        workers = {f.get("worker") for f in same if f.get("worker")}
+        if len(same) >= n_trip and len(workers) >= min_w:
+            tripped = True
+            _trip(queue_dir, b, stage_b, ttl, now,
+                  verdict=(f"{len(same)} '{stage_b}' failures across "
+                           f"{len(workers)} worker(s) on config "
+                           f"{fp}"),
+                  telemetry=telemetry, log=log)
+    _store(queue_dir, b)
+    return tripped
+
+
+def _trip(queue_dir: str, b: Dict[str, Any], stage: str, ttl_s: float,
+          now: float, verdict: str, telemetry=None, log=None) -> None:
+    b["state"] = "open"
+    b["stage"] = stage
+    b["tripped_unix"] = now
+    b["ttl_s"] = float(ttl_s)
+    b["verdict"] = f"circuit breaker open: {verdict}"
+    if log is not None:
+        log(f"breaker: OPEN {b['fp']} — {verdict}")
+    if telemetry is not None:
+        try:
+            telemetry.record_event("breaker_trip", fp=b["fp"],
+                                   stage=stage, verdict=b["verdict"])
+        except Exception:
+            pass
+    park_matching(queue_dir, b["fp"], b["verdict"],
+                  telemetry=telemetry, log=log)
+
+
+def park_record(queue_dir: str, record: Dict[str, Any], verdict: str,
+                telemetry=None, log=None) -> bool:
+    """Move one queued record to ``parked/`` with the breaker verdict
+    in its failure_log.  Tolerates losing the record to a racing
+    claim (returns False)."""
+    job_id = str(record.get("id", ""))
+    src = os.path.join(queue_dir, "queued", job_id + ".json")
+    try:
+        with open(src) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    rec.setdefault("failure_log", []).append({
+        "error": verdict, "stage": "breaker", "kind": jq.job_kind(rec),
+        "attempt": int(rec.get("attempts", 0)), "worker": "",
+        "trace_id": rec.get("trace_id", ""), "time_unix": time.time()})
+    rec["parked_by"] = fingerprint_of(rec)
+    dst = os.path.join(queue_dir, "parked", job_id + ".json")
+    try:
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        jq._write_record(src, rec)
+        os.rename(src, dst)
+    except OSError:
+        return False
+    if log is not None:
+        log(f"breaker: parked {job_id} ({verdict})")
+    if telemetry is not None:
+        try:
+            telemetry.record_event("breaker_park", job=job_id,
+                                   fp=rec.get("parked_by", ""),
+                                   trace_id=rec.get("trace_id", ""))
+        except Exception:
+            pass
+    return True
+
+
+def park_matching(queue_dir: str, fp: str, verdict: str,
+                  telemetry=None, log=None) -> int:
+    n = 0
+    for rec in jq.peek_queued(queue_dir):
+        if fingerprint_of(rec) == fp:
+            n += int(park_record(queue_dir, rec, verdict,
+                                 telemetry=telemetry, log=log))
+    return n
+
+
+def _parked_matching(queue_dir: str, fp: str) -> List[str]:
+    d = os.path.join(queue_dir, "parked")
+    out: List[str] = []
+    try:
+        names = sorted(n for n in os.listdir(d) if n.endswith(".json"))
+    except OSError:
+        return out
+    for name in names:
+        try:
+            with open(os.path.join(d, name)) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if fingerprint_of(rec) == fp:
+            out.append(str(rec.get("id", name[:-len(".json")])))
+    return out
+
+
+def half_open(queue_dir: str, fp: str,
+              b: Optional[Dict[str, Any]] = None,
+              telemetry=None, log=None) -> bool:
+    """open -> half_open: release exactly one parked probe job back to
+    the queue; the rest stay parked until the probe's verdict."""
+    b = b if b is not None else load(queue_dir, fp)
+    if b is None or b.get("state") != "open":
+        return False
+    b["state"] = "half_open"
+    b["half_open_unix"] = time.time()
+    _store(queue_dir, b)
+    probe = None
+    for job_id in _parked_matching(queue_dir, fp):
+        if jq.unpark(queue_dir, job_id,
+                     note=f"breaker {fp} half-open probe"):
+            probe = job_id
+            break
+    if log is not None:
+        log(f"breaker: HALF-OPEN {fp}"
+            + (f" — probe {probe} released" if probe else ""))
+    if telemetry is not None:
+        try:
+            telemetry.record_event("breaker_half_open", fp=fp,
+                                   probe=probe or "")
+        except Exception:
+            pass
+    return True
+
+
+def on_success(queue_dir: str, record: Dict[str, Any],
+               telemetry=None, log=None) -> bool:
+    """A matching job completed: close the breaker (whatever its
+    state) and release every parked twin."""
+    fp = fingerprint_of(record)
+    b = load(queue_dir, fp)
+    if b is None or b.get("state") == "closed":
+        return False
+    b["state"] = "closed"
+    b["failures"] = []
+    b["closed_unix"] = time.time()
+    _store(queue_dir, b)
+    released = 0
+    for job_id in _parked_matching(queue_dir, fp):
+        released += int(jq.unpark(queue_dir, job_id,
+                                  note=f"breaker {fp} closed"))
+    if log is not None:
+        log(f"breaker: CLOSED {fp} — {released} parked job(s) released")
+    if telemetry is not None:
+        try:
+            telemetry.record_event("breaker_close", fp=fp,
+                                   released=released)
+        except Exception:
+            pass
+    return True
+
+
+def sweep(queue_dir: str, ttl_s: Optional[float] = None,
+          telemetry=None, log=None) -> int:
+    """TTL maintenance, called from the serve poll loop: every open
+    breaker whose TTL expired is half-opened (one probe released).
+    Returns the number of transitions."""
+    now = time.time()
+    n = 0
+    for b in list_breakers(queue_dir):
+        if b.get("state") != "open":
+            continue
+        ttl = float(b.get("ttl_s", DEFAULT_TTL_S)
+                    if ttl_s is None else ttl_s)
+        if now >= float(b.get("tripped_unix", now)) + ttl:
+            n += int(half_open(queue_dir, str(b.get("fp", "")), b=b,
+                               telemetry=telemetry, log=log))
+    return n
+
+
+def reset(queue_dir: str, fp: str = "all", log=print) -> List[str]:
+    """Operator reset (``queue_fsck --reset-breaker``): half-open the
+    named breaker, or every open one with ``"all"``.  Returns the
+    fingerprints transitioned."""
+    done: List[str] = []
+    for b in list_breakers(queue_dir):
+        bfp = str(b.get("fp", ""))
+        if fp not in ("all", bfp):
+            continue
+        if b.get("state") == "open" and half_open(queue_dir, bfp, b=b,
+                                                  log=log):
+            done.append(bfp)
+    return done

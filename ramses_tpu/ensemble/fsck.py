@@ -1,0 +1,313 @@
+"""Crash-consistency scanner/repairer for the run-service queue.
+
+A fleet of workers can die at any instruction, so the queue directory
+accumulates a known taxonomy of wreckage.  ``scan`` classifies it,
+``repair`` fixes what is mechanically safe to fix, and
+``tools/queue_fsck.py`` is the operator CLI (also invoked — repair of
+the always-safe classes only — from serve startup).
+
+Corruption classes:
+
+* ``torn_tmp`` — a ``*.tmp`` left by a worker killed inside the
+  tmp+fsync+replace record write (queue records, heartbeats, breaker
+  state).  Repair: unlink; the target file is either the old or the
+  new complete version by construction.
+* ``orphan_heartbeat`` — a ``running/<id>.json.hb`` whose record
+  moved on (finish/reclaim unlink raced a crash).  Repair: unlink.
+* ``dead_running`` — a ``running/`` record whose fencing token is
+  provably dead: its heartbeat carries a *superseded* fence, or both
+  the heartbeat's wall stamp and file mtime agree it stopped longer
+  ago than ``stale_s``.  Repair: the same fence-bumping reclaim the
+  serve loop performs (requeue or fail by attempt budget).
+* ``duplicate_id`` — the same job id in two state dirs (torn rename
+  semantics on exotic filesystems, operator copies).  Repair: the
+  record in the most-final state wins (done > failed > running >
+  parked > queued); losers move to ``fsck_quarantine/``.
+* ``half_staged`` — a ``results/<job>/output_*.tmp`` (or pario) left
+  by a worker killed mid-checkpoint-stage, older than ``stale_s`` (a
+  LIVE worker's in-flight staging is never touched).  Repair: remove
+  — the atomic-commit contract says a ``.tmp`` is never a checkpoint.
+* ``orphan_parked`` — a ``parked/`` job whose breaker no longer
+  exists or is closed (crash between breaker close and release).
+  Repair: unpark back to ``queued/``.
+
+Exit-code contract of :func:`fsck` (what CI pins): check mode exits 0
+on a clean queue and 1 when findings exist (every class above is
+repairable, so 1 == "repairable verdict"); repair mode exits 0 when
+everything found was repaired, 2 when something resisted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.ensemble import breaker as bk
+
+#: duplicate_id precedence — most final wins
+_FINALITY = ("done", "failed", "running", "parked", "queued")
+
+#: classes safe to auto-repair at serve startup (no policy judgement,
+#: no touching another worker's live state)
+STARTUP_SAFE = ("torn_tmp", "orphan_heartbeat", "orphan_parked")
+
+
+@dataclass
+class Finding:
+    kind: str
+    path: str
+    detail: str
+    repair: str
+    repaired: bool = False
+    error: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"kind": self.kind, "path": self.path,
+             "detail": self.detail, "repair": self.repair,
+             "repaired": self.repaired}
+        if self.error:
+            d["error"] = self.error
+        d.update(self.extra)
+        return d
+
+
+def _tmp_dirs(queue_dir: str) -> List[str]:
+    return ([os.path.join(queue_dir, s) for s in jq.STATES]
+            + [os.path.join(queue_dir, bk.BREAKERS_DIR)])
+
+
+def _listdir(d: str) -> List[str]:
+    try:
+        return sorted(os.listdir(d))
+    except OSError:
+        return []
+
+
+def scan(queue_dir: str, stale_s: float = 300.0) -> List[Finding]:
+    """Classify every piece of wreckage in ``queue_dir`` (read-only)."""
+    out: List[Finding] = []
+    now = time.time()
+
+    # torn_tmp: killed mid tmp+fsync+replace anywhere we write records
+    for d in _tmp_dirs(queue_dir):
+        for name in _listdir(d):
+            if name.endswith(".tmp"):
+                out.append(Finding(
+                    "torn_tmp", os.path.join(d, name),
+                    "torn record write (crash inside tmp+fsync+replace)",
+                    "unlink"))
+
+    running = os.path.join(queue_dir, "running")
+    rec_names = [n for n in _listdir(running) if n.endswith(".json")]
+    rec_set = set(rec_names)
+
+    # orphan_heartbeat: sidecar outlived its record
+    for name in _listdir(running):
+        if not name.endswith(".json" + jq.HB_SUFFIX):
+            continue
+        if name[:-len(jq.HB_SUFFIX)] not in rec_set:
+            out.append(Finding(
+                "orphan_heartbeat", os.path.join(running, name),
+                "heartbeat sidecar with no running record",
+                "unlink"))
+
+    # dead_running: provably dead fencing tokens
+    for name in rec_names:
+        path = os.path.join(running, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        fence = int(rec.get("fence", 0) or 0)
+        hb = jq._read_hb(path)
+        why = None
+        if hb is not None and int(hb.get("fence", -1)) != fence:
+            why = (f"heartbeat carries superseded fence "
+                   f"{hb.get('fence')} (record at {fence})")
+        else:
+            # both wall stamp and mtime must agree it is old — a
+            # skewed clock alone never condemns a live worker
+            if hb is not None:
+                wall_age = max(0.0, now - float(
+                    hb.get("wall_unix", now)))
+                try:
+                    m_age = max(0.0, now - os.path.getmtime(
+                        jq._hb_path(path)))
+                except OSError:
+                    m_age = 0.0
+                age = min(wall_age, m_age)
+            else:
+                try:
+                    age = now - os.path.getmtime(path)
+                except OSError:
+                    continue
+            if age >= float(stale_s):
+                why = (f"no heartbeat progress for {age:.0f}s "
+                       f"(stale_s={stale_s:.0f})")
+        if why is not None:
+            out.append(Finding(
+                "dead_running", path, why, "reclaim (fence bump)",
+                extra={"job": str(rec.get("id", "")),
+                       "attempts": int(rec.get("attempts", 0))}))
+
+    # duplicate_id: same id in >1 state dir
+    seen: Dict[str, List[str]] = {}
+    for state in jq.STATES:
+        for name in _listdir(os.path.join(queue_dir, state)):
+            if name.endswith(".json"):
+                seen.setdefault(name, []).append(state)
+    for name, states in sorted(seen.items()):
+        if len(states) < 2:
+            continue
+        keep = min(states, key=_FINALITY.index)
+        for state in states:
+            if state == keep:
+                continue
+            out.append(Finding(
+                "duplicate_id", os.path.join(queue_dir, state, name),
+                f"job id also present in {keep}/ (which wins)",
+                "quarantine", extra={"winner_state": keep}))
+
+    # half_staged: *.tmp checkpoint stagings older than stale_s
+    from ramses_tpu.resilience.checkpoint import CHECKPOINT_PREFIXES
+    results = os.path.join(queue_dir, "results")
+    for job in _listdir(results):
+        rdir = os.path.join(results, job)
+        if not os.path.isdir(rdir):
+            continue
+        for name in _listdir(rdir):
+            if not (name.endswith(".tmp")
+                    and name.startswith(CHECKPOINT_PREFIXES)):
+                continue
+            path = os.path.join(rdir, name)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age < float(stale_s):
+                continue               # possibly a live worker staging
+            out.append(Finding(
+                "half_staged", path,
+                f"checkpoint staging abandoned {age:.0f}s ago",
+                "remove", extra={"job": job}))
+
+    # orphan_parked: parked jobs whose breaker is gone or closed
+    parked = os.path.join(queue_dir, "parked")
+    breakers = {str(b.get("fp", "")): str(b.get("state", ""))
+                for b in bk.list_breakers(queue_dir)}
+    for name in _listdir(parked):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(parked, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        fp = bk.fingerprint_of(rec)
+        state = breakers.get(fp, "")
+        if state in ("open", "half_open"):
+            continue
+        out.append(Finding(
+            "orphan_parked", path,
+            f"parked but breaker {fp} is "
+            + (f"'{state}'" if state else "gone"),
+            "unpark", extra={"job": str(rec.get("id", ""))}))
+
+    return out
+
+
+def repair(queue_dir: str, findings: List[Finding],
+           max_attempts: int = 3, backoff_base_s: float = 0.0,
+           only: Optional[tuple] = None, log=print) -> List[Finding]:
+    """Apply each finding's repair in place (mutates ``repaired`` /
+    ``error``).  ``only`` restricts to a subset of classes (serve
+    startup passes :data:`STARTUP_SAFE`)."""
+    qdir = os.path.join(queue_dir, "fsck_quarantine")
+    for f in findings:
+        if only is not None and f.kind not in only:
+            continue
+        try:
+            if f.kind in ("torn_tmp", "orphan_heartbeat"):
+                os.unlink(f.path)
+            elif f.kind == "half_staged":
+                if os.path.isdir(f.path):
+                    shutil.rmtree(f.path)
+                else:
+                    os.unlink(f.path)
+            elif f.kind == "dead_running":
+                name = os.path.basename(f.path)
+                with open(f.path) as fh:
+                    rec = json.load(fh)
+                state = jq._reclaim_one(
+                    queue_dir, name, rec, float("inf"), max_attempts,
+                    time.time(), backoff_base_s=backoff_base_s)
+                if state is None:
+                    raise OSError("lost reclaim race")
+                f.extra["reclaimed_to"] = state
+            elif f.kind == "duplicate_id":
+                os.makedirs(qdir, exist_ok=True)
+                state = os.path.basename(os.path.dirname(f.path))
+                dst = os.path.join(
+                    qdir, f"{state}__{os.path.basename(f.path)}")
+                os.replace(f.path, dst)
+                jq._unlink_hb(f.path)
+                f.extra["quarantined_as"] = dst
+            elif f.kind == "orphan_parked":
+                job = f.extra.get("job") or os.path.basename(
+                    f.path)[:-len(".json")]
+                if not jq.unpark(queue_dir, job,
+                                 note="fsck: orphaned park released"):
+                    raise OSError("unpark raced away")
+            else:
+                raise ValueError(f"no repair for kind {f.kind!r}")
+            f.repaired = True
+            if log is not None:
+                log(f"fsck: repaired {f.kind}: {f.path}")
+        except Exception as e:            # keep repairing the rest
+            f.error = f"{type(e).__name__}: {e}"
+            if log is not None:
+                log(f"fsck: FAILED to repair {f.kind} {f.path}: "
+                    f"{f.error}")
+    return findings
+
+
+def fsck(queue_dir: str, do_repair: bool = False,
+         stale_s: float = 300.0, max_attempts: int = 3,
+         log=print) -> "tuple[int, List[Finding]]":
+    """Scan (and optionally repair); returns ``(exit_code, findings)``
+    per the module-level exit-code contract."""
+    findings = scan(queue_dir, stale_s=stale_s)
+    if log is not None:
+        for f in findings:
+            log(f"fsck: [{f.kind}] {f.path} — {f.detail} "
+                f"(repair: {f.repair})")
+    if not do_repair:
+        return (1 if findings else 0), findings
+    repair(queue_dir, findings, max_attempts=max_attempts, log=log)
+    bad = [f for f in findings if not f.repaired]
+    return (2 if bad else 0), findings
+
+
+def startup_repair(queue_dir: str, log=print) -> int:
+    """Serve-startup hook: repair only the always-safe classes
+    (:data:`STARTUP_SAFE`); everything else is logged and left for the
+    operator CLI.  Returns the number of repairs made."""
+    findings = scan(queue_dir)
+    if not findings:
+        return 0
+    repair(queue_dir, findings, only=STARTUP_SAFE, log=log)
+    n = sum(1 for f in findings if f.repaired)
+    left = [f for f in findings
+            if not f.repaired and f.kind not in STARTUP_SAFE]
+    if left and log is not None:
+        log(f"fsck: {len(left)} finding(s) need `queue_fsck --repair` "
+            f"({', '.join(sorted({f.kind for f in left}))})")
+    return n

@@ -2,28 +2,55 @@
 
 The queue is a directory with one JSON record per job, and a job's
 lifecycle IS its location: ``queued/`` -> ``running/`` -> ``done/`` or
-``failed/``.  Every transition is a single ``os.rename`` on the same
-filesystem, so claiming is atomic — two workers racing for one job see
-exactly one rename succeed and one ``FileNotFoundError`` (the AMT
-task-queue scheduling shape, arXiv:2412.15518, reduced to POSIX).
+``failed/`` (plus ``parked/``, where the poison-config circuit breaker
+— :mod:`ramses_tpu.ensemble.breaker` — sidelines jobs whose frozen
+config keeps killing workers).  Every transition is a single
+``os.rename`` on the same filesystem, so claiming is atomic — two
+workers racing for one job see exactly one rename succeed and one
+``FileNotFoundError`` (the AMT task-queue scheduling shape,
+arXiv:2412.15518, reduced to POSIX).
 
-Liveness is the running record's mtime: a worker touches its claimed
-record (``heartbeat``) between fused windows, and any caller may
-``reclaim_stale`` records whose mtime is older than the staleness
-timeout — bumping the attempt count and renaming the job back into
-``queued/`` (or into ``failed/`` once ``max_attempts`` is exhausted).
-Results (telemetry JSONL + checkpoints) land under ``results/<job>/``.
+Claims are **fenced**: every claim (and every stale reclaim) bumps a
+monotone ``fence`` generation token in the record, and every
+worker-side write — heartbeat, ``complete()``/``fail()``/``requeue()``
+— re-reads the on-disk record and refuses to proceed when its token is
+stale (:class:`FenceLost`).  A worker that stalls past the staleness
+timeout and then *recovers* (a zombie) therefore cannot double-complete
+a job another worker already took over: its late writes are refused and
+logged as ``stage="fenced"`` ``failure_log`` entries on the record.
+
+Liveness is a **content heartbeat**, not an mtime: the worker writes a
+``<id>.json.hb`` sidecar carrying (fence, a worker-local monotone
+sequence counter, wall time), and :func:`reclaim_stale` judges
+staleness by *observing the sequence counter stand still* on its own
+monotonic clock — clock skew between hosts (or a skewed wall stamp)
+cannot false-trip a reclaim by itself.  A record with no heartbeat at
+all falls back to the record mtime, the pre-fencing signal.  Results
+(telemetry JSONL + checkpoints) land under ``results/<job>/``.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-STATES = ("queued", "running", "done", "failed")
+STATES = ("queued", "running", "done", "failed", "parked")
+
+#: heartbeat sidecar suffix (rides next to the running record; never
+#: matches the ``*.json`` record filters)
+HB_SUFFIX = ".hb"
+
+
+class FenceLost(RuntimeError):
+    """A worker-side queue write was refused because the claim's
+    fencing token no longer matches the on-disk record — the job was
+    reclaimed (and possibly re-claimed) while this worker stalled.
+    The worker must abandon the job: it owns neither the record nor
+    the right to complete/fail/requeue it."""
 
 
 @dataclass
@@ -37,6 +64,12 @@ class Job:
     @property
     def state(self) -> str:
         return os.path.basename(os.path.dirname(self.path))
+
+    @property
+    def fence(self) -> int:
+        """The fencing token this claim holds (the in-memory record is
+        the claim-time snapshot; reclaims bump only the on-disk one)."""
+        return int(self.record.get("fence", 0) or 0)
 
 
 def _dirs(queue_dir: str) -> Dict[str, str]:
@@ -89,11 +122,21 @@ def submit(queue_dir: str, namelist: str,
         "sweeps": dict(sweeps or {}), "solver": solver,
         "ndim": int(ndim), "dtype": dtype,
         "submitted_unix": time.time(), "attempts": 0,
+        # fencing generation: bumped by every claim and every stale
+        # reclaim; a worker holding an older token has lost the job
+        "fence": 0,
         # end-to-end correlation id (ramses_tpu/obs/trace): stamped
         # here once, then propagated into every telemetry record,
         # failure_log entry and checkpoint manifest this job produces
         "trace_id": new_trace_id(),
         "meta": dict(meta or {})}
+    # frozen-config fingerprint: the poison-config circuit breaker
+    # (ensemble/breaker) keys cross-worker failure counting on it
+    try:
+        from ramses_tpu.ensemble.breaker import config_fingerprint
+        record["config_fp"] = config_fingerprint(record)
+    except Exception:
+        pass
     # submit-time cost stamp (members x cells x steps + shard clamps):
     # the currency plan_gang bin-packs on.  Strictly best-effort — an
     # unparseable namelist submits unstamped and schedules as a small
@@ -118,13 +161,16 @@ def job_kind(record: Dict[str, Any]) -> str:
 
 def claim(queue_dir: str, worker: str = "",
           job_id: str = "") -> Optional[Job]:
-    """Atomically claim the oldest queued job (rename into
-    ``running/``), bump its attempt count and stamp the claim time.
-    Returns None when the queue is empty; racing workers each get a
-    distinct job or None.  ``job_id`` claims that specific job instead
-    of the FIFO head — the gang scheduler plans from a
-    :func:`peek_queued` snapshot and then claims each planned job by
-    id, dropping any it loses to a racing worker."""
+    """Atomically claim the oldest *eligible* queued job (rename into
+    ``running/``), bump its attempt count and fencing token, stamp the
+    claim time and write the first content heartbeat.  Returns None
+    when the queue is empty; racing workers each get a distinct job or
+    None.  A record inside its requeue-backoff window
+    (``not_before_unix`` in the future) is skipped by the FIFO scan so
+    a failing job cannot thundering-herd the fleet.  ``job_id`` claims
+    that specific job instead of the FIFO head — the gang scheduler
+    plans from a :func:`peek_queued` snapshot and then claims each
+    planned job by id, dropping any it loses to a racing worker."""
     dirs = _dirs(queue_dir)
     worker = worker or f"{os.uname().nodename}:{os.getpid()}"
     if job_id:
@@ -135,9 +181,20 @@ def claim(queue_dir: str, worker: str = "",
                            if n.endswith(".json"))
         except FileNotFoundError:
             return None
+    now = time.time()
     for name in names:
         src = os.path.join(dirs["queued"], name)
         dst = os.path.join(dirs["running"], name)
+        if not job_id:
+            # backoff eligibility pre-read (tolerant: a record renamed
+            # or half-written under us is simply someone else's)
+            try:
+                with open(src) as f:
+                    rec0 = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if float(rec0.get("not_before_unix") or 0.0) > now:
+                continue               # still in its backoff window
         try:
             os.rename(src, dst)        # the atomic claim
         except OSError:
@@ -145,10 +202,17 @@ def claim(queue_dir: str, worker: str = "",
         with open(dst) as f:
             record = json.load(f)
         record["attempts"] = int(record.get("attempts", 0)) + 1
+        # fenced claim: the new generation token; every write this
+        # worker makes on behalf of the job carries (and re-verifies)
+        # it, so a reclaimed predecessor cannot finish over us
+        record["fence"] = int(record.get("fence", 0)) + 1
         record["worker"] = worker
         record["claimed_unix"] = time.time()
+        record.pop("not_before_unix", None)
         _write_record(dst, record)
-        return Job(id=record["id"], path=dst, record=record)
+        job = Job(id=record["id"], path=dst, record=record)
+        heartbeat(job)                 # claim goes live immediately
+        return job
     return None
 
 
@@ -162,7 +226,7 @@ def peek_queued(queue_dir: str) -> List[Dict[str, Any]]:
     try:
         names = sorted(n for n in os.listdir(dirs["queued"])
                        if n.endswith(".json"))
-    except FileNotFoundError:
+    except (FileNotFoundError, NotADirectoryError):
         return out
     for name in names:
         try:
@@ -264,10 +328,147 @@ def plan_gang(records: List[Dict[str, Any]], ndev: int,
     return [(rec, int(n)) for rec, n in gang]
 
 
+# ---------------------------------------------------------------------
+# fenced heartbeats
+# ---------------------------------------------------------------------
+
+#: worker-local monotone heartbeat sequence — the progression signal
+#: reclaim observes; shared across this process's claims on purpose
+#: (any advance proves the worker's host thread is alive)
+_hb_seq = itertools.count(1)
+
+
+def _hb_path(job_path: str) -> str:
+    return job_path + HB_SUFFIX
+
+
+def _read_hb(job_path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_hb_path(job_path)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _unlink_hb(job_path: str) -> None:
+    try:
+        os.unlink(_hb_path(job_path))
+    except OSError:
+        pass
+
+
+def _is_enospc(err: BaseException) -> bool:
+    import errno
+    return isinstance(err, OSError) and err.errno == errno.ENOSPC
+
+
 def heartbeat(job: Job) -> None:
-    """Refresh the running record's mtime — the worker liveness signal
-    the staleness reclaim keys on."""
-    os.utime(job.path)
+    """Refresh the worker liveness signal: a fence-checked *content*
+    record (``<id>.json.hb``) carrying this process's monotone
+    sequence counter plus wall time — :func:`reclaim_stale` keys on
+    the counter standing still under its own clock, so neither
+    host-to-host clock skew nor a biased wall stamp can fake liveness
+    or death by itself.  Raises :class:`FenceLost` when the on-disk
+    record's fencing token no longer matches this claim (the job was
+    reclaimed while the worker stalled) — the one place a zombie
+    worker reliably discovers it must abandon the job."""
+    _check_fence(job, "heartbeat")
+    skew = 0.0
+    try:
+        from ramses_tpu.resilience.faultinject import heartbeat_skew
+        skew = heartbeat_skew()
+    except Exception:
+        pass
+    rec = {"job": job.id, "fence": job.fence, "seq": next(_hb_seq),
+           "wall_unix": time.time() + skew,
+           "mono_s": time.monotonic(),
+           "worker": str(job.record.get("worker", ""))}
+    try:
+        _write_record(_hb_path(job.path), rec)
+    except OSError as e:
+        if not _is_enospc(e):
+            raise
+        # full disk degrades, never crashes the worker: fall back to
+        # the zero-byte mtime bump so liveness survives ENOSPC
+        try:
+            os.utime(job.path)
+        except OSError:
+            pass
+
+
+def _age_heartbeat(job_path: str, by_s: float) -> None:
+    """Test/ops helper: make a running record's heartbeat look
+    ``by_s`` seconds old — content wall stamp AND file mtimes — the
+    simulation of a worker that died that long ago."""
+    hbp = _hb_path(job_path)
+    try:
+        with open(hbp) as f:
+            hb = json.load(f)
+        hb["wall_unix"] = float(hb.get("wall_unix", time.time())) - by_s
+        _write_record(hbp, hb)
+    except (OSError, json.JSONDecodeError):
+        pass
+    old = time.time() - by_s
+    for p in (job_path, hbp):
+        try:
+            os.utime(p, (old, old))
+        except OSError:
+            pass
+
+
+def _check_fence(job: Job, op: str, telemetry=None) -> None:
+    """Verify this claim still owns the record: the on-disk fencing
+    token must equal the claim's.  On mismatch the refusal is made
+    durable — a ``stage="fenced"`` entry lands in the canonical
+    record's ``failure_log`` wherever the record now lives — and
+    :class:`FenceLost` is raised."""
+    try:
+        with open(job.path) as f:
+            disk = json.load(f)
+        ok = int(disk.get("fence", 0) or 0) == job.fence
+    except (OSError, json.JSONDecodeError):
+        ok = False
+    if ok:
+        return
+    queue_dir = os.path.dirname(os.path.dirname(job.path))
+    cur = job_status(queue_dir, job.id)
+    if cur is not None:
+        where = (f"record now in {cur.state}/ at fence "
+                 f"{cur.record.get('fence', '?')}")
+    else:
+        where = "record gone"
+    msg = (f"fenced write refused: {op} by "
+           f"{job.record.get('worker', '?')} holds fence "
+           f"{job.fence}; {where}")
+    if cur is not None:
+        cur.record.setdefault("failure_log", []).append({
+            "error": msg, "stage": "fenced",
+            "kind": job_kind(cur.record),
+            "attempt": int(job.record.get("attempts", 0)),
+            "worker": str(job.record.get("worker", "")),
+            "trace_id": str(cur.record.get("trace_id", "")),
+            "time_unix": time.time()})
+        try:
+            _write_record(cur.path, cur.record)
+        except OSError:
+            pass
+    _emit(telemetry, "queue_fenced", job=job.id, op=op,
+          fence=job.fence, worker=str(job.record.get("worker", "")),
+          trace_id=str(job.record.get("trace_id", "")))
+    raise FenceLost(msg)
+
+
+def _backoff_delay(attempts: int, base_s: float,
+                   cap_s: float = 60.0) -> float:
+    """Jittered exponential requeue backoff: attempt 1 -> ~base,
+    doubling, capped; the jitter (0.5x..1x) decorrelates a fleet of
+    workers eyeing the same bounced job."""
+    if base_s <= 0.0:
+        return 0.0
+    import random
+    raw = min(float(cap_s), float(base_s)
+              * (2.0 ** max(0, int(attempts) - 1)))
+    return raw * (0.5 + 0.5 * random.random())
 
 
 def _log_failure(record: Dict[str, Any], error: str,
@@ -294,10 +495,36 @@ def _emit(telemetry, kind: str, **fields) -> None:
             pass
 
 
+def _breaker_note(job: Job, stage: str, failed: bool,
+                  telemetry=None) -> None:
+    """Feed the poison-config circuit breaker (best-effort): worker-
+    attributable failures only — stale reclaims, drains and fenced
+    refusals say nothing about the config."""
+    if stage in ("stale", "drain", "fenced"):
+        return
+    try:
+        from ramses_tpu.ensemble import breaker as _bk
+        queue_dir = os.path.dirname(os.path.dirname(job.path))
+        _bk.record_failure(queue_dir, job.record, stage,
+                           telemetry=telemetry)
+    except Exception:
+        pass
+
+
 def complete(job: Job, result: Optional[Dict[str, Any]] = None) -> str:
     """running -> done, folding ``result`` (artifact paths, final t/
-    nstep) into the record."""
-    return _finish(job, "done", result=result)
+    nstep) into the record.  Fence-checked: a reclaimed zombie's late
+    ``complete()`` raises :class:`FenceLost` instead of producing a
+    second ``done/`` entry.  A success half-opens nothing — it CLOSES
+    any matching poison-config breaker and releases parked twins."""
+    dst = _finish(job, "done", result=result)
+    try:
+        from ramses_tpu.ensemble import breaker as _bk
+        queue_dir = os.path.dirname(os.path.dirname(dst))
+        _bk.on_success(queue_dir, job.record)
+    except Exception:
+        pass
+    return dst
 
 
 def fail(job: Job, error: str = "",
@@ -307,62 +534,189 @@ def fail(job: Job, error: str = "",
     ``failure_log`` (and recorded as the headline ``error``).
     ``stage`` labels the log entry — the serve loop passes ``"hang"``
     for deadline-killed jobs so the classification survives in the
-    record."""
+    record.  Fence-checked like :func:`complete`."""
     if error:
         _log_failure(job.record, error, stage)
     _emit(telemetry, "queue_fail", job=job.id,
           trace_id=job.record.get("trace_id", ""),
           attempts=int(job.record.get("attempts", 0)), error=error,
           stage=stage)
-    return _finish(job, "failed", result=result, error=error)
+    dst = _finish(job, "failed", result=result, error=error)
+    _breaker_note(job, stage, failed=True, telemetry=telemetry)
+    return dst
 
 
 def requeue(job: Job, error: str = "", telemetry=None,
-            stage: str = "requeue") -> str:
+            stage: str = "requeue", backoff_base_s: float = 0.0,
+            backoff_cap_s: float = 60.0,
+            count_attempt: bool = True) -> str:
     """running -> queued (a failed attempt with attempts remaining);
     the attempt count stays — :func:`claim` bumps it on the next
     worker.  The attempt's error is appended to ``failure_log``, which
     survives the requeue because it lives in the record file.
-    ``stage`` labels the entry (``"hang"`` for kill-and-requeue)."""
+    ``stage`` labels the entry (``"hang"`` for kill-and-requeue,
+    ``"drain"`` for a SIGTERM graceful drain).
+
+    ``backoff_base_s > 0`` stamps a jittered-exponential
+    ``not_before_unix`` eligibility gate into the record so a job that
+    keeps bouncing does not thundering-herd the fleet's claim scans.
+    ``count_attempt=False`` refunds the claim's attempt bump (a drain
+    is the worker's fault, not the job's).  Fence-checked."""
+    _check_fence(job, "requeue", telemetry=telemetry)
     if error:
         _log_failure(job.record, error, stage)
+    if not count_attempt:
+        job.record["attempts"] = max(
+            0, int(job.record.get("attempts", 0)) - 1)
+    delay = 0.0
+    if stage != "drain":
+        delay = _backoff_delay(int(job.record.get("attempts", 0)),
+                               backoff_base_s, backoff_cap_s)
+    if delay > 0.0:
+        job.record["not_before_unix"] = time.time() + delay
+    else:
+        job.record.pop("not_before_unix", None)
     _emit(telemetry, "queue_requeue", job=job.id,
           trace_id=job.record.get("trace_id", ""),
           attempts=int(job.record.get("attempts", 0)), error=error,
-          stage=stage)
+          stage=stage, backoff_s=round(delay, 3))
     _write_record(job.path, job.record)
+    hb_of = job.path
     dst = os.path.join(os.path.dirname(os.path.dirname(job.path)),
                        "queued", os.path.basename(job.path))
     os.rename(job.path, dst)
+    _unlink_hb(hb_of)
     job.path = dst
+    if stage not in ("drain",):
+        _breaker_note(job, stage, failed=False, telemetry=telemetry)
     return dst
 
 
 def _finish(job: Job, state: str, result=None, error: str = "") -> str:
+    _check_fence(job, state)
     job.record["finished_unix"] = time.time()
     if result:
         job.record["result"] = result
     if error:
         job.record["error"] = error
     _write_record(job.path, job.record)
+    hb_of = job.path
     dst = os.path.join(os.path.dirname(os.path.dirname(job.path)),
                        state, os.path.basename(job.path))
     os.rename(job.path, dst)
+    _unlink_hb(hb_of)
     job.path = dst
     return dst
 
 
+# ---------------------------------------------------------------------
+# stale reclaim: fencing token + heartbeat progression as authority
+# ---------------------------------------------------------------------
+
+#: observer-side heartbeat progression cache:
+#: (queue_dir, job, fence, seq) -> monotonic time first observed.
+#: Staleness = the SAME (fence, seq) observed for stale_s of the
+#: observer's own clock — immune to writer-side clock skew.
+_hb_observed: Dict[Tuple[str, str, int, int], float] = {}
+
+
+def _hb_age(queue_dir: str, path: str, record: Dict[str, Any],
+            now: float, now_mono: float,
+            current_keys: set) -> Optional[float]:
+    """Effective heartbeat age of one running record, or None when the
+    record vanished under us.  Authority order:
+
+    1. a content heartbeat whose fence matches the record: the larger
+       of (a) observer-clock age since its (fence, seq) was first
+       seen and (b) the heartbeat's own claimed age — counted only as
+       far as BOTH its wall stamp and its file mtime agree (min of
+       the two), so a skewed wall stamp alone — or a skewed
+       filesystem clock alone — cannot fake death, while a worker
+       dead since before this observer started is still condemned;
+    2. a heartbeat with a MISMATCHED fence is a dead claim: infinite
+       age (the token was already superseded — nothing live holds it);
+    3. no heartbeat at all: the record mtime, the legacy signal.
+    """
+    hb = _read_hb(path)
+    fence = int(record.get("fence", 0) or 0)
+    if hb is not None and int(hb.get("fence", -1)) != fence:
+        return float("inf")            # superseded token: dead claim
+    if hb is not None:
+        key = (queue_dir, str(record.get("id", "")), fence,
+               int(hb.get("seq", 0)))
+        current_keys.add(key)
+        _hb_observed.setdefault(key, now_mono)
+        wall_age = max(0.0, now - float(hb.get("wall_unix", now)))
+        try:
+            mtime_age = max(0.0, now - os.path.getmtime(
+                _hb_path(path)))
+        except OSError:
+            mtime_age = 0.0
+        return max(now_mono - _hb_observed[key],
+                   min(wall_age, mtime_age))
+    try:
+        return now - os.path.getmtime(path)
+    except OSError:
+        return None                    # finished/reclaimed under us
+
+
+def _reclaim_one(queue_dir: str, name: str, record: Dict[str, Any],
+                 age: float, max_attempts: int, now: float,
+                 backoff_base_s: float = 0.0,
+                 backoff_cap_s: float = 60.0) -> Optional[str]:
+    """Move one stale running record out: bump the fencing token (the
+    zombie's is now refused everywhere), requeue or fail by attempt
+    budget, stamp the reclaim backoff.  Returns the destination state
+    or None when a racing caller won the rename."""
+    dirs = _dirs(queue_dir)
+    path = os.path.join(dirs["running"], name)
+    attempts = int(record.get("attempts", 0))
+    state = "queued" if attempts < max_attempts else "failed"
+    _log_failure(record, f"stale worker (no heartbeat progress for "
+                 f"{age:.0f}s, attempt {attempts})", "stale")
+    if state == "queued":
+        # the stale note is bookkeeping, not the job's verdict
+        record.pop("error", None)
+        delay = _backoff_delay(attempts, backoff_base_s, backoff_cap_s)
+        if delay > 0.0:
+            record["not_before_unix"] = now + delay
+    record["reclaimed_unix"] = now
+    # fence the dead claim out: every write the zombie attempts from
+    # here on compares its token against this bumped generation
+    record["fence"] = int(record.get("fence", 0)) + 1
+    dst = os.path.join(dirs[state], name)
+    try:
+        _write_record(path, record)
+        os.rename(path, dst)
+    except OSError:
+        return None
+    _unlink_hb(path)
+    return state
+
+
 def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
                   max_attempts: int = 3, log=print,
-                  telemetry=None) -> int:
-    """Requeue running jobs whose heartbeat mtime is older than
+                  telemetry=None, backoff_base_s: float = 0.0,
+                  backoff_cap_s: float = 60.0) -> int:
+    """Requeue running jobs whose heartbeat has made no progress for
     ``stale_s`` (a dead/preempted worker); jobs already at
     ``max_attempts`` go to ``failed/`` instead.  Returns the number of
-    records moved.  Safe to call concurrently — the rename either
-    succeeds for exactly one caller or raises and is skipped."""
+    records moved.
+
+    The authority is the **fencing token + heartbeat content**, not an
+    mtime: a claim whose token was superseded is reclaimed on sight; a
+    live claim is one whose heartbeat *sequence counter advances* —
+    judged on the observer's own monotonic clock (see
+    :func:`_hb_age`), so clock skew cannot false-trip a reclaim, and a
+    zombie that later resumes is refused by the bumped token anyway.
+    Safe to call concurrently — the rename either succeeds for exactly
+    one caller or raises and is skipped."""
     dirs = _dirs(queue_dir)
+    qabs = os.path.abspath(queue_dir)
     now = time.time()
+    now_mono = time.monotonic()
     moved = 0
+    current_keys: set = set()
     try:
         names = sorted(n for n in os.listdir(dirs["running"])
                        if n.endswith(".json"))
@@ -371,47 +725,77 @@ def reclaim_stale(queue_dir: str, stale_s: float = 300.0,
     for name in names:
         path = os.path.join(dirs["running"], name)
         try:
-            age = now - os.path.getmtime(path)
-        except OSError:
-            continue                   # finished/reclaimed under us
-        if age < stale_s:
-            continue
-        try:
             with open(path) as f:
                 record = json.load(f)
         except (OSError, json.JSONDecodeError):
+            continue                   # finished/reclaimed under us
+        age = _hb_age(qabs, path, record, now, now_mono, current_keys)
+        if age is None or age < stale_s:
             continue
         attempts = int(record.get("attempts", 0))
-        state = "queued" if attempts < max_attempts else "failed"
-        _log_failure(record, f"stale worker (no heartbeat for "
-                     f"{age:.0f}s, attempt {attempts})", "stale")
-        if state == "queued":
-            # the stale note is bookkeeping, not the job's verdict
-            record.pop("error", None)
-        record["reclaimed_unix"] = now
-        dst = os.path.join(dirs[state], name)
-        try:
-            _write_record(path, record)
-            os.rename(path, dst)
-        except OSError:
+        state = _reclaim_one(qabs, name, record, age, max_attempts,
+                             now, backoff_base_s=backoff_base_s,
+                             backoff_cap_s=backoff_cap_s)
+        if state is None:
             continue
         moved += 1
         _emit(telemetry, "queue_reclaim", job=record.get("id", name),
               trace_id=record.get("trace_id", ""),
-              attempts=attempts, to=state, heartbeat_age_s=round(age, 1))
+              attempts=attempts, to=state,
+              fence=int(record.get("fence", 0)),
+              heartbeat_age_s=round(min(age, 1e12), 1))
         if log is not None:
             log(f"queue: reclaimed {record.get('id', name)} -> {state} "
-                f"(heartbeat {age:.0f}s old, attempt {attempts})")
+                f"(heartbeat {age:.0f}s stale, attempt {attempts}, "
+                f"fence -> {int(record.get('fence', 0))})")
+    # drop observations for keys no longer current (job finished,
+    # moved, or its heartbeat advanced) so the cache stays bounded
+    for key in [k for k in _hb_observed
+                if k[0] == qabs and k not in current_keys]:
+        del _hb_observed[key]
     return moved
 
 
+def unpark(queue_dir: str, job_id: str, note: str = "") -> bool:
+    """parked -> queued (breaker half-open probe release / operator
+    reset / fsck repair of an orphaned park).  Clears the backoff gate
+    so the released job is immediately claimable.  Returns False when
+    the job is not parked (raced away)."""
+    src = os.path.join(queue_dir, "parked", job_id + ".json")
+    try:
+        with open(src) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    record.pop("not_before_unix", None)
+    record.pop("parked_by", None)
+    if note:
+        record.setdefault("failure_log", []).append({
+            "error": note, "stage": "unpark",
+            "kind": job_kind(record),
+            "attempt": int(record.get("attempts", 0)),
+            "worker": "", "trace_id": record.get("trace_id", ""),
+            "time_unix": time.time()})
+    dst = os.path.join(queue_dir, "queued", job_id + ".json")
+    try:
+        _write_record(src, record)
+        os.rename(src, dst)
+    except OSError:
+        return False
+    return True
+
+
 def job_status(queue_dir: str, job_id: str) -> Optional[Job]:
-    """Find a job in any state dir (None when unknown)."""
+    """Find a job in any state dir (None when unknown).  Tolerates a
+    record being renamed between the existence check and the read (a
+    racing claim/finish) by moving on to the next state dir."""
     for state, d in _dirs(queue_dir).items():
         path = os.path.join(d, job_id + ".json")
-        if os.path.isfile(path):
+        try:
             with open(path) as f:
                 return Job(id=job_id, path=path, record=json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
     return None
 
 
@@ -421,6 +805,6 @@ def queue_counts(queue_dir: str) -> Dict[str, int]:
         try:
             out[state] = len([n for n in os.listdir(d)
                               if n.endswith(".json")])
-        except FileNotFoundError:
+        except (FileNotFoundError, NotADirectoryError):
             out[state] = 0
     return out

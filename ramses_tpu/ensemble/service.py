@@ -27,11 +27,15 @@ from __future__ import annotations
 import json
 import os
 import re
+import signal
+import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ramses_tpu.ensemble import breaker as bkr
 from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.resilience.diskguard import DiskGuard, guarded_save
 from ramses_tpu.resilience.watchdog import HangDetected
 
 #: jax.config keys the serve loop snapshots on entry and restores on
@@ -41,6 +45,46 @@ _JAX_CACHE_KEYS = ("jax_compilation_cache_dir",
                    "jax_persistent_cache_min_compile_time_secs",
                    "jax_persistent_cache_min_entry_size_bytes",
                    "jax_persistent_cache_enable_xla_caches")
+
+
+class DrainRequested(Exception):
+    """Raised out of a job's chunk beat after a drain request
+    (SIGTERM): the in-flight chunk finished and a checkpoint was
+    attempted, so the serve loop requeues the job with
+    ``stage="drain"`` (attempt refunded) and exits cleanly — the next
+    worker resumes from the drain checkpoint."""
+
+
+#: process-wide drain latch — SIGTERM's handler only sets an event, so
+#: the signal is safe to take mid-chunk; the beat acts on it at the
+#: next chunk boundary
+_DRAIN = threading.Event()
+
+
+def request_drain() -> None:
+    """Ask every serve loop in this process to graceful-drain: finish
+    the current chunk, checkpoint, requeue held jobs with
+    ``stage="drain"``, exit.  The public API for embedders/tests;
+    SIGTERM routes here when :func:`serve` runs on the main thread."""
+    _DRAIN.set()
+
+
+def drain_requested() -> bool:
+    return _DRAIN.is_set()
+
+
+def _backoff_knobs() -> Tuple[float, float]:
+    """Requeue-backoff (base, cap) seconds — env-configured per worker
+    (``RAMSES_QUEUE_BACKOFF_S`` / ``RAMSES_QUEUE_BACKOFF_CAP_S``);
+    base 0 disables the eligibility gate."""
+    def _f(name, dflt):
+        try:
+            raw = os.environ.get(name)
+            return float(raw) if raw not in (None, "") else dflt
+        except (TypeError, ValueError):
+            return dflt
+    return _f("RAMSES_QUEUE_BACKOFF_S", 1.0), \
+        _f("RAMSES_QUEUE_BACKOFF_CAP_S", 60.0)
 
 
 def _job_setup(queue_dir: str, job: "jq.Job", log=print):
@@ -101,6 +145,12 @@ def _bind_trace(eng, rec: Dict[str, Any]) -> None:
               "job": str(rec.get("id") or ""),
               "worker": str(rec.get("worker") or "")}
     eng.trace_meta = {k: v for k, v in fields.items() if v}
+    # the claim's fencing token rides into telemetry records and
+    # checkpoint manifest meta: any artifact a fenced-out zombie still
+    # managed to write is attributable (and dismissible) by generation
+    fence = int(rec.get("fence", 0) or 0)
+    if fence:
+        eng.trace_meta["fence"] = fence
     eng.telemetry.bind(**eng.trace_meta)
 
 
@@ -220,16 +270,31 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
     from ramses_tpu.obs.profile import ProfileRequestWatcher
     watcher = ProfileRequestWatcher(rdir, log=log)
 
+    dguard = DiskGuard.from_params(params, rdir, log=log)
+
     def drive(eng):
         from ramses_tpu.resilience.checkpoint import rotate_checkpoints
 
         def beat(e):
             # worker liveness + resumability advance together: every
-            # fused window refreshes the claim mtime and lands a
-            # manifest-valid checkpoint (keep the newest two)
+            # fused window refreshes the fenced claim heartbeat and
+            # lands a manifest-valid checkpoint (keep the newest two).
+            # A reclaimed zombie dies HERE — heartbeat() raises
+            # FenceLost, which escalates straight out of supervise.
             jq.heartbeat(job)
-            e.save(rdir)
-            rotate_checkpoints(rdir, keep=2)
+
+            def _save():
+                e.save(rdir)
+                rotate_checkpoints(rdir, keep=2)
+            # disk-pressure degradation: below the soft watermark (or
+            # after an injected/real ENOSPC) the checkpoint is shed and
+            # the run keeps stepping — resumability gets coarser, the
+            # worker survives
+            guarded_save(_save, dguard, telemetry=e.telemetry, log=log,
+                         where="chunk-beat")
+            if drain_requested() and not e.run_complete():
+                raise DrainRequested(
+                    f"job {job.id}: worker draining (SIGTERM)")
             # on-demand profiling (ramses_tpu/obs/profile): the chunk
             # boundary is the one point with no fused window in flight
             watcher.poll(telemetry=e.telemetry)
@@ -237,11 +302,14 @@ def run_job(queue_dir: str, job: "jq.Job", max_attempts: int = 2,
 
     # hang_retries=0: a deadline-expired chunk escapes immediately so
     # the serve loop can kill-and-requeue with stage="hang" instead of
-    # retrying inside a worker the queue already believes is live
+    # retrying inside a worker the queue already believes is live;
+    # escalate: fence loss and drain are serve-loop control flow, not
+    # run failures — they must never burn a supervised retry
     try:
         eng = rsup.supervise(build, drive, params, base_dir=rdir,
                              max_attempts=max_attempts, log=log,
-                             hang_retries=0)
+                             hang_retries=0,
+                             escalate=(jq.FenceLost, DrainRequested))
     finally:
         # never leave a device trace open across attempts/errors —
         # jax.profiler allows one active trace per process
@@ -265,17 +333,29 @@ def _dispose(job: "jq.Job", err: BaseException, counts: Dict[str, int],
              max_attempts: int, telemetry, log, stage: str = "requeue"
              ) -> None:
     """Requeue-or-fail one errored job, mirroring the serve loop's
-    attempt accounting."""
+    attempt accounting.  Requeues carry the jittered-exponential
+    backoff gate (:func:`_backoff_knobs`) so a crash-looping job can't
+    thundering-herd the fleet's claim scans.  A :class:`FenceLost`
+    raised by the disposal itself means the record was reclaimed out
+    from under this worker mid-error — the job is simply abandoned
+    (its new owner carries it) and no count is taken."""
     text = "".join(traceback.format_exception_only(type(err),
                                                    err)).strip()
     log(f"serve: {job.id} "
         f"{'hang' if stage == 'hang' else 'failed'}: {err!r}")
-    if int(job.record.get("attempts", 0)) < max_attempts:
-        counts["requeued"] += 1
-        jq.requeue(job, error=text, telemetry=telemetry, stage=stage)
-    else:
-        counts["failed"] += 1
-        jq.fail(job, error=text, telemetry=telemetry, stage=stage)
+    base_s, cap_s = _backoff_knobs()
+    try:
+        if int(job.record.get("attempts", 0)) < max_attempts:
+            jq.requeue(job, error=text, telemetry=telemetry,
+                       stage=stage, backoff_base_s=base_s,
+                       backoff_cap_s=cap_s)
+            counts["requeued"] += 1
+        else:
+            jq.fail(job, error=text, telemetry=telemetry, stage=stage)
+            counts["failed"] += 1
+    except jq.FenceLost as fe:
+        log(f"serve: {job.id} disposal refused (claim reclaimed): "
+            f"{fe}")
 
 
 def run_gang(queue_dir: str,
@@ -332,6 +412,8 @@ def run_gang(queue_dir: str,
             f"{list(dev_ids)} ({plan.mode})")
         active.append({"job": job, "rdir": rdir, "params": params,
                        "eng": eng,
+                       "dguard": DiskGuard.from_params(params, rdir,
+                                                       log=log),
                        "watch": ProfileRequestWatcher(rdir, log=log)})
     if telemetry is not None:
         try:
@@ -341,10 +423,40 @@ def run_gang(queue_dir: str,
         except Exception:
             pass
     while active:
+        if drain_requested():
+            # SIGTERM graceful drain: the in-flight chunks are done
+            # (we only reach a loop top between chunks) — checkpoint
+            # every held job and hand it back with stage="drain"; the
+            # attempt is refunded because the drain is this worker's
+            # doing, not the job's
+            for st in list(active):
+                st["watch"].stop()
+                dg = st.get("dguard")
+                guarded_save(lambda _st=st: _st["eng"].save(
+                    _st["rdir"]), dg, telemetry=st["eng"].telemetry,
+                    log=log, where="drain")
+                st["eng"].telemetry.close(st["eng"],
+                                          print_timers=False)
+                try:
+                    jq.requeue(st["job"],
+                               error="worker draining (SIGTERM)",
+                               telemetry=telemetry, stage="drain",
+                               count_attempt=False)
+                    counts["requeued"] += 1
+                    log(f"serve: {st['job'].id} drained -> queued")
+                except jq.FenceLost as fe:
+                    log(f"serve: {st['job'].id} drain requeue "
+                        f"refused (claim reclaimed): {fe}")
+            return counts
         begun: List[Tuple[Dict[str, Any], Any]] = []
         for st in list(active):
             try:
                 begun.append((st, st["eng"].begin_chunk()))
+            except jq.FenceLost as e:
+                st["watch"].stop()
+                log(f"serve: {st['job'].id} fence lost — abandoning "
+                    f"(new owner carries it): {e}")
+                active.remove(st)
             except BaseException as e:  # noqa: BLE001
                 stage = "hang" if isinstance(e, HangDetected) \
                     else "requeue"
@@ -365,13 +477,21 @@ def run_gang(queue_dir: str,
                     quarantined=eng.quarantined_count,
                     wall_s=round(eng.wall_s, 6))
                 jq.heartbeat(st["job"])
-                st["eng"].save(st["rdir"])
-                rotate_checkpoints(st["rdir"], keep=2)
+                guarded_save(lambda _st=st: (
+                    _st["eng"].save(_st["rdir"]),
+                    rotate_checkpoints(_st["rdir"], keep=2)),
+                    st.get("dguard"), telemetry=eng.telemetry,
+                    log=log, where="gang-beat")
                 st["watch"].poll(telemetry=eng.telemetry)
                 if stepped == 0 and not st["eng"].run_complete():
                     raise RuntimeError(
                         f"job {st['job'].id}: no progress in a chunk "
                         "(inconsistent tend/nstepmax)")
+            except jq.FenceLost as e:
+                st["watch"].stop()
+                log(f"serve: {st['job'].id} fence lost — abandoning "
+                    f"(new owner carries it): {e}")
+                active.remove(st)
             except BaseException as e:  # noqa: BLE001
                 stage = "hang" if isinstance(e, HangDetected) \
                     else "requeue"
@@ -394,9 +514,13 @@ def run_gang(queue_dir: str,
                                  st["job"].record, snap, cache0,
                                  log=log, gang_info=gang_info)
             eng.telemetry.close(eng, print_timers=False)
-            counts["done"] += 1
-            jq.complete(st["job"], result=result)
-            log(f"serve: {st['job'].id} done -> {snap}")
+            try:
+                jq.complete(st["job"], result=result)
+                counts["done"] += 1
+                log(f"serve: {st['job'].id} done -> {snap}")
+            except jq.FenceLost as fe:
+                log(f"serve: {st['job'].id} completion refused "
+                    f"(claim reclaimed): {fe}")
             active.remove(st)
     return counts
 
@@ -404,7 +528,8 @@ def run_gang(queue_dir: str,
 def _counts_line(queue_dir: str) -> str:
     c = jq.queue_counts(queue_dir)
     return (f"queued={c['queued']} running={c['running']} "
-            f"done={c['done']} failed={c['failed']}")
+            f"done={c['done']} failed={c['failed']} "
+            f"parked={c.get('parked', 0)}")
 
 
 def _worker_telemetry(queue_dir: str, worker: str):
@@ -436,7 +561,8 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
           telemetry=None, order: str = "cost",
           gang_starve_s: float = 600.0,
           obs_port: Optional[int] = None,
-          obs_bind: str = "127.0.0.1") -> Dict[str, int]:
+          obs_bind: str = "127.0.0.1",
+          startup_fsck: bool = True) -> Dict[str, int]:
     """Worker loop: claim and run jobs until the queue is drained
     (``idle_exit``) or ``max_jobs`` jobs have been processed
     (0 = unbounded).  Returns done/failed counts for this worker.
@@ -447,6 +573,18 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
     mesh-wide jobs, with ``gang_starve_s`` bounding how long a big job
     can be overtaken — while ``"fifo"`` restores the blind
     oldest-first single-job behavior.
+
+    Fleet hardening: on the main thread SIGTERM triggers a **graceful
+    drain** (finish the in-flight chunk, checkpoint, requeue held
+    jobs with ``stage="drain"`` and the attempt refunded, exit 0);
+    embedders/tests call :func:`request_drain` directly.  Startup runs
+    the always-safe queue-fsck repairs (``startup_fsck=False`` opts
+    out).  Claims honor the requeue-backoff eligibility gate and the
+    poison-config circuit breaker (matching queued jobs are parked
+    while a breaker is open; TTL expiry half-opens it from this poll
+    loop).  Under hard disk pressure (``RAMSES_DISK_HARD_MB``) the
+    worker pauses claiming — alive and heartbeating — until space
+    returns.
 
     Observability: ``telemetry`` defaults to a per-worker sink under
     ``<queue_dir>/workers/`` receiving the queue lifecycle events
@@ -474,17 +612,76 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
     # config; snapshot it so an in-process caller (tests, a notebook)
     # gets its compilation-cache settings back when serve returns
     cache_snap = None
+    # SIGTERM -> graceful drain.  Only the main thread may install
+    # signal handlers; elsewhere (in-process embedding, test threads)
+    # request_drain() is the API.  The previous handler is restored on
+    # exit so serve-in-a-library never leaks its policy.
+    _DRAIN.clear()
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM,
+                                  lambda _s, _f: request_drain())
+    except ValueError:
+        pass
+    if startup_fsck:
+        # crash-consistency sweep of the always-safe classes (torn
+        # record tmps, orphaned heartbeats, orphaned parks) before
+        # touching the queue; anything needing judgement is only
+        # logged for the operator CLI
+        try:
+            from ramses_tpu.ensemble import fsck as qfsck
+            qfsck.startup_repair(queue_dir, log=log)
+        except Exception as e:  # noqa: BLE001 — advisory pass
+            if log is not None:
+                log(f"serve: startup fsck skipped: {e!r}")
+    # worker-level disk watermark (env): at hard pressure stop
+    # claiming, stay alive
+    wguard = DiskGuard.from_env(queue_dir, log=log)
+    backoff_base_s, backoff_cap_s = _backoff_knobs()
     try:
         telemetry.record_event("serve_start", worker=worker,
                                obs_url=obs.url if obs else "",
                                **jq.queue_counts(queue_dir))
         while True:
+            if drain_requested():
+                telemetry.record_event("serve_drain", worker=worker,
+                                       **jq.queue_counts(queue_dir))
+                if log is not None:
+                    log(f"serve: drain requested — exiting clean; "
+                        f"{_counts_line(queue_dir)}")
+                return counts
+            if not wguard.allow_claim():
+                # hard disk pressure: claiming pauses, the worker
+                # stays alive (io_degraded emitted on the transition
+                # edge by emit()) and re-checks every poll
+                wguard.emit(telemetry, where="claim")
+                time.sleep(poll_s)
+                continue
+            wguard.emit(telemetry, where="claim")   # recovery edge
             # default staleness from the first job's namelist is
             # unknowable before claiming — use the CLI/default value
             jq.reclaim_stale(queue_dir, stale_s=stale_s or 300.0,
                              max_attempts=max_attempts, log=log,
-                             telemetry=telemetry)
+                             telemetry=telemetry,
+                             backoff_base_s=backoff_base_s,
+                             backoff_cap_s=backoff_cap_s)
+            # poison-config breaker maintenance: TTL-expired breakers
+            # half-open (one probe released); open breakers park any
+            # matching queued jobs before we plan a claim
+            bkr.sweep(queue_dir, telemetry=telemetry,
+                      log=log if verbose else None)
             records = jq.peek_queued(queue_dir)
+            open_fps = bkr.open_fingerprints(queue_dir)
+            if open_fps:
+                keep = []
+                for r in records:
+                    fp = bkr.fingerprint_of(r)
+                    if fp in open_fps:
+                        bkr.park_record(queue_dir, r, open_fps[fp],
+                                        telemetry=telemetry, log=log)
+                    else:
+                        keep.append(r)
+                records = keep
             if not records:
                 if idle_exit:
                     telemetry.record_event("serve_idle", exiting=True,
@@ -504,6 +701,17 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
                     last_beat = now
                 time.sleep(poll_s)
                 continue
+            now_w = time.time()
+            eligible = [r for r in records
+                        if float(r.get("not_before_unix") or 0.0)
+                        <= now_w]
+            if not eligible:
+                # every queued record is inside its requeue-backoff
+                # window: the queue is NOT idle (no idle_exit), the
+                # jobs are just not claimable yet
+                time.sleep(poll_s)
+                continue
+            records = eligible
             import jax
             if cache_snap is None:
                 from ramses_tpu import platform as _plat
@@ -542,6 +750,26 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
                                      max_attempts=max_attempts,
                                      verbose=verbose, log=log,
                                      device_ids=dev_ids)
+                except DrainRequested as e:
+                    # graceful drain: the chunk finished and a drain
+                    # checkpoint was attempted inside the beat — hand
+                    # the job back (attempt refunded) and let the
+                    # loop-top drain check exit this worker
+                    try:
+                        jq.requeue(job, error=str(e),
+                                   telemetry=telemetry, stage="drain",
+                                   count_attempt=False)
+                        counts["requeued"] += 1
+                        log(f"serve: {job.id} drained -> queued")
+                    except jq.FenceLost as fe:
+                        log(f"serve: {job.id} drain requeue refused "
+                            f"(claim reclaimed): {fe}")
+                except jq.FenceLost as e:
+                    # this worker zombied past the stale timeout and
+                    # the job was reclaimed: abandon it — the refusal
+                    # is already durable in the record's failure_log
+                    log(f"serve: {job.id} fence lost — abandoning "
+                        f"(new owner carries it): {e}")
                 except HangDetected as e:
                     # serve-loop liveness: a deadline-expired chunk
                     # comes back HERE (run_job runs hang_retries=0) —
@@ -554,10 +782,14 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
                     _dispose(job, e, counts, max_attempts, telemetry,
                              log)
                 else:
-                    counts["done"] += 1
-                    jq.complete(job, result=result)
-                    log(f"serve: {job.id} done -> "
-                        f"{result.get('snapshot') or result.get('checkpoint')}")
+                    try:
+                        jq.complete(job, result=result)
+                        counts["done"] += 1
+                        log(f"serve: {job.id} done -> "
+                            f"{result.get('snapshot') or result.get('checkpoint')}")
+                    except jq.FenceLost as fe:
+                        log(f"serve: {job.id} completion refused "
+                            f"(claim reclaimed): {fe}")
             else:
                 log(f"serve: gang of {len(gang)} jobs over "
                     f"{sum(len(d) for _, d in gang)}/{ndev} devices")
@@ -570,6 +802,11 @@ def serve(queue_dir: str, worker: str = "", max_jobs: int = 0,
             if max_jobs and counts["done"] + counts["failed"] >= max_jobs:
                 return counts
     finally:
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:
+                pass
         if own_tel is not None:
             try:
                 own_tel.record_event("serve_exit", worker=worker,

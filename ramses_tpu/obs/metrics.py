@@ -128,6 +128,10 @@ def collect(queue_dir: str, now: Optional[float] = None) -> List[Family]:
     hb = Family("ramses_job_heartbeat_age_seconds", "gauge",
                 "Age of each running job's claim heartbeat (stale "
                 "workers are reclaimed past the staleness timeout).")
+    fenced = Family("ramses_fenced_writes_total", "counter",
+                    "Worker-side queue writes refused because the "
+                    "claim's fencing token was superseded (zombie "
+                    "reclaim protection).")
 
     n_attempts = n_quar = n_partial = n_hits = n_miss = 0
     n_cells = 0
@@ -158,14 +162,23 @@ def collect(queue_dir: str, now: Optional[float] = None) -> List[Family]:
         if state == "running":
             path = os.path.join(queue_dir, "running",
                                 str(rec.get("id", "?")) + ".json")
+            # content-heartbeat sidecar first (fenced claims write
+            # <id>.json.hb); pre-fencing records fall back to the
+            # record file's own mtime
             try:
-                hb.add(round(now - os.path.getmtime(path), 3),
-                       job=str(rec.get("id", "?")))
+                hb.add(round(now - os.path.getmtime(
+                    path + jq.HB_SUFFIX), 3),
+                    job=str(rec.get("id", "?")))
             except OSError:
-                pass
+                try:
+                    hb.add(round(now - os.path.getmtime(path), 3),
+                           job=str(rec.get("id", "?")))
+                except OSError:
+                    pass
     attempts.add(n_attempts)
     for stage in sorted(by_stage):
         failures.add(by_stage[stage], stage=stage)
+    fenced.add(by_stage.get("fenced", 0))
     quarantined.add(n_quar)
     partial.add(n_partial)
     cache_hits.add(n_hits)
@@ -201,9 +214,30 @@ def collect(queue_dir: str, now: Optional[float] = None) -> List[Family]:
         if gs is not None and gs.get("busy_frac") is not None:
             busy.add(float(gs["busy_frac"]), worker=worker)
 
-    fams = [depth, attempts, failures, quarantined, partial,
+    brk = Family("ramses_breaker_state", "gauge",
+                 "Poison-config circuit breakers by config "
+                 "fingerprint (0 closed, 1 half-open, 2 open).")
+    try:
+        from ramses_tpu.ensemble import breaker as bk
+        for b in bk.list_breakers(queue_dir):
+            brk.add(bk.STATE_VALUE.get(str(b.get("state")), 0),
+                    fp=str(b.get("fp", "?")),
+                    stage=str(b.get("stage", "")))
+    except Exception:
+        pass
+    disk = Family("ramses_disk_free_bytes", "gauge",
+                  "Free bytes on the filesystem holding the queue "
+                  "directory (diskguard watermarks gate checkpoints "
+                  "and claims on it).")
+    try:
+        st = os.statvfs(queue_dir)
+        disk.add(float(st.f_bavail) * float(st.f_frsize))
+    except OSError:
+        pass
+
+    fams = [depth, attempts, failures, fenced, quarantined, partial,
             cache_hits, cache_miss, cells, qwait, qwait_n, spd,
-            hb, whb, busy]
+            hb, whb, busy, brk, disk]
     return [f for f in fams if f.samples]
 
 

@@ -46,5 +46,7 @@ from ramses_tpu.resilience.checkpoint import (  # noqa: F401
     finalize_checkpoint, latest_valid_checkpoint, quarantine_shard,
     resolve_restart_dir, rotate_checkpoints, scrub_checkpoints,
     validate_checkpoint, validate_shard, write_global_manifest)
+from ramses_tpu.resilience.diskguard import (  # noqa: F401
+    DiskGuard, guarded_save)
 from ramses_tpu.resilience.stepguard import (  # noqa: F401
     StepGuard, StepRetryExhausted)

@@ -1,0 +1,189 @@
+"""Graceful degradation under disk pressure.
+
+A fleet worker's natural death mode on a filling disk is an unhandled
+``OSError(ENOSPC)`` out of the fsync-heavy checkpoint/commit paths —
+the worker crashes, the job bounces, the next worker crashes on the
+same disk.  The guard turns that into staged degradation:
+
+* below the **soft** free-space watermark the per-chunk checkpoint
+  beat is *shed* (the run keeps stepping, resumability gets coarser,
+  an ``io_degraded`` telemetry event + Prometheus gauge say so);
+* below the **hard** watermark the serve worker additionally *stops
+  claiming new jobs* — it stays alive, finishes what it holds,
+  heartbeats (zero-byte mtime fallback exists for ENOSPC), and
+  resumes claiming the moment space returns;
+* an actual ``ENOSPC`` raised inside a guarded write is absorbed
+  (:func:`guarded_save`): the checkpoint is skipped, the guard holds
+  itself at least soft-degraded for a cooldown, and the worker lives.
+
+Watermarks come from ``&ENSEMBLE_PARAMS disk_soft_free_mb`` /
+``disk_hard_free_mb`` (per-job) or the ``RAMSES_DISK_SOFT_MB`` /
+``RAMSES_DISK_HARD_MB`` env vars (per-worker; env wins).  ``0``
+disables a watermark.  Stdlib-only; the probe is injectable so tests
+never need to actually fill a disk.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import time
+from typing import Callable, Optional
+
+ENV_SOFT = "RAMSES_DISK_SOFT_MB"
+ENV_HARD = "RAMSES_DISK_HARD_MB"
+
+_MB = 1024.0 * 1024.0
+
+#: degradation levels, in increasing severity
+LEVELS = ("ok", "soft", "hard")
+
+
+def free_bytes(path: str) -> float:
+    """Free bytes available to this process on ``path``'s filesystem
+    (0.0 when even statvfs fails — a dead filesystem is maximally
+    degraded, not a crash)."""
+    try:
+        st = os.statvfs(path)
+        return float(st.f_bavail) * float(st.f_frsize)
+    except OSError:
+        return 0.0
+
+
+def is_enospc(err: BaseException) -> bool:
+    return isinstance(err, OSError) and err.errno == errno.ENOSPC
+
+
+def _env_mb(name: str, fallback: float) -> float:
+    try:
+        raw = os.environ.get(name)
+        return float(raw) if raw not in (None, "") else float(fallback)
+    except (TypeError, ValueError):
+        return float(fallback)
+
+
+class DiskGuard:
+    """Free-space watermark over one directory.  ``probe`` is the
+    free-bytes function (injectable for tests and fault drills);
+    ``cooldown_s`` is how long an observed ENOSPC keeps the guard at
+    least soft-degraded even if the probe claims space (quota errors
+    and statvfs lag both look like that)."""
+
+    def __init__(self, path: str, soft_free_bytes: float = 0.0,
+                 hard_free_bytes: float = 0.0,
+                 probe: Optional[Callable[[str], float]] = None,
+                 cooldown_s: float = 60.0, log=None):
+        self.path = path
+        self.soft = max(0.0, float(soft_free_bytes))
+        self.hard = max(0.0, float(hard_free_bytes))
+        self._probe = probe or free_bytes
+        self._cooldown_s = float(cooldown_s)
+        self._enospc_until = 0.0       # monotonic deadline
+        self._last_emitted = "ok"      # transition-edge event dedup
+        self._log = log
+
+    @classmethod
+    def from_env(cls, path: str, log=None) -> "DiskGuard":
+        """Worker-level guard: env watermarks only."""
+        return cls(path, soft_free_bytes=_env_mb(ENV_SOFT, 0.0) * _MB,
+                   hard_free_bytes=_env_mb(ENV_HARD, 0.0) * _MB,
+                   log=log)
+
+    @classmethod
+    def from_params(cls, params, path: str, log=None) -> "DiskGuard":
+        """Per-job guard: ``&ENSEMBLE_PARAMS`` watermarks, env
+        override."""
+        ens = getattr(params, "ensemble", None)
+        soft = float(getattr(ens, "disk_soft_free_mb", 0.0) or 0.0)
+        hard = float(getattr(ens, "disk_hard_free_mb", 0.0) or 0.0)
+        return cls(path,
+                   soft_free_bytes=_env_mb(ENV_SOFT, soft) * _MB,
+                   hard_free_bytes=_env_mb(ENV_HARD, hard) * _MB,
+                   log=log)
+
+    def free_bytes(self) -> float:
+        return float(self._probe(self.path))
+
+    def level(self) -> str:
+        """Current degradation level; an ENOSPC cooldown clamps to at
+        least ``soft`` regardless of what the probe says."""
+        free = self.free_bytes()
+        lvl = "ok"
+        if self.hard > 0.0 and free < self.hard:
+            lvl = "hard"
+        elif self.soft > 0.0 and free < self.soft:
+            lvl = "soft"
+        if lvl == "ok" and time.monotonic() < self._enospc_until:
+            lvl = "soft"
+        return lvl
+
+    def allow_checkpoint(self) -> bool:
+        """Shed checkpoint rotation first — below soft nothing new is
+        written to disk by the beat."""
+        return self.level() == "ok"
+
+    def allow_claim(self) -> bool:
+        """Stop claiming only at hard pressure — a soft-degraded
+        worker still drains the queue."""
+        return self.level() != "hard"
+
+    def note_enospc(self) -> None:
+        """An ENOSPC escaped a guarded write: hold degraded for the
+        cooldown window."""
+        self._enospc_until = time.monotonic() + self._cooldown_s
+
+    def emit(self, telemetry=None, where: str = "") -> str:
+        """Emit an ``io_degraded`` event on level *transitions* (both
+        directions — recovery is an event too).  Returns the level."""
+        lvl = self.level()
+        if lvl == self._last_emitted:
+            return lvl
+        self._last_emitted = lvl
+        free = self.free_bytes()
+        if self._log is not None:
+            self._log(f"diskguard: {where or self.path} -> {lvl} "
+                      f"({free / _MB:.0f} MiB free)")
+        if telemetry is not None:
+            try:
+                telemetry.record_event(
+                    "io_degraded", level=lvl, where=where,
+                    free_bytes=int(free),
+                    soft_bytes=int(self.soft),
+                    hard_bytes=int(self.hard))
+            except Exception:
+                pass
+        return lvl
+
+
+def guarded_save(save_fn: Callable[[], None],
+                 guard: Optional[DiskGuard], telemetry=None,
+                 log=None, where: str = "checkpoint") -> bool:
+    """Run an ENOSPC-prone checkpoint write under the watermark:
+    skipped outright when the guard is already degraded, and an
+    ``ENOSPC`` raised inside degrades (note + skip + event) instead of
+    crashing the worker.  Every other exception propagates untouched.
+    Returns True when the write actually ran."""
+    if guard is not None and not guard.allow_checkpoint():
+        guard.emit(telemetry, where=where)
+        return False
+    try:
+        save_fn()
+        if guard is not None:
+            guard.emit(telemetry, where=where)   # recovery edge
+        return True
+    except OSError as e:
+        if not is_enospc(e):
+            raise
+        if guard is not None:
+            guard.note_enospc()
+            guard.emit(telemetry, where=where)
+        if log is not None:
+            log(f"diskguard: ENOSPC during {where} — checkpoint "
+                f"shed, worker continues")
+        if telemetry is not None:
+            try:
+                telemetry.record_event("io_degraded", level="enospc",
+                                       where=where, free_bytes=0)
+            except Exception:
+                pass
+        return False

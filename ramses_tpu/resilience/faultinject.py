@@ -34,6 +34,21 @@ Spec syntax (``&RUN_PARAMS fault_inject='...'`` or env
                        barrier must kill-and-fall-through, and the
                        torn ``pario_NNNNN.tmp`` staging dir must
                        never scan as a valid checkpoint
+  ``zombie@K``         fleet-layer: the claimed worker's host thread
+                       sleeps ``RAMSES_ZOMBIE_SLEEP_S`` (default 5s)
+                       at the chunk that starts at nstep K — long
+                       enough for a short ``stale_timeout`` to
+                       reclaim the job — then RESUMES and keeps
+                       writing; the queue's fencing token must refuse
+                       its late heartbeat/complete()
+  ``enospc@K``         fleet-layer: the next checkpoint staging write
+                       at nstep >= K raises ``OSError(ENOSPC)`` —
+                       diskguard must shed the checkpoint and keep
+                       the worker alive
+  ``skew:<s>``         fleet-layer: bias every heartbeat wall-time
+                       stamp by ``s`` seconds (positive or negative)
+                       — the observer-clock reclaim logic must not
+                       false-trip on it
 
 Arming is strict: a fault fires only if the run is seen at
 ``nstep < K`` first, so a resumed run that restarts at nstep >= K does
@@ -64,7 +79,11 @@ _OPT_KEY = {"nan": "member", "hang": "member",
 # every step-indexed kind participates in strict arming and the fused
 # window clamp — a torn/die fault must not be skipped over by a fused
 # multi-step dispatch any more than a nan may be
-STEP_KINDS = ("nan", "sigterm", "hang", "torn", "die")
+STEP_KINDS = ("nan", "sigterm", "hang", "torn", "die",
+              "zombie", "enospc")
+
+# step-indexed kinds that take no ':key=' target option
+_UNTARGETED_AT = ("sigterm", "zombie", "enospc")
 
 
 def _parse(spec: str):
@@ -89,10 +108,12 @@ def _parse(spec: str):
                         f"in {part!r} (expected {want}=J)")
                 targets[len(faults)] = int(opt[len(want) + 1:])
             faults.append((kind, int(body)))
-        elif part.startswith("sigterm@"):
-            faults.append(("sigterm", int(part[8:])))
+        elif sep and kind in _UNTARGETED_AT:
+            faults.append((kind, int(rest)))
         elif part.startswith("truncate:"):
             faults.append(("truncate", part[len("truncate:"):]))
+        elif part.startswith("skew:"):
+            faults.append(("skew", float(part[len("skew:"):])))
         else:
             raise ValueError(f"unknown fault_inject spec {part!r}")
     return faults, targets
@@ -171,13 +192,15 @@ class FaultInjector:
 
     def observe(self, nstep: int) -> None:
         """Strict-arming observation for the dump-path faults
-        (torn/die): they fire inside ``dump_pario``, far from any
-        per-step guard, so the window clamp — which every driver calls
-        with the current nstep — records 'seen at nstep < K' for them.
-        nan/sigterm/hang arming stays inside their own guard checks
-        (member-targeted faults must arm against the MEMBER's step)."""
+        (torn/die/enospc): they fire inside the dump/staging path, far
+        from any per-step guard, so the window clamp — which every
+        driver calls with the current nstep — records 'seen at
+        nstep < K' for them.  nan/sigterm/hang/zombie arming stays
+        inside their own guard checks (member-targeted faults must arm
+        against the MEMBER's step)."""
         for i, (kind, k) in enumerate(self.faults):
-            if kind in ("torn", "die") and i not in self._armed:
+            if kind in ("torn", "die", "enospc") \
+                    and i not in self._armed:
                 self._armed[i] = int(nstep) < int(k)
 
     def clamp_window(self, nstep: int, n: int) -> int:
@@ -326,6 +349,52 @@ class FaultInjector:
             return True
         return False
 
+    def maybe_zombie(self, nstep: int) -> bool:
+        """``zombie@K``: stall the host thread long enough for a
+        short ``stale_timeout`` to reclaim the job, then RETURN — the
+        worker resumes and keeps writing, and the queue's fencing
+        token (not this injector) is what must stop it.  Sleep length
+        is ``RAMSES_ZOMBIE_SLEEP_S`` (default 5s).  Once per process
+        (like hang): the re-claimed attempt rebuilds the injector in
+        the same process and must not re-stall."""
+        import time
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "zombie":
+                continue
+            key = (kind, int(self.faults[i][1]))
+            if key in _zombie_fired \
+                    or not self._should_fire(i, kind, int(nstep)):
+                continue
+            _zombie_fired.add(key)
+            sleep_s = float(os.environ.get(
+                "RAMSES_ZOMBIE_SLEEP_S", "5"))
+            print(f" fault-inject: zombie stall {sleep_s:g}s at "
+                  f"nstep={int(nstep)}", flush=True)
+            time.sleep(sleep_s)
+            print(" fault-inject: zombie woke — resuming writes",
+                  flush=True)
+            return True
+        return False
+
+    def maybe_enospc(self, nstep: int) -> None:
+        """``enospc@K``: raise ``OSError(ENOSPC)`` out of the next
+        checkpoint staging write at nstep >= K — diskguard must
+        absorb it.  Once per process, so the job's later (and final)
+        checkpoints land."""
+        import errno
+        for i, (kind, _k) in enumerate(self.faults):
+            if kind != "enospc":
+                continue
+            key = (kind, int(self.faults[i][1]))
+            if key in _enospc_fired \
+                    or not self._should_fire(i, kind, int(nstep)):
+                continue
+            _enospc_fired.add(key)
+            print(f" fault-inject: ENOSPC at nstep={int(nstep)}",
+                  flush=True)
+            raise OSError(errno.ENOSPC, "fault-inject: no space "
+                          "left on device")
+
     def maybe_torn(self, shard_dir: str, shard: int,
                    nstep: int) -> bool:
         """``torn@K:shard=J``: called by ``dump_pario`` after shard
@@ -391,11 +460,33 @@ def _die(code: int):
 # hang faults already delivered in this process (see _hang_done)
 _hang_fired = set()
 
+# fleet-layer faults already delivered in this process: resumed /
+# re-claimed attempts rebuild the injector but must not re-fire
+_zombie_fired = set()
+_enospc_fired = set()
+
+
+def heartbeat_skew() -> float:
+    """Summed ``skew:<s>`` bias (seconds) from the env spec — applied
+    by the queue's heartbeat writer to its wall-time stamp.  Env-only
+    on purpose: the skew is a property of the (simulated) worker
+    host, not of any one job's namelist."""
+    spec = os.environ.get(ENV_VAR, "")
+    if "skew:" not in spec:
+        return 0.0
+    try:
+        faults, _targets = _parse(spec)
+    except ValueError:
+        return 0.0
+    return float(sum(arg for kind, arg in faults if kind == "skew"))
+
 
 def reset_fired():
     """Forget process-wide fired state (test isolation)."""
     _hang_fired.clear()
     _truncate_fired.clear()
+    _zombie_fired.clear()
+    _enospc_fired.clear()
 
 
 # ---- post-dump truncation (module-level: dump may run on the

@@ -102,7 +102,8 @@ def _close_tel(tel, sim):
 def supervise(build: Callable, drive: Callable, params,
               base_dir: str = ".", max_attempts: int = 3,
               backoff_s: float = 1.0, tend: Optional[float] = None,
-              log: Callable = print, hang_retries: int = 2):
+              log: Callable = print, hang_retries: int = 2,
+              escalate: tuple = ()):
     """Run ``drive(build(restart_dir))`` until complete or attempts
     are exhausted.
 
@@ -115,6 +116,12 @@ def supervise(build: Callable, drive: Callable, params,
     ``max_attempts`` (see module docstring); ``hang_retries=0`` makes
     a hang escape on first detection — the serve loop uses that to
     kill-and-requeue rather than retry in-worker.
+
+    ``escalate`` is a tuple of exception types that are control flow
+    for the CALLER, not failures of the run — they re-raise
+    immediately with no retry and no backoff.  The serve loop passes
+    its fence-loss and drain-request types: a worker that lost its
+    claim must stop touching the job, not resume it.
     """
     max_attempts = max(1, int(max_attempts))
     hang_retries = max(0, int(hang_retries))
@@ -150,6 +157,8 @@ def supervise(build: Callable, drive: Callable, params,
         try:
             drive(sim)
             last_err = None
+        except escalate:
+            raise                # caller-owned control flow, no retry
         except Exception as e:   # noqa: BLE001 — supervisor boundary
             last_err = e
             log(f"resilience: attempt {attempt} failed "

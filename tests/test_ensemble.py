@@ -16,10 +16,8 @@ Pins the tentpole contracts:
 
 import json
 import os
-import signal
 import subprocess
 import sys
-import time
 
 import numpy as np
 import pytest
@@ -363,17 +361,21 @@ def test_queue_stale_reclaim(tmp_path):
     # a live heartbeat protects the claim ...
     jq.heartbeat(job)
     assert jq.reclaim_stale(q, stale_s=300.0, log=None) == 0
-    # ... a dead worker's record (old mtime) is taken over
-    old = time.time() - 3600
-    os.utime(job.path, (old, old))
+    # ... a worker dead for an hour (stale content heartbeat) is
+    # taken over, and the takeover bumps the fencing token
+    jq._age_heartbeat(job.path, 3600.0)
     assert jq.reclaim_stale(q, stale_s=300.0, max_attempts=3,
                             log=None) == 1
     j = jq.job_status(q, "job-stale")
     assert j.state == "queued" and j.record["attempts"] == 1
+    assert j.record["fence"] == 2        # claim=1, reclaim=2
+    # the zombie's writes are now fenced off
+    with pytest.raises(jq.FenceLost):
+        jq.heartbeat(job)
     # at the attempt ceiling the takeover fails the job instead
     job = jq.claim(q)
     assert job.record["attempts"] == 2
-    os.utime(job.path, (old, old))
+    jq._age_heartbeat(job.path, 3600.0)
     jq.reclaim_stale(q, stale_s=300.0, max_attempts=2, log=None)
     j = jq.job_status(q, "job-stale")
     assert j.state == "failed" and "no heartbeat" in j.record["error"]
@@ -409,7 +411,7 @@ def test_serve_drains_queue_with_artifacts(tmp_path):
                    log=lambda *a: None)
     assert counts == {"done": 2, "failed": 0, "requeued": 0}
     assert jq.queue_counts(q) == {"queued": 0, "running": 0,
-                                  "done": 2, "failed": 0}
+                                  "done": 2, "failed": 0, "parked": 0}
     for jid in ids:
         job = jq.job_status(q, jid)
         res = job.record["result"]
@@ -656,8 +658,7 @@ def test_queue_failure_log_accumulates_across_requeues(tmp_path):
     job = jq.claim(q, worker="w1")
     jq.requeue(job, error="first boom", telemetry=tel)
     job = jq.claim(q, worker="w2")
-    old = time.time() - 3600
-    os.utime(job.path, (old, old))
+    jq._age_heartbeat(job.path, 3600.0)
     assert jq.reclaim_stale(q, stale_s=300.0, max_attempts=3,
                             log=None, telemetry=tel) == 1
     j = jq.job_status(q, "job-log")
@@ -686,7 +687,7 @@ def test_serve_idle_prints_queue_counts(tmp_path):
                    log=logs.append)
     assert counts == {"done": 0, "failed": 0, "requeued": 0}
     assert any("serve: idle, exiting — queued=0 running=0 done=0 "
-               "failed=0" in m for m in logs)
+               "failed=0 parked=0" in m for m in logs)
 
 
 #: SERVICE_NML with a member-targeted NaN fault + quarantine-only mode:
@@ -720,16 +721,22 @@ def test_partial_completion_never_requeues(tmp_path):
     assert "ensemble_done" in kinds
 
 
-def test_sigterm_mid_ensemble_serve_resume_bitwise(tmp_path):
-    """satellite: SIGTERM@K mid-ensemble under ``--serve`` with
-    auto-resume.  The killed worker's job is reclaimed, attempt 2
-    resumes from the beat checkpoint, and the final state — healthy
+def test_sigterm_mid_ensemble_serve_drain_resume_bitwise(tmp_path):
+    """satellite: SIGTERM@K mid-ensemble under ``--serve`` is now a
+    graceful DRAIN, not a crash: the worker finishes its chunk, saves
+    a checkpoint, hands the job back with a refunded attempt and a
+    ``stage="drain"`` failure_log entry, and exits 0.  A second worker
+    resumes from the drain checkpoint and the final state — healthy
     member AND the quarantined member's census — is bitwise identical
-    to an uninterrupted serve of the same job."""
+    to an uninterrupted serve of the same job.  (The SIGTERM lands at
+    step 4 — after the nan@3 quarantine is durably in the engine
+    state — because a drain checkpoint taken exactly AT a fault's
+    trigger step strictly disarms it on resume, by design.)"""
+    nml = POISON_NML.replace("nstepmax=4", "nstepmax=8")
     q = str(tmp_path / "q")
-    jid = jq.submit(q, POISON_NML, ndim=2, dtype="float64")
+    jid = jq.submit(q, nml, ndim=2, dtype="float64")
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, RAMSES_FAULT_INJECT="sigterm@2",
+    env = dict(os.environ, RAMSES_FAULT_INJECT="sigterm@4",
                JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1",
                PYTHONPATH=os.pathsep.join(
                    p for p in (root, os.environ.get("PYTHONPATH", ""))
@@ -739,27 +746,28 @@ def test_sigterm_mid_ensemble_serve_resume_bitwise(tmp_path):
          "--idle-exit", "--max-attempts", "2"],
         capture_output=True, text=True, timeout=420, env=env,
         cwd=str(tmp_path))
-    assert r.returncode == -signal.SIGTERM, \
+    assert r.returncode == 0, \
         (r.returncode, r.stdout[-2000:], r.stderr[-2000:])
+    assert "drain" in (r.stdout + r.stderr)
     job = jq.job_status(q, jid)
-    assert job.state == "running"      # died mid-claim, no handover
-    old = time.time() - 3600
-    os.utime(job.path, (old, old))
+    assert job.state == "queued", (job.state, job.record)
+    assert [e["stage"] for e in job.record["failure_log"]] == ["drain"]
+    # the drain refunds the attempt: the handover costs no budget
+    assert job.record["attempts"] == 0
     logs = []
     counts = serve(q, worker="resumer", idle_exit=True, max_attempts=2,
                    log=logs.append)
     assert counts == {"done": 1, "failed": 0, "requeued": 0}
     assert any("auto-resume from" in m or "resuming from" in m
                for m in logs), \
-        "attempt 2 must resume from the dead worker's beat checkpoint"
+        "the next claim must resume from the drain checkpoint"
     job = jq.job_status(q, jid)
-    assert job.state == "done" and job.record["attempts"] == 2
-    assert [e["stage"] for e in job.record["failure_log"]] == ["stale"]
+    assert job.state == "done" and job.record["attempts"] == 1
     res = job.record["result"]
 
     # uninterrupted twin of the same job (fresh queue, no env fault)
     q2 = str(tmp_path / "q2")
-    jid2 = jq.submit(q2, POISON_NML, ndim=2, dtype="float64")
+    jid2 = jq.submit(q2, nml, ndim=2, dtype="float64")
     counts2 = serve(q2, worker="twin", idle_exit=True, max_attempts=2,
                     log=lambda *a: None)
     assert counts2 == {"done": 1, "failed": 0, "requeued": 0}

@@ -1,0 +1,610 @@
+"""Fleet hardening (`ramses_tpu/ensemble/{queue,breaker,fsck}` +
+`ramses_tpu/resilience/diskguard`).
+
+Pins the tentpole contracts of the multi-host hardening PR:
+
+  * fenced claims — a reclaimed (zombie) worker's every queue write
+    raises :class:`FenceLost` and leaves a durable ``stage="fenced"``
+    failure_log entry; a zombie-reclaim race completes EXACTLY once
+    and the surviving result is bitwise identical to an uninterrupted
+    run;
+  * ``queue_fsck`` detects and repairs every crash-consistency class
+    (torn tmp, orphan heartbeat, dead running claim, duplicate id,
+    half-staged result, orphan parked) — ``--check`` exits 0 on a
+    clean queue and nonzero on each corruption;
+  * the poison-config circuit breaker trips on cross-worker repeats
+    of the same config+stage, parks matching queued jobs, and
+    half-opens one probe on reset/TTL;
+  * disk-pressure degradation — soft watermark sheds checkpoints,
+    hard pauses claims, ENOSPC is absorbed (the worker survives);
+  * drain/backoff plumbing: requeue backoff gates claims without
+    idle-exiting a worker, and skew-biased heartbeats alone cannot
+    false-trip a reclaim.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ramses_tpu.ensemble import breaker as bkr
+from ramses_tpu.ensemble import fsck as qfsck
+from ramses_tpu.ensemble import queue as jq
+from ramses_tpu.ensemble import service as svc
+from ramses_tpu.ensemble.batch import EnsembleEngine, EnsembleSpec
+from ramses_tpu.ensemble.service import serve
+from ramses_tpu.resilience import faultinject as fi
+from ramses_tpu.resilience.diskguard import DiskGuard, guarded_save
+
+pytestmark = pytest.mark.smoke
+
+_MB = 1024 * 1024
+
+#: 2D Sedov ensemble, 2 members, 4 chunks of 2 steps — the smallest
+#: job with enough chunk-beats for a mid-run zombie handover
+FLEET_NML = "\n".join([
+    "&RUN_PARAMS", "hydro=.true.", "nstepmax=8", "/",
+    "&AMR_PARAMS", "levelmin=4", "levelmax=4", "boxlen=1.0", "/",
+    "&INIT_PARAMS", "nregion=2",
+    "region_type(1)='square'", "region_type(2)='point'",
+    "x_center=0.5,0.5", "y_center=0.5,0.5",
+    "length_x=10.0,1.0", "length_y=10.0,1.0",
+    "exp_region=10.0,10.0", "d_region=1.0,0.0", "p_region=1e-5,0.1", "/",
+    "&HYDRO_PARAMS", "gamma=1.4", "riemann='hllc'", "/",
+    "&OUTPUT_PARAMS", "tend=1e9", "/",
+    "&ENSEMBLE_PARAMS", "nmember=2", "perturb_amp=0.01",
+    "chunk_steps=2", "/",
+])
+
+
+class _CapTel:
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+# ---------------------------------------------------------------------
+# fenced claims
+# ---------------------------------------------------------------------
+def test_fence_refuses_every_zombie_write(tmp_path):
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, "&RUN_PARAMS\n/", job_id="job-f")
+    zombie = jq.claim(q, worker="zombie")
+    assert zombie.fence == 1
+    jq._age_heartbeat(zombie.path, 3600.0)
+    assert jq.reclaim_stale(q, stale_s=300.0, log=None) == 1
+    # every worker-side write of the superseded claim is refused and
+    # each refusal is durable in the canonical record
+    for op in (lambda: jq.heartbeat(zombie),
+               lambda: jq.complete(zombie, result={"ok": True}),
+               lambda: jq.fail(zombie, error="late"),
+               lambda: jq.requeue(zombie, error="late")):
+        with pytest.raises(jq.FenceLost):
+            op()
+    j = jq.job_status(q, jid)
+    assert j.state == "queued"         # untouched by the zombie
+    stages = [e["stage"] for e in j.record["failure_log"]]
+    assert stages[0] == "stale" and stages.count("fenced") == 4
+    # the new claim holds the bumped token and works normally
+    # (submit=0 -> claim=1 -> reclaim=2 -> re-claim=3)
+    fresh = jq.claim(q, worker="healthy")
+    assert fresh.fence == 3
+    jq.heartbeat(fresh)
+    jq.complete(fresh, result={"ok": True})
+    assert jq.job_status(q, jid).state == "done"
+
+
+def test_zombie_reclaim_completes_exactly_once_bitwise(tmp_path):
+    """THE chaos pin: worker A claims and goes zombie mid-job; the
+    fleet reclaims, worker B resumes from A's checkpoint and
+    completes; A's late writes are refused with a durable fenced
+    event; the job lands in done/ exactly once and the surviving
+    result is bitwise identical to an uninterrupted run."""
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, FLEET_NML, ndim=2, dtype="float64")
+    zjob = jq.claim(q, worker="zombie")
+    params, rdir, _ = svc._job_setup(q, zjob, log=lambda *a: None)
+    spec = EnsembleSpec.from_params(params)
+    eng = EnsembleEngine(spec, dtype=jnp.float64)
+    for _ in range(2):                 # steps 1..4 of 8, with beats
+        eng.finish_chunk(eng.begin_chunk())
+        jq.heartbeat(zjob)
+        eng.save(rdir)
+    # ... the zombie stalls past the staleness timeout
+    jq._age_heartbeat(zjob.path, 3600.0)
+    counts = serve(q, worker="healthy", idle_exit=True, max_attempts=3,
+                   log=lambda *a: None)
+    assert counts == {"done": 1, "failed": 0, "requeued": 0}
+    # the zombie wakes and tries to keep going: refused, twice
+    with pytest.raises(jq.FenceLost):
+        jq.heartbeat(zjob)
+    with pytest.raises(jq.FenceLost):
+        jq.complete(zjob, result={"from": "zombie"})
+    done = [n for n in os.listdir(os.path.join(q, "done"))
+            if n.endswith(".json")]
+    assert done == [jid + ".json"]     # exactly once
+    j = jq.job_status(q, jid)
+    assert j.record["attempts"] == 2 and j.record["fence"] == 3
+    stages = [e["stage"] for e in j.record["failure_log"]]
+    assert stages[0] == "stale" and stages.count("fenced") == 2
+    assert j.record["result"].get("from") != "zombie"
+    # the refusals are a first-class metric
+    from ramses_tpu.obs.metrics import parse, render_queue_metrics
+    m = parse(render_queue_metrics(q))
+    assert m[("ramses_fenced_writes_total", ())] == 2.0
+
+    # bitwise vs an uninterrupted twin of the same job
+    q2 = str(tmp_path / "q2")
+    jid2 = jq.submit(q2, FLEET_NML, ndim=2, dtype="float64")
+    serve(q2, worker="twin", idle_exit=True, log=lambda *a: None)
+    res = j.record["result"]
+    res2 = jq.job_status(q2, jid2).record["result"]
+    a = np.load(os.path.join(res["snapshot"], "ensemble_state.npz"))
+    b = np.load(os.path.join(res2["snapshot"], "ensemble_state.npz"))
+    assert a["g0_s0"].tobytes() == b["g0_s0"].tobytes()
+    assert a["g0_t"].tobytes() == b["g0_t"].tobytes()
+
+
+def test_heartbeat_skew_alone_cannot_false_trip_reclaim(tmp_path,
+                                                        monkeypatch):
+    """A worker whose clock is an hour behind writes heartbeats that
+    LOOK ancient by wall stamp — but its hb file mtimes are fresh, and
+    staleness requires both signals (plus observer-clock progression)
+    to agree.  The fleet must not steal a live worker's claim."""
+    monkeypatch.setenv(fi.ENV_VAR, "skew:-3600")
+    assert fi.heartbeat_skew() == -3600.0
+    q = str(tmp_path / "q")
+    jq.submit(q, "&RUN_PARAMS\n/", job_id="job-skew")
+    job = jq.claim(q, worker="slow-clock")
+    jq.heartbeat(job)
+    assert jq.reclaim_stale(q, stale_s=60.0, log=None) == 0
+    assert jq.reclaim_stale(q, stale_s=60.0, log=None) == 0
+    jq.heartbeat(job)                  # still alive, still safe
+    jq.complete(job, result={"ok": True})
+    assert jq.job_status(q, "job-skew").state == "done"
+
+
+# ---------------------------------------------------------------------
+# requeue backoff
+# ---------------------------------------------------------------------
+def test_backoff_gates_claims_without_starving_others(tmp_path):
+    q = str(tmp_path / "q")
+    jq.submit(q, "&RUN_PARAMS\n/", job_id="job-bounce")
+    jq.submit(q, "&RUN_PARAMS\n/", job_id="job-fine")
+    job = jq.claim(q, worker="w")
+    jq.requeue(job, error="boom", backoff_base_s=30.0,
+               backoff_cap_s=60.0)
+    rec = jq.job_status(q, "job-bounce").record
+    assert rec["not_before_unix"] > time.time() + 10.0
+    # the bounced job is skipped, the healthy one still claims FIFO
+    nxt = jq.claim(q, worker="w")
+    assert nxt.id == "job-fine"
+    assert jq.claim(q, worker="w") is None
+    # once the gate passes, the bounced job claims again (and the
+    # gate stamp is consumed)
+    rec["not_before_unix"] = time.time() - 1.0
+    jq._write_record(jq.job_status(q, "job-bounce").path, rec)
+    again = jq.claim(q, worker="w")
+    assert again.id == "job-bounce"
+    assert "not_before_unix" not in again.record
+
+
+def test_backoff_delay_doubles_and_caps():
+    d1 = [jq._backoff_delay(1, 2.0, 60.0) for _ in range(20)]
+    d4 = [jq._backoff_delay(4, 2.0, 60.0) for _ in range(20)]
+    d9 = [jq._backoff_delay(9, 2.0, 60.0) for _ in range(20)]
+    assert all(1.0 <= d <= 2.0 for d in d1)
+    assert all(8.0 <= d <= 16.0 for d in d4)
+    assert all(30.0 <= d <= 60.0 for d in d9)       # capped
+    assert jq._backoff_delay(5, 0.0, 60.0) == 0.0   # disabled
+
+
+# ---------------------------------------------------------------------
+# queue fsck
+# ---------------------------------------------------------------------
+def _corrupt(q, kind):
+    """Plant exactly one instance of a corruption class; returns the
+    job ids involved."""
+    if kind == "torn_tmp":
+        with open(os.path.join(q, "queued", "torn.json.tmp"),
+                  "w") as f:
+            f.write("{")
+        return []
+    if kind == "orphan_heartbeat":
+        with open(os.path.join(q, "running", "ghost.json.hb"),
+                  "w") as f:
+            f.write("{}")
+        return []
+    if kind == "dead_running":
+        jid = jq.submit(q, "&RUN_PARAMS\n/")
+        job = jq.claim(q, worker="dead")
+        jq._age_heartbeat(job.path, 3600.0)
+        return [jid]
+    if kind == "duplicate_id":
+        jid = jq.submit(q, "&RUN_PARAMS\n/")
+        import shutil
+        shutil.copy(os.path.join(q, "queued", jid + ".json"),
+                    os.path.join(q, "done", jid + ".json"))
+        return [jid]
+    if kind == "half_staged":
+        jid = jq.submit(q, "&RUN_PARAMS\n/")
+        rd = jq.results_dir(q, jid)
+        stage = os.path.join(rd, "output_00001.tmp")
+        os.makedirs(stage)
+        os.utime(stage, (time.time() - 3600,) * 2)
+        return [jid]
+    if kind == "orphan_parked":
+        jid = jq.submit(q, "&RUN_PARAMS\n/")
+        os.rename(os.path.join(q, "queued", jid + ".json"),
+                  os.path.join(q, "parked", jid + ".json"))
+        return [jid]
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("kind", ["torn_tmp", "orphan_heartbeat",
+                                  "dead_running", "duplicate_id",
+                                  "half_staged", "orphan_parked"])
+def test_fsck_detects_and_repairs_each_class(tmp_path, kind):
+    q = str(tmp_path / "q")
+    jq.init_queue(q)
+    code, findings = qfsck.fsck(q, log=None)
+    assert code == 0 and findings == []            # clean queue
+    _corrupt(q, kind)
+    code, findings = qfsck.fsck(q, log=None)
+    assert code == 1
+    assert [f.kind for f in findings] == [kind]
+    code, findings = qfsck.fsck(q, do_repair=True, log=None)
+    assert code == 0 and all(f.repaired for f in findings)
+    code, findings = qfsck.fsck(q, log=None)
+    assert code == 0 and findings == []            # clean again
+
+
+def test_fsck_repair_semantics(tmp_path):
+    q = str(tmp_path / "q")
+    jq.init_queue(q)
+    # a dead running claim is reclaimed THROUGH the fencing machinery
+    (jid,) = _corrupt(q, "dead_running")
+    qfsck.fsck(q, do_repair=True, log=None)
+    j = jq.job_status(q, jid)
+    assert j.state == "queued" and j.record["fence"] == 2
+    assert [e["stage"] for e in j.record["failure_log"]] == ["stale"]
+    # duplicates keep the most-final copy and quarantine the rest
+    (jid2,) = _corrupt(q, "duplicate_id")
+    qfsck.fsck(q, do_repair=True, log=None)
+    assert jq.job_status(q, jid2).state == "done"
+    quar = os.listdir(os.path.join(q, "fsck_quarantine"))
+    assert quar == [f"queued__{jid2}.json"]
+    # an orphaned parked record (breaker gone) is released to queued
+    (jid3,) = _corrupt(q, "orphan_parked")
+    qfsck.fsck(q, do_repair=True, log=None)
+    assert jq.job_status(q, jid3).state == "queued"
+
+
+def test_fsck_startup_repairs_only_safe_classes(tmp_path):
+    q = str(tmp_path / "q")
+    jq.init_queue(q)
+    _corrupt(q, "torn_tmp")
+    (jid,) = _corrupt(q, "dead_running")
+    assert qfsck.startup_repair(q, log=lambda *a: None) == 1
+    # the torn tmp is gone; the dead claim is left for the serve
+    # loop's reclaim (which owns staleness policy), not startup
+    assert not os.path.exists(
+        os.path.join(q, "queued", "torn.json.tmp"))
+    assert jq.job_status(q, jid).state == "running"
+
+
+def test_fsck_cli_check_repair_json(tmp_path):
+    q = str(tmp_path / "q")
+    jq.init_queue(q)
+    _corrupt(q, "torn_tmp")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(root, "tools",
+                                        "queue_fsck.py"), q]
+    out = str(tmp_path / "fsck.json")
+    r = subprocess.run(cmd + ["--check", "--json", out],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    rep = json.load(open(out))
+    assert [f["kind"] for f in rep["findings"]] == ["torn_tmp"]
+    r = subprocess.run(cmd + ["--repair"], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    r = subprocess.run(cmd + ["--check"], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+# ---------------------------------------------------------------------
+# poison-config circuit breaker
+# ---------------------------------------------------------------------
+def test_breaker_trips_cross_worker_and_parks(tmp_path):
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, FLEET_NML, ndim=2)
+    twin = jq.submit(q, FLEET_NML, ndim=2)   # same fingerprint
+    other = jq.submit(q, FLEET_NML.replace("gamma=1.4", "gamma=1.5"),
+                      ndim=2)
+    rec = jq.job_status(q, jid).record
+    tel = _CapTel()
+    # one worker failing twice is NOT poison (min_workers=2) ...
+    rec["worker"] = "w1"
+    assert not bkr.record_failure(q, rec, "requeue", failures=2,
+                                  min_workers=2, telemetry=tel)
+    assert not bkr.record_failure(q, rec, "requeue", failures=2,
+                                  min_workers=2, telemetry=tel)
+    assert bkr.load(q, bkr.fingerprint_of(rec))["state"] == "closed"
+    # ... a second worker confirming the same stage IS
+    rec["worker"] = "w2"
+    assert bkr.record_failure(q, rec, "fail", failures=2,
+                              min_workers=2, telemetry=tel)
+    fp = bkr.fingerprint_of(rec)
+    assert bkr.load(q, fp)["state"] == "open"
+    assert "breaker_trip" in tel.kinds()
+    # matching queued jobs are parked, different configs are not
+    assert jq.job_status(q, jid).state == "parked"
+    assert jq.job_status(q, twin).state == "parked"
+    assert jq.job_status(q, other).state == "queued"
+    parked = jq.job_status(q, twin).record
+    assert parked["failure_log"][-1]["stage"] == "breaker"
+    # hang and crash count separately: a hang on an open breaker's
+    # config doesn't reset anything, and stale/drain/fenced never
+    # count at all (exercised via queue._breaker_note)
+    assert bkr.breaker_stage("hang") == "hang"
+    assert bkr.breaker_stage("requeue") == "crash"
+
+    # half-open releases exactly one probe
+    assert bkr.reset(q, fp, log=lambda *a: None) == [fp]
+    b = bkr.load(q, fp)
+    assert b["state"] == "half_open"
+    back = [j for j in (jid, twin)
+            if jq.job_status(q, j).state == "queued"]
+    assert len(back) == 1
+    # a success on the probe closes the breaker and releases the rest
+    bkr.on_success(q, rec, telemetry=tel)
+    assert bkr.load(q, fp)["state"] == "closed"
+    assert jq.job_status(q, jid).state == "queued"
+    assert jq.job_status(q, twin).state == "queued"
+
+
+def test_breaker_half_open_probe_failure_snaps_open(tmp_path):
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, FLEET_NML, ndim=2)
+    rec = jq.job_status(q, jid).record
+    rec["worker"] = "w1"
+    bkr.record_failure(q, rec, "fail", failures=1, min_workers=1)
+    fp = bkr.fingerprint_of(rec)
+    bkr.reset(q, fp, log=lambda *a: None)
+    assert bkr.load(q, fp)["state"] == "half_open"
+    # the probe fails: straight back to open, no threshold debate
+    assert bkr.record_failure(q, rec, "fail", failures=99,
+                              min_workers=99)
+    assert bkr.load(q, fp)["state"] == "open"
+
+
+def test_breaker_ttl_sweep_half_opens(tmp_path):
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, FLEET_NML, ndim=2)
+    rec = jq.job_status(q, jid).record
+    rec["worker"] = "w1"
+    bkr.record_failure(q, rec, "fail", failures=1, min_workers=1,
+                       ttl_s=0.0)
+    fp = bkr.fingerprint_of(rec)
+    assert jq.job_status(q, jid).state == "parked"
+    assert bkr.sweep(q, log=lambda *a: None) == 1
+    assert bkr.load(q, fp)["state"] == "half_open"
+    assert jq.job_status(q, jid).state == "queued"   # the probe
+
+
+def test_serve_trips_breaker_end_to_end(tmp_path, monkeypatch):
+    """Two attempts on a namelist the engine rejects trip the breaker
+    through the live serve loop; the matching queued twin is parked
+    and the CLI reset releases it half-open."""
+    monkeypatch.setenv("RAMSES_BREAKER_N", "2")
+    monkeypatch.setenv("RAMSES_BREAKER_MIN_WORKERS", "1")
+    monkeypatch.setenv("RAMSES_QUEUE_BACKOFF_S", "0")
+    q = str(tmp_path / "q")
+    bad = FLEET_NML.replace("levelmax=4", "levelmax=5")
+    jid = jq.submit(q, bad, ndim=2)
+    twin = jq.submit(q, bad, ndim=2)
+    counts = serve(q, worker="w1", idle_exit=True, max_attempts=2,
+                   order="fifo", log=lambda *a: None)
+    assert counts == {"done": 0, "failed": 1, "requeued": 1}
+    assert jq.job_status(q, jid).state == "failed"
+    assert jq.job_status(q, twin).state == "parked"
+    fp = bkr.fingerprint_of(jq.job_status(q, jid).record)
+    assert bkr.load(q, fp)["state"] == "open"
+    # operator resets via the fsck CLI; the twin is released as probe
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable,
+                        os.path.join(root, "tools", "queue_fsck.py"),
+                        q, "--reset-breaker", "all"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert bkr.load(q, fp)["state"] == "half_open"
+    assert jq.job_status(q, twin).state == "queued"
+
+
+# ---------------------------------------------------------------------
+# disk-pressure degradation
+# ---------------------------------------------------------------------
+def test_diskguard_watermarks_and_enospc_cooldown():
+    free = {"b": 100.0 * _MB}
+    tel = _CapTel()
+    g = DiskGuard("/tmp", soft_free_bytes=20 * _MB,
+                  hard_free_bytes=5 * _MB, probe=lambda p: free["b"])
+    assert g.level() == "ok" and g.allow_checkpoint() and \
+        g.allow_claim()
+    free["b"] = 10.0 * _MB
+    assert g.level() == "soft"
+    assert not g.allow_checkpoint() and g.allow_claim()
+    g.emit(tel, where="beat")
+    free["b"] = 2.0 * _MB
+    assert g.level() == "hard" and not g.allow_claim()
+    g.emit(tel, where="claim")
+    free["b"] = 100.0 * _MB
+    assert g.level() == "ok"
+    g.emit(tel, where="claim")         # recovery edge
+    levels = [f["level"] for k, f in tel.events if k == "io_degraded"]
+    assert levels == ["soft", "hard", "ok"]        # edges only
+    # a real ENOSPC forces soft for the cooldown even if statvfs
+    # disagrees (thin-provisioned/quota filesystems lie)
+    g.note_enospc()
+    assert g.level() == "soft" and not g.allow_checkpoint()
+
+
+def test_guarded_save_absorbs_enospc_only():
+    import errno
+    g = DiskGuard("/tmp", probe=lambda p: 1e15)
+    ran = []
+    assert guarded_save(lambda: ran.append(1), g) is True and ran
+    def enospc():
+        raise OSError(errno.ENOSPC, "no space left on device")
+    assert guarded_save(enospc, g, log=lambda *a: None) is False
+    assert g.level() == "soft"         # degraded, not crashed
+    assert guarded_save(lambda: ran.append(2), g) is False  # shed
+    def eperm():
+        raise OSError(errno.EPERM, "nope")
+    with pytest.raises(OSError):       # only ENOSPC is absorbed
+        guarded_save(eperm, DiskGuard("/tmp", probe=lambda p: 1e15))
+
+
+def test_serve_pauses_claims_under_hard_pressure(tmp_path,
+                                                monkeypatch):
+    """Hard watermark: the worker stops CLAIMING but stays alive —
+    the queued job is untouched and the worker exits cleanly on
+    drain, never by crash or idle-exit."""
+    monkeypatch.setenv("RAMSES_DISK_HARD_MB", str(10 ** 9))
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, FLEET_NML, ndim=2)
+    out = {}
+
+    def run():
+        out["counts"] = serve(q, worker="parched", idle_exit=True,
+                              poll_s=0.02, log=lambda *a: None)
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.5)
+    assert jq.job_status(q, jid).state == "queued"   # never claimed
+    svc.request_drain()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert out["counts"] == {"done": 0, "failed": 0, "requeued": 0}
+    wtel = os.path.join(q, "workers", "parched.jsonl")
+    kinds = [json.loads(line).get("kind") for line in open(wtel)]
+    assert "io_degraded" in kinds and "serve_drain" in kinds
+
+
+def test_enospc_fault_sheds_checkpoint_but_job_completes(tmp_path):
+    """An injected ENOSPC at the step-3 checkpoint degrades (the
+    checkpoint is shed, io_degraded recorded) — the run still
+    completes and the final snapshot is written."""
+    fi.reset_fired()
+    nml = FLEET_NML.replace("&RUN_PARAMS",
+                            "&RUN_PARAMS\nfault_inject='enospc@3'")
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, nml, ndim=2, dtype="float64")
+    counts = serve(q, worker="t", idle_exit=True, max_attempts=2,
+                   log=lambda *a: None)
+    assert counts == {"done": 1, "failed": 0, "requeued": 0}
+    job = jq.job_status(q, jid)
+    assert job.record["attempts"] == 1         # no retry burned
+    res = job.record["result"]
+    kinds = [json.loads(line).get("kind")
+             for line in open(res["telemetry"])]
+    assert "io_degraded" in kinds and "ensemble_done" in kinds
+    assert os.path.isfile(os.path.join(res["snapshot"],
+                                       "ensemble_state.npz"))
+
+
+# ---------------------------------------------------------------------
+# fault injection + supervisor plumbing
+# ---------------------------------------------------------------------
+def test_faultinject_parses_fleet_faults():
+    faults, _ = fi._parse("zombie@2,enospc@3,skew:5.5,nan@1:member=0")
+    assert ("zombie", 2) in faults and ("enospc", 3) in faults
+    assert ("skew", 5.5) in faults
+
+
+def test_faultinject_zombie_and_enospc_fire_once(monkeypatch):
+    import errno
+    fi.reset_fired()
+    monkeypatch.setenv("RAMSES_ZOMBIE_SLEEP_S", "0.05")
+    inj = fi.FaultInjector("zombie@1")
+    assert inj.maybe_zombie(1) is False      # strict arming: too late
+    inj = fi.FaultInjector("zombie@1")
+    inj.maybe_zombie(0)
+    t0 = time.monotonic()
+    assert inj.maybe_zombie(1) is True
+    assert time.monotonic() - t0 >= 0.05
+    inj = fi.FaultInjector("zombie@1")
+    inj.maybe_zombie(0)
+    assert inj.maybe_zombie(1) is False      # once per process
+    inj = fi.FaultInjector("enospc@2")
+    inj.observe(0)
+    with pytest.raises(OSError) as ei:
+        inj.maybe_enospc(2)
+    assert ei.value.errno == errno.ENOSPC
+    inj = fi.FaultInjector("enospc@2")
+    inj.observe(0)
+    inj.maybe_enospc(5)                      # once per process
+    fi.reset_fired()
+
+
+def test_supervise_escalates_caller_exceptions(tmp_path):
+    from ramses_tpu.resilience.supervisor import supervise
+
+    class Escape(Exception):
+        pass
+
+    params = None
+    builds = []
+
+    def build(restart):
+        builds.append(restart)
+        return object()
+
+    def drive(sim):
+        raise Escape("caller control flow")
+
+    # without escalate the supervisor would burn retries; with it the
+    # exception re-raises immediately after ONE build
+    from ramses_tpu.config import params_from_dict
+    params = params_from_dict({"run_params": {"nstepmax": 1}}, ndim=1)
+    with pytest.raises(Escape):
+        supervise(build, drive, params, base_dir=str(tmp_path),
+                  max_attempts=3, backoff_s=0.0,
+                  log=lambda *a: None, escalate=(Escape,))
+    assert len(builds) == 1
+
+
+# ---------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------
+def test_metrics_expose_breaker_and_disk_families(tmp_path):
+    from ramses_tpu.obs.metrics import parse, render_queue_metrics
+    q = str(tmp_path / "q")
+    jid = jq.submit(q, FLEET_NML, ndim=2)
+    rec = jq.job_status(q, jid).record
+    rec["worker"] = "w1"
+    bkr.record_failure(q, rec, "fail", failures=1, min_workers=1)
+    fp = bkr.fingerprint_of(rec)
+    m = parse(render_queue_metrics(q))
+    assert m[("ramses_breaker_state",
+              (("fp", fp), ("stage", "crash")))] == 2.0   # open
+    assert m[("ramses_queue_jobs", (("state", "parked"),))] == 1.0
+    assert m[("ramses_fenced_writes_total", ())] == 0.0
+    disk = [v for (name, _), v in m.items()
+            if name == "ramses_disk_free_bytes"]
+    assert disk and disk[0] > 0

@@ -19,7 +19,6 @@ Covers the PR 19 acceptance pins:
 
 import json
 import os
-import time
 import urllib.error
 import urllib.request
 
@@ -92,8 +91,7 @@ def test_trace_id_survives_requeue_and_reclaim(tmp_path):
     job = jq.claim(q, worker="w1")
     jq.requeue(job, error="boom", telemetry=tel)
     job = jq.claim(q, worker="w2")
-    old = time.time() - 3600
-    os.utime(job.path, (old, old))
+    jq._age_heartbeat(job.path, 3600.0)
     assert jq.reclaim_stale(q, stale_s=300.0, max_attempts=3,
                             log=None, telemetry=tel) == 1
     job = jq.claim(q, worker="w3")

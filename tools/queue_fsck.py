@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Operator CLI for queue crash-consistency and breaker control.
+
+    python tools/queue_fsck.py QUEUE_DIR --check
+    python tools/queue_fsck.py QUEUE_DIR --repair
+    python tools/queue_fsck.py QUEUE_DIR --check --json findings.json
+    python tools/queue_fsck.py QUEUE_DIR --reset-breaker <fp|all>
+
+Exit codes: ``--check`` — 0 clean, 1 repairable findings exist;
+``--repair`` — 0 everything repaired, 2 something resisted.
+``--reset-breaker`` half-opens the named poison-config breaker(s)
+(one parked probe job released each) and exits 0.
+
+Thin shell over :mod:`ramses_tpu.ensemble.fsck` and
+:mod:`ramses_tpu.ensemble.breaker` — jax-free, safe to run on a live
+queue (a live worker's in-flight staging is never touched).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="queue_fsck",
+        description="scan/repair a run-service queue directory")
+    ap.add_argument("queue_dir", help="queue directory (--queue of "
+                    "submit/serve)")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="scan only (default); exit 1 on findings")
+    mode.add_argument("--repair", action="store_true",
+                      help="scan and repair; exit 2 on failures")
+    ap.add_argument("--json", metavar="PATH", default="",
+                    help="also write findings as JSON ('-' = stdout)")
+    ap.add_argument("--stale-timeout", type=float, default=300.0,
+                    metavar="S", help="heartbeat age beyond which a "
+                    "running record counts as dead (default 300)")
+    ap.add_argument("--max-attempts", type=int, default=3,
+                    help="attempt budget when a dead_running repair "
+                    "requeues vs fails (default 3)")
+    ap.add_argument("--reset-breaker", metavar="FP", default="",
+                    help="half-open the poison-config breaker with "
+                    "this fingerprint ('all' = every open breaker) "
+                    "and exit")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.queue_dir):
+        print(f"queue_fsck: no such queue dir: {args.queue_dir}",
+              file=sys.stderr)
+        return 2
+
+    from ramses_tpu.ensemble import breaker as bk
+    from ramses_tpu.ensemble import fsck as qfsck
+
+    if args.reset_breaker:
+        done = bk.reset(args.queue_dir, args.reset_breaker, log=print)
+        if not done:
+            print(f"queue_fsck: no open breaker matched "
+                  f"{args.reset_breaker!r}")
+        return 0
+
+    code, findings = qfsck.fsck(
+        args.queue_dir, do_repair=bool(args.repair),
+        stale_s=args.stale_timeout, max_attempts=args.max_attempts,
+        log=print)
+    if args.json:
+        payload = json.dumps({"exit_code": code, "findings": [
+            f.to_dict() for f in findings]}, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    verdict = ("clean" if not findings else
+               f"{len(findings)} finding(s), "
+               f"{sum(1 for f in findings if f.repaired)} repaired")
+    print(f"queue_fsck: {verdict}")
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
